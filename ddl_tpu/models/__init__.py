@@ -1,0 +1,21 @@
+from ddl_tpu.models.densenet import (
+    DenseNetStage,
+    StageSpec,
+    apply_stage,
+    build_stages,
+    count_params,
+    forward_stages,
+    init_stages,
+    stage_boundary_shapes,
+)
+
+__all__ = [
+    "DenseNetStage",
+    "StageSpec",
+    "apply_stage",
+    "build_stages",
+    "count_params",
+    "forward_stages",
+    "init_stages",
+    "stage_boundary_shapes",
+]

@@ -1,0 +1,23 @@
+from ddl_tpu.utils.metrics import (
+    accuracy_score,
+    classification_metrics,
+    cross_entropy,
+    f1_score,
+    precision_score,
+    quadratic_weighted_kappa,
+    recall_score,
+)
+from ddl_tpu.utils.csv_logger import MetricLogger
+from ddl_tpu.utils.seed import set_seed
+
+__all__ = [
+    "accuracy_score",
+    "classification_metrics",
+    "cross_entropy",
+    "f1_score",
+    "precision_score",
+    "quadratic_weighted_kappa",
+    "recall_score",
+    "MetricLogger",
+    "set_seed",
+]

@@ -1,0 +1,23 @@
+"""Reproducibility helpers.
+
+The reference defines (but leaves commented out) a ``set_seed`` touching
+python/numpy/torch RNGs (``single.py:28-35``).  In JAX, determinism is the
+default: all randomness flows through explicit ``jax.random`` keys, so the
+framework threads a single root key.  ``set_seed`` here seeds the *host-side*
+RNGs (python/numpy) used by the data pipeline and returns the root JAX key.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+
+def set_seed(seed: int):
+    """Seed host RNGs and return the root ``jax.random`` key."""
+    random.seed(seed)
+    np.random.seed(seed)
+    import jax
+
+    return jax.random.key(seed)

@@ -1,0 +1,77 @@
+"""Metric suite vs sklearn (the reference's metric source, single.py:11)."""
+
+import numpy as np
+import pytest
+
+from ddl_tpu.utils import metrics as M
+
+sklearn_metrics = pytest.importorskip("sklearn.metrics")
+
+
+@pytest.fixture(params=[0, 1, 2, 3])
+def labels_pair(request):
+    rng = np.random.default_rng(request.param)
+    n = 500
+    if request.param == 3:
+        # degenerate: a class never predicted, a class never true
+        y_true = rng.integers(0, 4, n)
+        y_pred = rng.integers(1, 5, n)
+    else:
+        y_true = rng.integers(0, 5, n)
+        # correlated predictions so kappa is nontrivial
+        y_pred = np.where(rng.random(n) < 0.6, y_true, rng.integers(0, 5, n))
+    return y_true, y_pred
+
+
+def test_accuracy(labels_pair):
+    y, p = labels_pair
+    assert M.accuracy_score(y, p) == pytest.approx(sklearn_metrics.accuracy_score(y, p))
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+def test_prf(labels_pair, average):
+    y, p = labels_pair
+    assert M.f1_score(y, p, average) == pytest.approx(
+        sklearn_metrics.f1_score(y, p, average=average, zero_division=0)
+    )
+    assert M.precision_score(y, p, average) == pytest.approx(
+        sklearn_metrics.precision_score(y, p, average=average, zero_division=0)
+    )
+    assert M.recall_score(y, p, average) == pytest.approx(
+        sklearn_metrics.recall_score(y, p, average=average, zero_division=0)
+    )
+
+
+def test_qwk(labels_pair):
+    y, p = labels_pair
+    assert M.quadratic_weighted_kappa(y, p) == pytest.approx(
+        sklearn_metrics.cohen_kappa_score(y, p, weights="quadratic")
+    )
+
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, 5)).astype(np.float32)
+    targets = rng.integers(0, 5, 64)
+    expected = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(targets)
+    ).item()
+    assert M.cross_entropy(logits, targets) == pytest.approx(expected, rel=1e-5)
+
+
+def test_classification_metrics_keys():
+    y = np.array([0, 1, 2, 3, 4, 0])
+    p = np.array([0, 1, 2, 3, 4, 1])
+    out = M.classification_metrics(y, p)
+    # exactly the metric names the reference logs (single.py:244-251)
+    assert set(out) == {
+        "val_accuracy",
+        "macro_f1",
+        "weighted_f1",
+        "macro_precision",
+        "weighted_precision",
+        "macro_recall",
+        "weighted_recall",
+        "qwk",
+    }

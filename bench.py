@@ -1,0 +1,77 @@
+"""Headline benchmark: DenseNet121 training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation (BASELINE.md): the reference's best single-GPU run
+averages 90.77 s/epoch; the preprocessed APTOS train split at batch 30 gives
+~97 steps/epoch (2930 images — 80% of the 3662-image APTOS-2019 train set,
+the standard preprocessed split; the reference logs epoch_time, not
+steps/sec, so step count is derived).  That is 97 / 90.77 = 1.069 train
+steps/sec at global batch 30 on the reference's best single GPU.
+
+This bench times the same workload — DenseNet121, 224x224x3 uint8 in,
+5-class head, batch 30, full train step (normalize + forward + backward +
+Adam) — on one TPU chip in bfloat16 compute, steady-state (post-compile),
+with device-resident input batches (host data feed overlaps compute in the
+real trainer via the prefetching loader).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_STEPS_PER_SEC = 97 / 90.77  # best single-GPU reference run
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.config import ModelConfig, TrainConfig
+    from ddl_tpu.models import build_stages
+    from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ddl_tpu.train.state import create_train_state, make_optimizer
+    from ddl_tpu.train.steps import make_dp_step_fns
+
+    batch = 30
+    cfg = ModelConfig(compute_dtype="bfloat16")
+    stages = build_stages(cfg, num_stages=1)
+    tx = make_optimizer(TrainConfig())
+    state = create_train_state(stages, tx, jax.random.key(0), image_size=224)
+    mesh = build_mesh(MeshSpec(1, 1))
+    fns = make_dp_step_fns(stages, tx, mesh, jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.integers(0, 255, (batch, 224, 224, 3)), jnp.uint8)
+    labels = jnp.asarray(rng.integers(0, 5, (batch,)), jnp.int32)
+
+    # warmup: compile + 2 steady steps
+    for _ in range(3):
+        state, loss, _ = fns.train(state, images, labels)
+    jax.block_until_ready(state.params)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss, _ = fns.train(state, images, labels)
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - t0
+
+    steps_per_sec = iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "densenet121_train_steps_per_sec_bs30_1chip",
+                "value": round(steps_per_sec, 4),
+                "unit": "steps/sec",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

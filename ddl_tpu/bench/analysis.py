@@ -1,0 +1,110 @@
+"""Offline results analysis (reference ``ipynb/main.ipynb`` equivalent).
+
+The reference's only "published" numbers are pandas tables stored in a
+notebook: mean epoch time per job (cell 3), final-epoch quality metrics
+averaged per strategy (cell 5), and communication round-trip means excluding
+iteration 0 (cell 9).  This module reproduces those aggregations as a plain
+script over the CSV logs this framework (and the reference) writes.
+
+    python -m ddl_tpu.bench.analysis --log-dir training_logs
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+from collections import defaultdict
+from pathlib import Path
+
+import numpy as np
+
+from ddl_tpu.utils.csv_logger import read_metric_csv
+
+QUALITY_METRICS = [
+    "loss",
+    "train_accuracy",
+    "val_loss",
+    "val_accuracy",
+    "weighted_f1",
+    "qwk",
+]
+
+
+def epoch_time_per_job(log_dir: Path) -> dict[str, float]:
+    """Mean epoch_time per job id (notebook cell 3)."""
+    out = {}
+    for job_dir in sorted((log_dir / "by_job_id").glob("*")):
+        f = job_dir / "epoch_time.csv"
+        if f.exists():
+            rows = read_metric_csv(f)
+            if rows:
+                out[job_dir.name] = float(np.mean([r["value"] for r in rows]))
+    return out
+
+
+def final_epoch_quality(log_dir: Path, final_epoch: int | None = None) -> dict:
+    """Per-strategy mean of final-epoch quality metrics (notebook cell 5).
+
+    Strategy is read as the job-id prefix before the first '-', matching the
+    reference's '<strategy>-<hash>' TorchX job names.
+    """
+    per_strategy: dict[str, dict[str, list[float]]] = defaultdict(lambda: defaultdict(list))
+    for job_dir in sorted((log_dir / "by_job_id").glob("*")):
+        strategy = job_dir.name.split("-")[0]
+        for metric in QUALITY_METRICS:
+            f = job_dir / f"{metric}.csv"
+            if not f.exists():
+                continue
+            rows = read_metric_csv(f)
+            if not rows:
+                continue
+            last = final_epoch if final_epoch is not None else max(r["epoch"] for r in rows)
+            vals = [r["value"] for r in rows if r["epoch"] == last]
+            if vals:
+                per_strategy[strategy][metric].append(float(np.mean(vals)))
+    return {
+        s: {m: float(np.mean(v)) for m, v in metrics.items()}
+        for s, metrics in per_strategy.items()
+    }
+
+
+def comm_time_summary(log_dir: Path) -> dict[str, dict]:
+    """Per-job mean round-trip excluding iteration 0 (notebook cell 9)."""
+    f = log_dir / "communication_time.csv"
+    if not f.exists():
+        return {}
+    per_job: dict[str, list[tuple[int, float]]] = defaultdict(list)
+    with open(f, newline="") as fh:
+        for rec in csv.reader(fh):
+            if len(rec) == 3:
+                per_job[rec[0]].append((int(rec[1]), float(rec[2])))
+    out = {}
+    for job, rows in per_job.items():
+        steady = [t for i, t in rows if i > 0]
+        out[job] = {
+            "mean_ms": float(np.mean(steady)) if steady else float("nan"),
+            "init_ms": next((t for i, t in rows if i == 0), float("nan")),
+            "iterations": len(rows),
+        }
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log-dir", default="training_logs")
+    args = ap.parse_args(argv)
+    log_dir = Path(args.log_dir)
+
+    print("== mean epoch time per job (s) ==")
+    for job, t in epoch_time_per_job(log_dir).items():
+        print(f"  {job}: {t:.2f}")
+    print("== final-epoch quality per strategy ==")
+    for s, metrics in final_epoch_quality(log_dir).items():
+        print(f"  {s}: " + " ".join(f"{m}={v:.4f}" for m, v in metrics.items()))
+    print("== communication round-trip per job ==")
+    for job, r in comm_time_summary(log_dir).items():
+        print(f"  {job}: mean={r['mean_ms']:.3f}ms init={r['init_ms']:.1f}ms n={r['iterations']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,179 @@
+"""Collective-communication microbenchmarks over the device mesh.
+
+TPU-native re-design of the reference's latency probe
+(``communication_time.py``): there, rank0 times a 4 MiB fp32 NCCL ``send`` to
+rank1 plus a 1-float ack ``recv`` with CUDA events, 1000 iterations appended
+to a CSV, iteration 0 discarded as NCCL-init cost (``ipynb/main.ipynb`` cell
+9).  Here the equivalent p2p primitive is a jitted ``lax.ppermute`` pair over
+a 2-device mesh — payload one hop forward, ack one hop back — fenced with
+``block_until_ready`` (the CUDA-event analog for XLA's async dispatch), with
+iteration 0 likewise the compile+warmup cost.  On top of the reference's
+ping-pong, this module also measures the collectives the framework actually
+trains with (``psum``, ``all_gather``, ``ppermute``) across a size sweep and
+reports algorithmic bandwidth — the number that predicts DP-allreduce and
+pipeline-handoff cost (BASELINE.json's "allreduce GB/s" target metric).
+
+CSV output keeps the reference's row shape: ``job_id,iteration,elapsed_ms``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from functools import partial
+from time import perf_counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["PingPongResult", "ping_pong", "collective_bandwidth", "run_comm_bench"]
+
+DEFAULT_PAYLOAD_ELEMS = 1024 * 1024  # 4 MiB fp32, reference communication_time.py:18
+
+
+@dataclass
+class PingPongResult:
+    times_ms: np.ndarray  # per-iteration round-trip, iteration 0 = warmup/compile
+    payload_bytes: int
+
+    @property
+    def mean_ms(self) -> float:
+        """Mean excluding iteration 0 (init cost, per reference analysis)."""
+        return float(self.times_ms[1:].mean()) if len(self.times_ms) > 1 else float("nan")
+
+    @property
+    def one_way_gbps(self) -> float:
+        return self.payload_bytes / (self.mean_ms * 1e-3) / 1e9
+
+
+def _ring_mesh(n: int | None = None) -> Mesh:
+    devices = jax.devices()
+    n = n or min(2, len(devices))
+    return Mesh(np.array(devices[:n]), ("ring",))
+
+
+def ping_pong(
+    iterations: int = 1000,
+    payload_elems: int = DEFAULT_PAYLOAD_ELEMS,
+    mesh: Mesh | None = None,
+) -> PingPongResult:
+    """Round-trip: payload device0 -> device1, 1-float ack device1 -> device0."""
+    mesh = mesh or _ring_mesh(2)
+    n = mesh.devices.size
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [((i + 1) % n, i) for i in range(n)]
+
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P("ring"),
+        out_specs=P("ring"),
+        check_vma=False,
+    )
+    def round_trip(x):
+        y = lax.ppermute(x, "ring", fwd)
+        ack = lax.ppermute(y[:1], "ring", bwd)
+        return x + ack  # depend on the ack so the full round trip is timed
+
+    x = jnp.ones((n * payload_elems,), jnp.float32)
+    times = np.empty(iterations + 1)
+    for i in range(iterations + 1):
+        t0 = perf_counter()
+        round_trip(x).block_until_ready()
+        times[i] = (perf_counter() - t0) * 1e3
+    return PingPongResult(times_ms=times, payload_bytes=payload_elems * 4)
+
+
+def collective_bandwidth(
+    op: str,
+    mesh: Mesh | None = None,
+    payload_elems: int = DEFAULT_PAYLOAD_ELEMS,
+    iterations: int = 50,
+) -> dict:
+    """Algorithmic bandwidth of psum / all_gather / ppermute over the mesh.
+
+    algbw = bytes_moved_per_device / time; for psum the standard convention
+    bytes = 2 * (n-1)/n * payload (reduce-scatter + all-gather phases).
+    """
+    mesh = mesh or _ring_mesh()
+    n = mesh.devices.size
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    if op == "psum":
+        body, out_spec = (lambda v: lax.psum(v, "ring")), P("ring")
+    elif op == "all_gather":
+        body, out_spec = (lambda v: lax.all_gather(v, "ring", tiled=True)), P()
+    elif op == "ppermute":
+        body, out_spec = (lambda v: lax.ppermute(v, "ring", ring)), P("ring")
+    else:
+        raise ValueError(op)
+
+    fn = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=P("ring"), out_specs=out_spec, check_vma=False
+        )
+    )
+    x = jnp.ones((n * payload_elems,), jnp.float32)
+
+    fn(x).block_until_ready()  # compile
+    t0 = perf_counter()
+    for _ in range(iterations):
+        out = fn(x)
+    out.block_until_ready()
+    elapsed = (perf_counter() - t0) / iterations
+    payload_bytes = payload_elems * 4
+    if op == "psum":
+        moved = 2 * (n - 1) / n * payload_bytes
+    elif op == "all_gather":
+        moved = (n - 1) / n * (payload_bytes * n)
+    else:
+        moved = payload_bytes
+    return {
+        "op": op,
+        "devices": n,
+        "payload_bytes": payload_bytes,
+        "mean_ms": elapsed * 1e3,
+        "algbw_gbps": moved / elapsed / 1e9,
+    }
+
+
+def run_comm_bench(
+    log_dir: str | os.PathLike = "training_logs",
+    job_id: str | None = None,
+    iterations: int = 1000,
+) -> dict:
+    """Full microbenchmark: ping-pong CSV (reference-compatible rows) +
+    collective bandwidth sweep.  Returns a summary dict."""
+    from ddl_tpu.train.trainer import resolve_job_id
+
+    job_id = job_id or resolve_job_id()
+    os.makedirs(log_dir, exist_ok=True)
+
+    summary: dict = {"job_id": job_id, "devices": len(jax.devices())}
+    if len(jax.devices()) >= 2:
+        pp = ping_pong(iterations=iterations)
+        with open(os.path.join(log_dir, "communication_time.csv"), "a") as f:
+            for i, t in enumerate(pp.times_ms):
+                f.write(f"{job_id},{i},{t}\n")
+        summary["ping_pong_mean_ms"] = pp.mean_ms
+        summary["ping_pong_one_way_gbps"] = pp.one_way_gbps
+        for op in ("psum", "all_gather", "ppermute"):
+            r = collective_bandwidth(op)
+            summary[f"{op}_gbps"] = r["algbw_gbps"]
+            summary[f"{op}_ms"] = r["mean_ms"]
+    else:
+        # Single-chip: report HBM-loopback psum as a degenerate datapoint.
+        r = collective_bandwidth("psum", mesh=_ring_mesh(1))
+        summary["psum_ms"] = r["mean_ms"]
+    return summary
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run_comm_bench(), indent=2))

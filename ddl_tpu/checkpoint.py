@@ -1,0 +1,67 @@
+"""Sharded checkpoint / resume via Orbax.
+
+TPU-native replacement for the reference's ``torch.distributed.checkpoint``
+subsystem (``AppState`` at ``single.py:68-89``; save/load at
+``single.py:121-134``): asynchronous-capable sharded writes of
+``{params, opt_state, batch_stats, epoch}``, laid out as
+``<checkpoint_dir>/<job_id>/epoch_<n>`` with resume-by-``(job_id, epoch)``
+semantics — loading epoch N resumes training at epoch N+1
+(``single.py:124``).  Because ``TrainState`` keeps per-stage pytrees, a
+pipeline run checkpoints every stage into the same snapshot, matching the
+rank-keyed state dicts of the reference's PP variants (``pp.py:84-90``)
+without any rank bookkeeping.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import jax
+import orbax.checkpoint as ocp
+
+from ddl_tpu.train.state import TrainState
+
+__all__ = ["save_snapshot", "load_snapshot", "snapshot_path", "latest_epoch"]
+
+
+def snapshot_path(checkpoint_dir: str | os.PathLike, job_id: str, epoch: int) -> Path:
+    return Path(checkpoint_dir).absolute() / job_id / f"epoch_{epoch}"
+
+
+def save_snapshot(
+    checkpoint_dir: str | os.PathLike, job_id: str, epoch: int, state: TrainState
+) -> Path:
+    path = snapshot_path(checkpoint_dir, job_id, epoch)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"state": state, "epoch": epoch}, force=True)
+    return path
+
+
+def load_snapshot(
+    checkpoint_dir: str | os.PathLike,
+    job_id: str,
+    epoch: int,
+    abstract_state: TrainState,
+) -> tuple[TrainState, int]:
+    """Restore a snapshot; returns ``(state, epochs_run)`` where training
+    resumes at ``epochs_run = saved_epoch + 1`` (reference ``single.py:124``)."""
+    path = snapshot_path(checkpoint_dir, job_id, epoch)
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, {"state": abstract, "epoch": 0})
+    return restored["state"], int(restored["epoch"]) + 1
+
+
+def latest_epoch(checkpoint_dir: str | os.PathLike, job_id: str) -> int | None:
+    """Highest epoch snapshot available for a job, or None."""
+    job_dir = Path(checkpoint_dir) / job_id
+    if not job_dir.is_dir():
+        return None
+    epochs = [
+        int(p.name.removeprefix("epoch_"))
+        for p in job_dir.iterdir()
+        if p.name.startswith("epoch_") and p.name.removeprefix("epoch_").isdigit()
+    ]
+    return max(epochs) if epochs else None

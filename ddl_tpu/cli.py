@@ -1,0 +1,38 @@
+"""Command-line entry point: ``python -m ddl_tpu.cli --preset <strategy>``.
+
+The four reference entry-point scripts map to presets of one program:
+
+    python -m ddl_tpu.cli --preset single    # reference single.py
+    python -m ddl_tpu.cli --preset dp        # reference ddp.py
+    python -m ddl_tpu.cli --preset pp        # reference pp.py
+    python -m ddl_tpu.cli --preset dp_pp     # reference ddp_n_pp.py
+
+plus dotted overrides, e.g.
+
+    python -m ddl_tpu.cli --preset dp_pp --set mesh.data=4 mesh.pipe=2 \
+        data.global_batch_size=40 train.max_epochs=30
+"""
+
+from __future__ import annotations
+
+import json
+
+from ddl_tpu.config import parse_cli, to_dict
+from ddl_tpu.launch import bootstrap, world_info
+
+
+def main(argv=None) -> None:
+    cfg = parse_cli(argv)
+    bootstrap()
+    info = world_info()
+    print(f"[ddl_tpu] world: {json.dumps(info)}")
+    print(f"[ddl_tpu] config: {json.dumps(to_dict(cfg))}")
+
+    from ddl_tpu.train import Trainer
+
+    trainer = Trainer(cfg)
+    trainer.train()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+from ddl_tpu.data.dataset import AptosImageDataset, SyntheticAptosDataset, build_datasets
+from ddl_tpu.data.sampler import ShardedEpochSampler
+from ddl_tpu.data.loader import DataLoader, shard_batch
+
+__all__ = [
+    "AptosImageDataset",
+    "SyntheticAptosDataset",
+    "build_datasets",
+    "ShardedEpochSampler",
+    "DataLoader",
+    "shard_batch",
+]

@@ -1,0 +1,73 @@
+"""Epoch-seeded sharded index sampler.
+
+TPU-native analog of ``torch.utils.data.DistributedSampler`` as the reference
+uses it (``ddp.py:343`` with shuffle+drop_last; dp-subgroup-sharded in
+``ddp_n_pp.py:379-384``; ``set_epoch`` reseeding at ``ddp.py:178``): a global
+permutation seeded by ``(seed, epoch)`` is split across data-parallel *hosts*
+with rank-interleaved assignment.  In the JAX SPMD model there is one process
+per host (not per chip), so the sampler shards by host process; per-chip
+sharding of the resulting host batch happens on-device via ``NamedSharding``.
+
+Semantics match torch's: with ``drop_last`` the tail that does not divide by
+``num_shards`` is dropped; without it, indices wrap around to pad every shard
+to equal length (so all shards stay in lock-step — a collective-deadlock
+guard torch needs for NCCL and we need just as much for SPMD).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ShardedEpochSampler"]
+
+
+class ShardedEpochSampler:
+    def __init__(
+        self,
+        num_examples: int,
+        num_shards: int = 1,
+        shard_rank: int = 0,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 0,
+    ) -> None:
+        if not (0 <= shard_rank < num_shards):
+            raise ValueError(f"shard_rank {shard_rank} out of range for {num_shards}")
+        self.num_examples = num_examples
+        self.num_shards = num_shards
+        self.shard_rank = shard_rank
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        if drop_last:
+            self.shard_size = num_examples // num_shards
+        else:
+            self.shard_size = -(-num_examples // num_shards)  # ceil
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the permutation per epoch (reference ``ddp.py:178``)."""
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.shard_size
+
+    def indices(self) -> np.ndarray:
+        if self.shuffle:
+            order = np.random.default_rng((self.seed, self.epoch)).permutation(
+                self.num_examples
+            )
+        else:
+            order = np.arange(self.num_examples)
+        total = self.shard_size * self.num_shards
+        if self.drop_last:
+            order = order[:total]
+        else:
+            # wrap-around padding so every shard has equal length
+            pad = total - len(order)
+            if pad > 0:
+                order = np.concatenate([order, order[:pad]])
+        return order[self.shard_rank :: self.num_shards]
+
+    def __iter__(self):
+        return iter(self.indices())

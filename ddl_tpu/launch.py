@@ -1,0 +1,64 @@
+"""Multi-host bootstrap and launcher utilities.
+
+Replaces the reference's TorchX->Kubernetes launch stack (``.torchxconfig``,
+``command``, ``torchx_component/submit_single.py``) with the JAX multi-host
+model: *one process per TPU host*, each seeing its local chips, joined into
+one SPMD world by ``jax.distributed.initialize``.  There is no NCCL
+rendezvous and no rank->GPU binding (reference ``ddp.py:30-31``); the device
+mesh spans all hosts' chips automatically once the coordinator handshake
+completes.
+
+On Cloud TPU pods the coordinator/process-id/process-count are discovered
+from the TPU metadata environment, so ``bootstrap()`` with no arguments does
+the right thing both on a v4-32 pod slice and on a single dev host.
+``ddl_tpu.launcher.tpu_pod`` generates the per-host launch commands (the
+``torchx run`` analog, reference ``command:2-34``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["bootstrap", "world_info"]
+
+
+def bootstrap(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host world if one is configured; no-op otherwise.
+
+    Explicit args win; else ``DDL_COORDINATOR`` / ``DDL_NUM_PROCESSES`` /
+    ``DDL_PROCESS_ID`` env vars (the launcher sets these); else Cloud TPU
+    metadata auto-detection via ``jax.distributed.initialize()``'s defaults
+    when ``DDL_MULTIHOST=1``.
+    """
+    coordinator_address = coordinator_address or os.environ.get("DDL_COORDINATOR")
+    if num_processes is None and os.environ.get("DDL_NUM_PROCESSES"):
+        num_processes = int(os.environ["DDL_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("DDL_PROCESS_ID"):
+        process_id = int(os.environ["DDL_PROCESS_ID"])
+
+    if coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif os.environ.get("DDL_MULTIHOST") == "1":
+        jax.distributed.initialize()
+
+
+def world_info() -> dict:
+    """Rank/world/device info (the reference prints this in its smoke test,
+    ``test.py``)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": [str(d) for d in jax.local_devices()],
+        "global_device_count": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+    }

@@ -1,0 +1,3 @@
+from ddl_tpu.launcher.tpu_pod import JobSpec, kubernetes_manifest, pod_commands
+
+__all__ = ["JobSpec", "kubernetes_manifest", "pod_commands"]

@@ -1,0 +1,106 @@
+"""TPU pod launcher: the TorchX/Kubernetes replacement.
+
+The reference launches through TorchX (`torchx run -s kubernetes dist.ddp
+-j NxG --script ddp.py`, reference ``command:5-34``, with scheduler defaults
+in ``.torchxconfig`` and a custom single-GPU component in
+``torchx_component/submit_single.py``).  The TPU equivalent needs far less
+machinery: a slice is already a gang-scheduled unit, so a "job" is the same
+command run once per TPU host with coordinator env vars.  This module emits
+
+* ``pod_commands`` — per-host shell commands (for ``gcloud compute tpus
+  tpu-vm ssh --worker=all`` style fan-out), and
+* ``kubernetes_manifest`` — a JobSet-style YAML for GKE TPU slices
+  (completions == host count, one pod per host), mirroring the reference's
+  k8s deployment but with the TPU device plugin instead of per-GPU ranks.
+
+Job identity flows through ``DDL_JOB_ID`` (the TORCHX_JOB_ID analog,
+reference ``single.py:102``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+import uuid
+
+__all__ = ["JobSpec", "pod_commands", "kubernetes_manifest"]
+
+
+@dataclasses.dataclass
+class JobSpec:
+    name: str = "ddl"
+    preset: str = "dp_pp"
+    overrides: tuple[str, ...] = ()
+    num_hosts: int = 4  # v4-32 = 4 hosts x 4 chips
+    coordinator_port: int = 8476
+    image: str = "ddl-tpu:latest"
+    workdir: str = "/workspace"
+    env: tuple[tuple[str, str], ...] = ()
+
+    @property
+    def job_id(self) -> str:
+        return f"{self.preset}-{self.name}-{uuid.uuid4().hex[:10]}"
+
+
+def _train_argv(spec: JobSpec) -> list[str]:
+    argv = ["python", "-m", "ddl_tpu.cli", "--preset", spec.preset]
+    if spec.overrides:
+        argv += ["--set", *spec.overrides]
+    return argv
+
+
+def pod_commands(spec: JobSpec, coordinator_host: str = "$(hostname -i)") -> list[str]:
+    """One shell command per TPU host (worker i runs commands[i])."""
+    job_id = spec.job_id
+    cmds = []
+    for host in range(spec.num_hosts):
+        env = {
+            "DDL_JOB_ID": job_id,
+            "DDL_COORDINATOR": f"{coordinator_host}:{spec.coordinator_port}",
+            "DDL_NUM_PROCESSES": str(spec.num_hosts),
+            "DDL_PROCESS_ID": str(host),
+            **dict(spec.env),
+        }
+        envs = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        cmds.append(f"{envs} {' '.join(_train_argv(spec))}")
+    return cmds
+
+
+def kubernetes_manifest(spec: JobSpec, tpu_topology: str = "2x2x4") -> str:
+    """GKE JobSet-style manifest for a multi-host TPU slice job."""
+    job_id = spec.job_id
+    args = ", ".join(f'"{a}"' for a in _train_argv(spec))
+    extra_env = "\n".join(
+        f'            - {{name: "{k}", value: "{v}"}}' for k, v in spec.env
+    )
+    return f"""\
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: {spec.name}
+spec:
+  replicatedJobs:
+  - name: workers
+    template:
+      spec:
+        parallelism: {spec.num_hosts}
+        completions: {spec.num_hosts}
+        backoffLimit: 0
+        template:
+          spec:
+            restartPolicy: Never
+            nodeSelector:
+              cloud.google.com/gke-tpu-topology: {tpu_topology}
+            containers:
+            - name: train
+              image: {spec.image}
+              workingDir: {spec.workdir}
+              command: [{args}]
+              env:
+              - {{name: "DDL_JOB_ID", value: "{job_id}"}}
+              - {{name: "DDL_MULTIHOST", value: "1"}}
+{extra_env if spec.env else ''}
+              resources:
+                limits:
+                  google.com/tpu: 4
+"""

@@ -1,0 +1,4 @@
+from ddl_tpu.ops.image import normalize_images
+from ddl_tpu.ops.losses import cross_entropy_loss, softmax_cross_entropy
+
+__all__ = ["normalize_images", "cross_entropy_loss", "softmax_cross_entropy"]

@@ -1,0 +1,19 @@
+"""On-device image preprocessing.
+
+The reference normalises per-sample on the host dataloader (``/255`` in
+``Normalize``, ``single.py:38-42``), shipping float32 over the wire.  Here the
+uint8 batch is transferred raw and normalised on-device inside the jitted
+step; XLA fuses the convert+scale into the consumer (the stem convolution),
+so it costs no extra HBM round-trip and the host link carries 4x fewer bytes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["normalize_images"]
+
+
+def normalize_images(images, dtype=jnp.float32):
+    """uint8 HWC images -> [0,1] float in the compute dtype."""
+    return images.astype(dtype) / jnp.asarray(255.0, dtype)

@@ -1,0 +1,21 @@
+"""Loss ops (reference objective: ``F.cross_entropy``, ``single.py:139``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["softmax_cross_entropy", "cross_entropy_loss"]
+
+
+def softmax_cross_entropy(logits, labels):
+    """Per-example softmax cross-entropy from integer labels (stable)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32), axis=-1)
+    return lse - picked[..., 0]
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean cross-entropy — the training objective."""
+    return softmax_cross_entropy(logits, labels).mean()

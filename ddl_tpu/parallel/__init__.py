@@ -1,0 +1,3 @@
+from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
+
+__all__ = ["MeshSpec", "build_mesh"]

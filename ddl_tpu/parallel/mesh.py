@@ -1,0 +1,56 @@
+"""Device mesh construction: the framework's communication backbone.
+
+Replaces the reference's NCCL process-group plumbing (``init_process_group``
+at ``ddp.py:29``; ``init_device_mesh('cuda', (3,2), ('dp','pp'))`` at
+``ddp_n_pp.py:32-33``; manual subgroup carving via ``mesh.get_group`` at
+``ddp_n_pp.py:139,154``) with a single ``jax.sharding.Mesh`` over the TPU
+slice.  Named-axis collectives make the subgroup bookkeeping vanish: a
+``psum(..., 'data')`` *is* the dp-subgroup allreduce, a ``ppermute`` over
+``'pipe'`` *is* the stage-to-stage send/recv, and XLA lowers both onto ICI
+(intra-slice) or DCN (cross-slice) from the device assignment.
+
+Axis order is ``('data', 'pipe')`` with ``pipe`` innermost so pipeline-stage
+neighbours land on physically adjacent devices (the analog of the reference
+keeping pp pairs intra-node, SURVEY.md section 3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["MeshSpec", "build_mesh", "DATA_AXIS", "PIPE_AXIS"]
+
+DATA_AXIS = "data"
+PIPE_AXIS = "pipe"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    data: int = 1
+    pipe: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.pipe
+
+    @property
+    def axis_names(self) -> tuple[str, str]:
+        return (DATA_AXIS, PIPE_AXIS)
+
+
+def build_mesh(spec: MeshSpec, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build the ``(data, pipe)`` mesh from the first ``data*pipe`` devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = spec.num_devices
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {spec} needs {need} devices, have {len(devices)} "
+            f"({[d.platform for d in devices[:4]]}...)"
+        )
+    grid = np.array(devices[:need]).reshape(spec.data, spec.pipe)
+    return Mesh(grid, spec.axis_names)

@@ -1,0 +1,79 @@
+"""Cluster smoke test (reference ``test.py``): bring up the mesh, print the
+world layout, and run one collective over each mesh axis.
+
+Where the reference prints rank/world/device-name and all_reduces over the
+``pp`` subgroup on a live 3x2 NCCL cluster (``test.py:8-30``), this checks
+the same plumbing on whatever devices are present: builds the ``(data,pipe)``
+mesh, runs a ``psum`` over each axis inside ``shard_map``, and verifies the
+result against the closed form.
+
+    python -m ddl_tpu.tools.smoke --data 3 --pipe 2
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ddl_tpu.launch import bootstrap, world_info
+from ddl_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, MeshSpec, build_mesh
+
+
+def run_smoke(data: int, pipe: int) -> bool:
+    info = world_info()
+    print(f"[smoke] world: {info}")
+    mesh = build_mesh(MeshSpec(data, pipe))
+    print(f"[smoke] mesh: {mesh}")
+
+    n = data * pipe
+
+    @jax.jit
+    @jax.shard_map(
+        mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS), check_vma=False
+    )
+    def axis_sums(x):
+        d = lax.axis_index(DATA_AXIS)
+        p = lax.axis_index(PIPE_AXIS)
+        flat = d * pipe + p
+        return (
+            x
+            + lax.psum(jnp.float32(flat), PIPE_AXIS)
+            + lax.psum(jnp.float32(flat), DATA_AXIS)
+        )
+
+    out = np.asarray(axis_sums(jnp.zeros((n,), jnp.float32)))
+    ok = True
+    for d in range(data):
+        for p in range(pipe):
+            flat = d * pipe + p
+            pipe_sum = sum(d * pipe + q for q in range(pipe))
+            data_sum = sum(e * pipe + p for e in range(data))
+            expected = pipe_sum + data_sum
+            # each data-row block of the output holds that row's value
+            block = out[d * (n // data) : (d + 1) * (n // data)]
+            if not np.allclose(block, block[0]):
+                ok = False
+            if p == 0 and not np.isclose(block[0], expected):
+                print(f"[smoke] mismatch at (d={d},p={p}): {block[0]} != {expected}")
+                ok = False
+    print(f"[smoke] psum over '{PIPE_AXIS}' and '{DATA_AXIS}': {'OK' if ok else 'FAIL'}")
+    return ok
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", type=int, default=1)
+    ap.add_argument("--pipe", type=int, default=1)
+    args = ap.parse_args(argv)
+    bootstrap()
+    if not run_smoke(args.data, args.pipe):
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Pipeline split-point explorer (reference ``debug.py`` equivalent).
+
+The reference explored candidate FX split specs offline, printing per-stage
+parameter counts and recording which splits failed — mid-denseblock cuts
+break on DenseNet's concatenative skip connections (``debug.py:9-18``) and a
+4-stage split regressed epoch time (``debug.py:20-29``).  Here splits are
+*constructive* (block boundaries only, so the failure mode cannot occur) and
+the explorer reports, for every legal ``split_blocks`` choice at a given
+stage count: per-stage parameter counts, per-stage forward FLOP estimates
+(the quantity that actually balances a pipeline — DenseNet's late blocks
+hold most params but early blocks, at high resolution, most FLOPs), and the
+boundary-activation bytes each cut ships over ICI per microbatch.
+
+    python -m ddl_tpu.tools.split_explorer --stages 2 --image-size 224
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+
+import jax
+import jax.numpy as jnp
+
+from ddl_tpu.config import ModelConfig
+from ddl_tpu.models import build_stages, count_params, stage_boundary_shapes
+
+
+def _stage_costs(cfg: ModelConfig, image_size: int):
+    """Per-stage (params, flops) via abstract evaluation + XLA cost analysis."""
+    stages = build_stages(cfg)
+    out = []
+    x = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    for stage in stages:
+        variables = jax.eval_shape(
+            lambda k, v, s=stage: s.init(k, v, train=False), jax.random.key(0), x
+        )
+        n_params = count_params(variables["params"])
+        fwd = jax.jit(lambda v, y, s=stage: s.apply(v, y, train=False))
+        try:
+            cost = fwd.lower(variables, x).compile().cost_analysis()
+            flops = float(cost.get("flops", float("nan")))
+        except Exception:
+            flops = float("nan")
+        x = jax.eval_shape(lambda v, y, s=stage: s.apply(v, y, train=False), variables, x)
+        out.append((n_params, flops))
+    return out
+
+
+def explore(num_stages: int, image_size: int, microbatch: int, cfg: ModelConfig | None = None):
+    base = cfg or ModelConfig()
+    n_blocks = len(base.block_config)
+    rows = []
+    for splits in itertools.combinations(range(1, n_blocks), num_stages - 1):
+        c = ModelConfig(
+            growth_rate=base.growth_rate,
+            block_config=base.block_config,
+            num_init_features=base.num_init_features,
+            bn_size=base.bn_size,
+            num_classes=base.num_classes,
+            split_blocks=splits,
+            compute_dtype=base.compute_dtype,
+        )
+        costs = _stage_costs(c, image_size)
+        boundaries = stage_boundary_shapes(c, image_size)
+        rows.append(
+            {
+                "split_blocks": splits,
+                "stage_params": [p for p, _ in costs],
+                "stage_flops": [f for _, f in costs],
+                "boundary_bytes_per_microbatch": [
+                    microbatch * h * w * ch * 2 for (h, w, ch) in boundaries  # bf16
+                ],
+            }
+        )
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", type=int, default=2)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--microbatch", type=int, default=6)
+    args = ap.parse_args(argv)
+    rows = explore(args.stages, args.image_size, args.microbatch)
+    for r in rows:
+        total_f = sum(f for f in r["stage_flops"])
+        balance = (
+            max(r["stage_flops"]) / (total_f / len(r["stage_flops"]))
+            if total_f == total_f  # not NaN
+            else float("nan")
+        )
+        print(
+            f"split_blocks={r['split_blocks']}: params={r['stage_params']} "
+            f"flops={[f'{f:.3g}' for f in r['stage_flops']]} "
+            f"flop_imbalance={balance:.2f} "
+            f"boundary_bytes/mb={r['boundary_bytes_per_microbatch']}"
+        )
+
+
+if __name__ == "__main__":
+    main()

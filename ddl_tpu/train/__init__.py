@@ -1,0 +1,10 @@
+from ddl_tpu.train.state import TrainState, create_train_state, make_optimizer
+from ddl_tpu.train.trainer import Trainer, resolve_job_id
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_optimizer",
+    "Trainer",
+    "resolve_job_id",
+]

@@ -1,0 +1,50 @@
+"""Training state: the ``{model, optim, epoch}`` triple the reference
+checkpoints through DCP (``single.py:74-80``), as one immutable pytree.
+
+``params`` and ``batch_stats`` are *tuples with one entry per pipeline stage*
+(see ``ddl_tpu.models.densenet.init_stages``) — the same per-stage
+decomposition the reference's PP checkpoints express by keying state dicts
+with the stage rank (``pp.py:84-90``), but here it is a first-class structure
+that works identically for 1 stage (single/DP) and N stages (PP/hybrid).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import optax
+from flax import struct
+
+__all__ = ["TrainState", "make_optimizer", "create_train_state"]
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Tuple[Any, ...]
+    batch_stats: Tuple[Any, ...]
+    opt_state: optax.OptState
+
+
+def make_optimizer(train_cfg) -> optax.GradientTransformation:
+    """Adam with torch defaults (reference ``single.py:305`` uses
+    ``optim.Adam`` unconfigured: lr=1e-3, betas=(0.9,0.999), eps=1e-8)."""
+    return optax.adam(
+        learning_rate=train_cfg.learning_rate,
+        b1=train_cfg.b1,
+        b2=train_cfg.b2,
+        eps=train_cfg.eps,
+    )
+
+
+def create_train_state(stages, tx, rng, image_size: int) -> TrainState:
+    from ddl_tpu.models.densenet import init_stages
+    import jax.numpy as jnp
+
+    params, batch_stats = init_stages(stages, rng, image_size)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+    )

@@ -1,0 +1,89 @@
+"""Jitted train/eval steps for the single-device and data-parallel strategies.
+
+This is the TPU-native replacement for the reference's DDP path
+(``ddp.py:127,150-170``): instead of wrapping the model in a DDP reducer that
+fires bucketed NCCL allreduces during ``loss.backward()``, the *whole* train
+step — normalize, forward, loss, backward, Adam update — is one jitted SPMD
+program in which the batch is sharded over the ``data`` mesh axis and the
+replicated-parameter gradient reduction is inserted by XLA's partitioner
+(computation-follows-sharding; the collective rides ICI).  With
+``mesh.data == 1`` the same program is the single-device trainer
+(``single.py:136-154``), so "single" vs "DP" is a mesh shape, not a code path.
+
+A semantic upgrade over the reference: because the global batch is one logical
+array, BatchNorm statistics are computed over the *global* batch (SyncBN
+semantics) rather than per-replica as torch DDP defaults to — DP training is
+therefore exactly equivalent to single-device training on the same global
+batch, which the parity test asserts to float tolerance.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddl_tpu.models.densenet import forward_stages
+from ddl_tpu.ops import cross_entropy_loss, normalize_images
+from ddl_tpu.train.state import TrainState
+
+__all__ = ["StepFns", "make_dp_step_fns"]
+
+
+class StepFns(NamedTuple):
+    """train(state, images, labels) -> (state, loss, preds);
+    evaluate(state, images) -> logits."""
+
+    train: Callable
+    evaluate: Callable
+
+
+def make_dp_step_fns(stages, tx: optax.GradientTransformation, mesh: Mesh, compute_dtype) -> StepFns:
+    def train_step(state: TrainState, images, labels):
+        x = normalize_images(images, compute_dtype)
+
+        def loss_fn(params):
+            logits, new_stats = forward_stages(
+                stages, params, state.batch_stats, x, train=True
+            )
+            return cross_entropy_loss(logits, labels), (logits, new_stats)
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+        return new_state, loss, jnp.argmax(logits, axis=-1)
+
+    def eval_step(state: TrainState, images):
+        x = normalize_images(images, compute_dtype)
+        logits, _ = forward_stages(
+            stages, state.params, state.batch_stats, x, train=False
+        )
+        return logits
+
+    replicated = NamedSharding(mesh, P())
+    batch_sharding = NamedSharding(mesh, P("data"))
+
+    train = jax.jit(
+        train_step,
+        in_shardings=(replicated, batch_sharding, batch_sharding),
+        out_shardings=(replicated, replicated, batch_sharding),
+        donate_argnums=(0,),
+    )
+    evaluate = jax.jit(
+        eval_step,
+        in_shardings=(replicated, batch_sharding),
+        out_shardings=batch_sharding,
+    )
+    return StepFns(train=train, evaluate=evaluate)

@@ -1,0 +1,218 @@
+"""The Trainer: epoch loop, eval loop, metric logging, checkpointing.
+
+One trainer for all four reference entry points (``single.py`` / ``ddp.py`` /
+``pp.py`` / ``ddp_n_pp.py`` each re-implement their own ``Trainer`` class —
+SURVEY.md section 1): strategy is the mesh shape, the rest of the loop is
+shared.  Per-epoch behaviour mirrors the reference trainer
+(``single.py:169-197``): timed epoch, mean train loss, epoch-accumulated
+train accuracy, full eval metric suite, CSV logging, QWK-gated snapshot
+(``ddp.py:292-295`` — and unlike the reference, the save is actually wired
+up).  Metric aggregation across data-parallel replicas needs no explicit
+``all_gather`` (reference ``ddp.py:194-199``): step outputs are global
+``jax.Array``s already, fetched to host once per epoch.
+"""
+
+from __future__ import annotations
+
+import os
+from time import perf_counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddl_tpu import checkpoint as ckpt
+from ddl_tpu.config import Config
+from ddl_tpu.data import DataLoader, ShardedEpochSampler, build_datasets, shard_batch
+from ddl_tpu.models import build_stages, stage_boundary_shapes
+from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
+from ddl_tpu.train.state import create_train_state, make_optimizer
+from ddl_tpu.train.steps import make_dp_step_fns
+from ddl_tpu.utils import MetricLogger, classification_metrics, cross_entropy
+
+__all__ = ["Trainer", "resolve_job_id"]
+
+
+def resolve_job_id() -> str:
+    """Job identity from the launcher env (reference reads TORCHX_JOB_ID,
+    ``single.py:102``); the last path segment is the job name."""
+    raw = os.environ.get("DDL_JOB_ID") or os.environ.get("TORCHX_JOB_ID") or "local"
+    return raw.split("/")[-1]
+
+
+def _to_host(x) -> np.ndarray:
+    """Fetch a (possibly multi-host-sharded) jax.Array fully to this host."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
+class Trainer:
+    def __init__(self, cfg: Config, mesh=None, datasets=None) -> None:
+        cfg.validate()
+        self.cfg = cfg
+        self.job_id = resolve_job_id()
+        self.mesh = mesh if mesh is not None else build_mesh(
+            MeshSpec(cfg.mesh.data, cfg.mesh.pipe)
+        )
+
+        pipelined = cfg.strategy in ("pp", "dp_pp")
+        self.stages = build_stages(cfg.model, num_stages=None if pipelined else 1)
+        self.tx = make_optimizer(cfg.train)
+        rng = jax.random.key(cfg.train.seed)
+        self.state = create_train_state(
+            self.stages, self.tx, rng, cfg.data.image_size
+        )
+        compute_dtype = jnp.dtype(cfg.model.compute_dtype)
+        if pipelined:
+            from ddl_tpu.parallel.pipeline import make_pipeline_step_fns
+
+            self.step_fns = make_pipeline_step_fns(
+                self.stages,
+                self.tx,
+                self.mesh,
+                compute_dtype,
+                num_microbatches=cfg.train.num_microbatches,
+                boundary_shapes=stage_boundary_shapes(cfg.model, cfg.data.image_size),
+                num_classes=cfg.model.num_classes,
+                remat=cfg.model.remat,
+            )
+        else:
+            self.step_fns = make_dp_step_fns(
+                self.stages, self.tx, self.mesh, compute_dtype
+            )
+
+        train_ds, test_ds = datasets if datasets is not None else build_datasets(cfg.data)
+        # Host-level sharding (DistributedSampler analog, ddp.py:343): each
+        # process loads 1/process_count of the global batch; per-chip
+        # sharding happens on-device via NamedSharding.
+        n_proc, proc = jax.process_count(), jax.process_index()
+        if cfg.data.global_batch_size % n_proc:
+            raise ValueError("global_batch_size must divide by process count")
+        per_proc_batch = cfg.data.global_batch_size // n_proc
+        per_proc_eval = cfg.data.eval_batch_size // n_proc
+        self.train_loader = DataLoader(
+            train_ds,
+            per_proc_batch,
+            sampler=ShardedEpochSampler(
+                len(train_ds), n_proc, proc,
+                shuffle=cfg.data.shuffle, drop_last=cfg.data.drop_last,
+                seed=cfg.train.seed,
+            ),
+            num_workers=cfg.data.num_workers,
+            drop_last=cfg.data.drop_last,
+        )
+        self.test_loader = DataLoader(
+            test_ds,
+            per_proc_eval,
+            sampler=ShardedEpochSampler(
+                len(test_ds), n_proc, proc,
+                shuffle=cfg.data.shuffle, drop_last=True,
+                seed=cfg.train.seed + 1,
+            ),
+            num_workers=cfg.data.num_workers,
+            drop_last=True,
+        )
+
+        self.logger = MetricLogger(
+            cfg.train.log_dir,
+            self.job_id,
+            global_rank=proc,
+            local_rank=proc,
+            model_start_job_id=cfg.train.snapshot_job_id,
+        )
+        self.is_logging_process = proc == 0
+        self.epochs_run = 0
+        self.best_qwk = -1.0
+        if cfg.train.snapshot_job_id is not None:
+            self._load_snapshot()
+
+    # ------------------------------------------------------------------
+
+    def _load_snapshot(self) -> None:
+        t = self.cfg.train
+        path = ckpt.snapshot_path(t.checkpoint_dir, t.snapshot_job_id, t.snapshot_epoch)
+        if not path.exists():
+            print(f"No snapshot at {path}; starting fresh")
+            return
+        print(f"Loading snapshot from {path}")
+        self.state, self.epochs_run = ckpt.load_snapshot(
+            t.checkpoint_dir, t.snapshot_job_id, t.snapshot_epoch, self.state
+        )
+        print(f"Resuming training from epoch {self.epochs_run}")
+
+    def _save_snapshot(self, epoch: int) -> None:
+        path = ckpt.save_snapshot(
+            self.cfg.train.checkpoint_dir, self.job_id, epoch, self.state
+        )
+        print(f"Epoch {epoch} | Saved snapshot to {path}")
+
+    # ------------------------------------------------------------------
+
+    def _run_epoch(self, epoch: int):
+        """One training epoch; returns (mean_loss, accuracy, steps)."""
+        self.train_loader.set_epoch(epoch)
+        losses, preds, targets = [], [], []
+        steps = 0
+        for images, labels in self.train_loader:
+            gi, gl = shard_batch(self.mesh, images, labels)
+            self.state, loss, pred = self.step_fns.train(self.state, gi, gl)
+            losses.append(loss)
+            preds.append(pred)
+            targets.append(gl)
+            steps += 1
+        if steps == 0:
+            raise RuntimeError("empty epoch: dataset smaller than one batch")
+        mean_loss = float(np.mean([_to_host(l) for l in losses]))
+        y_pred = np.concatenate([_to_host(p) for p in preds])
+        y_true = np.concatenate([_to_host(t) for t in targets])
+        accuracy = float(np.mean(y_pred == y_true))
+        return mean_loss, accuracy, steps
+
+    def evaluate(self, epoch: int) -> dict:
+        """Eval loop -> metric dict (reference ``_evaluate``, single.py:199-251)."""
+        self.test_loader.set_epoch(epoch)
+        logits, targets = [], []
+        for images, labels in self.test_loader:
+            gi, gl = shard_batch(self.mesh, images, labels)
+            logits.append(self.step_fns.evaluate(self.state, gi))
+            targets.append(gl)
+        all_logits = np.concatenate([_to_host(l) for l in logits])
+        all_targets = np.concatenate([_to_host(t) for t in targets])
+        metrics = {"val_loss": cross_entropy(all_logits, all_targets)}
+        metrics.update(
+            classification_metrics(all_targets, np.argmax(all_logits, axis=-1))
+        )
+        return metrics
+
+    def train(self, max_epochs: int | None = None) -> None:
+        max_epochs = max_epochs or self.cfg.train.max_epochs
+        for epoch in range(self.epochs_run, max_epochs):
+            start = perf_counter()
+            mean_loss, accuracy, steps = self._run_epoch(epoch)
+            elapsed = perf_counter() - start
+            print(
+                f"Epoch {epoch} | Time: {elapsed:.2f}s | Steps: {steps} | "
+                f"Loss: {mean_loss:.4f} | Training Accuracy: {accuracy:.4f}"
+            )
+            if self.is_logging_process:
+                self.logger.log("loss", mean_loss, epoch)
+                self.logger.log("train_accuracy", accuracy, epoch)
+                self.logger.log("epoch_time", elapsed, epoch)
+
+            metrics = self.evaluate(epoch)
+            print(
+                f"Epoch {epoch} | Validation Loss: {metrics['val_loss']:.4f} | "
+                f"Accuracy: {metrics['val_accuracy']:.4f} | "
+                f"QWK: {metrics['qwk']:.4f}"
+            )
+            if self.is_logging_process:
+                self.logger.log_many(metrics, epoch)
+
+            if self.cfg.train.save_best_qwk and metrics["qwk"] > self.best_qwk:
+                self.best_qwk = metrics["qwk"]
+                print(f"New Best Validation QWK: {self.best_qwk:.4f}")
+                self._save_snapshot(epoch)
+            self.epochs_run = epoch + 1

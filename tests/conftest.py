@@ -9,10 +9,15 @@ TPU slice.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Force the CPU platform even when a TPU plugin was force-registered by the
+# environment (config.update wins over a registered-but-uninitialised backend).
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

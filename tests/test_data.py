@@ -1,0 +1,142 @@
+"""Data pipeline tests: sampler semantics vs torch.DistributedSampler, loaders."""
+
+import numpy as np
+import pytest
+
+from ddl_tpu.data import (
+    AptosImageDataset,
+    DataLoader,
+    ShardedEpochSampler,
+    SyntheticAptosDataset,
+)
+
+
+class TestShardedEpochSampler:
+    def test_partition_complete_and_disjoint(self):
+        n, shards = 103, 4
+        all_idx = []
+        for r in range(shards):
+            s = ShardedEpochSampler(n, shards, r, shuffle=True, drop_last=True, seed=7)
+            s.set_epoch(3)
+            idx = s.indices()
+            assert len(idx) == n // shards
+            all_idx.append(idx)
+        flat = np.concatenate(all_idx)
+        assert len(np.unique(flat)) == len(flat)  # disjoint
+
+    def test_no_drop_last_pads_by_wraparound(self):
+        n, shards = 10, 4
+        lengths = set()
+        flat = []
+        for r in range(shards):
+            s = ShardedEpochSampler(n, shards, r, shuffle=False, drop_last=False)
+            idx = s.indices()
+            lengths.add(len(idx))
+            flat.extend(idx)
+        assert lengths == {3}  # ceil(10/4), equal on every shard
+        assert set(flat) == set(range(n))  # every example appears
+
+    def test_epoch_reshuffles(self):
+        s = ShardedEpochSampler(100, 2, 0, shuffle=True, seed=1)
+        s.set_epoch(0)
+        a = s.indices().copy()
+        s.set_epoch(1)
+        b = s.indices()
+        assert not np.array_equal(a, b)
+        s.set_epoch(0)
+        np.testing.assert_array_equal(a, s.indices())  # deterministic per epoch
+
+    def test_matches_torch_distributed_sampler_invariants(self):
+        """Same shard sizes and coverage as torch's DistributedSampler."""
+        torch = pytest.importorskip("torch")
+        from torch.utils.data import DistributedSampler
+
+        class _DS(torch.utils.data.Dataset):
+            def __len__(self):
+                return 101
+
+            def __getitem__(self, i):
+                return i
+
+        for drop_last in (True, False):
+            torch_lens, ours_lens = [], []
+            for r in range(3):
+                ts = DistributedSampler(
+                    _DS(), num_replicas=3, rank=r, shuffle=True, drop_last=drop_last
+                )
+                ts.set_epoch(5)
+                torch_lens.append(len(list(ts)))
+                s = ShardedEpochSampler(101, 3, r, shuffle=True, drop_last=drop_last)
+                s.set_epoch(5)
+                ours_lens.append(len(s.indices()))
+            assert torch_lens == ours_lens
+
+
+class TestSynthetic:
+    def test_deterministic(self):
+        d = SyntheticAptosDataset(16, image_size=32, seed=3)
+        img1, lab1 = d[5]
+        img2, lab2 = d[5]
+        np.testing.assert_array_equal(img1, img2)
+        assert lab1 == lab2
+        assert img1.dtype == np.uint8 and img1.shape == (32, 32, 3)
+
+    def test_classes_are_separable(self):
+        """Blob positions must differ by class (the learnability signal)."""
+        d = SyntheticAptosDataset(200, image_size=32, seed=0)
+        means = {}
+        for c in range(5):
+            idxs = [i for i in range(200) if d.labels[i] == c][:10]
+            imgs = np.stack([d[i][0] for i in idxs]).astype(np.float32)
+            # centroid of brightness
+            m = imgs.mean(axis=(0, 3))
+            yy, xx = np.mgrid[0:32, 0:32]
+            w = m - m.min()
+            means[c] = (float((w * yy).sum() / w.sum()), float((w * xx).sum() / w.sum()))
+        centers = np.array(list(means.values()))
+        dists = np.linalg.norm(centers[:, None] - centers[None, :], axis=-1)
+        assert (dists + np.eye(5) * 99).min() > 1.5
+
+
+class TestAptosImageDataset:
+    def test_reads_csv_and_pngs(self, tmp_path):
+        from PIL import Image
+
+        (tmp_path / "imgs").mkdir()
+        with open(tmp_path / "meta.csv", "w") as f:
+            f.write("new_id_code,diagnosis\nabc,2\nxyz,4\n")
+        for name, shade in (("abc", 10), ("xyz", 200)):
+            Image.fromarray(np.full((8, 8, 3), shade, np.uint8)).save(
+                tmp_path / "imgs" / f"{name}.png"
+            )
+        ds = AptosImageDataset(tmp_path / "meta.csv", tmp_path / "imgs", "new_id_code")
+        assert len(ds) == 2
+        img, label = ds[1]
+        assert label == 4
+        assert img.shape == (8, 8, 3) and img[0, 0, 0] == 200
+
+    def test_missing_column_raises(self, tmp_path):
+        with open(tmp_path / "meta.csv", "w") as f:
+            f.write("id,diagnosis\n1,0\n")
+        with pytest.raises(ValueError):
+            AptosImageDataset(tmp_path / "meta.csv", tmp_path, "new_id_code")
+
+
+class TestDataLoader:
+    def test_shapes_and_coverage(self):
+        d = SyntheticAptosDataset(50, image_size=16, seed=0)
+        dl = DataLoader(d, batch_size=8, shuffle=True, drop_last=True, num_workers=2)
+        batches = list(dl)
+        assert len(batches) == len(dl) == 6
+        for imgs, labs in batches:
+            assert imgs.shape == (8, 16, 16, 3) and imgs.dtype == np.uint8
+            assert labs.shape == (8,) and labs.dtype == np.int32
+
+    def test_epoch_changes_order(self):
+        d = SyntheticAptosDataset(24, image_size=8, seed=0)
+        dl = DataLoader(d, batch_size=8, num_workers=0)
+        dl.set_epoch(0)
+        a = np.concatenate([l for _, l in dl])
+        dl.set_epoch(1)
+        b = np.concatenate([l for _, l in dl])
+        assert not np.array_equal(a, b)

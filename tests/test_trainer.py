@@ -1,0 +1,131 @@
+"""End-to-end Trainer tests: epoch loop, CSV logs, QWK-gated checkpointing,
+resume — on synthetic data over simulated meshes (all four strategies)."""
+
+import numpy as np
+import pytest
+
+from ddl_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, TrainConfig
+from ddl_tpu.data import SyntheticAptosDataset
+from ddl_tpu.utils.csv_logger import read_metric_csv
+
+
+def _tiny_cfg(tmp_path, strategy, mesh, epochs=2):
+    model = ModelConfig(
+        growth_rate=4,
+        block_config=(2, 2),
+        num_init_features=8,
+        bn_size=2,
+        num_classes=5,
+        split_blocks=(1,),
+        compute_dtype="float32",
+        remat=False,
+    )
+    cfg = Config(
+        strategy=strategy,
+        mesh=mesh,
+        model=model,
+        data=DataConfig(
+            dataset_dir="",
+            synthetic_num_train=64,
+            synthetic_num_test=32,
+            image_size=16,
+            global_batch_size=16,
+            eval_batch_size=16,
+            num_workers=0,
+        ),
+        train=TrainConfig(
+            max_epochs=epochs,
+            num_microbatches=2,
+            log_dir=str(tmp_path / "logs"),
+            checkpoint_dir=str(tmp_path / "ckpt"),
+        ),
+    )
+    return cfg.validate()
+
+
+def _datasets(cfg):
+    return (
+        SyntheticAptosDataset(cfg.data.synthetic_num_train, cfg.data.image_size, seed=1),
+        SyntheticAptosDataset(cfg.data.synthetic_num_test, cfg.data.image_size, seed=2),
+    )
+
+
+STRATEGIES = [
+    ("single", MeshConfig(1, 1)),
+    ("dp", MeshConfig(4, 1)),
+    ("pp", MeshConfig(1, 2)),
+    ("dp_pp", MeshConfig(2, 2)),
+]
+
+
+@pytest.mark.parametrize("strategy,mesh", STRATEGIES)
+def test_trainer_end_to_end(tmp_path, strategy, mesh):
+    from ddl_tpu.train import Trainer
+
+    cfg = _tiny_cfg(tmp_path, strategy, mesh)
+    trainer = Trainer(cfg, datasets=_datasets(cfg))
+    trainer.train()
+
+    job_dir = trainer.logger.job_dir
+    # the full reference metric suite is logged every epoch (single.py:187-189,244-251)
+    for metric in (
+        "loss",
+        "train_accuracy",
+        "epoch_time",
+        "val_loss",
+        "val_accuracy",
+        "macro_f1",
+        "weighted_f1",
+        "macro_precision",
+        "weighted_precision",
+        "macro_recall",
+        "weighted_recall",
+        "qwk",
+    ):
+        rows = read_metric_csv(job_dir / f"{metric}.csv")
+        assert [r["epoch"] for r in rows] == [0, 1], metric
+        assert all(np.isfinite(r["value"]) for r in rows)
+    # QWK-gated snapshot saved at least once
+    ckpt_dir = trainer.logger.job_dir  # logs dir; checkpoints separate:
+    from ddl_tpu.checkpoint import latest_epoch
+
+    assert latest_epoch(cfg.train.checkpoint_dir, trainer.job_id) is not None
+
+
+def test_resume_from_snapshot(tmp_path):
+    from ddl_tpu.checkpoint import latest_epoch
+    from ddl_tpu.train import Trainer
+
+    cfg = _tiny_cfg(tmp_path, "single", MeshConfig(1, 1), epochs=2)
+    t1 = Trainer(cfg, datasets=_datasets(cfg))
+    t1.train()
+    saved = latest_epoch(cfg.train.checkpoint_dir, t1.job_id)
+    assert saved is not None
+
+    cfg2 = _tiny_cfg(tmp_path, "single", MeshConfig(1, 1), epochs=4)
+    cfg2.train.snapshot_job_id = t1.job_id
+    cfg2.train.snapshot_epoch = saved
+    t2 = Trainer(cfg2, datasets=_datasets(cfg2))
+    assert t2.epochs_run == saved + 1  # resume semantics (single.py:124)
+    # resumed state carries the trained params (loss should not reset)
+    t2.train()
+    assert t2.epochs_run == 4
+
+
+def test_state_roundtrip(tmp_path, tiny_model_cfg):
+    """Checkpoint save/load restores the exact pytree."""
+    import jax
+
+    from ddl_tpu import checkpoint as ckpt
+    from ddl_tpu.config import TrainConfig as TC
+    from ddl_tpu.models import build_stages
+    from ddl_tpu.train.state import create_train_state, make_optimizer
+
+    stages = build_stages(tiny_model_cfg)
+    tx = make_optimizer(TC())
+    state = create_train_state(stages, tx, jax.random.key(0), 16)
+    ckpt.save_snapshot(tmp_path / "ck", "job", 3, state)
+    restored, epochs_run = ckpt.load_snapshot(tmp_path / "ck", "job", 3, state)
+    assert epochs_run == 4
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -60,11 +60,15 @@ class AptosImageDataset:
     def __len__(self) -> int:
         return len(self.filenames)
 
+    def image_path(self, idx: int) -> Path:
+        """File path for sample ``idx`` — lets the native C++ batch decoder
+        (``ddl_tpu.native``) bypass per-sample Python entirely."""
+        return self.root_dir / f"{self.filenames[idx]}.png"
+
     def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
         from PIL import Image
 
-        path = self.root_dir / f"{self.filenames[idx]}.png"
-        with Image.open(path) as im:
+        with Image.open(self.image_path(idx)) as im:
             arr = np.asarray(im.convert("RGB"), dtype=np.uint8)
         return arr, self.labels[idx]
 

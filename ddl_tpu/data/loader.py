@@ -58,14 +58,39 @@ class DataLoader:
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def _collate(self, idxs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        if self.num_workers > 0:
-            with ThreadPoolExecutor(self.num_workers) as pool:
-                samples = list(pool.map(self.dataset.__getitem__, idxs))
-        else:
-            samples = [self.dataset[i] for i in idxs]
-        images = np.stack([s[0] for s in samples])
-        labels = np.asarray([s[1] for s in samples], dtype=np.int32)
+        images = self._collate_native(idxs)
+        if images is None:
+            if self.num_workers > 0:
+                with ThreadPoolExecutor(self.num_workers) as pool:
+                    samples = list(pool.map(self.dataset.__getitem__, idxs))
+            else:
+                samples = [self.dataset[i] for i in idxs]
+            images = np.stack([s[0] for s in samples])
+        labels = np.asarray(
+            [self.dataset.labels[i] for i in idxs]
+            if hasattr(self.dataset, "labels")
+            else [self.dataset[i][1] for i in idxs],
+            dtype=np.int32,
+        )
         return images, labels
+
+    def _collate_native(self, idxs: np.ndarray) -> np.ndarray | None:
+        """Whole-batch decode through the C++ core (no per-sample Python),
+        when the dataset is file-backed and the native lib is built."""
+        if not hasattr(self.dataset, "image_path"):
+            return None
+        from ddl_tpu import native
+
+        if not native.native_available():
+            return None
+        paths = [self.dataset.image_path(int(i)) for i in idxs]
+        if not hasattr(self, "_hw"):
+            hw = native.image_size(paths[0])
+            if hw is None:
+                return None
+            self._hw = hw
+        h, w = self._hw
+        return native.load_batch(paths, h, w)
 
     def _batches(self) -> Iterator[np.ndarray]:
         idxs = np.asarray(list(self.sampler.indices()))

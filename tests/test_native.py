@@ -1,0 +1,76 @@
+"""Native C++ loader core: decode parity with PIL, batch path, fallbacks."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from ddl_tpu import native
+
+
+@pytest.fixture(scope="module")
+def png_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pngs")
+    rng = np.random.default_rng(0)
+    arrays = []
+    for i in range(6):
+        arr = rng.integers(0, 255, (24, 24, 3)).astype(np.uint8)
+        Image.fromarray(arr).save(d / f"img{i}.png")
+        arrays.append(arr)
+    return d, arrays
+
+
+needs_native = pytest.mark.skipif(
+    not native.native_available(), reason="native loader not built"
+)
+
+
+@needs_native
+def test_image_size(png_dir):
+    d, _ = png_dir
+    assert native.image_size(d / "img0.png") == (24, 24)
+
+
+@needs_native
+def test_batch_decode_matches_pil(png_dir):
+    d, arrays = png_dir
+    paths = [d / f"img{i}.png" for i in range(6)]
+    batch = native.load_batch(paths, 24, 24)
+    assert batch is not None and batch.shape == (6, 24, 24, 3)
+    for i, arr in enumerate(arrays):
+        np.testing.assert_array_equal(batch[i], arr)
+
+
+@needs_native
+def test_grayscale_and_palette_promoted_to_rgb(tmp_path):
+    gray = np.arange(0, 255, 255 // 16, dtype=np.uint8)[:16]
+    img = np.tile(gray, (16, 1))
+    Image.fromarray(img, mode="L").save(tmp_path / "gray.png")
+    batch = native.load_batch([tmp_path / "gray.png"], 16, 16)
+    assert batch is not None
+    np.testing.assert_array_equal(batch[0][..., 0], img)
+    np.testing.assert_array_equal(batch[0][..., 0], batch[0][..., 1])
+
+
+@needs_native
+def test_missing_file_fails_cleanly(tmp_path):
+    assert native.load_batch([tmp_path / "nope.png"], 8, 8) is None
+
+
+@needs_native
+def test_dataloader_uses_native_path(png_dir, tmp_path):
+    from ddl_tpu.data import AptosImageDataset, DataLoader
+
+    d, arrays = png_dir
+    with open(tmp_path / "meta.csv", "w") as f:
+        f.write("new_id_code,diagnosis\n")
+        for i in range(6):
+            f.write(f"img{i},{i % 5}\n")
+    ds = AptosImageDataset(tmp_path / "meta.csv", d, "new_id_code")
+    dl = DataLoader(ds, batch_size=3, shuffle=False, drop_last=True, num_workers=0)
+    batches = list(dl)
+    assert len(batches) == 2
+    images, labels = batches[0]
+    assert images.shape == (3, 24, 24, 3)
+    # order without shuffle is the identity permutation
+    np.testing.assert_array_equal(images[0], arrays[0])
+    assert list(labels) == [0, 1, 2]

@@ -100,6 +100,9 @@ class TrainConfig:
     # the saves themselves are commented out in the reference — here they work).
     save_best_qwk: bool = True
     log_gradient_stats: bool = False
+    # Capture a jax.profiler trace of one full epoch into this directory
+    # (the reference has only perf_counter timing — SURVEY.md section 5).
+    profile_dir: str | None = None
 
 
 @dataclass

@@ -31,7 +31,7 @@ from ddl_tpu.models.densenet import forward_stages
 from ddl_tpu.ops import cross_entropy_loss, normalize_images
 from ddl_tpu.train.state import TrainState
 
-__all__ = ["StepFns", "make_dp_step_fns"]
+__all__ = ["StepFns", "make_dp_step_fns", "make_grad_stats_fn"]
 
 
 class StepFns(NamedTuple):
@@ -87,3 +87,44 @@ def make_dp_step_fns(stages, tx: optax.GradientTransformation, mesh: Mesh, compu
         out_shardings=batch_sharding,
     )
     return StepFns(train=train, evaluate=evaluate)
+
+
+def make_grad_stats_fn(stages, mesh: Mesh, compute_dtype):
+    """Per-parameter |grad| statistics, computed on-device.
+
+    Observability parity with the reference's ``_log_gradient``
+    (``ddp.py:310-326``): min / mean / max / 25th / median / 75th / std of
+    the absolute gradient for every named parameter.  Returns
+    ``{qualified_name: (7,) float32}``; only the 7 summary scalars leave the
+    device (the reference pulls every full gradient tensor to host).
+    """
+
+    def stats_step(state: TrainState, images, labels):
+        x = normalize_images(images, compute_dtype)
+
+        def loss_fn(params):
+            logits, _ = forward_stages(
+                stages, params, state.batch_stats, x, train=True
+            )
+            return cross_entropy_loss(logits, labels)
+
+        grads = jax.grad(loss_fn)(state.params)
+
+        def summarize(g):
+            a = jnp.abs(g.astype(jnp.float32)).ravel()
+            q = jnp.quantile(a, jnp.asarray([0.25, 0.5, 0.75]))
+            return jnp.stack([a.min(), a.mean(), a.max(), q[0], q[1], q[2], a.std()])
+
+        return {
+            f"stage{i}/{jax.tree_util.keystr(path, simple=True, separator='/')}": summarize(g)
+            for i, stage_grads in enumerate(grads)
+            for path, g in jax.tree_util.tree_leaves_with_path(stage_grads)
+        }
+
+    replicated = NamedSharding(mesh, P())
+    batch_sharding = NamedSharding(mesh, P("data"))
+    return jax.jit(
+        stats_step,
+        in_shardings=(replicated, batch_sharding, batch_sharding),
+        out_shardings=replicated,
+    )

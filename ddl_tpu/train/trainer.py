@@ -83,6 +83,13 @@ class Trainer:
             self.step_fns = make_dp_step_fns(
                 self.stages, self.tx, self.mesh, compute_dtype
             )
+        self.grad_stats_fn = None
+        if cfg.train.log_gradient_stats and not pipelined:
+            from ddl_tpu.train.steps import make_grad_stats_fn
+
+            self.grad_stats_fn = make_grad_stats_fn(
+                self.stages, self.mesh, compute_dtype
+            )
 
         train_ds, test_ds = datasets if datasets is not None else build_datasets(cfg.data)
         # Host-level sharding (DistributedSampler analog, ddp.py:343): each
@@ -158,6 +165,10 @@ class Trainer:
         steps = 0
         for images, labels in self.train_loader:
             gi, gl = shard_batch(self.mesh, images, labels)
+            if self.grad_stats_fn is not None and self.is_logging_process:
+                # before the train step: it donates (consumes) self.state
+                stats = jax.device_get(self.grad_stats_fn(self.state, gi, gl))
+                self.logger.log_gradient_stats(stats, step=steps)
             self.state, loss, pred = self.step_fns.train(self.state, gi, gl)
             losses.append(loss)
             preds.append(pred)
@@ -189,10 +200,20 @@ class Trainer:
 
     def train(self, max_epochs: int | None = None) -> None:
         max_epochs = max_epochs or self.cfg.train.max_epochs
+        # Profile one post-warmup epoch when configured (the reference's only
+        # timing is perf_counter epoch walls, single.py:171-174; this captures
+        # a full XLA device trace instead).
+        profile_epoch = None
+        if self.cfg.train.profile_dir:
+            profile_epoch = min(self.epochs_run + 1, max_epochs - 1)
         for epoch in range(self.epochs_run, max_epochs):
+            if epoch == profile_epoch:
+                jax.profiler.start_trace(self.cfg.train.profile_dir)
             start = perf_counter()
             mean_loss, accuracy, steps = self._run_epoch(epoch)
             elapsed = perf_counter() - start
+            if epoch == profile_epoch:
+                jax.profiler.stop_trace()
             print(
                 f"Epoch {epoch} | Time: {elapsed:.2f}s | Steps: {steps} | "
                 f"Loss: {mean_loss:.4f} | Training Accuracy: {accuracy:.4f}"

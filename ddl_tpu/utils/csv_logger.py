@@ -72,24 +72,26 @@ class MetricLogger:
         Row schema follows reference ``ddp.py:325``:
         [timestamp, job_id, global_rank, local_rank, step, index, name,
          min, mean, max, p25, median, p75, std].
+
+        Accepts either raw gradient arrays (stats computed here, as the
+        reference does on host) or precomputed 7-vectors
+        [min, mean, max, p25, median, p75, std] from
+        ``ddl_tpu.train.steps.make_grad_stats_fn`` (stats computed on-device;
+        only 7 scalars per parameter cross the host boundary).
         """
         self.log_dir.mkdir(parents=True, exist_ok=True)
         now = datetime.now().strftime(_TS_FMT)
         with open(self.log_dir / "gradient.csv", "a", newline="") as f:
             writer = csv.writer(f)
             for i, (name, g) in enumerate(named_grads.items()):
-                a = np.abs(np.asarray(g, dtype=np.float64)).ravel()
-                if a.size == 0:
+                g = np.asarray(g, dtype=np.float64)
+                if g.size == 0:
                     continue
-                writer.writerow(
-                    [
-                        now,
-                        self.job_id,
-                        self.global_rank,
-                        self.local_rank,
-                        step,
-                        i,
-                        name,
+                if g.shape == (7,):
+                    stats = list(g)
+                else:
+                    a = np.abs(g).ravel()
+                    stats = [
                         a.min(),
                         a.mean(),
                         a.max(),
@@ -98,6 +100,9 @@ class MetricLogger:
                         np.quantile(a, 0.75),
                         a.std(),
                     ]
+                writer.writerow(
+                    [now, self.job_id, self.global_rank, self.local_rank, step, i, name]
+                    + stats
                 )
 
 

@@ -1,0 +1,59 @@
+"""Ops: normalize (jnp + pallas-interpret parity), loss functions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl_tpu.ops import cross_entropy_loss, normalize_images, softmax_cross_entropy
+
+
+def test_normalize_range_and_dtype():
+    imgs = np.array([[[[0, 128, 255]]]], np.uint8)
+    out = normalize_images(jnp.asarray(imgs), jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), [[[[0.0, 128 / 255, 1.0]]]], atol=1e-7)
+    assert out.dtype == jnp.float32
+
+
+def test_pallas_normalize_matches_reference():
+    from ddl_tpu.ops.pallas_image import pallas_normalize_images
+
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.integers(0, 255, (4, 16, 16, 3)), jnp.uint8)
+    got = pallas_normalize_images(imgs, jnp.float32, interpret=True)
+    want = normalize_images(imgs, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-7)
+
+
+def test_pallas_normalize_nondivisible_block():
+    from ddl_tpu.ops.pallas_image import pallas_normalize_images
+
+    rng = np.random.default_rng(1)
+    # F = 10*10*3 = 300, not a multiple of the 1536 block
+    imgs = jnp.asarray(rng.integers(0, 255, (2, 10, 10, 3)), jnp.uint8)
+    got = pallas_normalize_images(imgs, jnp.float32, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(normalize_images(imgs, jnp.float32)), atol=1e-7
+    )
+
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(32, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, 32)
+    want = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels)
+    ).item()
+    got = float(cross_entropy_loss(jnp.asarray(logits), jnp.asarray(labels)))
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_softmax_cross_entropy_gradient_is_softmax_minus_onehot():
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -1.0, 0.5]])
+    labels = jnp.asarray([2])
+    g = jax.grad(lambda l: softmax_cross_entropy(l, labels).sum())(logits)
+    p = np.exp(np.asarray(logits[0]))
+    p /= p.sum()
+    p[2] -= 1
+    np.testing.assert_allclose(np.asarray(g[0]), p, atol=1e-6)

@@ -56,6 +56,10 @@ class ModelConfig:
     compute_dtype: str = "float32"
     # Rematerialise stage activations in the pipeline backward (GPipe remat).
     remat: bool = True
+    # Optional torchvision state_dict (.pth) to initialise from — the
+    # ImageNet-pretrained start the reference uses (single.py:297); a
+    # mismatched classifier head is skipped (the head swap, single.py:298-299).
+    pretrained_path: str | None = None
 
 
 @dataclass

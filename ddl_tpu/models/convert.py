@@ -1,0 +1,103 @@
+"""Convert torchvision DenseNet state dicts to this framework's stage pytrees.
+
+The reference starts from ImageNet-pretrained torchvision weights
+(``models.densenet121(weights=IMAGENET1K_V1)``, reference ``single.py:297``)
+and swaps in a fresh 5-class head (``single.py:298-299``).  This module loads
+a saved torchvision ``state_dict`` (``.pth``, via torch on CPU) and maps it
+onto the staged Flax parameter/batch-stats tuples, so pretrained
+initialisation works here too:
+
+* module names were chosen to match torchvision's (``denseblock{b}``,
+  ``denselayer{l}``, ``norm1/conv1/norm2/conv2``, ``transition{t}``,
+  ``norm0/conv0/norm5``, ``classifier``), so the mapping is mechanical;
+* conv kernels transpose OIHW -> HWIO, linear weights (out,in) -> (in,out);
+* BatchNorm ``weight/bias`` -> ``scale/bias`` params and
+  ``running_mean/running_var`` -> ``mean/var`` batch stats;
+* a classifier whose shape disagrees (1000-class ImageNet head vs the
+  5-class config) is left at its fresh initialisation — exactly the
+  reference's head-swap behaviour.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+__all__ = ["convert_torch_state_dict", "load_torch_checkpoint"]
+
+
+def _torch_key(stage_path: tuple, is_stats: bool) -> str:
+    """Map a flax tree path inside one stage to the torchvision key."""
+    parts = [getattr(p, "key", str(p)) for p in stage_path]
+    *modules, leaf = parts
+    if modules and modules[0] == "classifier":
+        prefix = "classifier"
+        modules = modules[1:]
+    else:
+        prefix = "features" + ("." if modules else "")
+        prefix += ".".join(modules)
+    leaf_map = {
+        "kernel": "weight",
+        "scale": "weight",
+        "bias": "bias",
+        "mean": "running_mean",
+        "var": "running_var",
+    }
+    return f"{prefix}.{leaf_map[leaf]}"
+
+
+def _convert_leaf(torch_value: np.ndarray, flax_value) -> np.ndarray | None:
+    arr = np.asarray(torch_value)
+    want = tuple(flax_value.shape)
+    if arr.ndim == 4:  # conv OIHW -> HWIO
+        arr = arr.transpose(2, 3, 1, 0)
+    elif arr.ndim == 2:  # linear (out,in) -> (in,out)
+        arr = arr.T
+    if tuple(arr.shape) != want:
+        return None
+    return arr.astype(np.asarray(flax_value).dtype)
+
+
+def convert_torch_state_dict(
+    state_dict: Mapping[str, Any], params: tuple, batch_stats: tuple
+) -> tuple[tuple, tuple, list[str]]:
+    """Overlay a torchvision state dict onto staged (params, batch_stats).
+
+    Returns (params, batch_stats, skipped_keys); skipped keys are those whose
+    shapes disagree (e.g. the 1000-class classifier being replaced by the
+    5-class head) or that are absent from the state dict.
+    """
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    skipped: list[str] = []
+
+    def overlay(tree):
+        flat = jax.tree_util.tree_flatten_with_path(tree)
+        leaves, treedef = flat
+        out = []
+        for path, leaf in leaves:
+            key = _torch_key(path, is_stats=False)
+            if key in sd:
+                conv = _convert_leaf(sd[key], leaf)
+                if conv is not None:
+                    out.append(conv)
+                    continue
+            skipped.append(key)
+            out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    new_params = tuple(overlay(p) for p in params)
+    new_stats = tuple(overlay(s) for s in batch_stats)
+    return new_params, new_stats, skipped
+
+
+def load_torch_checkpoint(path: str, params: tuple, batch_stats: tuple):
+    """Load a ``.pth`` state dict (torch CPU) and overlay it."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    sd = {k: v.numpy() for k, v in sd.items()}
+    return convert_torch_state_dict(sd, params, batch_stats)

@@ -65,6 +65,15 @@ class Trainer:
         self.state = create_train_state(
             self.stages, self.tx, rng, cfg.data.image_size
         )
+        if cfg.model.pretrained_path:
+            from ddl_tpu.models.convert import load_torch_checkpoint
+
+            p, bs, skipped = load_torch_checkpoint(
+                cfg.model.pretrained_path, self.state.params, self.state.batch_stats
+            )
+            self.state = self.state.replace(params=p, batch_stats=bs)
+            if skipped:
+                print(f"[ddl_tpu] pretrained overlay skipped keys: {skipped}")
         compute_dtype = jnp.dtype(cfg.model.compute_dtype)
         if pipelined:
             from ddl_tpu.parallel.pipeline import make_pipeline_step_fns

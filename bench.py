@@ -27,7 +27,19 @@ BASELINE_STEPS_PER_SEC = 97 / 90.77  # best single-GPU reference run
 
 
 def main() -> None:
+    import os
+
     import jax
+
+    # Persistent compile cache: repeated bench runs (and the trainer) skip
+    # the ~30s DenseNet121 XLA compile.
+    cache_dir = os.environ.get("DDL_COMPILE_CACHE", "/tmp/ddl_tpu_xla_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     import jax.numpy as jnp
 
     from ddl_tpu.config import ModelConfig, TrainConfig
@@ -53,7 +65,7 @@ def main() -> None:
         state, loss, _ = fns.train(state, images, labels)
     jax.block_until_ready(state.params)
 
-    iters = 20
+    iters = int(os.environ.get("DDL_BENCH_ITERS", "20"))
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss, _ = fns.train(state, images, labels)

@@ -108,6 +108,8 @@ def collective_bandwidth(
         body, out_spec = (lambda v: lax.psum(v, "ring")), P("ring")
     elif op == "all_gather":
         body, out_spec = (lambda v: lax.all_gather(v, "ring", tiled=True)), P()
+    elif op == "reduce_scatter":
+        body, out_spec = (lambda v: lax.psum_scatter(v, "ring", tiled=True)), P("ring")
     elif op == "ppermute":
         body, out_spec = (lambda v: lax.ppermute(v, "ring", ring)), P("ring")
     else:
@@ -130,7 +132,10 @@ def collective_bandwidth(
     if op == "psum":
         moved = 2 * (n - 1) / n * payload_bytes
     elif op == "all_gather":
+        # per-device shard is payload_bytes; gathered result n * payload
         moved = (n - 1) / n * (payload_bytes * n)
+    elif op == "reduce_scatter":
+        moved = (n - 1) / n * payload_bytes
     else:
         moved = payload_bytes
     return {
@@ -162,7 +167,7 @@ def run_comm_bench(
                 f.write(f"{job_id},{i},{t}\n")
         summary["ping_pong_mean_ms"] = pp.mean_ms
         summary["ping_pong_one_way_gbps"] = pp.one_way_gbps
-        for op in ("psum", "all_gather", "ppermute"):
+        for op in ("psum", "all_gather", "reduce_scatter", "ppermute"):
             r = collective_bandwidth(op)
             summary[f"{op}_gbps"] = r["algbw_gbps"]
             summary[f"{op}_ms"] = r["mean_ms"]

@@ -1,0 +1,11 @@
+"""Communication microbenchmark — the reference ``communication_time.py``.
+
+Equivalent to: ``python -m ddl_tpu.bench.comm``
+"""
+
+import json
+
+from ddl_tpu.bench.comm import run_comm_bench
+
+if __name__ == "__main__":
+    print(json.dumps(run_comm_bench(), indent=2))

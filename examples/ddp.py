@@ -1,0 +1,12 @@
+"""Data-parallel training — the reference ``ddp.py`` config.
+
+Equivalent to: ``python -m ddl_tpu.cli --preset dp``
+(mesh.data defaults to 2; per-replica batch 15 as in the reference).
+"""
+
+import sys
+
+from ddl_tpu.cli import main
+
+if __name__ == "__main__":
+    main(["--preset", "dp", *sys.argv[1:]])

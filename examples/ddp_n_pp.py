@@ -1,0 +1,12 @@
+"""Hybrid DP x PP on a (3,2) (data,pipe) mesh — the reference ``ddp_n_pp.py``
+config (the north-star composition).
+
+Equivalent to: ``python -m ddl_tpu.cli --preset dp_pp``
+"""
+
+import sys
+
+from ddl_tpu.cli import main
+
+if __name__ == "__main__":
+    main(["--preset", "dp_pp", *sys.argv[1:]])

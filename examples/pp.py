@@ -1,0 +1,11 @@
+"""2-stage GPipe pipeline-parallel training — the reference ``pp.py`` config.
+
+Equivalent to: ``python -m ddl_tpu.cli --preset pp``
+"""
+
+import sys
+
+from ddl_tpu.cli import main
+
+if __name__ == "__main__":
+    main(["--preset", "pp", *sys.argv[1:]])

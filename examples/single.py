@@ -1,0 +1,9 @@
+"""Single-device training — the reference ``single.py`` config.
+
+Equivalent to: ``python -m ddl_tpu.cli --preset single``
+"""
+
+from ddl_tpu.cli import main
+
+if __name__ == "__main__":
+    main(["--preset", "single"])

@@ -113,7 +113,7 @@ class TestCommBench:
         assert np.isfinite(r.mean_ms) and r.mean_ms > 0
         assert r.one_way_gbps > 0
 
-    @pytest.mark.parametrize("op", ["psum", "all_gather", "ppermute"])
+    @pytest.mark.parametrize("op", ["psum", "all_gather", "reduce_scatter", "ppermute"])
     def test_collective_bandwidth(self, op):
         from ddl_tpu.bench.comm import collective_bandwidth
 

@@ -56,6 +56,10 @@ class ModelConfig:
     compute_dtype: str = "float32"
     # Rematerialise stage activations in the pipeline backward (GPipe remat).
     remat: bool = True
+    # Use the Pallas normalize kernel (ops/pallas_image.py) instead of the
+    # jnp path (which XLA fuses into the stem conv). Off by default; useful
+    # for A/B timing on real hardware.
+    pallas_normalize: bool = False
     # Optional torchvision state_dict (.pth) to initialise from — the
     # ImageNet-pretrained start the reference uses (single.py:297); a
     # mismatched classifier head is skipped (the head swap, single.py:298-299).
@@ -103,6 +107,9 @@ class TrainConfig:
     # Save a snapshot when validation QWK improves (reference ddp.py:292-295;
     # the saves themselves are commented out in the reference — here they work).
     save_best_qwk: bool = True
+    # Failure detection (absent in the reference — SURVEY.md section 5): halt
+    # with a clear diagnostic when the training loss goes non-finite.
+    halt_on_nan: bool = True
     log_gradient_stats: bool = False
     # Capture a jax.profiler trace of one full epoch into this directory
     # (the reference has only perf_counter timing — SURVEY.md section 5).

@@ -1,4 +1,20 @@
 from ddl_tpu.ops.image import normalize_images
 from ddl_tpu.ops.losses import cross_entropy_loss, softmax_cross_entropy
 
-__all__ = ["normalize_images", "cross_entropy_loss", "softmax_cross_entropy"]
+
+def get_normalizer(use_pallas: bool = False):
+    """Select the image-normalize implementation (jnp default; Pallas kernel
+    when requested — see ops/pallas_image.py)."""
+    if use_pallas:
+        from ddl_tpu.ops.pallas_image import pallas_normalize_images
+
+        return pallas_normalize_images
+    return normalize_images
+
+
+__all__ = [
+    "normalize_images",
+    "cross_entropy_loss",
+    "softmax_cross_entropy",
+    "get_normalizer",
+]

@@ -42,9 +42,15 @@ class StepFns(NamedTuple):
     evaluate: Callable
 
 
-def make_dp_step_fns(stages, tx: optax.GradientTransformation, mesh: Mesh, compute_dtype) -> StepFns:
+def make_dp_step_fns(
+    stages,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    compute_dtype,
+    normalizer=normalize_images,
+) -> StepFns:
     def train_step(state: TrainState, images, labels):
-        x = normalize_images(images, compute_dtype)
+        x = normalizer(images, compute_dtype)
 
         def loss_fn(params):
             logits, new_stats = forward_stages(
@@ -66,7 +72,7 @@ def make_dp_step_fns(stages, tx: optax.GradientTransformation, mesh: Mesh, compu
         return new_state, loss, jnp.argmax(logits, axis=-1)
 
     def eval_step(state: TrainState, images):
-        x = normalize_images(images, compute_dtype)
+        x = normalizer(images, compute_dtype)
         logits, _ = forward_stages(
             stages, state.params, state.batch_stats, x, train=False
         )

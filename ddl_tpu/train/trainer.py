@@ -89,8 +89,14 @@ class Trainer:
                 remat=cfg.model.remat,
             )
         else:
+            from ddl_tpu.ops import get_normalizer
+
             self.step_fns = make_dp_step_fns(
-                self.stages, self.tx, self.mesh, compute_dtype
+                self.stages,
+                self.tx,
+                self.mesh,
+                compute_dtype,
+                normalizer=get_normalizer(cfg.model.pallas_normalize),
             )
         self.grad_stats_fn = None
         if cfg.train.log_gradient_stats and not pipelined:
@@ -223,6 +229,12 @@ class Trainer:
             elapsed = perf_counter() - start
             if epoch == profile_epoch:
                 jax.profiler.stop_trace()
+            if self.cfg.train.halt_on_nan and not np.isfinite(mean_loss):
+                raise RuntimeError(
+                    f"Non-finite training loss {mean_loss} at epoch {epoch} "
+                    f"(step {int(self.state.step)}); halting. Last snapshot: "
+                    f"{ckpt.latest_epoch(self.cfg.train.checkpoint_dir, self.job_id)}"
+                )
             print(
                 f"Epoch {epoch} | Time: {elapsed:.2f}s | Steps: {steps} | "
                 f"Loss: {mean_loss:.4f} | Training Accuracy: {accuracy:.4f}"

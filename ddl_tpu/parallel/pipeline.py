@@ -156,12 +156,14 @@ def make_pipeline_step_fns(
                 logits_acc,
             )
 
-            # Ring-shift boundary activations one stage forward (stage
-            # handoff; the transpose of this op is the backward handoff).
-            bufs_rot = lax.ppermute(
-                bufs_out,
-                PIPE_AXIS,
-                [(j, (j + 1) % n_stages) for j in range(n_stages)],
+            # Stage handoff: boundary slot i only ever flows device i ->
+            # i+1, so each slot gets a single-pair permute (P-1 point-to-
+            # point transfers per tick) rather than riding the whole ring;
+            # devices outside the pair receive zeros, which nothing reads.
+            # The transpose of this op is the backward-pass handoff.
+            bufs_rot = tuple(
+                lax.ppermute(b, PIPE_AXIS, [(i, i + 1)])
+                for i, b in enumerate(bufs_out)
             )
             return (bufs_rot, stats_out, logits_acc, loss_acc), None
 

@@ -243,6 +243,9 @@ class Trainer:
                 self.logger.log("loss", mean_loss, epoch)
                 self.logger.log("train_accuracy", accuracy, epoch)
                 self.logger.log("epoch_time", elapsed, epoch)
+                # steps/sec/chip is BASELINE.json's target metric; the
+                # reference only logs epoch_time (steps derived offline).
+                self.logger.log("steps_per_sec", steps / elapsed, epoch)
 
             metrics = self.evaluate(epoch)
             print(

@@ -22,7 +22,13 @@ import orbax.checkpoint as ocp
 
 from ddl_tpu.train.state import TrainState
 
-__all__ = ["save_snapshot", "load_snapshot", "snapshot_path", "latest_epoch"]
+__all__ = [
+    "save_snapshot",
+    "load_snapshot",
+    "snapshot_path",
+    "latest_epoch",
+    "SnapshotManager",
+]
 
 
 def snapshot_path(checkpoint_dir: str | os.PathLike, job_id: str, epoch: int) -> Path:
@@ -52,6 +58,36 @@ def load_snapshot(
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(path, {"state": abstract, "epoch": 0})
     return restored["state"], int(restored["epoch"]) + 1
+
+
+class SnapshotManager:
+    """Asynchronous snapshot writer (SURVEY.md section 5: the TPU-native
+    equivalent of DCP is *async* sharded checkpointing — training continues
+    while the previous snapshot commits to storage in the background)."""
+
+    def __init__(self, checkpoint_dir: str | os.PathLike, job_id: str) -> None:
+        self.checkpoint_dir = checkpoint_dir
+        self.job_id = job_id
+        self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+
+    def save(self, epoch: int, state: TrainState) -> Path:
+        path = snapshot_path(self.checkpoint_dir, self.job_id, epoch)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        # one outstanding save at a time: wait for the previous commit
+        self._ckptr.wait_until_finished()
+        self._ckptr.save(
+            path,
+            args=ocp.args.StandardSave({"state": state, "epoch": epoch}),
+            force=True,
+        )
+        return path
+
+    def wait(self) -> None:
+        self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self._ckptr.wait_until_finished()
+        self._ckptr.close()
 
 
 def latest_epoch(checkpoint_dir: str | os.PathLike, job_id: str) -> int | None:

@@ -107,6 +107,8 @@ class TrainConfig:
     # Save a snapshot when validation QWK improves (reference ddp.py:292-295;
     # the saves themselves are commented out in the reference — here they work).
     save_best_qwk: bool = True
+    # Commit snapshots asynchronously (training continues during the write).
+    async_checkpoint: bool = True
     # Failure detection (absent in the reference — SURVEY.md section 5): halt
     # with a clear diagnostic when the training loss goes non-finite.
     halt_on_nan: bool = True

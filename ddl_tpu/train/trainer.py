@@ -148,6 +148,7 @@ class Trainer:
         self.is_logging_process = proc == 0
         self.epochs_run = 0
         self.best_qwk = -1.0
+        self._snapshot_mgr = None
         if cfg.train.snapshot_job_id is not None:
             self._load_snapshot()
 
@@ -166,9 +167,16 @@ class Trainer:
         print(f"Resuming training from epoch {self.epochs_run}")
 
     def _save_snapshot(self, epoch: int) -> None:
-        path = ckpt.save_snapshot(
-            self.cfg.train.checkpoint_dir, self.job_id, epoch, self.state
-        )
+        if self.cfg.train.async_checkpoint:
+            if self._snapshot_mgr is None:
+                self._snapshot_mgr = ckpt.SnapshotManager(
+                    self.cfg.train.checkpoint_dir, self.job_id
+                )
+            path = self._snapshot_mgr.save(epoch, self.state)
+        else:
+            path = ckpt.save_snapshot(
+                self.cfg.train.checkpoint_dir, self.job_id, epoch, self.state
+            )
         print(f"Epoch {epoch} | Saved snapshot to {path}")
 
     # ------------------------------------------------------------------
@@ -261,3 +269,5 @@ class Trainer:
                 print(f"New Best Validation QWK: {self.best_qwk:.4f}")
                 self._save_snapshot(epoch)
             self.epochs_run = epoch + 1
+        if self._snapshot_mgr is not None:
+            self._snapshot_mgr.wait()

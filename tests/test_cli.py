@@ -1,0 +1,39 @@
+"""CLI end-to-end: preset + dotted overrides drive a full tiny training run."""
+
+import numpy as np
+
+from ddl_tpu.utils.csv_logger import read_metric_csv
+
+
+def test_cli_single_end_to_end(tmp_path, monkeypatch):
+    from ddl_tpu import cli
+
+    monkeypatch.setenv("DDL_JOB_ID", "single-clitest")
+    cli.main(
+        [
+            "--preset",
+            "single",
+            "--set",
+            "model.growth_rate=4",
+            "model.block_config=[2,2]",
+            "model.num_init_features=8",
+            "model.bn_size=2",
+            "model.split_blocks=[1]",
+            "model.remat=false",
+            "data.image_size=16",
+            "data.synthetic_num_train=32",
+            "data.synthetic_num_test=16",
+            "data.global_batch_size=8",
+            "data.eval_batch_size=8",
+            "data.num_workers=0",
+            "train.max_epochs=1",
+            f"train.log_dir={tmp_path}/logs",
+            f"train.checkpoint_dir={tmp_path}/ckpt",
+        ]
+    )
+    rows = read_metric_csv(tmp_path / "logs" / "by_job_id" / "single-clitest" / "loss.csv")
+    assert len(rows) == 1 and np.isfinite(rows[0]["value"])
+    sps = read_metric_csv(
+        tmp_path / "logs" / "by_job_id" / "single-clitest" / "steps_per_sec.csv"
+    )
+    assert sps[0]["value"] > 0

@@ -1,0 +1,110 @@
+"""Ring attention: sequence/context parallelism over a ``seq`` mesh axis.
+
+The reference workload is a CNN with no sequence dimension, but this
+framework treats long-context scaling as a first-class capability of the
+communication backend (the same ``shard_map`` + ``ppermute`` machinery that
+drives the pipeline schedule in ``parallel/pipeline.py``).  Sequences are
+sharded over a ``seq`` mesh axis; each device holds its Q shard permanently
+while K/V shards rotate around the ring, one hop per step, overlapping the
+next hop's transfer with the current block's attention compute.  Softmax is
+accumulated online (running row-max / row-sum, flash-attention style), so
+attention over a sequence of length ``n_dev * T_local`` never materialises
+more than a ``T_local x T_local`` score block per device — memory per device
+is O(T_local), enabling context lengths far beyond single-chip HBM.
+
+Causal masking works on *global* positions: the Q shard of ring position
+``s`` attends to the K/V block that originated at position ``(s - i) mod n``
+at rotation step ``i``; blocks entirely in the future are masked out (their
+compute still runs — uniform SPMD program — but contributes nothing).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention", "make_ring_self_attention"]
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, mask, scale):
+    """One Q-shard x KV-block attention with unnormalised accumulation.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D); mask: (Tq, Tk) bool (True = keep).
+    Returns (block_acc (B,Tq,H,D), block_max (B,H,Tq), block_sum (B,H,Tq)).
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    blk_max = scores.max(axis=-1)
+    p = jnp.exp(scores - blk_max[..., None])
+    # rows with no visible keys: blk_max = -inf -> p would be exp(0)=1; zero them
+    p = jnp.where(mask[None, None], p, 0.0)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return acc, blk_max, p.sum(axis=-1)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Attention over a ring-sharded sequence (call inside ``shard_map``).
+
+    Per-device shapes: q, k, v: (B, T_local, H, D) — the local sequence
+    shard.  Returns the local output shard (B, T_local, H, D), numerically
+    equal to full softmax attention over the global sequence.
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    # ring: receive the next block from the left neighbour each step
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    local_pos = jnp.arange(t)
+
+    def step(carry, i):
+        k_blk, v_blk, acc, row_max, row_sum = carry
+        src = (s - i) % n  # ring position this K/V block originated from
+        if causal:
+            q_pos = s * t + local_pos
+            kv_pos = src * t + local_pos
+            mask = kv_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = jnp.ones((t, t), bool)
+        blk_acc, blk_max, blk_sum = _block_attention(q, k_blk, v_blk, mask, scale)
+        new_max = jnp.maximum(row_max, blk_max)
+        old_corr = jnp.exp(row_max - new_max)
+        blk_corr = jnp.exp(blk_max - new_max)
+        acc = acc * old_corr.transpose(0, 2, 1)[..., None] + (
+            blk_acc * blk_corr.transpose(0, 2, 1)[..., None]
+        )
+        row_sum = row_sum * old_corr + blk_sum * blk_corr
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, acc, new_max, row_sum), None
+
+    init = (
+        k,
+        v,
+        jnp.zeros_like(q),
+        jnp.full((b, h, t), _NEG_INF, q.dtype),
+        jnp.zeros((b, h, t), q.dtype),
+    )
+    (k, v, acc, row_max, row_sum), _ = lax.scan(step, init, jnp.arange(n))
+    denom = jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
+    return acc / denom
+
+
+def make_ring_self_attention(mesh: Mesh, axis_name: str = "seq", causal: bool = False):
+    """Jitted global-array entry point: (B, T, H, D) q/k/v sharded over T."""
+    spec = P(None, axis_name)
+
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(fn)

@@ -1,0 +1,62 @@
+"""Ring attention vs full-sequence softmax attention (exact parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from ddl_tpu.parallel.ring_attention import make_ring_self_attention
+
+B, T, H, D = 2, 32, 3, 8  # global sequence length T over 4 devices
+
+
+def full_attention(q, k, v, causal):
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        tq = np.arange(T)
+        scores = np.where(tq[None, :] <= tq[:, None], scores, -np.inf)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    return tuple(
+        rng.normal(size=(B, T, H, D)).astype(np.float32) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n_dev", [2, 4, 8])
+def test_ring_matches_full(qkv, causal, n_dev):
+    q, k, v = qkv
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
+    fn = make_ring_self_attention(mesh, causal=causal)
+    out = np.asarray(fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    want = full_attention(q, k, v, causal)
+    np.testing.assert_allclose(out, want, atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_differentiable():
+    """Grad flows through the ring (the training path for long-context)."""
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 16, 2, 4)), jnp.float32) for _ in range(3))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    fn = make_ring_self_attention(mesh, causal=True)
+
+    g = jax.grad(lambda a, b, c: fn(a, b, c).sum())(q, k, v)
+    assert g.shape == q.shape and bool(jnp.isfinite(g).all())
+
+    # compare against grad of dense reference
+    def dense(a, b, c):
+        scores = jnp.einsum("bqhd,bkhd->bhqk", a, b) / 2.0
+        tq = jnp.arange(16)
+        scores = jnp.where(tq[None, :] <= tq[:, None], scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, c).sum()
+
+    g_ref = jax.grad(dense)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=3e-5, rtol=1e-3)

@@ -1,3 +1,4 @@
+from ddl_tpu.models.transformer import LMConfig, TransformerLM, count_lm_params
 from ddl_tpu.models.densenet import (
     DenseNetStage,
     StageSpec,
@@ -10,6 +11,9 @@ from ddl_tpu.models.densenet import (
 )
 
 __all__ = [
+    "LMConfig",
+    "TransformerLM",
+    "count_lm_params",
     "DenseNetStage",
     "StageSpec",
     "apply_stage",

@@ -1,0 +1,356 @@
+"""Decoder-only Transformer LM — the long-context model family.
+
+The reference trains exactly one model family, a CNN classifier
+(``single.py:297-299``), whose parallelism surface is DP x PP.  This module
+is the capability the reference's design cannot express: a sequence model
+whose sharding exercises every remaining mesh axis — tensor parallelism
+(attention heads / MLP hidden / vocab over ``model``), sequence/context
+parallelism (ring attention over ``seq``, ``parallel/ring_attention.py``),
+expert parallelism (MoE expert dimension over ``expert``), and FSDP-style
+parameter sharding (over ``data``) — all expressed as logical axis
+annotations resolved by the rule table in ``parallel/sharding.py``.
+
+Architecture: pre-RMSNorm blocks, rotary position embeddings, causal
+attention, GELU MLP or a GShard-style top-k mixture-of-experts with token
+capacity and a load-balancing auxiliary loss.  Params are float32 masters
+with bfloat16 compute (TPU MXU-native); the loss-side logits are returned in
+float32.
+
+No torch/CUDA analog exists in the reference; parity citations therefore
+point at the subsystems this family plugs into: the mesh backbone
+(SURVEY.md §2 C10), the trainer (C3), and the checkpointing layout (C8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LMConfig", "TransformerLM", "count_lm_params"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    vocab_size: int = 256  # byte-level by default
+    d_model: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    head_dim: int = 32
+    d_ff: int = 1024
+    # MoE: 0 = dense MLP in every block; >0 = every block is a top-k MoE
+    # with this many experts.
+    num_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.5
+    moe_aux_weight: float = 0.01
+    rope_theta: float = 10000.0
+    compute_dtype: str = "bfloat16"
+    # 'dense': plain softmax attention, XLA partitions it (fine for short
+    # sequences).  'ring': inject a ring-attention core via
+    # ``TransformerLM(attn_core=...)`` for sequence lengths beyond one
+    # device's HBM.
+    attn_impl: str = "dense"
+    remat: bool = True
+    fsdp: bool = False
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def _rope(x, theta: float):
+    """Rotary embeddings over global positions. x: (B, T, H, D)."""
+    _, t, _, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+        return (y * scale).astype(self.dtype)
+
+
+def _dense_attention(q, k, v):
+    """Plain causal softmax attention; XLA partitions the sharded einsums."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype)
+    )
+    t = q.shape[1]
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: LMConfig
+    attn_core: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        # kernels are flat (embed, heads*head_dim) with the fused dim sharded
+        # over 'model' — identical placement to a per-head split, one matmul.
+        qkv_kernel = nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), ("embed", "heads")
+        )
+
+        def proj(name):
+            y = nn.Dense(
+                cfg.n_heads * cfg.head_dim,
+                use_bias=False,
+                dtype=cfg.dtype,
+                param_dtype=jnp.float32,
+                kernel_init=qkv_kernel,
+                name=name,
+            )(x)
+            return y.reshape(b, t, cfg.n_heads, cfg.head_dim)
+
+        q, k, v = proj("q"), proj("k"), proj("v")
+        q = _rope(q, cfg.rope_theta)
+        k = _rope(k, cfg.rope_theta)
+        spec = ("batch", "act_seq", "act_heads", None)
+        q = nn.with_logical_constraint(q, spec)
+        k = nn.with_logical_constraint(k, spec)
+        v = nn.with_logical_constraint(v, spec)
+        core = self.attn_core if self.attn_core is not None else _dense_attention
+        o = nn.with_logical_constraint(core(q, k, v), spec)
+        out = nn.Dense(
+            cfg.d_model,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "embed")
+            ),
+            name="out",
+        )(o.reshape(b, t, cfg.n_heads * cfg.head_dim))
+        return nn.with_logical_constraint(out, ("batch", "act_seq", "act_embed"))
+
+
+class Mlp(nn.Module):
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(
+            cfg.d_ff,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "mlp")
+            ),
+            name="wi",
+        )(x)
+        h = nn.with_logical_constraint(
+            nn.gelu(h), ("batch", "act_seq", "act_mlp")
+        )
+        out = nn.Dense(
+            cfg.d_model,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("mlp", "embed")
+            ),
+            name="wo",
+        )(h)
+        return nn.with_logical_constraint(out, ("batch", "act_seq", "act_embed"))
+
+
+def _top_k_dispatch(gates, k: int, capacity: int):
+    """GShard-style top-k routing with per-group token capacity.
+
+    gates: (B, S, E) router probabilities.  Returns (dispatch, combine),
+    both (B, S, E, C): dispatch is a 0/1 routing tensor, combine carries the
+    (renormalised) gate weights.  Tokens claim expert slots in priority
+    order (choice rank, then position); overflow tokens are dropped —
+    uniform static shapes, no data-dependent control flow.
+    """
+    b, s, e = gates.shape
+    g = gates
+    dispatch = jnp.zeros((b, s, e, capacity), gates.dtype)
+    combine = jnp.zeros((b, s, e, capacity), gates.dtype)
+    counts = jnp.zeros((b, e), gates.dtype)
+    selected_mass = jnp.zeros((b, s), gates.dtype)
+    for _ in range(k):
+        idx = jnp.argmax(g, axis=-1)  # (B, S)
+        onehot = jax.nn.one_hot(idx, e, dtype=gates.dtype)
+        gate_j = (g * onehot).sum(-1)  # (B, S)
+        pos = jnp.cumsum(onehot, axis=1) - 1 + counts[:, None, :]  # (B, S, E)
+        counts = counts + onehot.sum(axis=1)
+        pos_tok = (pos * onehot).sum(-1)  # (B, S)
+        keep = (pos_tok < capacity).astype(gates.dtype)
+        pos_oh = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity, dtype=gates.dtype)
+        d = onehot[..., None] * pos_oh[:, :, None, :] * keep[..., None, None]
+        dispatch = dispatch + d
+        combine = combine + d * gate_j[..., None, None]
+        selected_mass = selected_mass + gate_j * keep
+        g = g * (1.0 - onehot)
+    combine = combine / jnp.maximum(selected_mass, 1e-9)[..., None, None]
+    return dispatch, combine
+
+
+class MoeMlp(nn.Module):
+    """Top-k mixture-of-experts MLP with expert parallelism.
+
+    Experts live sharded over the ``expert`` mesh axis (and their hidden dim
+    over ``model`` — EP x TP); tokens are batch-sharded over ``data``.  The
+    dispatch/combine einsums change an array's sharded dimension from
+    token-sharded to expert-sharded, so XLA's partitioner lowers them to the
+    all-to-all exchanges that GShard/Switch implement by hand.
+    """
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        e = cfg.num_experts
+        capacity = max(
+            1, int(cfg.expert_top_k * s * cfg.capacity_factor / e)
+        )
+        # router in f32 for a stable softmax/argsort
+        router_logits = nn.Dense(
+            e,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "expert")
+            ),
+            name="router",
+        )(x.astype(jnp.float32))
+        gates = jax.nn.softmax(router_logits, axis=-1)  # (B, S, E)
+        dispatch, combine = _top_k_dispatch(gates, cfg.expert_top_k, capacity)
+
+        # Switch-transformer load-balance loss: E * sum_e f_e * p_e where
+        # f_e = fraction of tokens whose slot-0 choice is e, p_e = mean gate.
+        frac = dispatch.sum(-1).mean(axis=(0, 1))  # (E,) dispatched fraction
+        mean_gate = gates.mean(axis=(0, 1))
+        aux_loss = e * jnp.sum(frac / cfg.expert_top_k * mean_gate)
+
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                ("expert", "embed", "mlp"),
+            ),
+            (e, d, cfg.d_ff),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                ("expert", "mlp", "embed"),
+            ),
+            (e, cfg.d_ff, d),
+            jnp.float32,
+        )
+        dt = cfg.dtype
+        xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(dt), x.astype(dt))
+        xe = nn.with_logical_constraint(
+            xe, ("act_expert", "batch", None, "act_embed")
+        )
+        h = nn.gelu(jnp.einsum("ebcd,edf->ebcf", xe, wi.astype(dt)))
+        h = nn.with_logical_constraint(h, ("act_expert", "batch", None, "act_mlp"))
+        ye = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(dt))
+        ye = nn.with_logical_constraint(
+            ye, ("act_expert", "batch", None, "act_embed")
+        )
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(dt), ye)
+        y = nn.with_logical_constraint(y, ("batch", "act_seq", "act_embed"))
+        return y, aux_loss
+
+
+class Block(nn.Module):
+    cfg: LMConfig
+    attn_core: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = x + Attention(cfg, self.attn_core, name="attn")(
+            RMSNorm(cfg.dtype, name="norm_attn")(x)
+        )
+        h = RMSNorm(cfg.dtype, name="norm_mlp")(x)
+        if cfg.num_experts > 0:
+            y, aux = MoeMlp(cfg, name="moe")(h)
+        else:
+            y, aux = Mlp(cfg, name="mlp")(h), jnp.zeros((), jnp.float32)
+        return x + y, aux
+
+
+class TransformerLM(nn.Module):
+    """tokens (B, T) int32 -> (logits (B, T, V) f32, moe_aux_loss scalar)."""
+
+    cfg: LMConfig
+    attn_core: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )
+        x = embed(tokens)
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block)
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.n_layers):
+            x, aux = block(cfg, self.attn_core, name=f"block{i}")(x)
+            aux_total = aux_total + aux
+        x = RMSNorm(cfg.dtype, name="norm_f")(x)
+        logits = nn.Dense(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )(x.astype(jnp.float32))
+        logits = nn.with_logical_constraint(
+            logits, ("batch", "act_seq", "act_vocab")
+        )
+        return logits, aux_total
+
+
+def count_lm_params(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

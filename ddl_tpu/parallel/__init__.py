@@ -1,3 +1,10 @@
 from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
+from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
 
-__all__ = ["MeshSpec", "build_mesh"]
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "LMMeshSpec",
+    "build_lm_mesh",
+    "lm_logical_rules",
+]

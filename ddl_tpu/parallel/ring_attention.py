@@ -96,10 +96,23 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     return acc / denom
 
 
-def make_ring_self_attention(mesh: Mesh, axis_name: str = "seq", causal: bool = False):
-    """Jitted global-array entry point: (B, T, H, D) q/k/v sharded over T."""
-    spec = P(None, axis_name)
+def make_ring_self_attention(
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = False,
+    spec: P | None = None,
+    jit: bool = True,
+):
+    """Global-array entry point: (B, T, H, D) q/k/v sharded over T.
 
+    ``spec`` is the per-argument PartitionSpec; the default shards only the
+    sequence dim.  Pass e.g. ``P('data', 'seq', 'model', None)`` to also keep
+    batch local per data shard and heads local per model shard (head-parallel
+    attention needs no cross-head collective) — the core used inside the
+    transformer LM train step (``train/lm_steps.py``).
+    """
+    if spec is None:
+        spec = P(None, axis_name)
     fn = jax.shard_map(
         partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh,
@@ -107,4 +120,4 @@ def make_ring_self_attention(mesh: Mesh, axis_name: str = "seq", causal: bool = 
         out_specs=spec,
         check_vma=False,
     )
-    return jax.jit(fn)
+    return jax.jit(fn) if jit else fn

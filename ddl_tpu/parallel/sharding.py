@@ -1,0 +1,106 @@
+"""Logical-axis sharding rules: the TP / SP / EP / FSDP partitioning table.
+
+The reference framework has exactly one collective composition, assembled by
+hand out of NCCL subgroups (``ddp_n_pp.py:139-155``).  Here partitioning is a
+*table*: every parameter and activation in the transformer family
+(``models/transformer.py``) is annotated with logical axis names
+(``flax.linen.with_logical_partitioning`` / ``nn.with_logical_constraint``),
+and this module maps those names onto mesh axes.  Changing the parallelism
+strategy — pure DP, 2-D tensor parallelism, expert parallelism, FSDP-style
+parameter sharding, or any combination — is a rule-table edit, not a code
+change; XLA's SPMD partitioner then inserts the collectives
+(all-reduce for TP sums, all-to-all for expert dispatch, all-gather /
+reduce-scatter for FSDP) and routes them over ICI.
+
+Mesh axes (``build_lm_mesh``):
+    data    — batch / gradient data parallelism (and FSDP param sharding)
+    seq     — sequence/context parallelism (ring attention,
+              ``parallel/ring_attention.py``)
+    model   — tensor parallelism (attention heads, MLP hidden, vocab)
+    expert  — expert parallelism (MoE expert dimension)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "LMMeshSpec",
+    "build_lm_mesh",
+    "lm_logical_rules",
+    "SEQ_AXIS",
+    "MODEL_AXIS",
+    "EXPERT_AXIS",
+]
+
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
+
+
+@dataclasses.dataclass(frozen=True)
+class LMMeshSpec:
+    """4-axis mesh for the transformer family: (data, seq, model, expert)."""
+
+    data: int = 1
+    seq: int = 1
+    model: int = 1
+    expert: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.seq * self.model * self.expert
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return ("data", SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS)
+
+
+def build_lm_mesh(spec: LMMeshSpec, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """``model`` innermost so TP all-reduces ride the shortest ICI hops;
+    ``data`` outermost so gradient reduction can cross DCN (the same
+    inner/outer split as the (data, pipe) mesh, ``parallel/mesh.py``)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = spec.num_devices
+    if len(devices) < need:
+        raise ValueError(f"mesh {spec} needs {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(
+        spec.data, spec.seq, spec.expert, spec.model
+    )
+    # axis order in the Mesh matches axis_names: (data, seq, model, expert);
+    # physically, model varies fastest, then expert, then seq, then data.
+    return Mesh(grid.transpose(0, 1, 3, 2), spec.axis_names)
+
+
+def lm_logical_rules(fsdp: bool = False) -> tuple[tuple[str, str | None], ...]:
+    """Logical-name → mesh-axis table for the transformer family.
+
+    With ``fsdp=True`` the ``embed`` parameter dimension is additionally
+    sharded over ``data`` (ZeRO-3-style: params/optimizer state live sharded;
+    XLA all-gathers them per layer in forward/backward and reduce-scatters
+    the gradients — absent from the reference, whose DDP keeps full replicas,
+    SURVEY.md §2.3).
+    """
+    return (
+        # activations
+        ("batch", "data"),
+        ("act_seq", SEQ_AXIS),
+        ("act_embed", None),
+        ("act_heads", MODEL_AXIS),
+        ("act_mlp", MODEL_AXIS),
+        ("act_vocab", MODEL_AXIS),
+        ("act_expert", EXPERT_AXIS),
+        # parameters
+        ("embed", "data" if fsdp else None),
+        ("vocab", MODEL_AXIS),
+        ("heads", MODEL_AXIS),
+        ("head_dim", None),
+        ("mlp", MODEL_AXIS),
+        ("expert", EXPERT_AXIS),
+        ("norm", None),
+    )

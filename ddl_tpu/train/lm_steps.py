@@ -1,0 +1,191 @@
+"""Jitted train/eval steps for the transformer LM family.
+
+One jitted SPMD program per step, exactly like the CNN path
+(``train/steps.py``), but over the 4-axis ``(data, seq, model, expert)``
+mesh (``parallel/sharding.py``).  Parameter placement comes from the model's
+logical axis annotations resolved through the rule table; XLA's partitioner
+then inserts every collective the strategy needs — gradient all-reduce over
+``data`` (the DDP reducer, reference ``ddp.py:127``), TP all-reduces over
+``model``, MoE all-to-alls over ``expert``, FSDP all-gather/reduce-scatter
+when ``fsdp=True`` — from sharding propagation alone.  The only manual
+collective is ring attention's ``ppermute`` over ``seq``, injected as the
+attention core inside an otherwise-auto jit program via ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddl_tpu.models.transformer import LMConfig, TransformerLM
+from ddl_tpu.parallel.ring_attention import make_ring_self_attention
+from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
+
+__all__ = ["LMTrainState", "LMStepFns", "make_lm_step_fns", "make_ring_core"]
+
+
+class LMTrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: optax.OptState
+
+
+class LMStepFns(NamedTuple):
+    """train(state, inputs, targets) -> (state, metrics);
+    evaluate(state, inputs, targets) -> metrics;
+    init_state() -> a fresh sharded LMTrainState; mesh: the device mesh.
+
+    ``train`` donates its state argument (the TPU-memory-friendly pattern),
+    so a state that has been passed to ``train`` is consumed — always
+    rebind: ``state = fns.init_state()``, ``state, m = fns.train(state, ...)``.
+    """
+
+    train: Callable
+    evaluate: Callable
+    init_state: Callable
+    mesh: Mesh
+
+
+def make_ring_core(mesh: Mesh, causal: bool = True) -> Callable:
+    """Ring-attention core for injection into ``TransformerLM``: batch local
+    per ``data`` shard, heads local per ``model`` shard, K/V rotating over
+    the ``seq`` ring (``parallel/ring_attention.py``)."""
+    return make_ring_self_attention(
+        mesh,
+        causal=causal,
+        spec=P("data", "seq", "model", None),
+        jit=False,
+    )
+
+
+def _token_ce(logits, targets):
+    """Mean next-token cross-entropy (f32, stable)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, targets[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    return (lse - picked).mean()
+
+
+def make_lm_step_fns(
+    cfg: LMConfig,
+    spec: LMMeshSpec,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    batch: int,
+    seq_len: int,
+    devices=None,
+) -> LMStepFns:
+    """Build the sharded train state and jitted step functions.
+
+    ``batch`` must divide by ``spec.data`` and ``seq_len`` by ``spec.seq``
+    (static SPMD shapes); ``cfg.n_heads`` must divide by ``spec.model`` when
+    ``attn_impl='ring'`` (head-parallel manual core).
+    """
+    if cfg.attn_impl not in ("dense", "ring"):
+        raise ValueError(
+            f"unknown attn_impl {cfg.attn_impl!r} (expected 'dense' or 'ring')"
+        )
+    if batch % spec.data:
+        raise ValueError(f"batch {batch} must divide by mesh data={spec.data}")
+    if seq_len % spec.seq:
+        raise ValueError(f"seq_len {seq_len} must divide by mesh seq={spec.seq}")
+    if cfg.attn_impl == "ring" and cfg.n_heads % spec.model:
+        raise ValueError(
+            f"n_heads {cfg.n_heads} must divide by mesh model={spec.model} "
+            "for the head-parallel ring attention core"
+        )
+    if cfg.num_experts and cfg.num_experts % spec.expert:
+        raise ValueError(
+            f"num_experts {cfg.num_experts} must divide by mesh "
+            f"expert={spec.expert}"
+        )
+    mesh = build_lm_mesh(spec, devices)
+    rules = lm_logical_rules(cfg.fsdp)
+    attn_core = make_ring_core(mesh) if cfg.attn_impl == "ring" else None
+    model = TransformerLM(cfg, attn_core)
+
+    dummy = jnp.zeros((batch, seq_len), jnp.int32)
+
+    def init_params(rng):
+        return model.init(rng, dummy)["params"]
+
+    abs_params = jax.eval_shape(init_params, rng)
+    logical_specs = nn.get_partition_spec(abs_params)
+    param_shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, rules)
+
+    def create_state(rng):
+        params = nn.meta.unbox(init_params(rng))
+        params = jax.lax.with_sharding_constraint(params, param_shardings)
+        return LMTrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    tok_sharding = NamedSharding(mesh, P("data", "seq"))
+    replicated = NamedSharding(mesh, P())
+
+    def loss_fn(params, inputs, targets):
+        with nn.logical_axis_rules(rules):
+            logits, aux = model.apply({"params": params}, inputs)
+        ce = _token_ce(logits, targets)
+        loss = ce + cfg.moe_aux_weight * aux
+        return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})
+
+    def train_step(state, inputs, targets):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, (_, metrics)), grads = grad_fn(state.params, inputs, targets)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            ),
+            metrics,
+        )
+
+    def eval_step(state, inputs, targets):
+        _, (logits, metrics) = loss_fn(state.params, inputs, targets)
+        acc = (jnp.argmax(logits, -1) == targets).mean()
+        return dict(metrics, accuracy=acc)
+
+    def _with_mesh(fn):
+        # nn.with_logical_constraint lowers to bare-PartitionSpec sharding
+        # constraints, which resolve against the ambient mesh at trace time.
+        def wrapped(*args):
+            with jax.set_mesh(mesh):
+                return fn(*args)
+
+        return wrapped
+
+    create = _with_mesh(jax.jit(create_state))
+    train = _with_mesh(
+        jax.jit(
+            train_step,
+            in_shardings=(None, tok_sharding, tok_sharding),
+            out_shardings=(None, replicated),
+            donate_argnums=(0,),
+        )
+    )
+    evaluate = _with_mesh(
+        jax.jit(
+            eval_step,
+            in_shardings=(None, tok_sharding, tok_sharding),
+            out_shardings=replicated,
+        )
+    )
+    return LMStepFns(
+        train=train,
+        evaluate=evaluate,
+        init_state=lambda: create(rng),
+        mesh=mesh,
+    )

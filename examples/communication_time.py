@@ -4,6 +4,10 @@ Equivalent to: ``python -m ddl_tpu.bench.comm``
 """
 
 import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddl_tpu.bench.comm import run_comm_bench
 

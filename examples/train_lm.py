@@ -1,0 +1,108 @@
+"""Train the transformer LM family on synthetic byte sequences.
+
+Demonstrates the sharding-rule-driven strategy surface the CNN entry points
+cannot express (models/transformer.py): tensor parallelism, ring-attention
+sequence parallelism, MoE expert parallelism, and FSDP — all selected from
+the command line as mesh axis sizes, no code changes.
+
+    python examples/train_lm.py --data 2 --seq 2 --model 2 --steps 100
+    python examples/train_lm.py --experts 4 --expert-axis 2 --fsdp
+
+On a dev box without TPUs, add --cpu-devices 8 to simulate the mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=1)
+    ap.add_argument("--model", type=int, default=1)
+    ap.add_argument("--expert-axis", type=int, default=1)
+    ap.add_argument("--experts", type=int, default=0, help="0 = dense MLP")
+    ap.add_argument("--fsdp", action="store_true")
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="simulate N CPU devices (dev/test)")
+    args = ap.parse_args()
+
+    if args.cpu_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_devices}"
+        ).strip()
+    import jax
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    cfg = LMConfig(
+        vocab_size=256,
+        d_model=args.d_model,
+        n_layers=args.layers,
+        n_heads=8,
+        head_dim=args.d_model // 8,
+        d_ff=4 * args.d_model,
+        num_experts=args.experts,
+        compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
+        attn_impl="ring" if args.seq > 1 else "dense",
+        fsdp=args.fsdp,
+    )
+    spec = LMMeshSpec(args.data, args.seq, args.model, args.expert_axis)
+    fns = make_lm_step_fns(
+        cfg, spec, optax.adam(args.lr), jax.random.key(0), args.batch, args.seq_len
+    )
+    print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
+
+    # synthetic corpus: byte sequences from a fixed order-1 Markov chain —
+    # learnable structure with a known entropy floor
+    rng = np.random.default_rng(0)
+    trans = rng.dirichlet(np.full(8, 0.2), size=256)  # 8 likely successors
+    succ = rng.integers(0, 256, (256, 8))
+
+    def sample_batch():
+        seqs = np.empty((args.batch, args.seq_len + 1), np.int32)
+        seqs[:, 0] = rng.integers(0, 256, args.batch)
+        cum = trans.cumsum(axis=1)  # (256, 8) cumulative successor probs
+        for t in range(args.seq_len):
+            u = rng.random((args.batch, 1))
+            choice = (cum[seqs[:, t]] > u).argmax(axis=1)
+            seqs[:, t + 1] = succ[seqs[:, t], choice]
+        return jnp.asarray(seqs[:, :-1]), jnp.asarray(seqs[:, 1:])
+
+    state = fns.init_state()
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        inp, tgt = sample_batch()
+        state, m = fns.train(state, inp, tgt)
+        if i % 10 == 0 or i == args.steps - 1:
+            print(
+                f"step {i:4d} loss {float(m['loss']):.4f} "
+                f"ce {float(m['ce']):.4f} moe_aux {float(m['moe_aux']):.4f}"
+            )
+    dt = time.perf_counter() - t0
+    print(f"{args.steps} steps in {dt:.1f}s ({args.steps / dt:.2f} steps/s)")
+
+
+if __name__ == "__main__":
+    main()

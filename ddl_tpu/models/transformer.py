@@ -30,6 +30,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ddl_tpu.ops.attention import dense_attention
+
 __all__ = ["LMConfig", "TransformerLM", "count_lm_params"]
 
 
@@ -50,9 +52,11 @@ class LMConfig:
     rope_theta: float = 10000.0
     compute_dtype: str = "bfloat16"
     # 'dense': plain softmax attention, XLA partitions it (fine for short
-    # sequences).  'ring': inject a ring-attention core via
-    # ``TransformerLM(attn_core=...)`` for sequence lengths beyond one
-    # device's HBM.
+    # sequences).  'ring': ppermute ring over the seq axis, memory
+    # O(T_local^2) (parallel/ring_attention.py).  'ulysses': all-to-all
+    # head/sequence exchange, unmodified attention per head group
+    # (parallel/ulysses.py).  The manual cores are injected via
+    # ``TransformerLM(attn_core=...)`` by ``train/lm_steps.py``.
     attn_impl: str = "dense"
     remat: bool = True
     fsdp: bool = False
@@ -92,15 +96,7 @@ class RMSNorm(nn.Module):
 
 def _dense_attention(q, k, v):
     """Plain causal softmax attention; XLA partitions the sharded einsums."""
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
-        jnp.asarray(d, q.dtype)
-    )
-    t = q.shape[1]
-    mask = jnp.tril(jnp.ones((t, t), bool))
-    scores = jnp.where(mask[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return dense_attention(q, k, v, causal=True)
 
 
 class Attention(nn.Module):

@@ -1,3 +1,4 @@
+from ddl_tpu.ops.attention import dense_attention
 from ddl_tpu.ops.image import normalize_images
 from ddl_tpu.ops.losses import cross_entropy_loss, softmax_cross_entropy
 
@@ -13,6 +14,7 @@ def get_normalizer(use_pallas: bool = False):
 
 
 __all__ = [
+    "dense_attention",
     "normalize_images",
     "cross_entropy_loss",
     "softmax_cross_entropy",

@@ -1,0 +1,28 @@
+"""Dense softmax attention — the shared single-device attention kernel.
+
+One implementation used by every caller that needs unsharded attention over
+a local block: the transformer's default core (``models/transformer.py``)
+and the per-head-group attention inside Ulysses sequence parallelism
+(``parallel/ulysses.py``).  Scores masked with -1e30 (not -inf: keeps
+fully-masked rows finite), softmax in float32, output back in the compute
+dtype — all of it one fused MXU-friendly einsum pair under XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dense_attention"]
+
+
+def dense_attention(q, k, v, causal: bool = False):
+    """Full softmax attention. q, k, v: (B, T, H, D) -> (B, T, H, D)."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,5 +1,7 @@
 from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
+from ddl_tpu.parallel.ring_attention import make_ring_self_attention
 from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
+from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
 
 __all__ = [
     "MeshSpec",
@@ -7,4 +9,6 @@ __all__ = [
     "LMMeshSpec",
     "build_lm_mesh",
     "lm_logical_rules",
+    "make_ring_self_attention",
+    "make_ulysses_self_attention",
 ]

@@ -1,0 +1,78 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second long-context strategy next to ring attention
+(``parallel/ring_attention.py``), trading its P2P ``ppermute`` ring for two
+``all_to_all`` collectives (the DeepSpeed-Ulysses pattern): activations
+arrive sequence-sharded ``(B, T/n, H, D)``, one all-to-all regroups them to
+``(B, T, H/n, D)`` — full sequence, heads sharded — so each device runs
+*unmodified* full attention over its head group, and a second all-to-all
+restores sequence sharding.  Communication volume is O(B·T·H·D/n) per
+all-to-all regardless of sequence length, and the attention inner loop needs
+no online-softmax bookkeeping — on TPU the all-to-alls ride ICI and the
+attention itself stays one big MXU-friendly einsum per head group.
+
+Trade-off vs ring: Ulysses needs ``H`` divisible by the axis size and
+materialises full ``T x T`` score blocks per head group (memory O(T^2/n));
+ring keeps memory O(T_local^2) but serialises n block steps.  Both are
+numerically full attention; pick per workload (``LMConfig.attn_impl``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ddl_tpu.ops.attention import dense_attention
+
+__all__ = ["ulysses_attention", "make_ulysses_self_attention"]
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Attention over a sequence-sharded batch (call inside ``shard_map``).
+
+    Per-device shapes: q, k, v: (B, T_local, H, D) with the *local* head
+    count divisible by the ``axis_name`` mesh axis size.  Returns the local
+    output shard (B, T_local, H, D), numerically equal to full attention
+    over the global sequence.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"local head count {h} must divide by sequence axis size {n} "
+            "for Ulysses all-to-all attention (use ring attention otherwise)"
+        )
+    # (B, T/n, H, D) -> (B, T, H/n, D): split heads, gather sequence
+    def fwd(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    # inverse exchange: split sequence, gather heads
+    def bwd(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    out = dense_attention(fwd(q), fwd(k), fwd(v), causal=causal)
+    return bwd(out)
+
+
+def make_ulysses_self_attention(
+    mesh: Mesh,
+    axis_name: str = "seq",
+    causal: bool = False,
+    spec: P | None = None,
+    jit: bool = True,
+):
+    """Global-array entry point mirroring ``make_ring_self_attention``."""
+    if spec is None:
+        spec = P(None, axis_name)
+    fn = jax.shard_map(
+        partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return jax.jit(fn) if jit else fn

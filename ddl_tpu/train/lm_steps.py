@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddl_tpu.models.transformer import LMConfig, TransformerLM
 from ddl_tpu.parallel.ring_attention import make_ring_self_attention
 from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
+from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
 
 __all__ = ["LMTrainState", "LMStepFns", "make_lm_step_fns", "make_ring_core"]
 
@@ -87,21 +88,31 @@ def make_lm_step_fns(
     """Build the sharded train state and jitted step functions.
 
     ``batch`` must divide by ``spec.data`` and ``seq_len`` by ``spec.seq``
-    (static SPMD shapes); ``cfg.n_heads`` must divide by ``spec.model`` when
-    ``attn_impl='ring'`` (head-parallel manual core).
+    (static SPMD shapes).  The manual attention cores are head-parallel over
+    ``model``, so ``attn_impl='ring'`` and ``'ulysses'`` need ``cfg.n_heads``
+    divisible by ``spec.model``; ``'ulysses'`` additionally needs the local
+    head count ``n_heads / model`` divisible by ``spec.seq`` (its all-to-all
+    splits heads across the sequence axis).
     """
-    if cfg.attn_impl not in ("dense", "ring"):
+    if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(
-            f"unknown attn_impl {cfg.attn_impl!r} (expected 'dense' or 'ring')"
+            f"unknown attn_impl {cfg.attn_impl!r} "
+            "(expected 'dense', 'ring', or 'ulysses')"
         )
     if batch % spec.data:
         raise ValueError(f"batch {batch} must divide by mesh data={spec.data}")
     if seq_len % spec.seq:
         raise ValueError(f"seq_len {seq_len} must divide by mesh seq={spec.seq}")
-    if cfg.attn_impl == "ring" and cfg.n_heads % spec.model:
+    if cfg.attn_impl in ("ring", "ulysses") and cfg.n_heads % spec.model:
         raise ValueError(
             f"n_heads {cfg.n_heads} must divide by mesh model={spec.model} "
-            "for the head-parallel ring attention core"
+            "for the head-parallel manual attention cores"
+        )
+    if cfg.attn_impl == "ulysses" and (cfg.n_heads // spec.model) % spec.seq:
+        raise ValueError(
+            f"local head count {cfg.n_heads // spec.model} (n_heads/model) "
+            f"must divide by mesh seq={spec.seq} for Ulysses all-to-all "
+            "attention (use attn_impl='ring' otherwise)"
         )
     if cfg.num_experts and cfg.num_experts % spec.expert:
         raise ValueError(
@@ -110,7 +121,17 @@ def make_lm_step_fns(
         )
     mesh = build_lm_mesh(spec, devices)
     rules = lm_logical_rules(cfg.fsdp)
-    attn_core = make_ring_core(mesh) if cfg.attn_impl == "ring" else None
+    if cfg.attn_impl == "ring":
+        attn_core = make_ring_core(mesh)
+    elif cfg.attn_impl == "ulysses":
+        attn_core = make_ulysses_self_attention(
+            mesh,
+            causal=True,
+            spec=P("data", "seq", "model", None),
+            jit=False,
+        )
+    else:
+        attn_core = None
     model = TransformerLM(cfg, attn_core)
 
     dummy = jnp.zeros((batch, seq_len), jnp.int32)

@@ -29,6 +29,8 @@ def main() -> None:
     ap.add_argument("--expert-axis", type=int, default=1)
     ap.add_argument("--experts", type=int, default=0, help="0 = dense MLP")
     ap.add_argument("--fsdp", action="store_true")
+    ap.add_argument("--attn", default=None, choices=["dense", "ring", "ulysses"],
+                    help="attention impl (default: ring when --seq > 1, else dense)")
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--steps", type=int, default=100)
@@ -65,7 +67,7 @@ def main() -> None:
         d_ff=4 * args.d_model,
         num_experts=args.experts,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
-        attn_impl="ring" if args.seq > 1 else "dense",
+        attn_impl=args.attn or ("ring" if args.seq > 1 else "dense"),
         fsdp=args.fsdp,
     )
     spec = LMMeshSpec(args.data, args.seq, args.model, args.expert_axis)

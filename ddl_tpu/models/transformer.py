@@ -58,6 +58,11 @@ class LMConfig:
     # (parallel/ulysses.py).  The manual cores are injected via
     # ``TransformerLM(attn_core=...)`` by ``train/lm_steps.py``.
     attn_impl: str = "dense"
+    # Use the Pallas flash-attention kernel (ops/flash_attention.py) as the
+    # per-device attention: with 'dense' it replaces the O(T^2) score
+    # materialisation (requires seq mesh axis 1), with 'ulysses' it runs on
+    # each head group after the all-to-all.  'ring' is already blockwise.
+    flash: bool = False
     remat: bool = True
     fsdp: bool = False
 

@@ -1,4 +1,5 @@
 from ddl_tpu.ops.attention import dense_attention
+from ddl_tpu.ops.flash_attention import flash_attention
 from ddl_tpu.ops.image import normalize_images
 from ddl_tpu.ops.losses import cross_entropy_loss, softmax_cross_entropy
 
@@ -15,6 +16,7 @@ def get_normalizer(use_pallas: bool = False):
 
 __all__ = [
     "dense_attention",
+    "flash_attention",
     "normalize_images",
     "cross_entropy_loss",
     "softmax_cross_entropy",

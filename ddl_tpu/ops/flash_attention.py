@@ -1,0 +1,292 @@
+"""Pallas TPU flash attention: tiled online-softmax attention, fwd + bwd.
+
+The hot op of the transformer family (``models/transformer.py``).  XLA's
+default lowering materialises the (T x T) score matrix in HBM; this kernel
+never sees more than one (block_q x block_k) tile at a time: the grid's
+innermost dimension walks K/V blocks against a resident Q block while
+running row-max / row-sum statistics live in VMEM scratch across grid steps
+(the same online softmax the ring schedule uses *across* devices, here
+applied *within* one device's block loop).  Per-program VMEM is
+O(block_q x head_dim + block_k x head_dim) regardless of sequence length,
+and every matmul lands on the MXU at (block, head_dim) granularity.
+
+The backward pass is the standard two-kernel flash decomposition with a
+saved per-row logsumexp: one grid accumulates dQ over K/V blocks, one
+accumulates dK/dV over Q blocks, both recomputing probabilities from the
+residuals instead of storing them (rematerialisation in kernel form).
+
+Causal masking skips the compute of strictly-future blocks via predicated
+execution (``pl.when``), halving the causal FLOPs — the block-level analog
+of the ring schedule masking future blocks.
+
+Layout: (B, T, H, D) public API; internally heads fold into the grid's
+leading dimension so each program works on one (head, Q-block, K-block)
+cell.  Interpret mode (CPU) is auto-selected off-TPU so the same tests run
+on the simulated mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _pick_block(t: int, requested: int) -> int:
+    block = min(requested, t)
+    while t % block:
+        block //= 2
+    return max(block, 1)
+
+
+def _causal_mask(i, j, bq, bk, s):
+    q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(k_pos <= q_pos, s, _NEG_INF)
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scale, causal
+):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # causal: K/V blocks strictly in the future contribute nothing — skip
+    live = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(i, j, bq, bk, s)
+        m = m_sc[:]
+        blk_max = s.max(axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        p = jnp.exp(s - new_m)
+        corr = jnp.exp(m - new_m)
+        l_sc[:] = l_sc[:] * corr + p.sum(axis=-1, keepdims=True)
+        acc_sc[:] = acc_sc[:] * corr + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        m_sc[:] = new_m
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = jnp.maximum(l_sc[:], 1e-30)
+        o_ref[0] = (acc_sc[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_sc[:] + jnp.log(l))[:, 0]
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale, causal
+):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    live = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(i, j, bq, bk, s)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_sc[:] = dq_sc[:] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = (dq_sc[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_sc, dv_sc, *, scale, causal,
+):
+    # grid: (bh, k_blocks, q_blocks) — innermost walks Q blocks
+    j, i = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    # causal: Q blocks strictly before this K/V block never attend to it
+    live = (i * bq + bq - 1 >= j * bk) if causal else True
+
+    @pl.when(live)
+    def _():
+        q_blk = q_ref[0].astype(jnp.float32) * scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[0][:, None]
+        delta_blk = delta_ref[0][:, None]
+        s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(i, j, bq, bk, s)
+        p = jnp.exp(s - lse_blk)
+        dv_sc[:] = dv_sc[:] + jnp.dot(
+            p.T, do_blk, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do_blk, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        dk_sc[:] = dk_sc[:] + jnp.dot(
+            ds.T, q_blk, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)  # scale folded into q_blk
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ),
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, do):
+    q, k, v, out, lse = residuals
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # grid (bh, k_blocks, q_blocks): innermost dimension walks Q blocks
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    row_spec_t = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale=scale, causal=causal),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ),
+        grid=(bh, t // block_k, t // block_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t],
+        out_specs=(kv_spec_t, kv_spec_t),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Flash attention. q, k, v: (B, T, H, D) -> (B, T, H, D).
+
+    Differentiable (custom VJP, flash backward).  Block sizes are clamped to
+    the sequence length and halved until they divide it; pick powers of two.
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the kernel
+    runs on the CPU-simulated mesh (tests) and compiled on real chips.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    b, t, h, d = q.shape
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(t, block_k)
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out = _flash(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -31,7 +31,7 @@ from ddl_tpu.ops.attention import dense_attention
 __all__ = ["ulysses_attention", "make_ulysses_self_attention"]
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, attn_fn=None):
     """Attention over a sequence-sharded batch (call inside ``shard_map``).
 
     Per-device shapes: q, k, v: (B, T_local, H, D) with the *local* head
@@ -54,7 +54,8 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
     def bwd(x):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    out = dense_attention(fwd(q), fwd(k), fwd(v), causal=causal)
+    attn = attn_fn if attn_fn is not None else dense_attention
+    out = attn(fwd(q), fwd(k), fwd(v), causal=causal)
     return bwd(out)
 
 
@@ -64,12 +65,18 @@ def make_ulysses_self_attention(
     causal: bool = False,
     spec: P | None = None,
     jit: bool = True,
+    attn_fn=None,
 ):
-    """Global-array entry point mirroring ``make_ring_self_attention``."""
+    """Global-array entry point mirroring ``make_ring_self_attention``.
+
+    ``attn_fn(q, k, v, causal=...)`` replaces the dense per-head-group
+    attention — e.g. the Pallas flash kernel
+    (``ops/flash_attention.flash_attention``) for long sequences.
+    """
     if spec is None:
         spec = P(None, axis_name)
     fn = jax.shard_map(
-        partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        partial(ulysses_attention, axis_name=axis_name, causal=causal, attn_fn=attn_fn),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -25,6 +25,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddl_tpu.models.transformer import LMConfig, TransformerLM
+from ddl_tpu.ops.flash_attention import flash_attention
 from ddl_tpu.parallel.ring_attention import make_ring_self_attention
 from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
 from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
@@ -103,7 +104,8 @@ def make_lm_step_fns(
         raise ValueError(f"batch {batch} must divide by mesh data={spec.data}")
     if seq_len % spec.seq:
         raise ValueError(f"seq_len {seq_len} must divide by mesh seq={spec.seq}")
-    if cfg.attn_impl in ("ring", "ulysses") and cfg.n_heads % spec.model:
+    uses_manual_core = cfg.attn_impl in ("ring", "ulysses") or cfg.flash
+    if uses_manual_core and cfg.n_heads % spec.model:
         raise ValueError(
             f"n_heads {cfg.n_heads} must divide by mesh model={spec.model} "
             "for the head-parallel manual attention cores"
@@ -119,16 +121,40 @@ def make_lm_step_fns(
             f"num_experts {cfg.num_experts} must divide by mesh "
             f"expert={spec.expert}"
         )
+    if cfg.flash and cfg.attn_impl == "ring":
+        raise ValueError(
+            "flash=True is not supported with attn_impl='ring' "
+            "(the ring core is already blockwise online-softmax)"
+        )
+    if cfg.flash and cfg.attn_impl == "dense" and spec.seq > 1:
+        raise ValueError(
+            "flash=True with attn_impl='dense' requires mesh seq=1 "
+            "(the kernel attends within one device's sequence; use "
+            "attn_impl='ulysses' to combine flash with sequence parallelism)"
+        )
     mesh = build_lm_mesh(spec, devices)
     rules = lm_logical_rules(cfg.fsdp)
+    manual_spec = P("data", "seq", "model", None)
     if cfg.attn_impl == "ring":
         attn_core = make_ring_core(mesh)
     elif cfg.attn_impl == "ulysses":
         attn_core = make_ulysses_self_attention(
             mesh,
             causal=True,
-            spec=P("data", "seq", "model", None),
+            spec=manual_spec,
             jit=False,
+            attn_fn=flash_attention if cfg.flash else None,
+        )
+    elif cfg.flash:
+        # dense + flash: manual shard_map so the Pallas call sees the local
+        # (batch, full seq, local heads) block — GSPMD cannot partition a
+        # custom kernel, so it must live inside the manual region.
+        attn_core = jax.shard_map(
+            partial(flash_attention, causal=True),
+            mesh=mesh,
+            in_specs=(manual_spec,) * 3,
+            out_specs=manual_spec,
+            check_vma=False,
         )
     else:
         attn_core = None

@@ -31,6 +31,8 @@ def main() -> None:
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--attn", default=None, choices=["dense", "ring", "ulysses"],
                     help="attention impl (default: ring when --seq > 1, else dense)")
+    ap.add_argument("--flash", action="store_true",
+                    help="use the Pallas flash-attention kernel (dense/ulysses)")
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--steps", type=int, default=100)
@@ -67,7 +69,9 @@ def main() -> None:
         d_ff=4 * args.d_model,
         num_experts=args.experts,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
-        attn_impl=args.attn or ("ring" if args.seq > 1 else "dense"),
+        attn_impl=args.attn
+        or (("ulysses" if args.flash else "ring") if args.seq > 1 else "dense"),
+        flash=args.flash,
         fsdp=args.fsdp,
     )
     spec = LMMeshSpec(args.data, args.seq, args.model, args.expert_axis)

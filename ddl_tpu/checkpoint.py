@@ -10,17 +10,23 @@ semantics — loading epoch N resumes training at epoch N+1
 pipeline run checkpoints every stage into the same snapshot, matching the
 rank-keyed state dicts of the reference's PP variants (``pp.py:84-90``)
 without any rank bookkeeping.
+
+The functions are pytree-generic: the same save/load path checkpoints the
+CNN ``TrainState`` and the transformer family's ``LMTrainState``
+(``train/lm_steps.py``), and because Orbax writes *global* arrays, a
+snapshot saved on one mesh restores onto a different mesh/sharding
+(elastic resharding — restore's ``abstract_state`` carries the target
+shardings).  The reference's DCP resume is fixed-topology.
 """
 
 from __future__ import annotations
 
 import os
 from pathlib import Path
+from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
-
-from ddl_tpu.train.state import TrainState
 
 __all__ = [
     "save_snapshot",
@@ -36,7 +42,7 @@ def snapshot_path(checkpoint_dir: str | os.PathLike, job_id: str, epoch: int) ->
 
 
 def save_snapshot(
-    checkpoint_dir: str | os.PathLike, job_id: str, epoch: int, state: TrainState
+    checkpoint_dir: str | os.PathLike, job_id: str, epoch: int, state: Any,
 ) -> Path:
     path = snapshot_path(checkpoint_dir, job_id, epoch)
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -49,8 +55,8 @@ def load_snapshot(
     checkpoint_dir: str | os.PathLike,
     job_id: str,
     epoch: int,
-    abstract_state: TrainState,
-) -> tuple[TrainState, int]:
+    abstract_state: Any,
+) -> tuple[Any, int]:
     """Restore a snapshot; returns ``(state, epochs_run)`` where training
     resumes at ``epochs_run = saved_epoch + 1`` (reference ``single.py:124``)."""
     path = snapshot_path(checkpoint_dir, job_id, epoch)
@@ -70,7 +76,7 @@ class SnapshotManager:
         self.job_id = job_id
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
 
-    def save(self, epoch: int, state: TrainState) -> Path:
+    def save(self, epoch: int, state: Any) -> Path:
         path = snapshot_path(self.checkpoint_dir, self.job_id, epoch)
         path.parent.mkdir(parents=True, exist_ok=True)
         # one outstanding save at a time: wait for the previous commit

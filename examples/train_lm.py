@@ -41,6 +41,12 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="simulate N CPU devices (dev/test)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="save a snapshot every --save-every steps")
+    ap.add_argument("--save-every", type=int, default=50)
+    ap.add_argument("--resume-step", type=int, default=None,
+                    help="restore the snapshot saved at this step (any mesh)")
+    ap.add_argument("--job-id", default="lm")
     args = ap.parse_args()
 
     if args.cpu_devices:
@@ -82,14 +88,17 @@ def main() -> None:
 
     # synthetic corpus: byte sequences from a fixed order-1 Markov chain —
     # learnable structure with a known entropy floor
-    rng = np.random.default_rng(0)
-    trans = rng.dirichlet(np.full(8, 0.2), size=256)  # 8 likely successors
-    succ = rng.integers(0, 256, (256, 8))
+    chain_rng = np.random.default_rng(0)
+    trans = chain_rng.dirichlet(np.full(8, 0.2), size=256)  # 8 likely successors
+    succ = chain_rng.integers(0, 256, (256, 8))
+    cum = trans.cumsum(axis=1)  # (256, 8) cumulative successor probs
 
-    def sample_batch():
+    def sample_batch(step):
+        # seeded by step so a resumed run continues the stream instead of
+        # re-consuming the batches the original run already trained on
+        rng = np.random.default_rng(1000 + step)
         seqs = np.empty((args.batch, args.seq_len + 1), np.int32)
         seqs[:, 0] = rng.integers(0, 256, args.batch)
-        cum = trans.cumsum(axis=1)  # (256, 8) cumulative successor probs
         for t in range(args.seq_len):
             u = rng.random((args.batch, 1))
             choice = (cum[seqs[:, t]] > u).argmax(axis=1)
@@ -97,17 +106,31 @@ def main() -> None:
         return jnp.asarray(seqs[:, :-1]), jnp.asarray(seqs[:, 1:])
 
     state = fns.init_state()
+    start = 0
+    if args.checkpoint_dir and args.resume_step is not None:
+        from ddl_tpu.checkpoint import load_snapshot
+
+        state, _ = load_snapshot(
+            args.checkpoint_dir, args.job_id, args.resume_step, state
+        )
+        start = int(state.step)
+        print(f"resumed from step {start} (snapshots are mesh-independent)")
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        inp, tgt = sample_batch()
+    for i in range(start, args.steps):
+        inp, tgt = sample_batch(i)
         state, m = fns.train(state, inp, tgt)
         if i % 10 == 0 or i == args.steps - 1:
             print(
                 f"step {i:4d} loss {float(m['loss']):.4f} "
                 f"ce {float(m['ce']):.4f} moe_aux {float(m['moe_aux']):.4f}"
             )
+        if args.checkpoint_dir and (i + 1) % args.save_every == 0:
+            from ddl_tpu.checkpoint import save_snapshot
+
+            save_snapshot(args.checkpoint_dir, args.job_id, i + 1, state)
+    steps_run = args.steps - start
     dt = time.perf_counter() - t0
-    print(f"{args.steps} steps in {dt:.1f}s ({args.steps / dt:.2f} steps/s)")
+    print(f"{steps_run} steps in {dt:.1f}s ({steps_run / dt:.2f} steps/s)")
 
 
 if __name__ == "__main__":

@@ -112,6 +112,9 @@ class TrainConfig:
     # Failure detection (absent in the reference — SURVEY.md section 5): halt
     # with a clear diagnostic when the training loss goes non-finite.
     halt_on_nan: bool = True
+    # Preemption handling (absent in the reference): catch SIGTERM, finish
+    # the in-flight step, checkpoint, and exit cleanly for relaunch+resume.
+    preemption_save: bool = True
     log_gradient_stats: bool = False
     # Capture a jax.profiler trace of one full epoch into this directory
     # (the reference has only perf_counter timing — SURVEY.md section 5).

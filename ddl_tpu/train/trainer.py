@@ -181,8 +181,12 @@ class Trainer:
 
     # ------------------------------------------------------------------
 
-    def _run_epoch(self, epoch: int):
-        """One training epoch; returns (mean_loss, accuracy, steps)."""
+    def _run_epoch(self, epoch: int, guard=None):
+        """One training epoch; returns (mean_loss, accuracy, steps).
+
+        ``guard`` (a ``PreemptionGuard``) stops the epoch after the
+        in-flight step when a preemption signal has arrived.
+        """
         self.train_loader.set_epoch(epoch)
         losses, preds, targets = [], [], []
         steps = 0
@@ -197,6 +201,8 @@ class Trainer:
             preds.append(pred)
             targets.append(gl)
             steps += 1
+            if guard is not None and guard.requested:
+                break
         if steps == 0:
             raise RuntimeError("empty epoch: dataset smaller than one batch")
         mean_loss = float(np.mean([_to_host(l) for l in losses]))
@@ -221,7 +227,13 @@ class Trainer:
         )
         return metrics
 
-    def train(self, max_epochs: int | None = None) -> None:
+    def train(self, max_epochs: int | None = None, guard=None) -> None:
+        from ddl_tpu.utils.preemption import PreemptionGuard
+
+        if guard is None and self.cfg.train.preemption_save:
+            with PreemptionGuard() as installed:
+                return self.train(max_epochs, guard=installed)
+
         max_epochs = max_epochs or self.cfg.train.max_epochs
         # Profile one post-warmup epoch when configured (the reference's only
         # timing is perf_counter epoch walls, single.py:171-174; this captures
@@ -233,7 +245,7 @@ class Trainer:
             if epoch == profile_epoch:
                 jax.profiler.start_trace(self.cfg.train.profile_dir)
             start = perf_counter()
-            mean_loss, accuracy, steps = self._run_epoch(epoch)
+            mean_loss, accuracy, steps = self._run_epoch(epoch, guard)
             elapsed = perf_counter() - start
             if epoch == profile_epoch:
                 jax.profiler.stop_trace()
@@ -269,5 +281,18 @@ class Trainer:
                 print(f"New Best Validation QWK: {self.best_qwk:.4f}")
                 self._save_snapshot(epoch)
             self.epochs_run = epoch + 1
+            if guard is not None and guard.requested:
+                # Preempted (SIGTERM): checkpoint what we have and exit
+                # cleanly; the partially-trained epoch is saved under its own
+                # number, so the relaunch resumes at the next epoch.
+                self._save_snapshot(epoch)
+                if self._snapshot_mgr is not None:
+                    self._snapshot_mgr.wait()
+                print(
+                    f"Preempted at epoch {epoch}; snapshot committed. Resume "
+                    f"with train.snapshot_job_id={self.job_id} "
+                    f"train.snapshot_epoch={epoch}"
+                )
+                return
         if self._snapshot_mgr is not None:
             self._snapshot_mgr.wait()

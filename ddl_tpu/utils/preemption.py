@@ -1,0 +1,49 @@
+"""Preemption detection: turn SIGTERM into a clean checkpoint-and-exit.
+
+The reference has no failure or preemption handling at all (SURVEY.md §5):
+a Kubernetes eviction kills the pod and recovery is a manual re-submit with
+``snapshot_job_id``/``snapshot_epoch`` (``ddp.py:109-110``).  TPU pods and
+preemptible/spot VMs deliver SIGTERM with a grace window before the kill;
+this guard catches it, the trainer finishes the in-flight step, writes a
+snapshot, and exits cleanly — the relaunched job resumes from it.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+__all__ = ["PreemptionGuard"]
+
+
+class PreemptionGuard:
+    """Context manager: while active, the given signals set a flag instead
+    of killing the process.  Poll ``requested`` at step/epoch boundaries."""
+
+    def __init__(self, signals=(signal.SIGTERM,)) -> None:
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._previous: dict[int, object] = {}
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def request(self) -> None:
+        """Mark preemption as requested (what the signal handler does);
+        public so tests and cooperative shutdown paths can trigger it."""
+        self._event.set()
+
+    def _handler(self, signum, frame) -> None:
+        self._event.set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+        return None

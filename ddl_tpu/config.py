@@ -97,6 +97,9 @@ class TrainConfig:
     b2: float = 0.999
     eps: float = 1e-8
     num_microbatches: int = 5  # reference pp.py:378
+    # "gpipe" (reference ScheduleGPipe semantics, pp.py:140) or "1f1b"
+    # (O(stages) activation memory instead of O(microbatches))
+    pipeline_schedule: str = "gpipe"
     seed: int = 42
     log_dir: str = field(default_factory=lambda: _env("DDL_LOG_DIR", "training_logs"))
     checkpoint_dir: str = field(default_factory=lambda: _env("DDL_CHECKPOINT_DIR", "checkpoints"))

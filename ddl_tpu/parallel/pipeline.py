@@ -32,6 +32,22 @@ the ``('data', 'pipe')`` mesh:
 BatchNorm semantics match torch GPipe: train-mode normalisation uses each
 *microbatch's* statistics, and running stats advance once per microbatch in
 order; stats are then averaged over the ``data`` axis.
+
+Two schedules are provided (``schedule=``):
+
+* ``"gpipe"`` — all forwards, then all backwards (derived by autodiff of the
+  forward scan, as above).  Activation residency grows with the microbatch
+  count M: every microbatch's stage input is alive until its backward runs.
+* ``"1f1b"`` — explicit one-forward-one-backward interleave.  The backward
+  pipeline is hand-written with per-tick ``jax.vjp``: stage ``s`` runs the
+  forward of microbatch ``t - s`` and the backward of microbatch
+  ``t - (2P-2-s)`` in the same clock tick, cotangents ride a reverse
+  ``ppermute``, and stage inputs live in a ring buffer of depth
+  ``min(2(P-1-s)+1, M)`` — O(P), independent of M.  That caps activation
+  memory for deep pipelines with many microbatches (the standard 1F1B
+  advantage) and shortens the schedule from 2(M+P-1) to M+2(P-1) ticks.
+  Gradients are bit-compatible with the GPipe schedule (same math, same
+  microbatch order — asserted by ``tests/test_parallel.py``).
 """
 
 from __future__ import annotations
@@ -71,7 +87,10 @@ def make_pipeline_step_fns(
     boundary_shapes: Sequence[tuple[int, ...]],
     num_classes: int,
     remat: bool = True,
+    schedule: str = "gpipe",
 ) -> StepFns:
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     n_stages = len(stages)
     if mesh.shape[PIPE_AXIS] != n_stages:
         raise ValueError(
@@ -81,6 +100,13 @@ def make_pipeline_step_fns(
         raise ValueError("need one boundary shape per stage cut")
     M = num_microbatches
 
+    def split_microbatches(images, labels):
+        local_b = images.shape[0]
+        if local_b % M:
+            raise ValueError(f"per-replica batch {local_b} % microbatches {M} != 0")
+        mb = local_b // M
+        return images.reshape(M, mb, *images.shape[1:]), labels.reshape(M, mb), mb
+
     def stage_fn(i: int, train: bool):
         def fn(params_i, stats_i, x):
             return apply_stage(stages[i], params_i, stats_i, x, train)
@@ -89,18 +115,14 @@ def make_pipeline_step_fns(
         # forward during the backward pipeline phase.
         return jax.checkpoint(fn) if (remat and train) else fn
 
-    def schedule(params, batch_stats, images, labels, *, train: bool):
+    def gpipe_schedule(params, batch_stats, images, labels, *, train: bool):
         """Per-device GPipe schedule. images: (local_B, H, W, C) uint8.
 
         Returns (loss_sum_over_microbatches, logits (local_B, C), new_stats).
         """
         s = lax.axis_index(PIPE_AXIS)
         local_b = images.shape[0]
-        if local_b % M:
-            raise ValueError(f"per-replica batch {local_b} % microbatches {M} != 0")
-        mb = local_b // M
-        imgs = images.reshape(M, mb, *images.shape[1:])
-        labs = labels.reshape(M, mb)
+        imgs, labs, mb = split_microbatches(images, labels)
         fns = [stage_fn(i, train) for i in range(n_stages)]
 
         T = M + n_stages - 1
@@ -192,19 +214,15 @@ def make_pipeline_step_fns(
         )
         return jax.tree.map(lambda x: lax.pmean(x, DATA_AXIS), combined)
 
-    def per_device_train(state: TrainState, images, labels):
-        def loss_fn(params):
-            loss_sum, logits, new_stats = schedule(
-                params, state.batch_stats, images, labels, train=True
-            )
-            return loss_sum / M, (logits, new_stats)
-
-        (loss_local, (logits, new_stats)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
-        # Stages hold disjoint params: pipe-psum concatenates stage grads;
-        # data-pmean averages the data shards (the DDP allreduce).
-        grads = jax.tree.map(lambda g: lax.pmean(lax.psum(g, PIPE_AXIS), DATA_AXIS), grads)
+    def reduce_and_update(state, grads, loss_local, new_stats, logits):
+        """Shared step tail for both schedules.  Stages hold disjoint
+        params: pipe-psum concatenates stage grads; data-pmean averages the
+        data shards (the DDP allreduce).  The optimizer update then runs
+        replicated on every device — parameters stay bit-identical across
+        the mesh with no broadcast."""
+        grads = jax.tree.map(
+            lambda g: lax.pmean(lax.psum(g, PIPE_AXIS), DATA_AXIS), grads
+        )
         loss = lax.pmean(lax.psum(loss_local, PIPE_AXIS), DATA_AXIS)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -216,9 +234,169 @@ def make_pipeline_step_fns(
         )
         return new_state, loss, jnp.argmax(logits, axis=-1)
 
+    def per_device_train(state: TrainState, images, labels):
+        def loss_fn(params):
+            loss_sum, logits, new_stats = gpipe_schedule(
+                params, state.batch_stats, images, labels, train=True
+            )
+            return loss_sum / M, (logits, new_stats)
+
+        (loss_local, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        return reduce_and_update(state, grads, loss_local, new_stats, logits)
+
+    def per_device_train_1f1b(state: TrainState, images, labels):
+        """Explicit 1F1B: stage ``s`` runs the forward of microbatch ``t-s``
+        and the backward of microbatch ``t-(2(P-1)-s)`` in the same tick;
+        cotangents ride the reverse ppermute; stage inputs live in ring
+        buffers of depth O(P), independent of the microbatch count."""
+        last = n_stages - 1
+        local_b = images.shape[0]
+        imgs, labs, mb = split_microbatches(images, labels)
+        params = state.params
+
+        # Ring-buffer depth per non-last stage: a microbatch's stage input
+        # is written at tick f+s and consumed by its backward at tick
+        # f+2(P-1)-s.  The last stage's forward and backward share a tick,
+        # one fused vjp serves both, so it needs no buffer at all.
+        depth = [min(2 * (last - i) + 1, M) for i in range(last)]
+        in_shapes = [(mb, *images.shape[1:])] + [
+            (mb, *shape) for shape in boundary_shapes[:-1]
+        ]
+        resid0 = tuple(
+            jnp.zeros((depth[i], *in_shapes[i]), compute_dtype) for i in range(last)
+        )
+        bufs0 = tuple(
+            jnp.zeros((mb, *shape), compute_dtype) for shape in boundary_shapes
+        )
+        logits0 = jnp.zeros((M, mb, num_classes), jnp.float32)
+        grads0 = jax.tree.map(jnp.zeros_like, params)
+
+        def tick(carry, t):
+            def make_branch(i):
+                def branch(fwd_bufs, bwd_bufs, resid, stats, logits_acc, loss_acc, grads):
+                    f_idx = jnp.clip(t - i, 0, M - 1)
+                    fwd_valid = (t >= i) & (t - i < M)
+                    off = 2 * last - i
+                    b_idx = jnp.clip(t - off, 0, M - 1)
+                    bwd_valid = (t >= off) & (t - off < M)
+
+                    def fwd_only(p, x):
+                        # Train-mode BN normalises by the microbatch's own
+                        # statistics, so the output does not depend on the
+                        # running stats — recomputing the forward with
+                        # current `stats` reproduces it exactly.
+                        return apply_stage(stages[i], p, stats[i], x, train=True)
+
+                    # ---- forward: microbatch f_idx through stage i ----
+                    if i == 0:
+                        mb_in = lax.dynamic_index_in_dim(imgs, f_idx, 0, keepdims=False)
+                        x_in = normalize_images(mb_in, compute_dtype)
+                    else:
+                        x_in = fwd_bufs[i - 1]
+                    if i == last:
+                        # Fused: this tick's backward is the same microbatch.
+                        (out_f, new_stats_i), vjp_fn = jax.vjp(
+                            fwd_only, params[i], x_in, has_aux=False
+                        )
+                    else:
+                        out_f, new_stats_i = fwd_only(params[i], x_in)
+                        res_i = lax.dynamic_update_index_in_dim(
+                            resid[i], x_in.astype(compute_dtype), f_idx % depth[i], 0
+                        )
+                        res_i = jnp.where(fwd_valid, res_i, resid[i])
+                        resid = tuple(res_i if j == i else resid[j] for j in range(last))
+                        fwd_bufs = tuple(
+                            out_f.astype(compute_dtype) if j == i else fwd_bufs[j]
+                            for j in range(last)
+                        )
+                    stats = tuple(
+                        _where_tree(fwd_valid, new_stats_i, stats[i]) if j == i else stats[j]
+                        for j in range(n_stages)
+                    )
+
+                    # ---- backward: microbatch b_idx through stage i ----
+                    if i == last:
+                        labs_mb = lax.dynamic_index_in_dim(labs, b_idx, 0, keepdims=False)
+                        loss_mb, g_out = jax.value_and_grad(
+                            lambda lg: softmax_cross_entropy(lg, labs_mb).mean()
+                        )(out_f)
+                        g_out = (g_out / M).astype(out_f.dtype)
+                        loss_acc = loss_acc + jnp.where(bwd_valid, loss_mb, 0.0)
+                        logits_acc = jnp.where(
+                            bwd_valid,
+                            lax.dynamic_update_index_in_dim(
+                                logits_acc, out_f.astype(jnp.float32), b_idx, 0
+                            ),
+                            logits_acc,
+                        )
+                        # vjp was taken with the (out, stats) pair as output;
+                        # stats get a zero cotangent.
+                        dparams_i, dx = vjp_fn(
+                            (g_out, jax.tree.map(jnp.zeros_like, new_stats_i))
+                        )
+                    else:
+                        x_b = lax.dynamic_index_in_dim(
+                            resid[i], b_idx % depth[i], 0, keepdims=False
+                        )
+                        (out_b, new_stats_b), vjp_fn = jax.vjp(fwd_only, params[i], x_b)
+                        g_out = bwd_bufs[i].astype(out_b.dtype)
+                        dparams_i, dx = vjp_fn(
+                            (g_out, jax.tree.map(jnp.zeros_like, new_stats_b))
+                        )
+                    grads = tuple(
+                        jax.tree.map(
+                            lambda g, d: g + jnp.where(bwd_valid, d, jnp.zeros_like(d)),
+                            grads[i],
+                            dparams_i,
+                        )
+                        if j == i
+                        else grads[j]
+                        for j in range(n_stages)
+                    )
+                    if i > 0:
+                        bwd_bufs = tuple(
+                            dx.astype(compute_dtype) if j == i - 1 else bwd_bufs[j]
+                            for j in range(last)
+                        )
+                    return fwd_bufs, bwd_bufs, resid, stats, logits_acc, loss_acc, grads
+
+                return branch
+
+            s = lax.axis_index(PIPE_AXIS)
+            fwd_bufs, bwd_bufs, resid, stats, logits_acc, loss_acc, grads = lax.switch(
+                s, [make_branch(i) for i in range(n_stages)], *carry
+            )
+            # Activations flow i -> i+1, cotangents i+1 -> i; each boundary
+            # slot is a single-pair permute (see the GPipe schedule above).
+            fwd_bufs = tuple(
+                lax.ppermute(b, PIPE_AXIS, [(j, j + 1)]) for j, b in enumerate(fwd_bufs)
+            )
+            bwd_bufs = tuple(
+                lax.ppermute(b, PIPE_AXIS, [(j + 1, j)]) for j, b in enumerate(bwd_bufs)
+            )
+            return (fwd_bufs, bwd_bufs, resid, stats, logits_acc, loss_acc, grads), None
+
+        T = M + 2 * last
+        init = (
+            bufs0,
+            bufs0,
+            resid0,
+            state.batch_stats,
+            logits0,
+            jnp.zeros((), jnp.float32),
+            grads0,
+        )
+        (_, _, _, new_stats, logits_all, loss_sum, grads), _ = lax.scan(
+            tick, init, jnp.arange(T)
+        )
+        logits = lax.psum(logits_all, PIPE_AXIS).reshape(local_b, num_classes)
+        return reduce_and_update(state, grads, loss_sum / M, new_stats, logits)
+
     def per_device_eval(state: TrainState, images):
         dummy_labels = jnp.zeros((images.shape[0],), jnp.int32)
-        _, logits, _ = schedule(
+        _, logits, _ = gpipe_schedule(
             state.params, state.batch_stats, images, dummy_labels, train=False
         )
         return logits
@@ -227,7 +405,7 @@ def make_pipeline_step_fns(
     batch_spec = P(DATA_AXIS)
     train = jax.jit(
         jax.shard_map(
-            per_device_train,
+            per_device_train_1f1b if schedule == "1f1b" else per_device_train,
             mesh=mesh,
             in_specs=(state_spec, batch_spec, batch_spec),
             out_specs=(state_spec, P(), batch_spec),

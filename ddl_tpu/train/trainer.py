@@ -87,6 +87,7 @@ class Trainer:
                 boundary_shapes=stage_boundary_shapes(cfg.model, cfg.data.image_size),
                 num_classes=cfg.model.num_classes,
                 remat=cfg.model.remat,
+                schedule=cfg.train.pipeline_schedule,
             )
         else:
             from ddl_tpu.ops import get_normalizer

@@ -133,6 +133,32 @@ def test_pipeline_matches_sequential(tiny_model_cfg, batch, data, microbatches):
     _assert_tree_close(new_state.batch_stats, tuple(ref_stats), atol=2e-5)
 
 
+@pytest.mark.parametrize("data,microbatches", [(1, 2), (2, 2), (1, 4)])
+def test_1f1b_matches_gpipe(tiny_model_cfg, batch, data, microbatches):
+    """The hand-written 1F1B interleave must reproduce the autodiff-derived
+    GPipe schedule exactly — same microbatch math, different clocking."""
+    images, labels = batch
+    stages, tx, state0 = _fresh(tiny_model_cfg, sgd=True)
+    mesh = build_mesh(MeshSpec(data, 2))
+    kwargs = dict(
+        tx=tx,
+        mesh=mesh,
+        compute_dtype=jnp.float32,
+        num_microbatches=microbatches,
+        boundary_shapes=stage_boundary_shapes(tiny_model_cfg, IMG),
+        num_classes=NUM_CLASSES,
+        remat=False,
+    )
+    g = make_pipeline_step_fns(stages, schedule="gpipe", **kwargs)
+    f = make_pipeline_step_fns(stages, schedule="1f1b", **kwargs)
+    sg, lg, pg = g.train(_clone(state0), images, labels)
+    sf, lf, pf = f.train(_clone(state0), images, labels)
+    assert float(lg) == pytest.approx(float(lf), abs=1e-6)
+    np.testing.assert_array_equal(np.asarray(pg), np.asarray(pf))
+    _assert_tree_close(sg.params, sf.params, atol=1e-6)
+    _assert_tree_close(sg.batch_stats, sf.batch_stats, atol=1e-6)
+
+
 def test_pipeline_remat_matches_no_remat(tiny_model_cfg, batch):
     """jax.checkpoint on stages must not change the math."""
     images, labels = batch

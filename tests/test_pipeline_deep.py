@@ -71,6 +71,38 @@ def test_four_stage_pipeline_matches_sequential(cfg4, batch_data=None):
     _assert_tree_close(new_state.params, ref_params, atol=1e-4)
 
 
+def test_four_stage_1f1b_matches_gpipe(cfg4):
+    """1F1B on a 4-deep pipeline (warmup/steady/cooldown phases all
+    exercised: M=4 microbatches, ring depths 7/5/3/1 clamped to 4)."""
+    img = 32
+    stages = build_stages(cfg4)
+    tx = optax.sgd(0.1)
+    state = create_train_state(stages, tx, jax.random.key(0), img)
+    mesh = build_mesh(MeshSpec(1, 4))
+    kwargs = dict(
+        tx=tx,
+        mesh=mesh,
+        compute_dtype=jnp.float32,
+        num_microbatches=4,
+        boundary_shapes=stage_boundary_shapes(cfg4, img),
+        num_classes=5,
+        remat=False,
+    )
+    g = make_pipeline_step_fns(stages, schedule="gpipe", **kwargs)
+    f = make_pipeline_step_fns(stages, schedule="1f1b", **kwargs)
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, (B, img, img, 3)).astype(np.uint8)
+    labels = rng.integers(0, 5, (B,)).astype(np.int32)
+    clone = lambda s: jax.tree.map(jnp.copy, s)
+    sg, lg, pg = g.train(clone(state), images, labels)
+    sf, lf, pf = f.train(clone(state), images, labels)
+    assert float(lg) == pytest.approx(float(lf), abs=1e-6)
+    np.testing.assert_array_equal(np.asarray(pg), np.asarray(pf))
+    from tests.test_parallel import _assert_tree_close
+
+    _assert_tree_close(sg.params, sf.params, atol=1e-6)
+
+
 def test_bfloat16_pipeline_step(tiny_model_cfg):
     """bf16 compute dtype must run and learn-step without NaNs (the TPU MXU
     path); params stay f32."""

@@ -11,4 +11,16 @@ __all__ = [
     "lm_logical_rules",
     "make_ring_self_attention",
     "make_ulysses_self_attention",
+    "make_lm_pipeline_step_fns",
+    "split_lm_params",
 ]
+
+
+def __getattr__(name):
+    # lm_pipeline imports from train.lm_steps, which imports this package;
+    # resolve lazily to keep the package import acyclic.
+    if name in ("make_lm_pipeline_step_fns", "split_lm_params"):
+        from ddl_tpu.parallel import lm_pipeline
+
+        return getattr(lm_pipeline, name)
+    raise AttributeError(name)

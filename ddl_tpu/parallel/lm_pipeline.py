@@ -1,0 +1,351 @@
+"""Pipeline parallelism for the transformer LM family.
+
+The CNN path implements GPipe fully manually over a ``(data, pipe)`` mesh
+(``parallel/pipeline.py`` — every collective hand-placed inside one
+``shard_map``).  The transformer family instead expresses TP / SP / EP /
+FSDP as *logical-axis rules* resolved by XLA's SPMD partitioner
+(``parallel/sharding.py``), and this module adds the pipeline axis without
+giving that up: the GPipe clock loop runs inside a **partial-manual**
+``jax.shard_map`` that is manual over ``pipe`` only (``axis_names={'pipe'}``)
+— stage handoffs are explicit ``lax.ppermute`` hops, while everything inside
+a stage (batch over ``data``, sequence over ``seq``, heads/MLP over
+``model``, experts over ``expert``, FSDP parameter sharding) stays in auto
+mode and is partitioned by GSPMD exactly as in the non-pipelined path.
+
+This is the composition the reference builds by hand out of NCCL subgroups
+plus a DDP wrapper per pipeline stage (``ddp_n_pp.py:139-155``), extended to
+the axes its design cannot express, with no subgroup bookkeeping at all.
+
+Design (scan-over-ticks, stage-stacked params):
+
+* the ``n_layers`` decoder blocks are split into ``pipe`` equal stages;
+  per-stage block params are **stacked** on a leading stage axis and sharded
+  ``P('pipe', ...)`` — each device holds only its own stage's parameters and
+  optimizer state (unlike the CNN pipeline, which replicates the full tuple
+  and switches on stage index).  Gradients and Adam state inherit the same
+  sharding, so pipeline parallelism here also shards memory.
+* embedding and LM head run *outside* the manual region in plain GSPMD land
+  (they are cheap next to the block stack; MaxText's pipeline makes the same
+  cut).  Their gradients arrive through the shard_map transpose: the
+  embedded microbatch array enters replicated-over-pipe, so its cotangent is
+  the pipe-psum of per-device cotangents — only stage 0 contributes.
+* the GPipe schedule is a ``lax.scan`` over ``T = M + P - 1`` clock ticks.
+  Every device runs its stage every tick (the off-schedule ticks are the
+  GPipe bubble); there is no ``lax.switch`` because stages are uniform.
+  Stage 0 reads microbatch ``t`` from the embedded input; others read the
+  ``ppermute``'d boundary buffer.  The last stage's outputs accumulate into
+  a per-microbatch buffer; off-schedule writes land on clamped indices that
+  later valid writes overwrite, so no masking is needed on the data path.
+* the backward schedule is autodiff through the scan: each ``ppermute``
+  transposes into the reverse hop and the ticks replay backwards — the same
+  property the CNN pipeline exploits (``parallel/pipeline.py``).
+* per-stage MoE aux losses leave the manual region as a ``P('pipe')``-sharded
+  ``(pipe,)`` vector and are summed outside, keeping loss reductions out of
+  the differentiated manual region (psum-under-grad transposes into a psum
+  and scales cotangents — the trap documented in ``train/steps.py``).
+
+Restrictions (v1): ``attn_impl='dense'`` (and ``flash=False``) inside the
+pipeline — the ring/Ulysses cores are themselves ``shard_map``s over ``seq``
+and cannot nest inside the partial-manual region; dense attention is plain
+einsums that GSPMD partitions over whatever ``seq``/``model`` axes the mesh
+has.  ``n_layers`` must divide evenly into ``pipe`` stages and the batch
+into ``num_microbatches * data`` shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddl_tpu.models.transformer import Block, LMConfig, RMSNorm, TransformerLM
+from ddl_tpu.parallel.sharding import (
+    LM_PIPE_AXIS,
+    LMMeshSpec,
+    build_lm_mesh,
+    lm_logical_rules,
+)
+from ddl_tpu.train.lm_steps import LMStepFns, LMTrainState, _token_ce
+
+__all__ = ["make_lm_pipeline_step_fns", "split_lm_params"]
+
+
+class _Embed(nn.Module):
+    """Stage-0 prologue: token embedding (params shared-structure with
+    ``TransformerLM.embed`` so full-model checkpoints restructure 1:1)."""
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=jnp.float32,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )(tokens)
+        return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+
+class _Head(nn.Module):
+    """Last-stage epilogue: final RMSNorm + vocab projection."""
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = RMSNorm(cfg.dtype, name="norm_f")(x)
+        logits = nn.Dense(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )(x.astype(jnp.float32))
+        return nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+
+def split_lm_params(full_params: Any, n_stages: int) -> dict:
+    """Restructure a full ``TransformerLM`` param tree into the pipeline
+    layout ``{embed, blocks, head}``: ``blocks`` is the per-layer trees
+    stacked to ``(pipe, layers_per_stage, ...)``, stage-major in layer order
+    (stage p owns layers ``[p*Lps, (p+1)*Lps)``)."""
+    layer_keys = sorted(
+        (k for k in full_params if k.startswith("block")),
+        key=lambda k: int(k.removeprefix("block")),
+    )
+    n_layers = len(layer_keys)
+    lps = n_layers // n_stages
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs).reshape(n_stages, lps, *xs[0].shape),
+        *(full_params[k] for k in layer_keys),
+    )
+    return {
+        "embed": {"embed": full_params["embed"]},
+        "blocks": stacked,
+        "head": {"norm_f": full_params["norm_f"], "lm_head": full_params["lm_head"]},
+    }
+
+
+def make_lm_pipeline_step_fns(
+    cfg: LMConfig,
+    spec: LMMeshSpec,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    batch: int,
+    seq_len: int,
+    num_microbatches: int,
+    devices=None,
+) -> LMStepFns:
+    """Pipeline-parallel LM step functions (same interface as
+    ``make_lm_step_fns``).  Requires ``spec.pipe > 1``."""
+    n_stages, M = spec.pipe, num_microbatches
+    if n_stages < 2:
+        raise ValueError("make_lm_pipeline_step_fns needs spec.pipe >= 2")
+    if cfg.attn_impl != "dense" or cfg.flash:
+        raise ValueError(
+            "pipeline parallelism currently composes with attn_impl='dense' "
+            "only (the ring/Ulysses/flash cores are shard_maps over seq and "
+            "cannot nest inside the manual-over-pipe region)"
+        )
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers {cfg.n_layers} % pipe {n_stages} != 0")
+    if M < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {M}")
+    if batch % M:
+        raise ValueError(f"batch {batch} % microbatches {M} != 0")
+    mb = batch // M
+    if mb % spec.data:
+        raise ValueError(f"microbatch {mb} % mesh data={spec.data} != 0")
+    if seq_len % spec.seq:
+        raise ValueError(f"seq_len {seq_len} % mesh seq={spec.seq} != 0")
+    if cfg.num_experts and cfg.num_experts % spec.expert:
+        raise ValueError(
+            f"num_experts {cfg.num_experts} % mesh expert={spec.expert} != 0"
+        )
+    lps = cfg.n_layers // n_stages
+    mesh = build_lm_mesh(spec, devices)
+    rules = lm_logical_rules(cfg.fsdp)
+    block_cls = nn.remat(Block) if cfg.remat else Block
+    block_mod = block_cls(cfg, None)
+    embed_mod = _Embed(cfg)
+    head_mod = _Head(cfg)
+    compute_dtype = cfg.dtype
+    d = cfg.d_model
+
+    def stage_fn(stage_blocks, x):
+        """Run this device's ``lps`` decoder blocks (scan over the stacked
+        layer axis). Returns (out, summed moe aux)."""
+
+        def layer(carry, p):
+            y, aux = block_mod.apply({"params": p}, carry)
+            return y, aux
+
+        y, auxs = lax.scan(layer, x, stage_blocks)
+        return y, auxs.sum()
+
+    def pipeline_body(blocks_stacked, x_mb):
+        """Manual over ``pipe`` only.  blocks_stacked arrives as the local
+        (1, lps, ...) stage slice; x_mb (M, mb, T, D) is replicated over
+        pipe and auto-sharded over data/seq.  Returns the last stage's
+        per-microbatch outputs (lifted to a (1, M, mb, T, D) pipe-sharded
+        array; callers slice [-1]) and the (1,) per-stage aux loss."""
+        stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
+        s = lax.axis_index(LM_PIPE_AXIS)
+        t_len = x_mb.shape[2]
+        buf0 = jnp.zeros((mb, t_len, d), compute_dtype)
+        acc0 = jnp.zeros((M, mb, t_len, d), compute_dtype)
+
+        def tick(carry, t):
+            buf, acc, aux = carry
+            x_first = lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(s == 0, x_first, buf)
+            out, aux_t = stage_fn(stage_blocks, x_in)
+            valid = (t >= s) & (t - s < M)
+            aux = aux + jnp.where(valid, aux_t, 0.0)
+            # Off-schedule writes land on clamped indices; the valid write
+            # for microbatch i happens at tick P-1+i, after any clamped
+            # garbage, so the final buffer needs no masking (and only the
+            # last pipe coordinate's buffer is ever read).
+            acc = lax.dynamic_update_index_in_dim(
+                acc, out, jnp.clip(t - (n_stages - 1), 0, M - 1), 0
+            )
+            buf = lax.ppermute(
+                out, LM_PIPE_AXIS, [(i, i + 1) for i in range(n_stages - 1)]
+            )
+            return (buf, acc, aux), None
+
+        init = (buf0, acc0, jnp.zeros((), jnp.float32))
+        (_, acc, aux), _ = lax.scan(tick, init, jnp.arange(M + n_stages - 1))
+        return acc[None], aux[None]
+
+    pipeline = jax.shard_map(
+        pipeline_body,
+        mesh=mesh,
+        in_specs=(P(LM_PIPE_AXIS), P()),
+        out_specs=(P(LM_PIPE_AXIS), P(LM_PIPE_AXIS)),
+        axis_names={LM_PIPE_AXIS},
+        check_vma=False,
+    )
+
+    mb_spec = NamedSharding(mesh, P(None, "data", "seq"))
+
+    def forward(params, tokens):
+        with nn.logical_axis_rules(rules):
+            x = embed_mod.apply({"params": params["embed"]}, tokens)  # (B,T,D)
+            x = x.reshape(M, mb, seq_len, d)
+            x = lax.with_sharding_constraint(x, mb_spec)
+            acc, aux_vec = pipeline(params["blocks"], x)
+            x_out = acc[-1].reshape(batch, seq_len, d)
+            logits = head_mod.apply({"params": params["head"]}, x_out)
+        # Each (stage, microbatch) aux term is a mean over that microbatch's
+        # rows; dividing the sum by M recovers the full-batch per-layer mean
+        # the non-pipelined model computes.
+        return logits, aux_vec.sum() / M
+
+    # ---- init: build the full (non-pipelined) model's params and
+    # restructure, so pipeline and single-program checkpoints interconvert
+    # and parity tests can share initialisation. ----
+    dummy = jnp.zeros((batch, seq_len), jnp.int32)
+    full_model = TransformerLM(cfg, None)
+
+    def init_params(rng):
+        full = nn.meta.unbox(full_model.init(rng, dummy)["params"])
+        return split_lm_params(full, n_stages)
+
+    # Shardings: embed/head from the logical rule table; stacked blocks get
+    # ('pipe', None) prepended to each leaf's rule-resolved spec.
+    abs_params = jax.eval_shape(lambda r: full_model.init(r, dummy)["params"], rng)
+    logical = nn.get_partition_spec(abs_params)
+    mesh_sharding = nn.logical_to_mesh_sharding(logical, mesh, rules)
+    block0 = mesh_sharding["block0"]
+    blocks_sharding = jax.tree.map(
+        lambda sh: NamedSharding(mesh, P(LM_PIPE_AXIS, None, *sh.spec)), block0
+    )
+    param_shardings = {
+        "embed": {"embed": mesh_sharding["embed"]},
+        "blocks": blocks_sharding,
+        "head": {
+            "norm_f": mesh_sharding["norm_f"],
+            "lm_head": mesh_sharding["lm_head"],
+        },
+    }
+
+    def create_state(rng):
+        params = init_params(rng)
+        params = jax.lax.with_sharding_constraint(params, param_shardings)
+        return LMTrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    tok_sharding = NamedSharding(mesh, P("data", "seq"))
+    replicated = NamedSharding(mesh, P())
+
+    def loss_fn(params, inputs, targets):
+        logits, aux = forward(params, inputs)
+        ce = _token_ce(logits, targets)
+        loss = ce + cfg.moe_aux_weight * aux
+        return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})
+
+    def train_step(state, inputs, targets):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, (_, metrics)), grads = grad_fn(state.params, inputs, targets)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(step=state.step + 1, params=new_params, opt_state=new_opt),
+            metrics,
+        )
+
+    def eval_step(state, inputs, targets):
+        _, (logits, metrics) = loss_fn(state.params, inputs, targets)
+        acc = (jnp.argmax(logits, -1) == targets).mean()
+        return dict(metrics, accuracy=acc)
+
+    def _with_mesh(fn):
+        def wrapped(*args):
+            with jax.set_mesh(mesh):
+                return fn(*args)
+
+        return wrapped
+
+    create = _with_mesh(jax.jit(create_state))
+    train = _with_mesh(
+        jax.jit(
+            train_step,
+            in_shardings=(None, tok_sharding, tok_sharding),
+            out_shardings=(None, replicated),
+            donate_argnums=(0,),
+        )
+    )
+    evaluate = _with_mesh(
+        jax.jit(
+            eval_step,
+            in_shardings=(None, tok_sharding, tok_sharding),
+            out_shardings=replicated,
+        )
+    )
+    return LMStepFns(
+        train=train,
+        evaluate=evaluate,
+        init_state=lambda: create(rng),
+        mesh=mesh,
+    )

@@ -14,6 +14,8 @@ reduce-scatter for FSDP) and routes them over ICI.
 
 Mesh axes (``build_lm_mesh``):
     data    — batch / gradient data parallelism (and FSDP param sharding)
+    pipe    — pipeline parallelism over decoder-layer stages
+              (``parallel/lm_pipeline.py``)
     seq     — sequence/context parallelism (ring attention,
               ``parallel/ring_attention.py``)
     model   — tensor parallelism (attention heads, MLP hidden, vocab)
@@ -36,45 +38,53 @@ __all__ = [
     "SEQ_AXIS",
     "MODEL_AXIS",
     "EXPERT_AXIS",
+    "LM_PIPE_AXIS",
 ]
 
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
+PIPE_AXIS = LM_PIPE_AXIS = "pipe"
 
 
 @dataclasses.dataclass(frozen=True)
 class LMMeshSpec:
-    """4-axis mesh for the transformer family: (data, seq, model, expert)."""
+    """5-axis mesh for the transformer family:
+    (data, pipe, seq, model, expert)."""
 
     data: int = 1
     seq: int = 1
     model: int = 1
     expert: int = 1
+    pipe: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.seq * self.model * self.expert
+        return self.data * self.pipe * self.seq * self.model * self.expert
 
     @property
     def axis_names(self) -> tuple[str, ...]:
-        return ("data", SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS)
+        return ("data", PIPE_AXIS, SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS)
 
 
 def build_lm_mesh(spec: LMMeshSpec, devices: Sequence[jax.Device] | None = None) -> Mesh:
     """``model`` innermost so TP all-reduces ride the shortest ICI hops;
     ``data`` outermost so gradient reduction can cross DCN (the same
-    inner/outer split as the (data, pipe) mesh, ``parallel/mesh.py``)."""
+    inner/outer split as the (data, pipe) mesh, ``parallel/mesh.py``).
+    ``pipe`` sits next to ``data``: stage handoffs move one boundary
+    activation per microbatch tick — tiny volume, DCN-tolerant — while
+    seq/expert/model collectives stay on short ICI hops."""
     devices = list(devices if devices is not None else jax.devices())
     need = spec.num_devices
     if len(devices) < need:
         raise ValueError(f"mesh {spec} needs {need} devices, have {len(devices)}")
     grid = np.array(devices[:need]).reshape(
-        spec.data, spec.seq, spec.expert, spec.model
+        spec.data, spec.pipe, spec.seq, spec.expert, spec.model
     )
-    # axis order in the Mesh matches axis_names: (data, seq, model, expert);
-    # physically, model varies fastest, then expert, then seq, then data.
-    return Mesh(grid.transpose(0, 1, 3, 2), spec.axis_names)
+    # axis order in the Mesh matches axis_names: (data, pipe, seq, model,
+    # expert); physically, model varies fastest, then expert, then seq,
+    # then pipe, then data.
+    return Mesh(grid.transpose(0, 1, 2, 4, 3), spec.axis_names)
 
 
 def lm_logical_rules(fsdp: bool = False) -> tuple[tuple[str, str | None], ...]:

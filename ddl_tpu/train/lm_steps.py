@@ -85,6 +85,7 @@ def make_lm_step_fns(
     batch: int,
     seq_len: int,
     devices=None,
+    num_microbatches: int = 0,
 ) -> LMStepFns:
     """Build the sharded train state and jitted step functions.
 
@@ -94,7 +95,31 @@ def make_lm_step_fns(
     divisible by ``spec.model``; ``'ulysses'`` additionally needs the local
     head count ``n_heads / model`` divisible by ``spec.seq`` (its all-to-all
     splits heads across the sequence axis).
+
+    With ``spec.pipe > 1`` this delegates to the pipeline-parallel
+    implementation (``parallel/lm_pipeline.py``), which runs the decoder
+    stack as a GPipe schedule over the ``pipe`` mesh axis with
+    ``num_microbatches`` microbatches per step (0 = default to one
+    microbatch per stage).
     """
+    if spec.pipe > 1:
+        from ddl_tpu.parallel.lm_pipeline import make_lm_pipeline_step_fns
+
+        return make_lm_pipeline_step_fns(
+            cfg,
+            spec,
+            tx,
+            rng,
+            batch,
+            seq_len,
+            num_microbatches=num_microbatches or spec.pipe,
+            devices=devices,
+        )
+    if num_microbatches > 1:
+        raise ValueError(
+            f"num_microbatches={num_microbatches} requires a pipe mesh axis "
+            "(spec.pipe > 1); the non-pipelined step has no microbatching"
+        )
     if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(
             f"unknown attn_impl {cfg.attn_impl!r} "

@@ -7,6 +7,7 @@ the command line as mesh axis sizes, no code changes.
 
     python examples/train_lm.py --data 2 --seq 2 --model 2 --steps 100
     python examples/train_lm.py --experts 4 --expert-axis 2 --fsdp
+    python examples/train_lm.py --pipe 2 --model 2 --microbatches 4
 
 On a dev box without TPUs, add --cpu-devices 8 to simulate the mesh.
 """
@@ -27,6 +28,10 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=1)
     ap.add_argument("--model", type=int, default=1)
     ap.add_argument("--expert-axis", type=int, default=1)
+    ap.add_argument("--pipe", type=int, default=1,
+                    help="pipeline stages over the decoder layers (dense attn)")
+    ap.add_argument("--microbatches", type=int, default=0,
+                    help="GPipe microbatches when --pipe > 1 (default: --pipe)")
     ap.add_argument("--experts", type=int, default=0, help="0 = dense MLP")
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--attn", default=None, choices=["dense", "ring", "ulysses"],
@@ -76,13 +81,20 @@ def main() -> None:
         num_experts=args.experts,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
         attn_impl=args.attn
-        or (("ulysses" if args.flash else "ring") if args.seq > 1 else "dense"),
+        or (
+            "dense"
+            if args.pipe > 1
+            else ("ulysses" if args.flash else "ring") if args.seq > 1 else "dense"
+        ),
         flash=args.flash,
         fsdp=args.fsdp,
     )
-    spec = LMMeshSpec(args.data, args.seq, args.model, args.expert_axis)
+    spec = LMMeshSpec(
+        args.data, args.seq, args.model, args.expert_axis, pipe=args.pipe
+    )
     fns = make_lm_step_fns(
-        cfg, spec, optax.adam(args.lr), jax.random.key(0), args.batch, args.seq_len
+        cfg, spec, optax.adam(args.lr), jax.random.key(0), args.batch, args.seq_len,
+        num_microbatches=args.microbatches,
     )
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
 

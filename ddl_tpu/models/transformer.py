@@ -32,7 +32,14 @@ import jax.numpy as jnp
 
 from ddl_tpu.ops.attention import dense_attention
 
-__all__ = ["LMConfig", "TransformerLM", "count_lm_params"]
+__all__ = [
+    "LMConfig",
+    "TransformerLM",
+    "count_lm_params",
+    "make_embed",
+    "make_lm_head",
+    "apply_final_norm_and_head",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -308,6 +315,45 @@ class Block(nn.Module):
         return x + y, aux
 
 
+def make_embed(cfg: LMConfig) -> nn.Embed:
+    """The token embedding ('embed' in the param tree) — single source of
+    truth shared by ``TransformerLM`` and the pipeline's stage-0 prologue
+    (``parallel/lm_pipeline.py``), so full-model and pipelined param trees
+    restructure 1:1."""
+    return nn.Embed(
+        cfg.vocab_size,
+        cfg.d_model,
+        dtype=cfg.dtype,
+        param_dtype=jnp.float32,
+        embedding_init=nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")
+        ),
+        name="embed",
+    )
+
+
+def make_lm_head(cfg: LMConfig) -> nn.Dense:
+    """The vocab projection ('lm_head'); f32 so loss-side softmax is f32."""
+    return nn.Dense(
+        cfg.vocab_size,
+        use_bias=False,
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), ("embed", "vocab")
+        ),
+        name="lm_head",
+    )
+
+
+def apply_final_norm_and_head(cfg: LMConfig, x):
+    """Final RMSNorm ('norm_f') + lm_head -> constrained f32 logits.
+    Call inside an ``nn.compact`` method."""
+    x = RMSNorm(cfg.dtype, name="norm_f")(x)
+    logits = make_lm_head(cfg)(x.astype(jnp.float32))
+    return nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+
 class TransformerLM(nn.Module):
     """tokens (B, T) int32 -> (logits (B, T, V) f32, moe_aux_loss scalar)."""
 
@@ -317,17 +363,7 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens):
         cfg = self.cfg
-        embed = nn.Embed(
-            cfg.vocab_size,
-            cfg.d_model,
-            dtype=cfg.dtype,
-            param_dtype=jnp.float32,
-            embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("vocab", "embed")
-            ),
-            name="embed",
-        )
-        x = embed(tokens)
+        x = make_embed(cfg)(tokens)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         block = Block
         if cfg.remat:
@@ -336,21 +372,7 @@ class TransformerLM(nn.Module):
         for i in range(cfg.n_layers):
             x, aux = block(cfg, self.attn_core, name=f"block{i}")(x)
             aux_total = aux_total + aux
-        x = RMSNorm(cfg.dtype, name="norm_f")(x)
-        logits = nn.Dense(
-            cfg.vocab_size,
-            use_bias=False,
-            dtype=jnp.float32,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("embed", "vocab")
-            ),
-            name="lm_head",
-        )(x.astype(jnp.float32))
-        logits = nn.with_logical_constraint(
-            logits, ("batch", "act_seq", "act_vocab")
-        )
-        return logits, aux_total
+        return apply_final_norm_and_head(cfg, x), aux_total
 
 
 def count_lm_params(params) -> int:

@@ -44,11 +44,15 @@ Design (scan-over-ticks, stage-stacked params):
   the differentiated manual region (psum-under-grad transposes into a psum
   and scales cotangents — the trap documented in ``train/steps.py``).
 
-Restrictions (v1): ``attn_impl='dense'`` (and ``flash=False``) inside the
-pipeline — the ring/Ulysses cores are themselves ``shard_map``s over ``seq``
-and cannot nest inside the partial-manual region; dense attention is plain
-einsums that GSPMD partitions over whatever ``seq``/``model`` axes the mesh
-has.  ``n_layers`` must divide evenly into ``pipe`` stages and the batch
+Sequence parallelism composes through **nested** partial-manual shard_maps:
+the ring / Ulysses attention cores become inner ``shard_map``s that inherit
+the context mesh (no ``mesh=`` argument) and are manual over ``seq`` only —
+their ``ppermute`` / ``all_to_all`` collectives run over the ``seq`` axis
+while batch and heads stay auto-partitioned over ``data``/``model`` by
+GSPMD, inside the outer manual-over-``pipe`` region.  ``flash=True`` stays
+unsupported here: a Pallas call cannot be auto-partitioned over the
+remaining axes, so it requires the fully-manual region of the non-pipelined
+path.  ``n_layers`` must divide evenly into ``pipe`` stages and the batch
 into ``num_microbatches * data`` shards.
 """
 
@@ -63,60 +67,51 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ddl_tpu.models.transformer import Block, LMConfig, RMSNorm, TransformerLM
+from ddl_tpu.models.transformer import (
+    Block,
+    LMConfig,
+    TransformerLM,
+    apply_final_norm_and_head,
+    make_embed,
+)
 from ddl_tpu.parallel.sharding import (
-    LM_PIPE_AXIS,
+    PIPE_AXIS,
     LMMeshSpec,
     build_lm_mesh,
     lm_logical_rules,
 )
-from ddl_tpu.train.lm_steps import LMStepFns, LMTrainState, _token_ce
+from ddl_tpu.train.lm_steps import (
+    LMStepFns,
+    LMTrainState,
+    _token_ce,
+    finalize_step_fns,
+)
 
 __all__ = ["make_lm_pipeline_step_fns", "split_lm_params"]
 
 
 class _Embed(nn.Module):
-    """Stage-0 prologue: token embedding (params shared-structure with
-    ``TransformerLM.embed`` so full-model checkpoints restructure 1:1)."""
+    """Stage-0 prologue.  Uses ``make_embed`` — the same construction
+    ``TransformerLM`` composes — so full-model checkpoints restructure 1:1
+    (``split_lm_params``)."""
 
     cfg: LMConfig
 
     @nn.compact
     def __call__(self, tokens):
-        cfg = self.cfg
-        x = nn.Embed(
-            cfg.vocab_size,
-            cfg.d_model,
-            dtype=cfg.dtype,
-            param_dtype=jnp.float32,
-            embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("vocab", "embed")
-            ),
-            name="embed",
-        )(tokens)
+        x = make_embed(self.cfg)(tokens)
         return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 
 
 class _Head(nn.Module):
-    """Last-stage epilogue: final RMSNorm + vocab projection."""
+    """Last-stage epilogue: final RMSNorm + vocab projection (shared
+    construction with ``TransformerLM``)."""
 
     cfg: LMConfig
 
     @nn.compact
     def __call__(self, x):
-        cfg = self.cfg
-        x = RMSNorm(cfg.dtype, name="norm_f")(x)
-        logits = nn.Dense(
-            cfg.vocab_size,
-            use_bias=False,
-            dtype=jnp.float32,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("embed", "vocab")
-            ),
-            name="lm_head",
-        )(x.astype(jnp.float32))
-        return nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+        return apply_final_norm_and_head(self.cfg, x)
 
 
 def split_lm_params(full_params: Any, n_stages: int) -> dict:
@@ -156,11 +151,19 @@ def make_lm_pipeline_step_fns(
     n_stages, M = spec.pipe, num_microbatches
     if n_stages < 2:
         raise ValueError("make_lm_pipeline_step_fns needs spec.pipe >= 2")
-    if cfg.attn_impl != "dense" or cfg.flash:
+    if cfg.attn_impl not in ("dense", "ring", "ulysses"):
+        raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+    if cfg.flash:
         raise ValueError(
-            "pipeline parallelism currently composes with attn_impl='dense' "
-            "only (the ring/Ulysses/flash cores are shard_maps over seq and "
-            "cannot nest inside the manual-over-pipe region)"
+            "flash=True is not supported with pipeline parallelism: the "
+            "Pallas kernel needs the fully-manual attention region of the "
+            "non-pipelined path (GSPMD cannot auto-partition a custom call "
+            "over the data/model axes inside the manual-over-pipe region)"
+        )
+    if cfg.attn_impl == "ulysses" and cfg.n_heads % spec.seq:
+        raise ValueError(
+            f"n_heads {cfg.n_heads} % mesh seq={spec.seq} != 0 (the nested "
+            "Ulysses all-to-all splits the global head dim across seq)"
         )
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers {cfg.n_layers} % pipe {n_stages} != 0")
@@ -180,8 +183,46 @@ def make_lm_pipeline_step_fns(
     lps = cfg.n_layers // n_stages
     mesh = build_lm_mesh(spec, devices)
     rules = lm_logical_rules(cfg.fsdp)
+
+    # Sequence-parallel attention cores nest as inner shard_maps: no mesh
+    # argument (they inherit the context mesh, in which 'pipe' is already
+    # manual), manual over 'seq' only, specs naming only 'seq' — batch and
+    # heads remain auto-partitioned over data/model by GSPMD.
+    seq_spec = P(None, "seq")
+    if cfg.attn_impl == "ring":
+        from ddl_tpu.parallel.ring_attention import ring_attention
+
+        # The ring coordinate rides in as data (a P('seq')-sharded arange):
+        # lax.axis_index cannot lower inside nested manual regions.
+        ring_sm = jax.shard_map(
+            lambda q, k, v, pos: ring_attention(
+                q, k, v, axis_name="seq", causal=True, pos=pos[0]
+            ),
+            in_specs=(seq_spec,) * 3 + (P("seq"),),
+            out_specs=seq_spec,
+            axis_names={"seq"},
+            check_vma=False,
+        )
+
+        def attn_core(q, k, v):
+            return ring_sm(q, k, v, jnp.arange(spec.seq, dtype=jnp.int32))
+
+    elif cfg.attn_impl == "ulysses":
+        from functools import partial
+
+        from ddl_tpu.parallel.ulysses import ulysses_attention
+
+        attn_core = jax.shard_map(
+            partial(ulysses_attention, axis_name="seq", causal=True),
+            in_specs=(seq_spec,) * 3,
+            out_specs=seq_spec,
+            axis_names={"seq"},
+            check_vma=False,
+        )
+    else:
+        attn_core = None
     block_cls = nn.remat(Block) if cfg.remat else Block
-    block_mod = block_cls(cfg, None)
+    block_mod = block_cls(cfg, attn_core)
     embed_mod = _Embed(cfg)
     head_mod = _Head(cfg)
     compute_dtype = cfg.dtype
@@ -205,7 +246,7 @@ def make_lm_pipeline_step_fns(
         per-microbatch outputs (lifted to a (1, M, mb, T, D) pipe-sharded
         array; callers slice [-1]) and the (1,) per-stage aux loss."""
         stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
-        s = lax.axis_index(LM_PIPE_AXIS)
+        s = lax.axis_index(PIPE_AXIS)
         t_len = x_mb.shape[2]
         buf0 = jnp.zeros((mb, t_len, d), compute_dtype)
         acc0 = jnp.zeros((M, mb, t_len, d), compute_dtype)
@@ -227,7 +268,7 @@ def make_lm_pipeline_step_fns(
                 acc, out, jnp.clip(t - (n_stages - 1), 0, M - 1), 0
             )
             buf = lax.ppermute(
-                out, LM_PIPE_AXIS, [(i, i + 1) for i in range(n_stages - 1)]
+                out, PIPE_AXIS, [(i, i + 1) for i in range(n_stages - 1)]
             )
             return (buf, acc, aux), None
 
@@ -238,9 +279,9 @@ def make_lm_pipeline_step_fns(
     pipeline = jax.shard_map(
         pipeline_body,
         mesh=mesh,
-        in_specs=(P(LM_PIPE_AXIS), P()),
-        out_specs=(P(LM_PIPE_AXIS), P(LM_PIPE_AXIS)),
-        axis_names={LM_PIPE_AXIS},
+        in_specs=(P(PIPE_AXIS), P()),
+        out_specs=(P(PIPE_AXIS), P(PIPE_AXIS)),
+        axis_names={PIPE_AXIS},
         check_vma=False,
     )
 
@@ -276,7 +317,7 @@ def make_lm_pipeline_step_fns(
     mesh_sharding = nn.logical_to_mesh_sharding(logical, mesh, rules)
     block0 = mesh_sharding["block0"]
     blocks_sharding = jax.tree.map(
-        lambda sh: NamedSharding(mesh, P(LM_PIPE_AXIS, None, *sh.spec)), block0
+        lambda sh: NamedSharding(mesh, P(PIPE_AXIS, None, *sh.spec)), block0
     )
     param_shardings = {
         "embed": {"embed": mesh_sharding["embed"]},
@@ -296,56 +337,10 @@ def make_lm_pipeline_step_fns(
             opt_state=tx.init(params),
         )
 
-    tok_sharding = NamedSharding(mesh, P("data", "seq"))
-    replicated = NamedSharding(mesh, P())
-
     def loss_fn(params, inputs, targets):
         logits, aux = forward(params, inputs)
         ce = _token_ce(logits, targets)
         loss = ce + cfg.moe_aux_weight * aux
         return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})
 
-    def train_step(state, inputs, targets):
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, (_, metrics)), grads = grad_fn(state.params, inputs, targets)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return (
-            state.replace(step=state.step + 1, params=new_params, opt_state=new_opt),
-            metrics,
-        )
-
-    def eval_step(state, inputs, targets):
-        _, (logits, metrics) = loss_fn(state.params, inputs, targets)
-        acc = (jnp.argmax(logits, -1) == targets).mean()
-        return dict(metrics, accuracy=acc)
-
-    def _with_mesh(fn):
-        def wrapped(*args):
-            with jax.set_mesh(mesh):
-                return fn(*args)
-
-        return wrapped
-
-    create = _with_mesh(jax.jit(create_state))
-    train = _with_mesh(
-        jax.jit(
-            train_step,
-            in_shardings=(None, tok_sharding, tok_sharding),
-            out_shardings=(None, replicated),
-            donate_argnums=(0,),
-        )
-    )
-    evaluate = _with_mesh(
-        jax.jit(
-            eval_step,
-            in_shardings=(None, tok_sharding, tok_sharding),
-            out_shardings=replicated,
-        )
-    )
-    return LMStepFns(
-        train=train,
-        evaluate=evaluate,
-        init_state=lambda: create(rng),
-        mesh=mesh,
-    )
+    return finalize_step_fns(mesh, tx, loss_fn, create_state, rng)

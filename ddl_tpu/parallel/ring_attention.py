@@ -48,15 +48,23 @@ def _block_attention(q, k, v, mask, scale):
     return acc, blk_max, p.sum(axis=-1)
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, pos=None):
     """Attention over a ring-sharded sequence (call inside ``shard_map``).
 
     Per-device shapes: q, k, v: (B, T_local, H, D) — the local sequence
     shard.  Returns the local output shard (B, T_local, H, D), numerically
     equal to full softmax attention over the global sequence.
+
+    ``pos`` overrides the device's ring coordinate (default
+    ``lax.axis_index``).  Callers nesting this inside another partial-manual
+    ``shard_map`` must pass it as data — e.g. the local element of a
+    ``P(axis_name)``-sharded ``arange`` — because ``lax.axis_index`` cannot
+    lower inside nested manual regions (its lowering binds every other mesh
+    axis, colliding with the parent's bound axes; see
+    ``parallel/lm_pipeline.py``).
     """
     n = lax.axis_size(axis_name)
-    s = lax.axis_index(axis_name)
+    s = lax.axis_index(axis_name) if pos is None else pos
     b, t, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
     # ring: receive the next block from the left neighbour each step

@@ -38,19 +38,23 @@ __all__ = [
     "SEQ_AXIS",
     "MODEL_AXIS",
     "EXPERT_AXIS",
-    "LM_PIPE_AXIS",
+    "PIPE_AXIS",
 ]
 
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
-PIPE_AXIS = LM_PIPE_AXIS = "pipe"
+PIPE_AXIS = "pipe"
 
 
 @dataclasses.dataclass(frozen=True)
 class LMMeshSpec:
-    """5-axis mesh for the transformer family:
-    (data, pipe, seq, model, expert)."""
+    """5-axis mesh for the transformer family.
+
+    Mesh axis order is ``(data, pipe, seq, model, expert)`` — but note the
+    *field* order below is ``(data, seq, model, expert, pipe)``: ``pipe``
+    was added last to keep existing positional constructions valid.  Pass
+    ``pipe`` by keyword."""
 
     data: int = 1
     seq: int = 1

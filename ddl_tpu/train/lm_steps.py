@@ -30,7 +30,13 @@ from ddl_tpu.parallel.ring_attention import make_ring_self_attention
 from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
 from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
 
-__all__ = ["LMTrainState", "LMStepFns", "make_lm_step_fns", "make_ring_core"]
+__all__ = [
+    "LMTrainState",
+    "LMStepFns",
+    "make_lm_step_fns",
+    "make_ring_core",
+    "finalize_step_fns",
+]
 
 
 class LMTrainState(struct.PyTreeNode):
@@ -75,6 +81,72 @@ def _token_ce(logits, targets):
         logits, targets[..., None].astype(jnp.int32), axis=-1
     )[..., 0]
     return (lse - picked).mean()
+
+
+def finalize_step_fns(
+    mesh: Mesh,
+    tx: optax.GradientTransformation,
+    loss_fn,
+    create_state,
+    rng: jax.Array,
+) -> LMStepFns:
+    """Shared tail for the non-pipelined and pipelined LM paths: wrap a
+    ``loss_fn(params, inputs, targets) -> (loss, (logits, metrics))`` and a
+    ``create_state(rng)`` into jitted, donated, mesh-scoped step functions.
+
+    ``jax.set_mesh`` wraps every call because ``nn.with_logical_constraint``
+    lowers to bare-PartitionSpec sharding constraints, which resolve against
+    the ambient mesh at trace time.
+    """
+    tok_sharding = NamedSharding(mesh, P("data", "seq"))
+    replicated = NamedSharding(mesh, P())
+
+    def train_step(state, inputs, targets):
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (_, (_, metrics)), grads = grad_fn(state.params, inputs, targets)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            ),
+            metrics,
+        )
+
+    def eval_step(state, inputs, targets):
+        _, (logits, metrics) = loss_fn(state.params, inputs, targets)
+        acc = (jnp.argmax(logits, -1) == targets).mean()
+        return dict(metrics, accuracy=acc)
+
+    def _with_mesh(fn):
+        def wrapped(*args):
+            with jax.set_mesh(mesh):
+                return fn(*args)
+
+        return wrapped
+
+    create = _with_mesh(jax.jit(create_state))
+    train = _with_mesh(
+        jax.jit(
+            train_step,
+            in_shardings=(None, tok_sharding, tok_sharding),
+            out_shardings=(None, replicated),
+            donate_argnums=(0,),
+        )
+    )
+    evaluate = _with_mesh(
+        jax.jit(
+            eval_step,
+            in_shardings=(None, tok_sharding, tok_sharding),
+            out_shardings=replicated,
+        )
+    )
+    return LMStepFns(
+        train=train,
+        evaluate=evaluate,
+        init_state=lambda: create(rng),
+        mesh=mesh,
+    )
 
 
 def make_lm_step_fns(
@@ -203,9 +275,6 @@ def make_lm_step_fns(
             opt_state=tx.init(params),
         )
 
-    tok_sharding = NamedSharding(mesh, P("data", "seq"))
-    replicated = NamedSharding(mesh, P())
-
     def loss_fn(params, inputs, targets):
         with nn.logical_axis_rules(rules):
             logits, aux = model.apply({"params": params}, inputs)
@@ -213,51 +282,4 @@ def make_lm_step_fns(
         loss = ce + cfg.moe_aux_weight * aux
         return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})
 
-    def train_step(state, inputs, targets):
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, (_, metrics)), grads = grad_fn(state.params, inputs, targets)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return (
-            state.replace(
-                step=state.step + 1, params=new_params, opt_state=new_opt
-            ),
-            metrics,
-        )
-
-    def eval_step(state, inputs, targets):
-        _, (logits, metrics) = loss_fn(state.params, inputs, targets)
-        acc = (jnp.argmax(logits, -1) == targets).mean()
-        return dict(metrics, accuracy=acc)
-
-    def _with_mesh(fn):
-        # nn.with_logical_constraint lowers to bare-PartitionSpec sharding
-        # constraints, which resolve against the ambient mesh at trace time.
-        def wrapped(*args):
-            with jax.set_mesh(mesh):
-                return fn(*args)
-
-        return wrapped
-
-    create = _with_mesh(jax.jit(create_state))
-    train = _with_mesh(
-        jax.jit(
-            train_step,
-            in_shardings=(None, tok_sharding, tok_sharding),
-            out_shardings=(None, replicated),
-            donate_argnums=(0,),
-        )
-    )
-    evaluate = _with_mesh(
-        jax.jit(
-            eval_step,
-            in_shardings=(None, tok_sharding, tok_sharding),
-            out_shardings=replicated,
-        )
-    )
-    return LMStepFns(
-        train=train,
-        evaluate=evaluate,
-        init_state=lambda: create(rng),
-        mesh=mesh,
-    )
+    return finalize_step_fns(mesh, tx, loss_fn, create_state, rng)

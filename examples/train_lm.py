@@ -29,7 +29,7 @@ def main() -> None:
     ap.add_argument("--model", type=int, default=1)
     ap.add_argument("--expert-axis", type=int, default=1)
     ap.add_argument("--pipe", type=int, default=1,
-                    help="pipeline stages over the decoder layers (dense attn)")
+                    help="pipeline stages over the decoder layers")
     ap.add_argument("--microbatches", type=int, default=0,
                     help="GPipe microbatches when --pipe > 1 (default: --pipe)")
     ap.add_argument("--experts", type=int, default=0, help="0 = dense MLP")
@@ -81,11 +81,7 @@ def main() -> None:
         num_experts=args.experts,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
         attn_impl=args.attn
-        or (
-            "dense"
-            if args.pipe > 1
-            else ("ulysses" if args.flash else "ring") if args.seq > 1 else "dense"
-        ),
+        or (("ulysses" if args.flash else "ring") if args.seq > 1 else "dense"),
         flash=args.flash,
         fsdp=args.fsdp,
     )

@@ -7,6 +7,8 @@ same model/seed — same loss, same post-Adam parameters — on the simulated
 is validated only statistically, SURVEY.md §4.)
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -94,6 +96,28 @@ def test_lm_pipeline_matches_single_dense(spec, microbatches):
     assert np.isfinite(float(em["loss"])) and 0.0 <= float(em["accuracy"]) <= 1.0
 
 
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_lm_pipeline_with_sequence_parallel_attention(impl):
+    """PP x SP x TP: the ring/Ulysses cores nest as inner shard_maps
+    (manual over seq, inheriting the context mesh) inside the
+    manual-over-pipe pipeline region.  Must match the single-device dense
+    run — both cores are numerically full attention."""
+    cfg = _cfg(n_heads=4, n_layers=4)
+    tx = optax.adam(1e-2)
+    rng = jax.random.key(0)
+    inp, tgt = _batch()
+    _, p1_ref, loss_ref = _single_step(cfg, tx, rng, inp, tgt)
+
+    spec = LMMeshSpec(data=1, pipe=2, seq=2, model=2)
+    fns = make_lm_step_fns(
+        dataclasses.replace(cfg, attn_impl=impl), spec, tx, rng, B, T,
+        devices=jax.devices()[:8], num_microbatches=2,
+    )
+    s1, m = fns.train(fns.init_state(), inp, tgt)
+    assert abs(float(m["loss"]) - loss_ref) < 1e-5
+    assert _maxerr(split_lm_params(p1_ref, 2), jax.device_get(s1.params)) < 1e-3
+
+
 def test_lm_pipeline_moe_composition():
     """PP x TP x EP x FSDP in one program.  MoE parity is approximate: the
     load-balance aux is a product of batch-means, so per-microbatch
@@ -136,9 +160,9 @@ def test_split_lm_params_stage_major():
 def test_lm_pipeline_validation_errors():
     tx = optax.adam(1e-2)
     rng = jax.random.key(0)
-    with pytest.raises(ValueError, match="dense"):
+    with pytest.raises(ValueError, match="flash"):
         make_lm_pipeline_step_fns(
-            _cfg(attn_impl="ring"), LMMeshSpec(pipe=2), tx, rng, B, T, 2,
+            _cfg(flash=True), LMMeshSpec(pipe=2), tx, rng, B, T, 2,
             devices=jax.devices()[:2],
         )
     with pytest.raises(ValueError, match="n_layers"):

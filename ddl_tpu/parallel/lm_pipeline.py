@@ -87,7 +87,13 @@ from ddl_tpu.train.lm_steps import (
     finalize_step_fns,
 )
 
-__all__ = ["make_lm_pipeline_step_fns", "split_lm_params"]
+__all__ = [
+    "make_lm_pipeline_step_fns",
+    "split_lm_params",
+    "merge_lm_params",
+    "convert_lm_state",
+    "abstract_lm_state",
+]
 
 
 class _Embed(nn.Module):
@@ -134,6 +140,131 @@ def split_lm_params(full_params: Any, n_stages: int) -> dict:
         "blocks": stacked,
         "head": {"norm_f": full_params["norm_f"], "lm_head": full_params["lm_head"]},
     }
+
+
+def merge_lm_params(pp_params: dict) -> dict:
+    """Inverse of ``split_lm_params``: pipeline layout ``{embed, blocks,
+    head}`` back to the flat ``TransformerLM`` tree (``block{i}`` keyed,
+    stage-major layer order)."""
+    blocks = pp_params["blocks"]
+    shape_leaf = jax.tree.leaves(blocks)[0]
+    n_stages, lps = shape_leaf.shape[:2]
+    full = {
+        "embed": pp_params["embed"]["embed"],
+        "norm_f": pp_params["head"]["norm_f"],
+        "lm_head": pp_params["head"]["lm_head"],
+    }
+    for p in range(n_stages):
+        for j in range(lps):
+            full[f"block{p * lps + j}"] = jax.tree.map(
+                lambda x: x[p, j], blocks
+            )
+    return full
+
+
+def _is_pipeline_tree(x) -> bool:
+    return isinstance(x, dict) and set(x) == {"embed", "blocks", "head"}
+
+
+def _is_full_tree(x) -> bool:
+    return isinstance(x, dict) and "lm_head" in x and "block0" in x
+
+
+def _map_param_subtrees(x, convert):
+    """Apply ``convert`` to every param-layout dict inside an arbitrary
+    optimizer-state structure (NamedTuples / tuples / lists / dicts of
+    arrays and param-shaped trees, e.g. Adam's ``mu``/``nu``).  The layout
+    checks run first so a param tree is converted whole, not recursed into."""
+    if _is_pipeline_tree(x) or _is_full_tree(x):
+        return convert(x)
+    if isinstance(x, tuple) and hasattr(x, "_fields"):  # NamedTuple state
+        return type(x)(*(_map_param_subtrees(f, convert) for f in x))
+    if isinstance(x, (tuple, list)):
+        return type(x)(_map_param_subtrees(f, convert) for f in x)
+    if isinstance(x, dict):  # e.g. multi_transform's inner_states
+        return {k: _map_param_subtrees(v, convert) for k, v in x.items()}
+    return x
+
+
+def abstract_lm_state(
+    cfg: LMConfig,
+    tx: optax.GradientTransformation,
+    n_stages: int = 1,
+    mesh: Mesh | None = None,
+) -> LMTrainState:
+    """Shape/dtype skeleton of an ``LMTrainState`` in the given layout
+    (``n_stages=1`` = full, ``>1`` = pipeline), for use as a restore target
+    (``checkpoint.load_snapshot``) without building step functions, running
+    an init on devices, or needing the saved run's mesh: param shapes depend
+    only on ``cfg`` (RoPE — no seq-length-shaped params), so a snapshot's
+    tree is reconstructible from config alone.
+
+    Pass ``mesh`` (the *restoring* run's mesh) to attach replicated
+    shardings to the skeleton — without it Orbax falls back to the sharding
+    file written at save time, which only resolves on the exact saving
+    topology.  The restored replicated arrays are then re-placed by
+    ``convert_lm_state(..., like=...)``."""
+    model = TransformerLM(cfg, None)
+    dummy = jnp.zeros((1, 1), jnp.int32)
+
+    def build(rng):
+        params = nn.meta.unbox(model.init(rng, dummy)["params"])
+        if n_stages > 1:
+            params = split_lm_params(params, n_stages)
+        return LMTrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    abstract = jax.eval_shape(build, jax.random.key(0))
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        abstract = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=rep),
+            abstract,
+        )
+    return abstract
+
+
+def convert_lm_state(
+    state: LMTrainState,
+    *,
+    n_stages: int | None = None,
+    like: LMTrainState | None = None,
+) -> LMTrainState:
+    """Convert an ``LMTrainState`` between the full (non-pipelined) and
+    pipeline param layouts, including every param-shaped subtree of the
+    optimizer state (Adam ``mu``/``nu`` mirror the param tree, so the same
+    structural transform applies).
+
+    Pass ``n_stages`` to go full -> pipeline; omit it to go pipeline ->
+    full.  ``like`` (a state from the destination step functions'
+    ``init_state``) re-places the converted arrays onto the destination
+    mesh/shardings — required when the source and destination meshes
+    differ.  Together with Orbax's mesh-elastic restore (``checkpoint.py``)
+    this makes the parallelism topology a *resume-time* choice: a snapshot
+    from a plain TP/FSDP run continues as a pipelined run and vice versa
+    (``tests/test_lm_pipeline.py::test_lm_pipeline_checkpoint_interop``).
+    """
+    if n_stages is None:
+        convert = merge_lm_params
+        if not _is_pipeline_tree(state.params):
+            raise ValueError(
+                "state is not in pipeline layout; pass n_stages to convert "
+                "full -> pipeline"
+            )
+    else:
+        if not _is_full_tree(state.params):
+            raise ValueError("state is not in full layout")
+        convert = lambda p: split_lm_params(p, n_stages)
+    out = state.replace(
+        params=convert(state.params),
+        opt_state=_map_param_subtrees(state.opt_state, convert),
+    )
+    if like is not None:
+        out = jax.device_put(out, jax.tree.map(lambda x: x.sharding, like))
+    return out
 
 
 def make_lm_pipeline_step_fns(

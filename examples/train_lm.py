@@ -51,6 +51,9 @@ def main() -> None:
     ap.add_argument("--save-every", type=int, default=50)
     ap.add_argument("--resume-step", type=int, default=None,
                     help="restore the snapshot saved at this step (any mesh)")
+    ap.add_argument("--resume-pipe", type=int, default=None,
+                    help="pipe stage count the snapshot was saved with, if "
+                    "it differs from --pipe (cross-layout resume)")
     ap.add_argument("--job-id", default="lm")
     args = ap.parse_args()
 
@@ -88,8 +91,9 @@ def main() -> None:
     spec = LMMeshSpec(
         args.data, args.seq, args.model, args.expert_axis, pipe=args.pipe
     )
+    tx = optax.adam(args.lr)
     fns = make_lm_step_fns(
-        cfg, spec, optax.adam(args.lr), jax.random.key(0), args.batch, args.seq_len,
+        cfg, spec, tx, jax.random.key(0), args.batch, args.seq_len,
         num_microbatches=args.microbatches,
     )
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
@@ -118,11 +122,40 @@ def main() -> None:
     if args.checkpoint_dir and args.resume_step is not None:
         from ddl_tpu.checkpoint import load_snapshot
 
-        state, _ = load_snapshot(
-            args.checkpoint_dir, args.job_id, args.resume_step, state
-        )
+        saved_pipe = args.resume_pipe if args.resume_pipe is not None else args.pipe
+        if saved_pipe == args.pipe:
+            state, _ = load_snapshot(
+                args.checkpoint_dir, args.job_id, args.resume_step, state
+            )
+            print("resumed (snapshots are mesh-independent)")
+        else:
+            # Cross-layout resume: the snapshot was written with a
+            # different pipe stage count (possibly none).  Restore through
+            # an abstract skeleton of the saved layout (no init, no step
+            # functions — the saved run's batch/mesh/flash settings are
+            # irrelevant to the state tree), then restructure params +
+            # optimizer state and re-place onto this run's mesh.
+            from ddl_tpu.parallel.lm_pipeline import (
+                abstract_lm_state,
+                convert_lm_state,
+            )
+
+            restored, _ = load_snapshot(
+                args.checkpoint_dir, args.job_id, args.resume_step,
+                abstract_lm_state(cfg, tx, saved_pipe, mesh=fns.mesh),
+            )
+            if args.pipe > 1:
+                if saved_pipe > 1:  # restage: merge, then re-split below
+                    restored = convert_lm_state(restored)
+                state = convert_lm_state(restored, n_stages=args.pipe, like=state)
+            else:  # saved_pipe > 1 here (layouts differ): merge + place
+                state = convert_lm_state(restored, like=state)
+            print(
+                f"resumed across layouts (saved pipe={saved_pipe} -> "
+                f"run pipe={args.pipe})"
+            )
         start = int(state.step)
-        print(f"resumed from step {start} (snapshots are mesh-independent)")
+        print(f"continuing from step {start}")
     t0 = time.perf_counter()
     for i in range(start, args.steps):
         inp, tgt = sample_batch(i)

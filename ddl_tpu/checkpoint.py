@@ -32,6 +32,7 @@ __all__ = [
     "save_snapshot",
     "load_snapshot",
     "snapshot_path",
+    "snapshot_metadata",
     "latest_epoch",
     "SnapshotManager",
 ]
@@ -64,6 +65,18 @@ def load_snapshot(
     with ocp.StandardCheckpointer() as ckptr:
         restored = ckptr.restore(path, {"state": abstract, "epoch": 0})
     return restored["state"], int(restored["epoch"]) + 1
+
+
+def snapshot_metadata(
+    checkpoint_dir: str | os.PathLike, job_id: str, epoch: int
+) -> Any:
+    """Structure of a saved snapshot — the ``{state, epoch}`` tree with
+    shape/dtype/sharding metadata leaves, read without touching array data.
+    Lets a resuming run discover how a snapshot was laid out (e.g. its
+    pipeline stage count) instead of being told via flags."""
+    path = snapshot_path(checkpoint_dir, job_id, epoch)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.metadata(path).item_metadata.tree
 
 
 class SnapshotManager:

@@ -93,6 +93,7 @@ __all__ = [
     "merge_lm_params",
     "convert_lm_state",
     "abstract_lm_state",
+    "saved_pipe_stages",
 ]
 
 
@@ -184,6 +185,22 @@ def _map_param_subtrees(x, convert):
     if isinstance(x, dict):  # e.g. multi_transform's inner_states
         return {k: _map_param_subtrees(v, convert) for k, v in x.items()}
     return x
+
+
+def saved_pipe_stages(params: Any) -> int:
+    """Pipe stage count a params tree was written with (1 = full layout).
+    Works on real trees and on checkpoint *metadata* trees (anything whose
+    leaves carry ``.shape`` — see ``checkpoint.snapshot_metadata``), so a
+    resuming run can discover a snapshot's layout without flags."""
+    if _is_pipeline_tree(params):
+        return int(jax.tree.leaves(params["blocks"])[0].shape[0])
+    if not _is_full_tree(params):
+        raise ValueError(
+            f"unrecognized params layout (keys: {sorted(params)[:8]}...)"
+            if isinstance(params, dict)
+            else f"unrecognized params layout: {type(params)}"
+        )
+    return 1
 
 
 def abstract_lm_state(

@@ -50,10 +50,9 @@ def main() -> None:
                     help="save a snapshot every --save-every steps")
     ap.add_argument("--save-every", type=int, default=50)
     ap.add_argument("--resume-step", type=int, default=None,
-                    help="restore the snapshot saved at this step (any mesh)")
-    ap.add_argument("--resume-pipe", type=int, default=None,
-                    help="pipe stage count the snapshot was saved with, if "
-                    "it differs from --pipe (cross-layout resume)")
+                    help="restore the snapshot saved at this step (any mesh, "
+                    "any pipeline layout — the saved layout is read from the "
+                    "snapshot's metadata)")
     ap.add_argument("--job-id", default="lm")
     args = ap.parse_args()
 
@@ -120,9 +119,14 @@ def main() -> None:
     state = fns.init_state()
     start = 0
     if args.checkpoint_dir and args.resume_step is not None:
-        from ddl_tpu.checkpoint import load_snapshot
+        from ddl_tpu.checkpoint import load_snapshot, snapshot_metadata
+        from ddl_tpu.parallel.lm_pipeline import saved_pipe_stages
 
-        saved_pipe = args.resume_pipe if args.resume_pipe is not None else args.pipe
+        # The snapshot itself records its layout — no flag to get wrong.
+        saved_md = snapshot_metadata(
+            args.checkpoint_dir, args.job_id, args.resume_step
+        )
+        saved_pipe = saved_pipe_stages(saved_md["state"]["params"])
         if saved_pipe == args.pipe:
             state, _ = load_snapshot(
                 args.checkpoint_dir, args.job_id, args.resume_step, state

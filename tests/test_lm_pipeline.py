@@ -145,8 +145,12 @@ def test_lm_pipeline_checkpoint_interop(tmp_path):
     plain DP run (full layout) resumes as a pipelined run and vice versa —
     convert_lm_state restructures params AND Adam mu/nu; Orbax handles the
     mesh change.  Loss after resume must match the uninterrupted run."""
-    from ddl_tpu.checkpoint import load_snapshot, save_snapshot
-    from ddl_tpu.parallel.lm_pipeline import abstract_lm_state, convert_lm_state
+    from ddl_tpu.checkpoint import load_snapshot, save_snapshot, snapshot_metadata
+    from ddl_tpu.parallel.lm_pipeline import (
+        abstract_lm_state,
+        convert_lm_state,
+        saved_pipe_stages,
+    )
 
     cfg = _cfg()
     tx = optax.adam(1e-2)
@@ -171,6 +175,9 @@ def test_lm_pipeline_checkpoint_interop(tmp_path):
     # only resolves on the exact saving topology).
     state, _ = run(full_fns, full_fns.init_state(), batches[:3])
     save_snapshot(tmp_path, "full-job", 3, state)
+    # the snapshot records its own layout — discoverable from metadata alone
+    md = snapshot_metadata(tmp_path, "full-job", 3)
+    assert saved_pipe_stages(md["state"]["params"]) == 1
     pp_fns = make_lm_step_fns(cfg, LMMeshSpec(data=2, pipe=2), tx, rng, B, T,
                               devices=jax.devices()[:4], num_microbatches=2)
     restored, _ = load_snapshot(
@@ -183,6 +190,8 @@ def test_lm_pipeline_checkpoint_interop(tmp_path):
 
     # pipeline -> full: saved on 4 devices, restored onto 2
     save_snapshot(tmp_path, "pp-job", 5, pp_state)
+    md = snapshot_metadata(tmp_path, "pp-job", 5)
+    assert saved_pipe_stages(md["state"]["params"]) == 2
     restored_pp, _ = load_snapshot(
         tmp_path, "pp-job", 5,
         abstract_lm_state(cfg, tx, n_stages=2, mesh=full_fns.mesh),

@@ -1,0 +1,7 @@
+from ddl_tpu.infer.decode import (
+    LMDecode,
+    init_kv_cache,
+    make_lm_generator,
+)
+
+__all__ = ["LMDecode", "init_kv_cache", "make_lm_generator"]

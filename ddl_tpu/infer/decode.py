@@ -1,0 +1,158 @@
+"""Autoregressive inference for the transformer LM family.
+
+The reference's only inference surface is a loss-less pipeline schedule used
+for evaluation (``pp.py:146-150``); it has no generation path at all.  A
+complete framework needs one, so this module adds KV-cached autoregressive
+decoding over the *training* parameter tree — no weight export step, no
+separate inference model:
+
+* ``Attention``/``Block`` (``models/transformer.py``) expose an incremental
+  mode sharing the training parameters by construction (same submodule
+  names), so any training snapshot — including one restructured from the
+  pipeline layout by ``parallel.lm_pipeline.convert_lm_state`` — decodes
+  as-is.
+* The KV cache is a static-shape ``(B, prompt+max_new, H, Dh)`` buffer per
+  layer, updated in place via ``dynamic_update_slice`` — XLA keeps the
+  update in-place on TPU, and the whole generate loop is ONE jitted
+  program: prefill, then ``lax.scan`` over decode steps (compiler-friendly
+  control flow; no per-token dispatch from Python).
+* Sharding: the same logical-axis rule table as training
+  (``parallel/sharding.py``) — batch over ``data``, heads over ``model`` —
+  so tensor-parallel decode works on the same mesh as the training run.
+  Sampling happens on replicated logits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddl_tpu.models.transformer import (
+    Block,
+    LMConfig,
+    apply_final_norm_and_head,
+    make_embed,
+)
+from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
+
+__all__ = ["LMDecode", "init_kv_cache", "make_lm_generator"]
+
+
+class LMDecode(nn.Module):
+    """One incremental forward over the full layer stack.
+
+    ``tokens`` (B, T) — the prompt at prefill (T = prompt length) or the
+    last sampled token during decode (T = 1); ``caches`` — per-layer
+    ``(k, v)`` tuples; ``offset`` — positions already in the cache.
+    Returns (logits (B, T, V) f32, new caches).  Submodule names mirror
+    ``TransformerLM`` exactly, so the training param tree applies as-is.
+    """
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, tokens, caches, offset, last_only: bool = False):
+        cfg = self.cfg
+        x = make_embed(cfg)(tokens)
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        new_caches = []
+        for i in range(cfg.n_layers):
+            x, _aux, c = Block(cfg, None, name=f"block{i}")(x, caches[i], offset)
+            new_caches.append(c)
+        if last_only:  # prefill only needs the next-token logits
+            x = x[:, -1:]
+        return apply_final_norm_and_head(cfg, x), tuple(new_caches)
+
+
+def init_kv_cache(
+    cfg: LMConfig, batch: int, max_len: int, dtype=None
+) -> tuple:
+    """Per-layer zeroed ``(k, v)`` buffers of shape (B, max_len, H, Dh)."""
+    dtype = dtype or cfg.dtype
+    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    zero = jnp.zeros(shape, dtype)
+    return tuple((zero, zero) for _ in range(cfg.n_layers))
+
+
+def make_lm_generator(
+    cfg: LMConfig,
+    spec: Optional[LMMeshSpec] = None,
+    *,
+    prompt_len: int,
+    max_new: int,
+    batch: int = 1,
+    temperature: float = 0.0,
+    devices=None,
+    mesh=None,
+):
+    """Build a jitted ``generate(params, prompt, rng) -> tokens`` function.
+
+    ``prompt`` is (B, prompt_len) int32; the result is (B, max_new) int32.
+    ``temperature=0`` decodes greedily; otherwise tokens are sampled from
+    ``softmax(logits / temperature)``.  One XLA program: prefill + a
+    ``lax.scan`` of single-token steps over a static-size KV cache.
+
+    ``spec``/``devices`` (or an explicit ``mesh``) place the computation:
+    batch over ``data``, attention heads over ``model`` (tensor-parallel
+    decode).  ``cfg.attn_impl`` is ignored here — incremental decode is
+    always cached dense attention; ring/Ulysses are training-time
+    strategies for long-context *processing*, and the prompt fits the
+    cache by construction.
+    """
+    if mesh is None:
+        mesh = build_lm_mesh(spec or LMMeshSpec(), devices)
+    rules = lm_logical_rules(cfg.fsdp)
+    model = LMDecode(cfg)
+    max_len = prompt_len + max_new
+
+    def generate(params, prompt, rng):
+        caches = init_kv_cache(cfg, batch, max_len)
+
+        with nn.logical_axis_rules(rules):
+            logits, caches = model.apply(
+                {"params": params}, prompt, caches, 0, last_only=True
+            )
+        last = logits[:, -1]
+
+        def sample(logits, rng):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                rng, logits / jnp.float32(temperature), axis=-1
+            ).astype(jnp.int32)
+
+        def step(carry, i):
+            last, caches, rng = carry
+            rng, sub = jax.random.split(rng)
+            tok = sample(last, sub)
+            with nn.logical_axis_rules(rules):
+                logits, caches = model.apply(
+                    {"params": params}, tok[:, None], caches, prompt_len + i
+                )
+            return (logits[:, 0], caches, rng), tok
+
+        (_, _, _), toks = lax.scan(
+            step, (last, caches, rng), jnp.arange(max_new)
+        )
+        return toks.T  # (B, max_new)
+
+    tok_sharding = NamedSharding(mesh, P("data"))
+
+    jitted = jax.jit(
+        generate,
+        in_shardings=(None, tok_sharding, None),
+        out_shardings=tok_sharding,
+    )
+
+    def run(params, prompt, rng=None):
+        if rng is None:
+            rng = jax.random.key(0)
+        with jax.set_mesh(mesh):
+            return jitted(params, prompt, rng)
+
+    return run

@@ -78,12 +78,16 @@ class LMConfig:
         return jnp.dtype(self.compute_dtype)
 
 
-def _rope(x, theta: float):
-    """Rotary embeddings over global positions. x: (B, T, H, D)."""
+def _rope(x, theta: float, positions=None):
+    """Rotary embeddings. x: (B, T, H, D); ``positions`` (T,) overrides the
+    default global positions 0..T-1 (incremental decode passes
+    ``offset + arange(T)``)."""
     _, t, _, d = x.shape
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # (T, half)
+    if positions is None:
+        positions = jnp.arange(t, dtype=jnp.float32)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
     cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
@@ -112,11 +116,22 @@ def _dense_attention(q, k, v):
 
 
 class Attention(nn.Module):
+    """Causal self-attention.  Two modes share the same parameters:
+
+    * training/eval (``kv_cache=None``): full-sequence attention through
+      ``attn_core`` (dense, ring, Ulysses, or flash).
+    * incremental decode (``kv_cache=(k, v)`` of shape (B, L, H, Dh),
+      ``offset`` = number of positions already decoded): the new tokens'
+      K/V are written into the cache at ``offset`` and the queries attend
+      over the whole cache under the causal mask; returns
+      ``(out, (new_k, new_v))``.  Used by ``infer/decode.py``.
+    """
+
     cfg: LMConfig
     attn_core: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv_cache=None, offset=None):
         cfg = self.cfg
         b, t, _ = x.shape
         # kernels are flat (embed, heads*head_dim) with the fused dim sharded
@@ -137,14 +152,32 @@ class Attention(nn.Module):
             return y.reshape(b, t, cfg.n_heads, cfg.head_dim)
 
         q, k, v = proj("q"), proj("k"), proj("v")
-        q = _rope(q, cfg.rope_theta)
-        k = _rope(k, cfg.rope_theta)
+        positions = None
+        if kv_cache is not None:
+            positions = offset + jnp.arange(t)
+        q = _rope(q, cfg.rope_theta, positions)
+        k = _rope(k, cfg.rope_theta, positions)
         spec = ("batch", "act_seq", "act_heads", None)
         q = nn.with_logical_constraint(q, spec)
         k = nn.with_logical_constraint(k, spec)
         v = nn.with_logical_constraint(v, spec)
-        core = self.attn_core if self.attn_core is not None else _dense_attention
-        o = nn.with_logical_constraint(core(q, k, v), spec)
+        if kv_cache is None:
+            core = self.attn_core if self.attn_core is not None else _dense_attention
+            o = nn.with_logical_constraint(core(q, k, v), spec)
+            new_cache = None
+        else:
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, offset, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, offset, 0, 0))
+            ck = nn.with_logical_constraint(ck, spec)
+            cv = nn.with_logical_constraint(cv, spec)
+            # queries at global positions offset+i attend keys <= that
+            # position; padded cache slots beyond offset+t are masked out.
+            key_pos = jnp.arange(ck.shape[1])
+            mask = key_pos[None, :] <= (offset + jnp.arange(t))[:, None]  # (T, L)
+            o = dense_attention(q, ck, cv, mask=mask)
+            o = nn.with_logical_constraint(o, spec)
+            new_cache = (ck, cv)
         out = nn.Dense(
             cfg.d_model,
             use_bias=False,
@@ -155,7 +188,8 @@ class Attention(nn.Module):
             ),
             name="out",
         )(o.reshape(b, t, cfg.n_heads * cfg.head_dim))
-        return nn.with_logical_constraint(out, ("batch", "act_seq", "act_embed"))
+        out = nn.with_logical_constraint(out, ("batch", "act_seq", "act_embed"))
+        return out if kv_cache is None else (out, new_cache)
 
 
 class Mlp(nn.Module):
@@ -298,21 +332,30 @@ class MoeMlp(nn.Module):
 
 
 class Block(nn.Module):
+    """Pre-norm decoder block.  With ``kv_cache`` (incremental decode) the
+    return gains the updated cache: ``(x, aux, new_cache)``."""
+
     cfg: LMConfig
     attn_core: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv_cache=None, offset=None):
         cfg = self.cfg
-        x = x + Attention(cfg, self.attn_core, name="attn")(
-            RMSNorm(cfg.dtype, name="norm_attn")(x)
-        )
+        attn = Attention(cfg, self.attn_core, name="attn")
+        h = RMSNorm(cfg.dtype, name="norm_attn")(x)
+        if kv_cache is None:
+            x = x + attn(h)
+            new_cache = None
+        else:
+            a, new_cache = attn(h, kv_cache, offset)
+            x = x + a
         h = RMSNorm(cfg.dtype, name="norm_mlp")(x)
         if cfg.num_experts > 0:
             y, aux = MoeMlp(cfg, name="moe")(h)
         else:
             y, aux = Mlp(cfg, name="mlp")(h), jnp.zeros((), jnp.float32)
-        return x + y, aux
+        x = x + y
+        return (x, aux) if kv_cache is None else (x, aux, new_cache)
 
 
 def make_embed(cfg: LMConfig) -> nn.Embed:

@@ -16,13 +16,17 @@ import jax.numpy as jnp
 __all__ = ["dense_attention"]
 
 
-def dense_attention(q, k, v, causal: bool = False):
-    """Full softmax attention. q, k, v: (B, T, H, D) -> (B, T, H, D)."""
+def dense_attention(q, k, v, causal: bool = False, mask=None):
+    """Full softmax attention. q: (B, Tq, H, D), k/v: (B, Tk, H, D) ->
+    (B, Tq, H, D).  ``mask`` is an explicit (Tq, Tk) bool mask (True =
+    attend) for cross-length cases like KV-cache decode; ``causal`` builds
+    the square tril mask."""
     d = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
-    if causal:
+    if causal and mask is None:
         t = q.shape[1]
         mask = jnp.tril(jnp.ones((t, t), bool))
+    if mask is not None:
         scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -98,22 +98,17 @@ def main() -> None:
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
 
     # synthetic corpus: byte sequences from a fixed order-1 Markov chain —
-    # learnable structure with a known entropy floor
-    chain_rng = np.random.default_rng(0)
-    trans = chain_rng.dirichlet(np.full(8, 0.2), size=256)  # 8 likely successors
-    succ = chain_rng.integers(0, 256, (256, 8))
-    cum = trans.cumsum(axis=1)  # (256, 8) cumulative successor probs
+    # learnable structure with a known entropy floor (shared with
+    # generate_lm.py via ddl_tpu.data.synthetic_lm)
+    from ddl_tpu.data.synthetic_lm import MarkovChain
+
+    chain = MarkovChain()
 
     def sample_batch(step):
         # seeded by step so a resumed run continues the stream instead of
         # re-consuming the batches the original run already trained on
         rng = np.random.default_rng(1000 + step)
-        seqs = np.empty((args.batch, args.seq_len + 1), np.int32)
-        seqs[:, 0] = rng.integers(0, 256, args.batch)
-        for t in range(args.seq_len):
-            u = rng.random((args.batch, 1))
-            choice = (cum[seqs[:, t]] > u).argmax(axis=1)
-            seqs[:, t + 1] = succ[seqs[:, t], choice]
+        seqs = chain.sample(rng, args.batch, args.seq_len + 1)
         return jnp.asarray(seqs[:, :-1]), jnp.asarray(seqs[:, 1:])
 
     state = fns.init_state()

@@ -1,0 +1,155 @@
+"""KV-cached autoregressive decoding (infer/decode.py).
+
+Parity discipline: incremental decode shares parameters with the training
+model by construction, so its logits must match the full-sequence forward
+bit-for-bit-close in f32 — both at prefill and after every cached step.
+(The reference has no generation path at all; its only inference surface is
+the loss-less eval schedule, ``pp.py:146-150``.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl_tpu.infer import LMDecode, init_kv_cache, make_lm_generator
+from ddl_tpu.models.transformer import LMConfig, TransformerLM
+from ddl_tpu.parallel.sharding import LMMeshSpec
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=32,
+        d_model=16,
+        n_layers=2,
+        n_heads=2,
+        head_dim=8,
+        d_ff=32,
+        compute_dtype="float32",
+        attn_impl="dense",
+        remat=False,
+    )
+    base.update(kw)
+    return LMConfig(**base)
+
+
+def _params(cfg, batch=2, t=8, seed=0):
+    model = TransformerLM(cfg, None)
+    dummy = jnp.zeros((batch, t), jnp.int32)
+    import flax.linen as nn
+
+    return nn.meta.unbox(model.init(jax.random.key(seed), dummy)["params"])
+
+
+def test_prefill_matches_full_forward():
+    """Prefill through the cache path == the training forward."""
+    cfg = _cfg()
+    b, p = 2, 6
+    params = _params(cfg, b, p)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 32, (b, p)))
+
+    ref_logits, _ = TransformerLM(cfg, None).apply({"params": params}, toks)
+
+    caches = init_kv_cache(cfg, b, p + 2)
+    dec_logits, _ = LMDecode(cfg).apply({"params": params}, toks, caches, 0)
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(dec_logits), atol=1e-5
+    )
+
+
+def test_incremental_matches_full_forward():
+    """Token-by-token cached decode reproduces the full forward's logits at
+    every position."""
+    cfg = _cfg()
+    b, t = 2, 7
+    params = _params(cfg, b, t)
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 32, (b, t)))
+
+    ref_logits, _ = TransformerLM(cfg, None).apply({"params": params}, toks)
+
+    dec = LMDecode(cfg)
+    caches = init_kv_cache(cfg, b, t)
+    got = []
+    for i in range(t):
+        logits, caches = dec.apply(
+            {"params": params}, toks[:, i : i + 1], caches, i
+        )
+        got.append(logits[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.stack([np.asarray(g) for g in got], 1),
+        atol=1e-5,
+    )
+
+
+def test_greedy_generate_matches_teacher_forcing():
+    """The jitted generate loop == a python loop re-running the full
+    forward and taking argmax each step."""
+    cfg = _cfg()
+    b, p, n = 2, 4, 5
+    params = _params(cfg, b, p)
+    prompt = jnp.asarray(np.random.default_rng(2).integers(0, 32, (b, p)))
+
+    model = TransformerLM(cfg, None)
+    seq = prompt
+    ref = []
+    for _ in range(n):
+        logits, _ = model.apply({"params": params}, seq)
+        tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        ref.append(np.asarray(tok))
+        seq = jnp.concatenate([seq, tok[:, None]], axis=1)
+
+    gen = make_lm_generator(
+        cfg, prompt_len=p, max_new=n, batch=b, devices=jax.devices()[:1]
+    )
+    out = np.asarray(gen(params, prompt))
+    assert out.shape == (b, n)
+    np.testing.assert_array_equal(out, np.stack(ref, 1))
+
+
+def test_tp_decode_matches_single_device():
+    """Tensor-parallel decode on a (data=2, model=2) mesh == 1 device."""
+    cfg = _cfg(n_heads=4)
+    b, p, n = 4, 4, 4
+    params = _params(cfg, b, p)
+    prompt = jnp.asarray(np.random.default_rng(3).integers(0, 32, (b, p)))
+
+    single = make_lm_generator(
+        cfg, prompt_len=p, max_new=n, batch=b, devices=jax.devices()[:1]
+    )
+    tp = make_lm_generator(
+        cfg,
+        LMMeshSpec(data=2, model=2),
+        prompt_len=p,
+        max_new=n,
+        batch=b,
+        devices=jax.devices()[:4],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single(params, prompt)), np.asarray(tp(params, prompt))
+    )
+
+
+def test_sampled_generation_and_moe():
+    """Temperature sampling is deterministic under a fixed key; MoE decode
+    runs end-to-end (capacity-based routing makes incremental MoE logits
+    legitimately diverge from teacher forcing, so only self-consistency is
+    asserted)."""
+    cfg = _cfg(num_experts=4, expert_top_k=2)
+    b, p, n = 2, 4, 4
+    params = _params(cfg, b, p)
+    prompt = jnp.asarray(np.random.default_rng(4).integers(0, 32, (b, p)))
+
+    gen = make_lm_generator(
+        cfg, prompt_len=p, max_new=n, batch=b, temperature=0.8,
+        devices=jax.devices()[:1],
+    )
+    a = np.asarray(gen(params, prompt, jax.random.key(7)))
+    bb = np.asarray(gen(params, prompt, jax.random.key(7)))
+    np.testing.assert_array_equal(a, bb)
+    assert a.shape == (b, n)
+    assert ((a >= 0) & (a < 32)).all()
+    # different keys must eventually diverge (an untrained model's output
+    # distribution is near-uniform over 32 tokens)
+    others = [np.asarray(gen(params, prompt, jax.random.key(s)))
+              for s in (8, 9, 10)]
+    assert any(not np.array_equal(a, o) for o in others)

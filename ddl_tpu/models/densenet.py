@@ -265,16 +265,24 @@ def init_stages(
     the natural unit for pipeline sharding (each ``pipe`` device owns one
     entry) and for the per-stage checkpoints the reference writes
     (``pp.py:84-90`` keys state by rank).
+
+    The whole initialisation is one jitted program: un-jitted Flax init
+    runs the forward eagerly, and DenseNet121's hundreds of ops dispatched
+    one-by-one take minutes on a remote/tunneled TPU where the same work
+    compiled is seconds.
     """
-    params, batch_stats = [], []
-    x = jnp.zeros((batch_size, image_size, image_size, 3), jnp.float32)
-    for i, stage in enumerate(stages):
-        rng, sub = jax.random.split(rng)
-        variables = stage.init(sub, x, train=False)
-        params.append(variables["params"])
-        batch_stats.append(variables.get("batch_stats", {}))
-        x = stage.apply(variables, x, train=False)
-    return tuple(params), tuple(batch_stats)
+
+    def _init(rng):
+        params, batch_stats = [], []
+        x = jnp.zeros((batch_size, image_size, image_size, 3), jnp.float32)
+        for stage in stages:
+            rng, sub = jax.random.split(rng)
+            x, variables = stage.init_with_output(sub, x, train=False)
+            params.append(variables["params"])
+            batch_stats.append(variables.get("batch_stats", {}))
+        return tuple(params), tuple(batch_stats)
+
+    return jax.jit(_init)(rng)
 
 
 def apply_stage(stage: DenseNetStage, params, batch_stats, x, train: bool):

@@ -96,6 +96,13 @@ class TrainConfig:
     b1: float = 0.9
     b2: float = 0.999
     eps: float = 1e-8
+    # Training-schedule surface the reference lacks (train/state.py
+    # build_optimizer): defaults reproduce its unconfigured Adam exactly.
+    weight_decay: float = 0.0  # >0 switches to decoupled AdamW
+    grad_clip_norm: float = 0.0  # >0 enables global-norm clipping
+    lr_schedule: str = "constant"  # "constant" | "cosine"
+    warmup_steps: int = 0  # linear 0 -> lr ramp prepended to either schedule
+    decay_steps: int = 0  # total steps for cosine (incl. warmup)
     num_microbatches: int = 5  # reference pp.py:378
     # "gpipe" (reference ScheduleGPipe semantics, pp.py:140) or "1f1b"
     # (O(stages) activation memory instead of O(microbatches))
@@ -246,7 +253,18 @@ def parse_cli(argv: list[str] | None = None) -> Config:
         help="dotted config overrides, e.g. train.max_epochs=3 mesh.data=4",
     )
     parser.add_argument("--print-config", action="store_true")
+    parser.add_argument(
+        "--cpu-devices",
+        type=int,
+        default=0,
+        help="simulate N CPU devices instead of real TPUs (dev/test; same "
+        "as the examples' flag)",
+    )
     args = parser.parse_args(argv)
+    if args.cpu_devices:
+        from ddl_tpu.launch import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
     overrides = {}
     for item in args.set:
         path, _, value = item.partition("=")

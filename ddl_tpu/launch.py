@@ -21,7 +21,21 @@ import os
 
 import jax
 
-__all__ = ["bootstrap", "world_info"]
+__all__ = ["bootstrap", "world_info", "force_cpu_devices"]
+
+
+def force_cpu_devices(n: int) -> None:
+    """Simulate ``n`` CPU devices instead of real TPUs (dev/test) — the one
+    place the XLA_FLAGS + jax_platforms dance lives (used by the CLI's and
+    the examples' ``--cpu-devices`` flags and mirrored by tests/conftest.py).
+    Safe any time before the JAX backend initialises, even after ``import
+    jax``; ``config.update`` is preferred over the ``JAX_PLATFORMS`` env var,
+    which can hang under externally-registered platform plugins."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    jax.config.update("jax_platforms", "cpu")
 
 
 def bootstrap(

@@ -138,6 +138,14 @@ class Trainer:
             num_workers=cfg.data.num_workers,
             drop_last=True,
         )
+        if len(self.test_loader) == 0:
+            raise ValueError(
+                f"eval set ({len(test_ds)} examples) yields zero full "
+                f"batches at eval_batch_size={cfg.data.eval_batch_size} "
+                f"across {n_proc} process(es); eval batches must be full "
+                "(static SPMD shapes) — shrink data.eval_batch_size or "
+                "grow the test split"
+            )
 
         self.logger = MetricLogger(
             cfg.train.log_dir,

@@ -47,14 +47,10 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.cpu_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.cpu_devices}"
-        ).strip()
-    import jax
+        from ddl_tpu.launch import force_cpu_devices
 
-    if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_devices(args.cpu_devices)
+    import jax
     import jax.numpy as jnp
     import numpy as np
     import optax

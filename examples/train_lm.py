@@ -42,6 +42,14 @@ def main() -> None:
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--warmup", type=int, default=0,
+                    help="linear LR warmup steps")
+    ap.add_argument("--cosine", action="store_true",
+                    help="cosine-decay the LR to 0 over --steps")
+    ap.add_argument("--weight-decay", type=float, default=0.0,
+                    help=">0 switches to decoupled AdamW")
+    ap.add_argument("--clip-norm", type=float, default=0.0,
+                    help=">0 enables global-norm gradient clipping")
     ap.add_argument("--d-model", type=int, default=128)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--cpu-devices", type=int, default=0,
@@ -57,17 +65,12 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.cpu_devices:
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.cpu_devices}"
-        ).strip()
-    import jax
+        from ddl_tpu.launch import force_cpu_devices
 
-    if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_devices(args.cpu_devices)
+    import jax
     import jax.numpy as jnp
     import numpy as np
-    import optax
 
     from ddl_tpu.models.transformer import LMConfig
     from ddl_tpu.parallel.sharding import LMMeshSpec
@@ -90,7 +93,16 @@ def main() -> None:
     spec = LMMeshSpec(
         args.data, args.seq, args.model, args.expert_axis, pipe=args.pipe
     )
-    tx = optax.adam(args.lr)
+    from ddl_tpu.train.state import build_optimizer
+
+    tx = build_optimizer(
+        args.lr,
+        weight_decay=args.weight_decay,
+        grad_clip_norm=args.clip_norm,
+        lr_schedule="cosine" if args.cosine else "constant",
+        warmup_steps=args.warmup,
+        decay_steps=args.steps if args.cosine else 0,
+    )
     fns = make_lm_step_fns(
         cfg, spec, tx, jax.random.key(0), args.batch, args.seq_len,
         num_microbatches=args.microbatches,

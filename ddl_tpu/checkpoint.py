@@ -75,6 +75,13 @@ def snapshot_metadata(
     Lets a resuming run discover how a snapshot was laid out (e.g. its
     pipeline stage count) instead of being told via flags."""
     path = snapshot_path(checkpoint_dir, job_id, epoch)
+    if not path.is_dir():
+        have = latest_epoch(checkpoint_dir, job_id)
+        raise FileNotFoundError(
+            f"no snapshot at {path}"
+            + (f" (latest for job {job_id!r}: {have})" if have is not None
+               else f" (job {job_id!r} has no snapshots)")
+        )
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.metadata(path).item_metadata.tree
 

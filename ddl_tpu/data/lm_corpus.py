@@ -1,0 +1,138 @@
+"""Token-corpus data pipeline for the transformer LM family.
+
+The reference's data pipeline is images-only (CSV metadata + PNGs,
+``single.py:38-65``); the LM family needs the text equivalent.  Design
+follows the same host-sharded pattern as the image path
+(``data/sampler.py``): a flat token array on disk is viewed as
+non-overlapping ``seq_len + 1``-token windows, a global epoch-seeded
+permutation of window indices is split across data-parallel hosts
+(`ShardedEpochSampler`), and each batch slices ``(inputs, targets)`` as
+``window[:-1] / window[1:]``.  Storage is a memory-mapped ``.npy`` — the
+loader touches only the pages a batch needs, so corpus size is bounded by
+disk, not RAM, and every host maps the same file read-only.
+
+``encode_text_file`` builds a byte-level corpus (vocab 256, matching
+``train_lm.py``'s default LMConfig) from any text/binary file; corpora
+tokenized elsewhere just need an integer ``.npy``.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+from ddl_tpu.data.sampler import ShardedEpochSampler
+
+__all__ = ["TokenCorpus", "TokenBatches", "encode_text_file"]
+
+
+def encode_text_file(
+    text_path: str | os.PathLike, out_path: str | os.PathLike
+) -> Path:
+    """Byte-level encode a file into a ``uint8`` token ``.npy``."""
+    out = Path(out_path)
+    tokens = np.frombuffer(Path(text_path).read_bytes(), np.uint8)
+    np.save(out, tokens)
+    return out
+
+
+class TokenCorpus:
+    """Non-overlapping ``seq_len + 1``-token windows over a memmapped
+    token array.  ``__getitem__`` returns ``(inputs, targets)`` int32
+    arrays of length ``seq_len`` (targets shifted by one)."""
+
+    def __init__(self, path: str | os.PathLike, seq_len: int) -> None:
+        self.tokens = np.load(path, mmap_mode="r")
+        if self.tokens.ndim != 1 or not np.issubdtype(
+            self.tokens.dtype, np.integer
+        ):
+            raise ValueError(
+                f"{path}: expected a 1-D integer token array, got "
+                f"{self.tokens.shape} {self.tokens.dtype}"
+            )
+        self.seq_len = seq_len
+        self.num_windows = (len(self.tokens) - 1) // seq_len
+        if self.num_windows < 1:
+            raise ValueError(
+                f"{path}: {len(self.tokens)} tokens is too short for even "
+                f"one seq_len={seq_len} window"
+            )
+
+    def __len__(self) -> int:
+        return self.num_windows
+
+    def __getitem__(self, i: int):
+        s = self.seq_len
+        w = np.asarray(self.tokens[i * s : i * s + s + 1], np.int32)
+        return w[:-1], w[1:]
+
+    def max_token(self) -> int:
+        """Highest token id (one pass over the memmap) — for vocab checks."""
+        return int(self.tokens.max())
+
+
+class TokenBatches:
+    """Host-sharded epoch iterator of ``(inputs, targets)`` batches, both
+    ``(batch, seq_len)`` int32 — the LM analog of the image ``DataLoader``
+    (same sampler semantics: ``set_epoch`` reshuffle, drop_last, shard by
+    process).  ``batch`` is the *per-host* batch size."""
+
+    def __init__(
+        self,
+        corpus: TokenCorpus,
+        batch: int,
+        num_shards: int = 1,
+        shard_rank: int = 0,
+        shuffle: bool = True,
+        seed: int = 0,
+    ) -> None:
+        self.corpus = corpus
+        self.batch = batch
+        self.sampler = ShardedEpochSampler(
+            len(corpus), num_shards, shard_rank, shuffle=shuffle,
+            drop_last=True, seed=seed,
+        )
+        if len(self) == 0:
+            raise ValueError(
+                f"corpus yields {len(self.sampler)} windows/shard at "
+                f"seq_len={corpus.seq_len} across {num_shards} shard(s) — "
+                f"fewer than one batch of {batch}"
+            )
+
+    def set_epoch(self, epoch: int) -> None:
+        if epoch != self.sampler.epoch:
+            self.sampler.set_epoch(epoch)
+            self._idxs = None
+
+    def __len__(self) -> int:
+        return len(self.sampler) // self.batch
+
+    def _materialize(self, chunk: np.ndarray):
+        s = self.corpus.seq_len
+        inp = np.empty((len(chunk), s), np.int32)
+        tgt = np.empty((len(chunk), s), np.int32)
+        for j, i in enumerate(chunk):
+            inp[j], tgt[j] = self.corpus[int(i)]
+        return inp, tgt
+
+    def _indices(self) -> np.ndarray:
+        if getattr(self, "_idxs", None) is None:
+            self._idxs = self.sampler.indices()
+        return self._idxs
+
+    def batch_at(self, step: int):
+        """Deterministic batch for global *training step* ``step``: epoch
+        ``step // len(self)``, position ``step % len(self)``.  Because the
+        mapping is pure in ``step``, a resumed run continues the token
+        stream exactly where the interrupted run left it."""
+        epoch, pos = divmod(step, len(self))
+        self.set_epoch(epoch)
+        idxs = self._indices()
+        return self._materialize(idxs[pos * self.batch : (pos + 1) * self.batch])
+
+    def __iter__(self):
+        idxs = self._indices()
+        for b in range(len(self)):
+            yield self._materialize(idxs[b * self.batch : (b + 1) * self.batch])

@@ -37,6 +37,9 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--experts", type=int, default=0)
     ap.add_argument("--fsdp", action="store_true")
+    ap.add_argument("--prompt-text", default=None,
+                    help="byte-level text prompt (e.g. for --corpus-trained "
+                    "models); output is decoded as text")
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--max-new", type=int, default=64)
     ap.add_argument("--batch", type=int, default=2)
@@ -101,8 +104,26 @@ def main() -> None:
         mesh=mesh,
     )
 
-    # prompts drawn from the training corpus's Markov chain (the same
-    # seed-0 chain train_lm.py trains on, ddl_tpu.data.synthetic_lm)
+    if args.prompt_text is not None:
+        enc = args.prompt_text.encode()
+        if len(enc) > args.prompt_len:
+            print(f"note: keeping the LAST {args.prompt_len} of "
+                  f"{len(enc)} prompt bytes (raise --prompt-len to keep all)")
+        raw = enc[-args.prompt_len:]  # trailing bytes = continuation context
+        raw = raw.rjust(args.prompt_len, b" ")  # left-pad to the fixed shape
+        prompts = np.tile(
+            np.frombuffer(raw, np.uint8).astype(np.int32), (args.batch, 1)
+        )
+        toks = np.asarray(gen(state.params, jnp.asarray(prompts),
+                              jax.random.key(args.seed)))
+        for b in range(args.batch):
+            text = bytes(int(t) % 256 for t in toks[b]).decode(errors="replace")
+            print(f"{raw.decode(errors='replace')!r} -> {text!r}")
+        return
+
+    # default: prompts drawn from the synthetic training corpus's Markov
+    # chain (the same seed-0 chain train_lm.py trains on,
+    # ddl_tpu.data.synthetic_lm)
     from ddl_tpu.data.synthetic_lm import MarkovChain
 
     chain = MarkovChain()

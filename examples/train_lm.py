@@ -38,6 +38,9 @@ def main() -> None:
                     help="attention impl (default: ring when --seq > 1, else dense)")
     ap.add_argument("--flash", action="store_true",
                     help="use the Pallas flash-attention kernel (dense/ulysses)")
+    ap.add_argument("--corpus", default=None,
+                    help="token .npy or raw text file to train on "
+                    "(default: synthetic Markov-chain bytes)")
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--steps", type=int, default=100)
@@ -109,19 +112,70 @@ def main() -> None:
     )
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
 
-    # synthetic corpus: byte sequences from a fixed order-1 Markov chain —
-    # learnable structure with a known entropy floor (shared with
-    # generate_lm.py via ddl_tpu.data.synthetic_lm)
-    from ddl_tpu.data.synthetic_lm import MarkovChain
+    if args.corpus:
+        # real corpus: memmapped token windows, host-sharded per process;
+        # each process loads 1/n_proc of the global batch and the shards
+        # are assembled into one global jax.Array
+        from ddl_tpu.data.lm_corpus import TokenBatches, TokenCorpus, encode_text_file
 
-    chain = MarkovChain()
+        n_proc, proc = jax.process_count(), jax.process_index()
+        if args.batch % n_proc:
+            raise ValueError(
+                f"--batch {args.batch} must divide by process count {n_proc}"
+            )
+        path = args.corpus
+        if not path.endswith(".npy"):
+            npy = path + ".npy"
+            stale = not os.path.exists(npy) or (
+                os.path.getmtime(npy) < os.path.getmtime(path)
+            )
+            if stale and proc == 0:  # encode once, one writer
+                encode_text_file(path, npy)
+            if n_proc > 1:
+                from jax.experimental import multihost_utils
 
-    def sample_batch(step):
-        # seeded by step so a resumed run continues the stream instead of
-        # re-consuming the batches the original run already trained on
-        rng = np.random.default_rng(1000 + step)
-        seqs = chain.sample(rng, args.batch, args.seq_len + 1)
-        return jnp.asarray(seqs[:, :-1]), jnp.asarray(seqs[:, 1:])
+                multihost_utils.sync_global_devices("corpus_encode")
+            path = npy
+        corpus = TokenCorpus(path, args.seq_len)
+        if corpus.max_token() >= cfg.vocab_size:
+            raise ValueError(
+                f"corpus has token id {corpus.max_token()} but the model's "
+                f"vocab_size is {cfg.vocab_size}; out-of-range ids would be "
+                "silently clamped by the embedding gather"
+            )
+        batches = TokenBatches(
+            corpus, args.batch // n_proc, n_proc, proc, seed=0
+        )
+        print(f"corpus: {len(corpus)} windows of {args.seq_len}+1 tokens, "
+              f"{len(batches)} batches/epoch/host")
+        if n_proc > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            gspec = NamedSharding(fns.mesh, P("data", "seq"))
+
+        def sample_batch(step):
+            # pure in step -> a resumed run continues the stream exactly
+            inp, tgt = batches.batch_at(step)
+            if n_proc > 1:  # host shards -> one global array
+                return (
+                    jax.make_array_from_process_local_data(gspec, inp),
+                    jax.make_array_from_process_local_data(gspec, tgt),
+                )
+            return jnp.asarray(inp), jnp.asarray(tgt)
+    else:
+        # synthetic corpus: byte sequences from a fixed order-1 Markov
+        # chain — learnable structure with a known entropy floor (shared
+        # with generate_lm.py via ddl_tpu.data.synthetic_lm)
+        from ddl_tpu.data.synthetic_lm import MarkovChain
+
+        chain = MarkovChain()
+
+        def sample_batch(step):
+            # seeded by step so a resumed run continues the stream instead
+            # of re-consuming batches the original run already trained on
+            rng = np.random.default_rng(1000 + step)
+            seqs = chain.sample(rng, args.batch, args.seq_len + 1)
+            return jnp.asarray(seqs[:, :-1]), jnp.asarray(seqs[:, 1:])
 
     state = fns.init_state()
     start = 0

@@ -1,0 +1,83 @@
+"""Token-corpus pipeline (data/lm_corpus.py).
+
+The LM analog of the image data-path tests: window slicing, host-shard
+disjointness, epoch reshuffling, and the step-pure batch mapping that
+makes resume continue the token stream exactly.
+"""
+
+import numpy as np
+import pytest
+
+from ddl_tpu.data.lm_corpus import TokenBatches, TokenCorpus, encode_text_file
+
+
+@pytest.fixture
+def corpus_path(tmp_path):
+    toks = np.arange(1000, dtype=np.uint16) % 251
+    p = tmp_path / "toks.npy"
+    np.save(p, toks)
+    return p
+
+
+def test_encode_text_file_roundtrip(tmp_path):
+    raw = bytes(range(256)) * 3
+    src = tmp_path / "corpus.txt"
+    src.write_bytes(raw)
+    out = encode_text_file(src, tmp_path / "corpus.npy")
+    toks = np.load(out)
+    assert toks.dtype == np.uint8
+    np.testing.assert_array_equal(toks, np.frombuffer(raw, np.uint8))
+
+
+def test_windows_and_shift(corpus_path):
+    c = TokenCorpus(corpus_path, seq_len=16)
+    assert len(c) == 999 // 16
+    inp, tgt = c[3]
+    assert inp.shape == tgt.shape == (16,)
+    np.testing.assert_array_equal(inp[1:], tgt[:-1])  # shifted by one
+    np.testing.assert_array_equal(inp, np.arange(48, 64) % 251)
+    assert c.max_token() == 250
+
+
+def test_rejects_bad_inputs(tmp_path, corpus_path):
+    bad = tmp_path / "bad.npy"
+    np.save(bad, np.zeros((4, 4), np.float32))
+    with pytest.raises(ValueError, match="1-D integer"):
+        TokenCorpus(bad, 8)
+    with pytest.raises(ValueError, match="too short"):
+        TokenCorpus(corpus_path, seq_len=2000)
+    with pytest.raises(ValueError, match="fewer than one batch"):
+        TokenBatches(TokenCorpus(corpus_path, 16), batch=100)
+
+
+def test_shards_are_disjoint_and_cover(corpus_path):
+    c = TokenCorpus(corpus_path, seq_len=16)
+    b0 = TokenBatches(c, batch=4, num_shards=2, shard_rank=0)
+    b1 = TokenBatches(c, batch=4, num_shards=2, shard_rank=1)
+    i0 = set(map(int, b0.sampler.indices()))
+    i1 = set(map(int, b1.sampler.indices()))
+    assert not (i0 & i1)
+    assert len(i0 | i1) == (len(c) // 2) * 2
+
+
+def test_batch_at_is_step_pure_and_epochs_reshuffle(corpus_path):
+    c = TokenCorpus(corpus_path, seq_len=16)
+    b = TokenBatches(c, batch=4)
+    per_epoch = len(b)
+
+    # iterating epoch 0 == batch_at(0..len-1)
+    b.set_epoch(0)
+    for step, (inp, tgt) in enumerate(iter(b)):
+        inp2, tgt2 = b.batch_at(step)
+        np.testing.assert_array_equal(inp, inp2)
+        np.testing.assert_array_equal(tgt, tgt2)
+
+    # second epoch reshuffles
+    first_of_e0 = b.batch_at(0)[0]
+    first_of_e1 = b.batch_at(per_epoch)[0]
+    assert not np.array_equal(first_of_e0, first_of_e1)
+
+    # step-purity across arbitrary access order (resume anywhere)
+    a = b.batch_at(per_epoch + 2)[0]
+    _ = b.batch_at(3)
+    np.testing.assert_array_equal(a, b.batch_at(per_epoch + 2)[0])

@@ -47,6 +47,7 @@ def main() -> None:
     from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
     from ddl_tpu.train.state import create_train_state, make_optimizer
     from ddl_tpu.train.steps import make_dp_step_fns
+    from ddl_tpu.utils.timing import fence
 
     batch = 30
     cfg = ModelConfig(compute_dtype="bfloat16")
@@ -63,13 +64,13 @@ def main() -> None:
     # warmup: compile + 2 steady steps
     for _ in range(3):
         state, loss, _ = fns.train(state, images, labels)
-    jax.block_until_ready(state.params)
+    fence(loss)
 
     iters = int(os.environ.get("DDL_BENCH_ITERS", "20"))
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss, _ = fns.train(state, images, labels)
-    jax.block_until_ready(state.params)
+    fence(loss)  # true fence: readback, not just block_until_ready
     elapsed = time.perf_counter() - t0
 
     steps_per_sec = iters / elapsed

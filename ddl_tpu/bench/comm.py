@@ -5,9 +5,12 @@ TPU-native re-design of the reference's latency probe
 rank1 plus a 1-float ack ``recv`` with CUDA events, 1000 iterations appended
 to a CSV, iteration 0 discarded as NCCL-init cost (``ipynb/main.ipynb`` cell
 9).  Here the equivalent p2p primitive is a jitted ``lax.ppermute`` pair over
-a 2-device mesh — payload one hop forward, ack one hop back — fenced with
-``block_until_ready`` (the CUDA-event analog for XLA's async dispatch), with
-iteration 0 likewise the compile+warmup cost.  On top of the reference's
+a 2-device mesh — payload one hop forward, ack one hop back — fenced with a
+true device fence (``utils/timing.fence``: block + 1-element readback, since
+bare ``block_until_ready`` can return before execution on tunneled
+backends), with iteration 0 likewise the compile+warmup cost.  The fence's
+own host round-trip is measured separately (``fence_floor_ms``) and
+subtracted from the reported mean.  On top of the reference's
 ping-pong, this module also measures the collectives the framework actually
 trains with (``psum``, ``all_gather``, ``ppermute``) across a size sweep and
 reports algorithmic bandwidth — the number that predicts DP-allreduce and
@@ -28,7 +31,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddl_tpu.utils.timing import fence
 
 __all__ = ["PingPongResult", "ping_pong", "collective_bandwidth", "run_comm_bench"]
 
@@ -39,11 +45,15 @@ DEFAULT_PAYLOAD_ELEMS = 1024 * 1024  # 4 MiB fp32, reference communication_time.
 class PingPongResult:
     times_ms: np.ndarray  # per-iteration round-trip, iteration 0 = warmup/compile
     payload_bytes: int
+    fence_floor_ms: float = 0.0  # host cost of the fence itself
 
     @property
     def mean_ms(self) -> float:
-        """Mean excluding iteration 0 (init cost, per reference analysis)."""
-        return float(self.times_ms[1:].mean()) if len(self.times_ms) > 1 else float("nan")
+        """Mean excluding iteration 0 (init cost, per reference analysis),
+        net of the measured per-sample fence overhead."""
+        if len(self.times_ms) <= 1:
+            return float("nan")
+        return max(float(self.times_ms[1:].mean()) - self.fence_floor_ms, 1e-6)
 
     @property
     def one_way_gbps(self) -> float:
@@ -84,9 +94,20 @@ def ping_pong(
     times = np.empty(iterations + 1)
     for i in range(iterations + 1):
         t0 = perf_counter()
-        round_trip(x).block_until_ready()
+        fence(round_trip(x))
         times[i] = (perf_counter() - t0) * 1e3
-    return PingPongResult(times_ms=times, payload_bytes=payload_elems * 4)
+    # fence cost on an already-materialised array: the per-sample overhead
+    # the fence adds on top of the round trip being measured
+    floors = np.empty(20)
+    for i in range(len(floors)):
+        t0 = perf_counter()
+        fence(x)
+        floors[i] = (perf_counter() - t0) * 1e3
+    return PingPongResult(
+        times_ms=times,
+        payload_bytes=payload_elems * 4,
+        fence_floor_ms=float(np.median(floors)),
+    )
 
 
 def collective_bandwidth(
@@ -122,11 +143,11 @@ def collective_bandwidth(
     )
     x = jnp.ones((n * payload_elems,), jnp.float32)
 
-    fn(x).block_until_ready()  # compile
+    fence(fn(x))  # compile
     t0 = perf_counter()
     for _ in range(iterations):
         out = fn(x)
-    out.block_until_ready()
+    fence(out)
     elapsed = (perf_counter() - t0) / iterations
     payload_bytes = payload_elems * 4
     if op == "psum":

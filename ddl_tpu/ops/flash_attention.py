@@ -93,7 +93,7 @@ def _fwd_kernel(
     def _():
         l = jnp.maximum(l_sc[:], 1e-30)
         o_ref[0] = (acc_sc[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_sc[:] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = (m_sc[:] + jnp.log(l))[:, 0]
 
 
 def _dq_kernel(
@@ -116,8 +116,8 @@ def _dq_kernel(
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(i, j, bq, bk, s)
@@ -157,8 +157,8 @@ def _dkdv_kernel(
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
         do_blk = do_ref[0].astype(jnp.float32)
-        lse_blk = lse_ref[0][:, None]
-        delta_blk = delta_ref[0][:, None]
+        lse_blk = lse_ref[0, 0][:, None]
+        delta_blk = delta_ref[0, 0][:, None]
         s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
             s = _causal_mask(i, j, bq, bk, s)
@@ -191,7 +191,10 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         functools.partial(_fwd_kernel, scale=scale, causal=causal),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            # row stats ride in a (bh, 1, t) layout: the (1, 1, block_q)
+            # block then satisfies Mosaic's tiling rule (second-to-last
+            # block dim == array dim; last dim a 128-multiple or == t)
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ),
         grid=(bh, t // block_q, t // block_k),
         in_specs=[
@@ -201,7 +204,7 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -222,11 +225,13 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, do):
     q, k, v, out, lse = residuals
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )[:, None, :]  # (bh, 1, t) — same row-stat layout as lse
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal),
@@ -241,7 +246,7 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, do):
     # grid (bh, k_blocks, q_blocks): innermost dimension walks Q blocks
     q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    row_spec_t = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    row_spec_t = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(_dkdv_kernel, scale=scale, causal=causal),
         out_shape=(
@@ -268,16 +273,20 @@ def flash_attention(
     k,
     v,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     interpret: bool | None = None,
 ):
     """Flash attention. q, k, v: (B, T, H, D) -> (B, T, H, D).
 
     Differentiable (custom VJP, flash backward).  Block sizes are clamped to
     the sequence length and halved until they divide it; pick powers of two.
-    ``interpret=None`` auto-selects interpreter mode off-TPU so the kernel
-    runs on the CPU-simulated mesh (tests) and compiled on real chips.
+    Defaults come from a v5e sweep (B=2, H=8, D=64, causal, bf16, true-fenced
+    timing): 512x512 beats 128x128 by ~2x and beats XLA's dense lowering
+    fwd (16.0 vs 18.6 ms at T=8192) and bwd (32.2 vs 48.6 ms) while keeping
+    the T^2 score tile out of HBM.  ``interpret=None`` auto-selects
+    interpreter mode off-TPU so the kernel runs on the CPU-simulated mesh
+    (tests) and compiled on real chips.
     """
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")

@@ -1,0 +1,26 @@
+"""True device fences for timing measurements.
+
+XLA dispatch is asynchronous; ``jax.block_until_ready`` is the canonical
+fence, but under remote/tunneled backends (e.g. a TPU reached through a
+forwarding plugin) it can return before device execution completes —
+timings then measure *dispatch*, not compute (observed: a 5-second matmul
+chain "completing" in 1.3 ms).  A value readback cannot lie: the bytes
+only exist on the host after the program ran.  ``fence`` does both — the
+canonical block plus a 1-element readback of the last leaf — and is what
+every benchmark in this repo times against.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fence"]
+
+
+def fence(tree) -> None:
+    """Wait until everything in ``tree`` has actually been computed."""
+    leaves = [x for x in jax.tree.leaves(tree) if isinstance(x, jax.Array)]
+    jax.block_until_ready(leaves)
+    if leaves:
+        jax.device_get(jnp.ravel(leaves[-1])[:1])

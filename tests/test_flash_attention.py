@@ -53,7 +53,7 @@ def test_flash_matches_dense_grads(qkv, causal):
 def test_flash_mismatched_block_sizes_clamp():
     rng = np.random.default_rng(2)
     q = jnp.asarray(rng.normal(size=(1, 48, 2, 8)), jnp.float32)  # T=48
-    out = flash_attention(q, q, q, causal=True)  # blocks clamp 128 -> 48
+    out = flash_attention(q, q, q, causal=True)  # blocks clamp the 512 default -> 48
     want = dense_attention(q, q, q, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=1e-4)
 

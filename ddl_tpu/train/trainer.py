@@ -29,6 +29,7 @@ from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
 from ddl_tpu.train.state import create_train_state, make_optimizer
 from ddl_tpu.train.steps import make_dp_step_fns
 from ddl_tpu.utils import MetricLogger, classification_metrics, cross_entropy
+from ddl_tpu.utils.memory import hbm_stats
 
 __all__ = ["Trainer", "resolve_job_id"]
 
@@ -275,6 +276,10 @@ class Trainer:
                 # steps/sec/chip is BASELINE.json's target metric; the
                 # reference only logs epoch_time (steps derived offline).
                 self.logger.log("steps_per_sec", steps / elapsed, epoch)
+                # HBM watermark (no analog in the reference; utils/memory.py)
+                mem = hbm_stats()
+                if mem is not None:
+                    self.logger.log("hbm_peak_bytes", mem["peak_bytes_in_use"], epoch)
 
             metrics = self.evaluate(epoch)
             print(

@@ -173,3 +173,33 @@ class TestGraftEntry:
 
         fn, args = ge.entry()
         jax.jit(fn).lower(*args)  # raises on any tracing/sharding error
+
+
+class TestMemoryStats:
+    def test_graceful_none_without_stats(self):
+        from ddl_tpu.utils.memory import hbm_stats
+
+        class NoStats:
+            def memory_stats(self):
+                return None
+
+        class Raises:
+            def memory_stats(self):
+                raise RuntimeError("unsupported")
+
+        assert hbm_stats(NoStats()) is None
+        assert hbm_stats(Raises()) is None
+        # and whatever the ambient backend returns, it's a dict or None
+        assert hbm_stats() is None or isinstance(hbm_stats(), dict)
+
+    def test_shape_when_backend_reports(self):
+        from ddl_tpu.utils.memory import hbm_stats
+
+        class FakeDev:
+            def memory_stats(self):
+                return {"bytes_in_use": 10, "peak_bytes_in_use": 99,
+                        "bytes_limit": 1000}
+
+        out = hbm_stats(FakeDev())
+        assert out == {"bytes_in_use": 10, "peak_bytes_in_use": 99,
+                       "bytes_limit": 1000}

@@ -104,6 +104,11 @@ def make_lm_generator(
     strategies for long-context *processing*, and the prompt fits the
     cache by construction.
     """
+    if not cfg.causal:
+        raise ValueError(
+            "autoregressive decode requires a causal LM (cfg.causal=True); "
+            "bidirectional-encoder configs (e.g. ViT's) have no decode order"
+        )
     if mesh is None:
         mesh = build_lm_mesh(spec or LMMeshSpec(), devices)
     rules = lm_logical_rules(cfg.fsdp)

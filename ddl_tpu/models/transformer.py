@@ -24,6 +24,7 @@ point at the subsystems this family plugs into: the mesh backbone
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -72,6 +73,9 @@ class LMConfig:
     flash: bool = False
     remat: bool = True
     fsdp: bool = False
+    # False = bidirectional attention (encoder use, e.g. the ViT family —
+    # models/vit.py); LM training/decoding requires the causal default.
+    causal: bool = True
 
     @property
     def dtype(self):
@@ -110,9 +114,6 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(self.dtype)
 
 
-def _dense_attention(q, k, v):
-    """Plain causal softmax attention; XLA partitions the sharded einsums."""
-    return dense_attention(q, k, v, causal=True)
 
 
 class Attention(nn.Module):
@@ -162,7 +163,7 @@ class Attention(nn.Module):
         k = nn.with_logical_constraint(k, spec)
         v = nn.with_logical_constraint(v, spec)
         if kv_cache is None:
-            core = self.attn_core if self.attn_core is not None else _dense_attention
+            core = self.attn_core or partial(dense_attention, causal=cfg.causal)
             o = nn.with_logical_constraint(core(q, k, v), spec)
             new_cache = None
         else:

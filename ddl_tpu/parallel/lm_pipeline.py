@@ -301,6 +301,11 @@ def make_lm_pipeline_step_fns(
         raise ValueError("make_lm_pipeline_step_fns needs spec.pipe >= 2")
     if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+    if not cfg.causal and cfg.attn_impl != "dense":
+        raise ValueError(
+            "causal=False is only implemented for dense attention "
+            "(the nested ring/Ulysses cores are built causal)"
+        )
     if cfg.flash:
         raise ValueError(
             "flash=True is not supported with pipeline parallelism: the "

@@ -197,6 +197,12 @@ def make_lm_step_fns(
             f"unknown attn_impl {cfg.attn_impl!r} "
             "(expected 'dense', 'ring', or 'ulysses')"
         )
+    if not cfg.causal and (cfg.attn_impl != "dense" or cfg.flash):
+        raise ValueError(
+            "causal=False (bidirectional encoder) is only implemented for "
+            "the XLA dense attention path; the ring/Ulysses/flash cores "
+            "are built causal"
+        )
     if batch % spec.data:
         raise ValueError(f"batch {batch} must divide by mesh data={spec.data}")
     if seq_len % spec.seq:

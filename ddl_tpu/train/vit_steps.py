@@ -1,0 +1,132 @@
+"""Jitted train/eval steps for the ViT family (models/vit.py).
+
+Same SPMD pattern as the LM steps (``train/lm_steps.py``): parameter
+placement from logical-axis annotations over a ``(data, model)`` mesh —
+batch sharded over ``data``, attention heads / MLP hidden over ``model``
+(TP), optional FSDP — one jitted, donated step.  Input is the CNN data
+path's uint8 batch; /255 normalisation runs on device (``ops/image.py``)
+so the wire format matches the DenseNet trainer's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddl_tpu.models.vit import ViT, ViTConfig
+from ddl_tpu.ops import normalize_images
+from ddl_tpu.ops.losses import cross_entropy_loss
+from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
+
+__all__ = ["ViTTrainState", "ViTStepFns", "make_vit_step_fns"]
+
+
+class ViTTrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: optax.OptState
+
+
+class ViTStepFns(NamedTuple):
+    """train(state, images_u8, labels) -> (state, metrics);
+    evaluate(state, images_u8) -> logits; init_state() -> sharded state.
+    ``train`` donates its state argument — always rebind."""
+
+    train: Callable
+    evaluate: Callable
+    init_state: Callable
+    mesh: Mesh
+
+
+def make_vit_step_fns(
+    cfg: ViTConfig,
+    spec: LMMeshSpec,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    batch: int,
+    devices=None,
+) -> ViTStepFns:
+    if spec.seq > 1 or spec.expert > 1 or spec.pipe > 1:
+        raise ValueError(
+            "ViT steps shard over (data, model) only; got "
+            f"seq={spec.seq} expert={spec.expert} pipe={spec.pipe}"
+        )
+    if batch % spec.data:
+        raise ValueError(f"batch {batch} must divide by mesh data={spec.data}")
+    mesh = build_lm_mesh(spec, devices)
+    rules = lm_logical_rules(cfg.fsdp)
+    model = ViT(cfg)
+    dummy = jnp.zeros((batch, cfg.image_size, cfg.image_size, 3), jnp.float32)
+
+    def init_params(rng):
+        return model.init(rng, dummy)["params"]
+
+    abs_params = jax.eval_shape(init_params, rng)
+    logical = nn.get_partition_spec(abs_params)
+    param_shardings = nn.logical_to_mesh_sharding(logical, mesh, rules)
+
+    def create_state(rng):
+        params = nn.meta.unbox(init_params(rng))
+        params = jax.lax.with_sharding_constraint(params, param_shardings)
+        return ViTTrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    def loss_fn(params, images, labels):
+        x = normalize_images(images, cfg.dtype)
+        with nn.logical_axis_rules(rules):
+            logits = model.apply({"params": params}, x)
+        loss = cross_entropy_loss(logits, labels)
+        acc = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, (logits, {"loss": loss, "accuracy": acc})
+
+    def train_step(state, images, labels):
+        (_, (_, metrics)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, images, labels
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=optax.apply_updates(state.params, updates),
+                opt_state=new_opt,
+            ),
+            metrics,
+        )
+
+    def eval_step(state, images):
+        x = normalize_images(images, cfg.dtype)
+        with nn.logical_axis_rules(rules):
+            return model.apply({"params": state.params}, x)
+
+    img_sharding = NamedSharding(mesh, P("data"))
+    replicated = NamedSharding(mesh, P())
+
+    def _with_mesh(fn):
+        def wrapped(*args):
+            with jax.set_mesh(mesh):
+                return fn(*args)
+
+        return wrapped
+
+    return ViTStepFns(
+        train=_with_mesh(jax.jit(
+            train_step,
+            in_shardings=(None, img_sharding, img_sharding),
+            out_shardings=(None, replicated),
+            donate_argnums=(0,),
+        )),
+        evaluate=_with_mesh(jax.jit(
+            eval_step, in_shardings=(None, img_sharding),
+        )),
+        init_state=lambda: _with_mesh(jax.jit(create_state))(rng),
+        mesh=mesh,
+    )

@@ -1,0 +1,119 @@
+"""Train the ViT family on the APTOS-shape image data path.
+
+Second vision model family (models/vit.py): the LM's transformer blocks
+run bidirectionally over a patch sequence, sharded TP over heads/MLP and
+DP over batch by the same logical-axis rule table — where the reference
+supports exactly one vision model (DenseNet121, single.py:297-299).
+
+    python examples/train_vit.py --cpu-devices 8 --data 2 --model 2 \
+        --image-size 32 --patch 8 --epochs 2
+
+Uses the synthetic APTOS-shape dataset when DDL_DATASET_DIR is unset
+(same fallback as the CNN trainer); point it at the real data for the
+full 224px task.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", type=int, default=1)
+    ap.add_argument("--model", type=int, default=1)
+    ap.add_argument("--fsdp", action="store_true")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--patch", type=int, default=16)
+    ap.add_argument("--d-model", type=int, default=384)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--num-train", type=int, default=256,
+                    help="synthetic train examples (when no real dataset)")
+    ap.add_argument("--num-test", type=int, default=64)
+    ap.add_argument("--cpu-devices", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.cpu_devices:
+        from ddl_tpu.launch import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
+    import jax
+    import numpy as np
+
+    from ddl_tpu.config import DataConfig
+    from ddl_tpu.data import DataLoader, ShardedEpochSampler, build_datasets, shard_batch
+    from ddl_tpu.models.vit import ViTConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.state import build_optimizer
+    from ddl_tpu.train.vit_steps import make_vit_step_fns
+    from ddl_tpu.utils.metrics import classification_metrics
+
+    cfg = ViTConfig(
+        image_size=args.image_size,
+        patch_size=args.patch,
+        d_model=args.d_model,
+        n_layers=args.layers,
+        n_heads=max(2, args.d_model // 64),
+        head_dim=64 if args.d_model >= 128 else args.d_model // 2,
+        d_ff=4 * args.d_model,
+        compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
+        fsdp=args.fsdp,
+    )
+    spec = LMMeshSpec(data=args.data, model=args.model)
+    tx = build_optimizer(args.lr, weight_decay=0.05, grad_clip_norm=1.0)
+    fns = make_vit_step_fns(cfg, spec, tx, jax.random.key(0), args.batch)
+    print(f"mesh=(data={args.data}, model={args.model}) fsdp={args.fsdp} "
+          f"patches={cfg.num_patches}")
+
+    dc = DataConfig(
+        image_size=args.image_size,
+        global_batch_size=args.batch,
+        eval_batch_size=args.batch,
+        synthetic_num_train=args.num_train,
+        synthetic_num_test=args.num_test,
+    )
+    train_ds, test_ds = build_datasets(dc)
+    n_proc, proc = jax.process_count(), jax.process_index()
+    train_loader = DataLoader(
+        train_ds, args.batch // n_proc,
+        sampler=ShardedEpochSampler(len(train_ds), n_proc, proc, seed=0),
+    )
+    test_loader = DataLoader(
+        test_ds, args.batch // n_proc,
+        sampler=ShardedEpochSampler(len(test_ds), n_proc, proc, seed=1),
+    )
+
+    state = fns.init_state()
+    for epoch in range(args.epochs):
+        train_loader.set_epoch(epoch)
+        t0 = time.perf_counter()
+        losses, steps = [], 0
+        for images, labels in train_loader:
+            gi, gl = shard_batch(fns.mesh, images, labels)
+            state, m = fns.train(state, gi, gl)
+            losses.append(float(m["loss"]))
+            steps += 1
+        dt = time.perf_counter() - t0
+        preds, targets = [], []
+        for images, labels in test_loader:
+            gi, gl = shard_batch(fns.mesh, images, labels)
+            preds.append(np.argmax(np.asarray(fns.evaluate(state, gi)), -1))
+            targets.append(np.asarray(gl))
+        mets = classification_metrics(
+            np.concatenate(targets), np.concatenate(preds)
+        )
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
+              f"({steps} steps, {dt:.1f}s, {steps / dt:.2f} steps/s) | "
+              f"val_acc {mets['val_accuracy']:.4f} qwk {mets['qwk']:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Worker process for the multi-host integration test (test_multihost.py).
+
+Runs the FULL CNN Trainer as one of two cooperating processes: the
+launcher env contract (``DDL_COORDINATOR``/``DDL_NUM_PROCESSES``/
+``DDL_PROCESS_ID`` — ``launch.bootstrap``), Gloo-backed
+``jax.distributed.initialize`` on CPU, per-process data sharding
+(``ShardedEpochSampler``), cross-process global-batch assembly
+(``shard_batch`` -> ``make_array_from_process_local_data``), and
+cross-process metric gathers (``_to_host`` -> ``process_allgather``).
+Not collected by pytest (no ``test_`` prefix).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddl_tpu.launch import bootstrap, force_cpu_devices, world_info  # noqa: E402
+
+force_cpu_devices(4)
+
+import jax  # noqa: E402
+
+from ddl_tpu.config import preset  # noqa: E402
+from ddl_tpu.train import Trainer  # noqa: E402
+
+
+def main() -> None:
+    bootstrap()  # reads DDL_COORDINATOR / DDL_NUM_PROCESSES / DDL_PROCESS_ID
+    info = world_info()
+    assert info["process_count"] == 2, info
+    assert info["global_device_count"] == 8, info
+
+    cfg = preset(
+        "dp",
+        **{
+            "mesh.data": "8",
+            "data.image_size": "32",
+            "data.global_batch_size": "16",
+            "data.eval_batch_size": "16",
+            "data.synthetic_num_train": "48",
+            "data.synthetic_num_test": "16",
+            "data.num_workers": "0",
+            "model.growth_rate": "4",
+            "model.block_config": "[2,2]",
+            "model.num_init_features": "8",
+            "model.bn_size": "2",
+            "train.max_epochs": "2",
+            "train.save_best_qwk": "false",
+            "train.preemption_save": "false",
+            "train.log_dir": os.environ["DDL_TEST_LOG_DIR"],
+        },
+    )
+    trainer = Trainer(cfg)
+    trainer.train()
+    # Every process computed from the same global batches, so the final
+    # state must agree bit-for-bit; hash the raw bytes of every leaf (via
+    # the multihost gather, so each process sees the full global arrays).
+    import hashlib
+
+    import numpy as np
+
+    from ddl_tpu.train.trainer import _to_host
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(trainer.state.params):
+        h.update(np.ascontiguousarray(_to_host(leaf)).tobytes())
+    print(f"WORKER_OK process={info['process_index']} checksum={h.hexdigest()}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""True multi-process integration test of the distributed stack.
+
+The reference cannot test its distributed paths without a live NCCL
+cluster (SURVEY.md §4 — "nothing mocks NCCL").  Here two ACTUAL processes
+form a world over Gloo on CPU (4 simulated devices each -> one 8-device
+global mesh) and run the full DP Trainer end-to-end: launcher env
+bootstrap, cross-process global-batch assembly, metric allgathers.  Both
+workers must finish and agree bit-for-bit on the final parameters.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+WORKER = Path(__file__).parent / "multihost_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dp_trainer(tmp_path):
+    port = _free_port()
+    env_base = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs, logs = [], []
+    for pid in (0, 1):
+        env = dict(
+            env_base,
+            DDL_COORDINATOR=f"localhost:{port}",
+            DDL_NUM_PROCESSES="2",
+            DDL_PROCESS_ID=str(pid),
+            DDL_TEST_LOG_DIR=str(tmp_path / "logs"),
+        )
+        # output to files, not pipes: a worker filling an undrained pipe
+        # would block mid-collective and stall the whole world
+        log = open(tmp_path / f"worker{pid}.log", "w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(WORKER)],
+            env=env,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    outs = []
+    for p, log in zip(procs, logs):
+        try:
+            p.wait(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        log.seek(0)
+        outs.append(log.read())
+        log.close()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert "WORKER_OK" in out, out[-2000:]
+    # both processes trained the same global model
+    sums = sorted(
+        line.split("checksum=")[1]
+        for out in outs
+        for line in out.splitlines()
+        if "WORKER_OK" in line
+    )
+    assert len(sums) == 2 and sums[0] == sums[1], sums

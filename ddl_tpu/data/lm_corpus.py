@@ -72,6 +72,39 @@ class TokenCorpus:
         """Highest token id (one pass over the memmap) — for vocab checks."""
         return int(self.tokens.max())
 
+    def split(self, eval_fraction: float) -> tuple["_CorpusSlice", "_CorpusSlice"]:
+        """(train, eval) views sharing this memmap: the LAST
+        ``eval_fraction`` of windows are held out (contiguous tail split —
+        no token of an eval window appears in a train window)."""
+        if not 0.0 < eval_fraction < 1.0:
+            raise ValueError(f"eval_fraction {eval_fraction} not in (0, 1)")
+        n_eval = max(1, int(self.num_windows * eval_fraction))
+        n_train = self.num_windows - n_eval
+        if n_train < 1:
+            raise ValueError(
+                f"eval_fraction {eval_fraction} leaves no training windows "
+                f"(corpus has {self.num_windows})"
+            )
+        return _CorpusSlice(self, 0, n_train), _CorpusSlice(self, n_train, n_eval)
+
+
+class _CorpusSlice:
+    """Contiguous window range of a ``TokenCorpus`` (shares the memmap)."""
+
+    def __init__(self, corpus: TokenCorpus, start: int, count: int) -> None:
+        self.corpus = corpus
+        self.seq_len = corpus.seq_len
+        self.start = start
+        self.count = count
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __getitem__(self, i: int):
+        if not 0 <= i < self.count:
+            raise IndexError(i)
+        return self.corpus[self.start + i]
+
 
 class TokenBatches:
     """Host-sharded epoch iterator of ``(inputs, targets)`` batches, both

@@ -87,6 +87,7 @@ def make_lm_generator(
     max_new: int,
     batch: int = 1,
     temperature: float = 0.0,
+    top_k: int | None = None,
     devices=None,
     mesh=None,
 ):
@@ -94,7 +95,8 @@ def make_lm_generator(
 
     ``prompt`` is (B, prompt_len) int32; the result is (B, max_new) int32.
     ``temperature=0`` decodes greedily; otherwise tokens are sampled from
-    ``softmax(logits / temperature)``.  One XLA program: prefill + a
+    ``softmax(logits / temperature)``, optionally restricted to the
+    ``top_k`` most likely tokens.  One XLA program: prefill + a
     ``lax.scan`` of single-token steps over a static-size KV cache.
 
     ``spec``/``devices`` (or an explicit ``mesh``) place the computation:
@@ -109,6 +111,16 @@ def make_lm_generator(
             "autoregressive decode requires a causal LM (cfg.causal=True); "
             "bidirectional-encoder configs (e.g. ViT's) have no decode order"
         )
+    if top_k is not None:
+        if temperature == 0.0:
+            raise ValueError(
+                "top_k has no effect with temperature=0 (greedy decoding); "
+                "set a temperature or drop top_k"
+            )
+        if not 1 <= top_k <= cfg.vocab_size:
+            raise ValueError(
+                f"top_k {top_k} out of range [1, vocab_size={cfg.vocab_size}]"
+            )
     if mesh is None:
         mesh = build_lm_mesh(spec or LMMeshSpec(), devices)
     rules = lm_logical_rules(cfg.fsdp)
@@ -127,6 +139,9 @@ def make_lm_generator(
         def sample(logits, rng):
             if temperature == 0.0:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if top_k is not None:
+                kth = lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
             return jax.random.categorical(
                 rng, logits / jnp.float32(temperature), axis=-1
             ).astype(jnp.int32)

@@ -45,6 +45,8 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="0 = greedy")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="restrict sampling to the k most likely tokens")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu-devices", type=int, default=0)
     args = ap.parse_args()
@@ -101,6 +103,7 @@ def main() -> None:
         max_new=args.max_new,
         batch=args.batch,
         temperature=args.temperature,
+        top_k=args.top_k,
         mesh=mesh,
     )
 

@@ -41,6 +41,11 @@ def main() -> None:
     ap.add_argument("--corpus", default=None,
                     help="token .npy or raw text file to train on "
                     "(default: synthetic Markov-chain bytes)")
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="with --corpus: evaluate held-out perplexity every "
+                    "N steps (0 = off)")
+    ap.add_argument("--eval-frac", type=float, default=0.05,
+                    help="tail fraction of corpus windows held out for eval")
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--steps", type=int, default=100)
@@ -143,11 +148,31 @@ def main() -> None:
                 f"vocab_size is {cfg.vocab_size}; out-of-range ids would be "
                 "silently clamped by the embedding gather"
             )
+        eval_view = None
+        if args.eval_every:
+            train_view, ev = corpus.split(args.eval_frac)
+            if len(ev) >= args.batch:
+                eval_view = ev
+            else:
+                # too small to fill one batch: keep every window for training
+                print(f"note: eval split ({len(ev)} windows) smaller than one "
+                      f"batch of {args.batch}; held-out eval disabled — grow "
+                      "--eval-frac or shrink --batch")
+                train_view = corpus
+        else:
+            train_view = corpus
         batches = TokenBatches(
-            corpus, args.batch // n_proc, n_proc, proc, seed=0
+            train_view, args.batch // n_proc, n_proc, proc, seed=0
+        )
+        eval_batches = (
+            TokenBatches(eval_view, args.batch // n_proc, n_proc, proc,
+                         shuffle=False, seed=0)
+            if eval_view is not None
+            else None
         )
         print(f"corpus: {len(corpus)} windows of {args.seq_len}+1 tokens, "
-              f"{len(batches)} batches/epoch/host")
+              f"{len(batches)} train batches/epoch/host"
+              + (f", {len(eval_batches)} eval batches" if eval_batches else ""))
         if n_proc > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -221,6 +246,24 @@ def main() -> None:
             )
         start = int(state.step)
         print(f"continuing from step {start}")
+    def eval_heldout():
+        import math
+
+        def to_global(x):
+            # multi-host: assemble host shards into one global array, same
+            # as the training batches
+            if n_proc > 1:
+                return jax.make_array_from_process_local_data(gspec, x)
+            return jnp.asarray(x)
+
+        ces = []
+        for e_inp, e_tgt in eval_batches:
+            em = fns.evaluate(state, to_global(e_inp), to_global(e_tgt))
+            ces.append(float(em["ce"]))
+        ce = float(np.mean(ces))
+        print(f"  heldout: ce {ce:.4f} ppl {math.exp(ce):.2f} "
+              f"({len(ces)} batches)")
+
     t0 = time.perf_counter()
     for i in range(start, args.steps):
         inp, tgt = sample_batch(i)
@@ -230,6 +273,9 @@ def main() -> None:
                 f"step {i:4d} loss {float(m['loss']):.4f} "
                 f"ce {float(m['ce']):.4f} moe_aux {float(m['moe_aux']):.4f}"
             )
+        if (args.corpus and args.eval_every and eval_batches
+                and (i + 1) % args.eval_every == 0):
+            eval_heldout()
         if args.checkpoint_dir and (i + 1) % args.save_every == 0:
             from ddl_tpu.checkpoint import save_snapshot
 

@@ -153,3 +153,22 @@ def test_sampled_generation_and_moe():
     others = [np.asarray(gen(params, prompt, jax.random.key(s)))
               for s in (8, 9, 10)]
     assert any(not np.array_equal(a, o) for o in others)
+
+
+def test_top_k_sampling_restricts_support():
+    """top_k=1 sampling == greedy decoding, for any temperature."""
+    cfg = _cfg()
+    b, p, n = 2, 4, 5
+    params = _params(cfg, b, p)
+    prompt = jnp.asarray(np.random.default_rng(5).integers(0, 32, (b, p)))
+    greedy = make_lm_generator(
+        cfg, prompt_len=p, max_new=n, batch=b, devices=jax.devices()[:1]
+    )
+    k1 = make_lm_generator(
+        cfg, prompt_len=p, max_new=n, batch=b, temperature=1.3, top_k=1,
+        devices=jax.devices()[:1],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(greedy(params, prompt)),
+        np.asarray(k1(params, prompt, jax.random.key(3))),
+    )

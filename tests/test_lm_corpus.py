@@ -60,6 +60,26 @@ def test_shards_are_disjoint_and_cover(corpus_path):
     assert len(i0 | i1) == (len(c) // 2) * 2
 
 
+def test_split_is_disjoint_tail(corpus_path):
+    c = TokenCorpus(corpus_path, seq_len=16)
+    train, ev = c.split(0.2)
+    assert len(train) + len(ev) == len(c)
+    assert len(ev) == max(1, int(len(c) * 0.2))
+    # eval view is exactly the tail windows of the corpus
+    np.testing.assert_array_equal(ev[0][0], c[len(train)][0])
+    np.testing.assert_array_equal(ev[len(ev) - 1][0], c[len(c) - 1][0])
+    with pytest.raises(IndexError):
+        ev[len(ev)]
+    with pytest.raises(ValueError):
+        c.split(0.0)
+    with pytest.raises(ValueError, match="no training windows"):
+        TokenCorpus(corpus_path, seq_len=999).split(0.5)  # 1 window total
+    # batches over a view work
+    b = TokenBatches(train, batch=4)
+    inp, _ = next(iter(b))
+    assert inp.shape == (4, 16)
+
+
 def test_batch_at_is_step_pure_and_epochs_reshuffle(corpus_path):
     c = TokenCorpus(corpus_path, seq_len=16)
     b = TokenBatches(c, batch=4)

@@ -21,7 +21,6 @@ CSV output keeps the reference's row shape: ``job_id,iteration,elapsed_ms``.
 
 from __future__ import annotations
 
-import math
 import os
 from dataclasses import dataclass
 from functools import partial
@@ -32,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ddl_tpu.utils.timing import fence
 

@@ -15,7 +15,6 @@ points become four presets of the same trainer:
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import os
 from dataclasses import dataclass, field, fields, is_dataclass

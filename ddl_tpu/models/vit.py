@@ -16,7 +16,6 @@ import dataclasses
 from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from ddl_tpu.models.transformer import Block, LMConfig, RMSNorm

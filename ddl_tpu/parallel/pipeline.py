@@ -52,17 +52,16 @@ Two schedules are provided (``schedule=``):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ddl_tpu.models.densenet import DenseNetStage, apply_stage
-from ddl_tpu.ops import cross_entropy_loss, normalize_images, softmax_cross_entropy
+from ddl_tpu.ops import normalize_images, softmax_cross_entropy
 from ddl_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
 from ddl_tpu.train.state import TrainState
 from ddl_tpu.train.steps import StepFns

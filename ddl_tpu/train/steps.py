@@ -19,7 +19,6 @@ batch, which the parity test asserts to float tolerance.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, NamedTuple
 
 import jax

@@ -10,7 +10,6 @@ the loss-less eval schedule, ``pp.py:146-150``.)
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from ddl_tpu.infer import LMDecode, init_kv_cache, make_lm_generator
 from ddl_tpu.models.transformer import LMConfig, TransformerLM

@@ -9,7 +9,6 @@ import os
 import signal
 import threading
 
-import pytest
 
 from ddl_tpu.checkpoint import latest_epoch
 from ddl_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, TrainConfig

@@ -12,7 +12,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from ddl_tpu.models.transformer import LMConfig
 from ddl_tpu.parallel.sharding import LMMeshSpec

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from ddl_tpu.models.transformer import Block, LMConfig, RMSNorm
 
-__all__ = ["ViTConfig", "ViT"]
+__all__ = ["ViTConfig", "ViT", "make_patch_embed", "make_vit_head"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +67,39 @@ class ViTConfig:
         return jnp.dtype(self.compute_dtype)
 
 
+def make_patch_embed(cfg: ViTConfig) -> nn.Conv:
+    """The patchify conv ('patch_embed' in the param tree): stride = kernel
+    = patch, i.e. one MXU matmul per patch.  Single source of truth shared
+    by ``ViT`` and the pipeline path (``train/vit_steps.py``), so the two
+    forward implementations cannot drift."""
+    return nn.Conv(
+        cfg.d_model,
+        (cfg.patch_size, cfg.patch_size),
+        strides=(cfg.patch_size, cfg.patch_size),
+        dtype=cfg.dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), (None, None, None, "embed")
+        ),
+        name="patch_embed",
+    )
+
+
+def make_vit_head(cfg: ViTConfig) -> nn.Dense:
+    """The classifier head ('head'); f32 so the loss-side softmax is f32.
+    Shared by ``ViT`` and the pipeline path."""
+    return nn.Dense(
+        cfg.num_classes,
+        use_bias=True,
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), ("embed", None)
+        ),
+        name="head",
+    )
+
+
 class ViT(nn.Module):
     """images (B, H, W, 3) float -> logits (B, num_classes) f32."""
 
@@ -78,18 +111,7 @@ class ViT(nn.Module):
         cfg = self.cfg
         bc = cfg.block_config()
         b = images.shape[0]
-        # patchify: one conv with stride = kernel = patch (an MXU matmul)
-        x = nn.Conv(
-            cfg.d_model,
-            (cfg.patch_size, cfg.patch_size),
-            strides=(cfg.patch_size, cfg.patch_size),
-            dtype=cfg.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), (None, None, None, "embed")
-            ),
-            name="patch_embed",
-        )(images.astype(cfg.dtype))
+        x = make_patch_embed(cfg)(images.astype(cfg.dtype))
         x = x.reshape(b, cfg.num_patches, cfg.d_model)
         pos = self.param(
             "pos_embed",
@@ -106,14 +128,4 @@ class ViT(nn.Module):
             x, _aux = block(bc, self.attn_core, name=f"block{i}")(x)
         x = RMSNorm(cfg.dtype, name="norm_f")(x)
         x = x.mean(axis=1)  # mean-pool over patches
-        logits = nn.Dense(
-            cfg.num_classes,
-            use_bias=True,
-            dtype=jnp.float32,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("embed", None)
-            ),
-            name="head",
-        )(x.astype(jnp.float32))
-        return logits
+        return make_vit_head(cfg)(x.astype(jnp.float32))

@@ -89,12 +89,89 @@ from ddl_tpu.train.lm_steps import (
 
 __all__ = [
     "make_lm_pipeline_step_fns",
+    "make_blocks_pipeline",
     "split_lm_params",
     "merge_lm_params",
     "convert_lm_state",
     "abstract_lm_state",
     "saved_pipe_stages",
 ]
+
+
+def make_blocks_pipeline(
+    mesh: Mesh,
+    block_mod: nn.Module,
+    *,
+    n_stages: int,
+    num_microbatches: int,
+    mb: int,
+    d_model: int,
+    compute_dtype,
+):
+    """The GPipe clock loop over a stack of uniform decoder/encoder blocks,
+    as a partial-manual shard_map (manual over ``pipe`` only) — shared by
+    the LM (``make_lm_pipeline_step_fns``) and ViT
+    (``train/vit_steps.py``) pipelines.
+
+    Returns ``pipeline(blocks_stacked, x_mb)`` where ``blocks_stacked`` is
+    the ``(pipe, layers_per_stage, ...)`` param stack sharded
+    ``P('pipe', ...)`` and ``x_mb`` is ``(M, mb, T, d_model)`` microbatched
+    activations; yields ``(acc, aux_vec)`` with ``acc`` the last stage's
+    per-microbatch outputs (callers slice ``[-1]``) and ``aux_vec`` the
+    ``(pipe,)`` per-stage aux-loss vector.  See the module docstring for
+    the schedule design.
+    """
+    M = num_microbatches
+    d = d_model
+
+    def stage_fn(stage_blocks, x):
+        def layer(carry, p):
+            y, aux = block_mod.apply({"params": p}, carry)
+            return y, aux
+
+        y, auxs = lax.scan(layer, x, stage_blocks)
+        return y, auxs.sum()
+
+    def pipeline_body(blocks_stacked, x_mb):
+        stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
+        s = lax.axis_index(PIPE_AXIS)
+        t_len = x_mb.shape[2]
+        buf0 = jnp.zeros((mb, t_len, d), compute_dtype)
+        acc0 = jnp.zeros((M, mb, t_len, d), compute_dtype)
+
+        def tick(carry, t):
+            buf, acc, aux = carry
+            x_first = lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(s == 0, x_first, buf)
+            out, aux_t = stage_fn(stage_blocks, x_in)
+            valid = (t >= s) & (t - s < M)
+            aux = aux + jnp.where(valid, aux_t, 0.0)
+            # Off-schedule writes land on clamped indices; the valid write
+            # for microbatch i happens at tick P-1+i, after any clamped
+            # garbage, so the final buffer needs no masking (and only the
+            # last pipe coordinate's buffer is ever read).
+            acc = lax.dynamic_update_index_in_dim(
+                acc, out, jnp.clip(t - (n_stages - 1), 0, M - 1), 0
+            )
+            buf = lax.ppermute(
+                out, PIPE_AXIS, [(i, i + 1) for i in range(n_stages - 1)]
+            )
+            return (buf, acc, aux), None
+
+        init = (buf0, acc0, jnp.zeros((), jnp.float32))
+        (_, acc, aux), _ = lax.scan(tick, init, jnp.arange(M + n_stages - 1))
+        return acc[None], aux[None]
+
+    return jax.shard_map(
+        pipeline_body,
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P()),
+        out_specs=(P(PIPE_AXIS), P(PIPE_AXIS)),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )
 
 
 class _Embed(nn.Module):
@@ -121,24 +198,28 @@ class _Head(nn.Module):
         return apply_final_norm_and_head(self.cfg, x)
 
 
-def split_lm_params(full_params: Any, n_stages: int) -> dict:
-    """Restructure a full ``TransformerLM`` param tree into the pipeline
-    layout ``{embed, blocks, head}``: ``blocks`` is the per-layer trees
-    stacked to ``(pipe, layers_per_stage, ...)``, stage-major in layer order
-    (stage p owns layers ``[p*Lps, (p+1)*Lps)``)."""
+def stack_block_params(full_params: Any, n_stages: int):
+    """Stack a param tree's ``block{i}`` subtrees to
+    ``(n_stages, layers_per_stage, ...)``, stage-major in layer order
+    (stage p owns layers ``[p*Lps, (p+1)*Lps)``) — the unit every blocks
+    pipeline shards ``P('pipe', ...)``.  Shared by the LM and ViT splits."""
     layer_keys = sorted(
         (k for k in full_params if k.startswith("block")),
         key=lambda k: int(k.removeprefix("block")),
     )
-    n_layers = len(layer_keys)
-    lps = n_layers // n_stages
-    stacked = jax.tree.map(
+    lps = len(layer_keys) // n_stages
+    return jax.tree.map(
         lambda *xs: jnp.stack(xs).reshape(n_stages, lps, *xs[0].shape),
         *(full_params[k] for k in layer_keys),
     )
+
+
+def split_lm_params(full_params: Any, n_stages: int) -> dict:
+    """Restructure a full ``TransformerLM`` param tree into the pipeline
+    layout ``{embed, blocks, head}`` (see ``stack_block_params``)."""
     return {
         "embed": {"embed": full_params["embed"]},
-        "blocks": stacked,
+        "blocks": stack_block_params(full_params, n_stages),
         "head": {"norm_f": full_params["norm_f"], "lm_head": full_params["lm_head"]},
     }
 
@@ -381,61 +462,14 @@ def make_lm_pipeline_step_fns(
     compute_dtype = cfg.dtype
     d = cfg.d_model
 
-    def stage_fn(stage_blocks, x):
-        """Run this device's ``lps`` decoder blocks (scan over the stacked
-        layer axis). Returns (out, summed moe aux)."""
-
-        def layer(carry, p):
-            y, aux = block_mod.apply({"params": p}, carry)
-            return y, aux
-
-        y, auxs = lax.scan(layer, x, stage_blocks)
-        return y, auxs.sum()
-
-    def pipeline_body(blocks_stacked, x_mb):
-        """Manual over ``pipe`` only.  blocks_stacked arrives as the local
-        (1, lps, ...) stage slice; x_mb (M, mb, T, D) is replicated over
-        pipe and auto-sharded over data/seq.  Returns the last stage's
-        per-microbatch outputs (lifted to a (1, M, mb, T, D) pipe-sharded
-        array; callers slice [-1]) and the (1,) per-stage aux loss."""
-        stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
-        s = lax.axis_index(PIPE_AXIS)
-        t_len = x_mb.shape[2]
-        buf0 = jnp.zeros((mb, t_len, d), compute_dtype)
-        acc0 = jnp.zeros((M, mb, t_len, d), compute_dtype)
-
-        def tick(carry, t):
-            buf, acc, aux = carry
-            x_first = lax.dynamic_index_in_dim(
-                x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
-            )
-            x_in = jnp.where(s == 0, x_first, buf)
-            out, aux_t = stage_fn(stage_blocks, x_in)
-            valid = (t >= s) & (t - s < M)
-            aux = aux + jnp.where(valid, aux_t, 0.0)
-            # Off-schedule writes land on clamped indices; the valid write
-            # for microbatch i happens at tick P-1+i, after any clamped
-            # garbage, so the final buffer needs no masking (and only the
-            # last pipe coordinate's buffer is ever read).
-            acc = lax.dynamic_update_index_in_dim(
-                acc, out, jnp.clip(t - (n_stages - 1), 0, M - 1), 0
-            )
-            buf = lax.ppermute(
-                out, PIPE_AXIS, [(i, i + 1) for i in range(n_stages - 1)]
-            )
-            return (buf, acc, aux), None
-
-        init = (buf0, acc0, jnp.zeros((), jnp.float32))
-        (_, acc, aux), _ = lax.scan(tick, init, jnp.arange(M + n_stages - 1))
-        return acc[None], aux[None]
-
-    pipeline = jax.shard_map(
-        pipeline_body,
-        mesh=mesh,
-        in_specs=(P(PIPE_AXIS), P()),
-        out_specs=(P(PIPE_AXIS), P(PIPE_AXIS)),
-        axis_names={PIPE_AXIS},
-        check_vma=False,
+    pipeline = make_blocks_pipeline(
+        mesh,
+        block_mod,
+        n_stages=n_stages,
+        num_microbatches=M,
+        mb=mb,
+        d_model=d,
+        compute_dtype=compute_dtype,
     )
 
     mb_spec = NamedSharding(mesh, P(None, "data", "seq"))

@@ -51,12 +51,21 @@ def make_vit_step_fns(
     rng: jax.Array,
     batch: int,
     devices=None,
+    num_microbatches: int = 0,
 ) -> ViTStepFns:
-    if spec.seq > 1 or spec.expert > 1 or spec.pipe > 1:
+    if spec.seq > 1 or spec.expert > 1:
         raise ValueError(
-            "ViT steps shard over (data, model) only; got "
-            f"seq={spec.seq} expert={spec.expert} pipe={spec.pipe}"
+            "ViT steps shard over (data, model, pipe); got "
+            f"seq={spec.seq} expert={spec.expert}"
         )
+    if spec.pipe > 1:
+        return _make_vit_pipeline_step_fns(
+            cfg, spec, tx, rng, batch,
+            num_microbatches=num_microbatches or spec.pipe,
+            devices=devices,
+        )
+    if num_microbatches > 1:
+        raise ValueError("num_microbatches needs spec.pipe > 1")
     if batch % spec.data:
         raise ValueError(f"batch {batch} must divide by mesh data={spec.data}")
     mesh = build_lm_mesh(spec, devices)
@@ -80,10 +89,20 @@ def make_vit_step_fns(
             opt_state=tx.init(params),
         )
 
-    def loss_fn(params, images, labels):
+    def forward(params, images):
         x = normalize_images(images, cfg.dtype)
         with nn.logical_axis_rules(rules):
-            logits = model.apply({"params": params}, x)
+            return model.apply({"params": params}, x)
+
+    return _finalize_vit(mesh, tx, forward, create_state, rng)
+
+
+def _finalize_vit(mesh, tx, forward, create_state, rng) -> ViTStepFns:
+    """Shared jit tail for the plain and pipelined ViT paths: wraps a
+    ``forward(params, images) -> logits`` and a ``create_state(rng)``."""
+
+    def loss_fn(params, images, labels):
+        logits = forward(params, images)
         loss = cross_entropy_loss(logits, labels)
         acc = (jnp.argmax(logits, -1) == labels).mean()
         return loss, (logits, {"loss": loss, "accuracy": acc})
@@ -103,9 +122,7 @@ def make_vit_step_fns(
         )
 
     def eval_step(state, images):
-        x = normalize_images(images, cfg.dtype)
-        with nn.logical_axis_rules(rules):
-            return model.apply({"params": state.params}, x)
+        return forward(state.params, images)
 
     img_sharding = NamedSharding(mesh, P("data"))
     replicated = NamedSharding(mesh, P())
@@ -130,3 +147,116 @@ def make_vit_step_fns(
         init_state=lambda: _with_mesh(jax.jit(create_state))(rng),
         mesh=mesh,
     )
+
+
+def _make_vit_pipeline_step_fns(
+    cfg: ViTConfig,
+    spec: LMMeshSpec,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    batch: int,
+    num_microbatches: int,
+    devices=None,
+) -> ViTStepFns:
+    """Pipeline-parallel ViT: the encoder blocks run as a GPipe schedule
+    over the ``pipe`` mesh axis (the shared clock loop,
+    ``parallel/lm_pipeline.py::make_blocks_pipeline``) with stage-stacked,
+    pipe-sharded params; the patch embedding and pooled head run outside
+    the manual region in plain GSPMD land.  Composes with DP over ``data``
+    and TP over ``model`` — the DP x PP hybrid of the reference's
+    north-star config (``ddp_n_pp.py``), on a transformer vision model."""
+    from ddl_tpu.models.transformer import Block, RMSNorm
+    from ddl_tpu.parallel.lm_pipeline import (
+        make_blocks_pipeline,
+        stack_block_params,
+    )
+    from ddl_tpu.parallel.sharding import PIPE_AXIS
+
+    n_stages, M = spec.pipe, num_microbatches
+    if M < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {M}")
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"n_layers {cfg.n_layers} % pipe {n_stages} != 0")
+    if batch % M:
+        raise ValueError(f"batch {batch} % microbatches {M} != 0")
+    mb = batch // M
+    if mb % spec.data:
+        raise ValueError(f"microbatch {mb} % mesh data={spec.data} != 0")
+    mesh = build_lm_mesh(spec, devices)
+    rules = lm_logical_rules(cfg.fsdp)
+    bc = cfg.block_config()
+    block_cls = nn.remat(Block) if cfg.remat else Block
+    block_mod = block_cls(bc, None)
+    T, d = cfg.num_patches, cfg.d_model
+
+    pipeline = make_blocks_pipeline(
+        mesh, block_mod,
+        n_stages=n_stages, num_microbatches=M, mb=mb,
+        d_model=d, compute_dtype=cfg.dtype,
+    )
+
+    # the same submodule constructors ViT composes, applied with the
+    # corresponding param subtrees — shared source, no drift
+    from ddl_tpu.models.vit import make_patch_embed, make_vit_head
+
+    conv_mod = make_patch_embed(cfg)
+    norm_mod = RMSNorm(cfg.dtype)
+    head_mod = make_vit_head(cfg)
+
+    def split_vit_params(full):
+        return {
+            "embed": {"patch_embed": full["patch_embed"],
+                      "pos_embed": full["pos_embed"]},
+            "blocks": stack_block_params(full, n_stages),
+            "head": {"norm_f": full["norm_f"], "head": full["head"]},
+        }
+
+    full_model = ViT(cfg)
+    dummy = jnp.zeros((batch, cfg.image_size, cfg.image_size, 3), jnp.float32)
+
+    abs_params = jax.eval_shape(lambda r: full_model.init(r, dummy)["params"], rng)
+    logical = nn.get_partition_spec(abs_params)
+    mesh_sharding = nn.logical_to_mesh_sharding(logical, mesh, rules)
+    block0 = mesh_sharding["block0"]
+    blocks_sharding = jax.tree.map(
+        lambda sh: NamedSharding(mesh, P(PIPE_AXIS, None, *sh.spec)), block0
+    )
+    param_shardings = {
+        "embed": {"patch_embed": mesh_sharding["patch_embed"],
+                  "pos_embed": mesh_sharding["pos_embed"]},
+        "blocks": blocks_sharding,
+        "head": {"norm_f": mesh_sharding["norm_f"],
+                 "head": mesh_sharding["head"]},
+    }
+
+    def create_state(rng):
+        params = split_vit_params(
+            nn.meta.unbox(full_model.init(rng, dummy)["params"])
+        )
+        params = jax.lax.with_sharding_constraint(params, param_shardings)
+        return ViTTrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    mb_spec = NamedSharding(mesh, P(None, "data"))
+
+    def forward(params, images):
+        x = normalize_images(images, cfg.dtype)
+        with nn.logical_axis_rules(rules):
+            x = conv_mod.apply({"params": params["embed"]["patch_embed"]}, x)
+            x = x.reshape(batch, T, d)
+            x = x + params["embed"]["pos_embed"].astype(cfg.dtype)
+            x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+            x = x.reshape(M, mb, T, d)
+            x = jax.lax.with_sharding_constraint(x, mb_spec)
+            acc, _aux = pipeline(params["blocks"], x)
+            x_out = acc[-1].reshape(batch, T, d)
+            x_out = norm_mod.apply({"params": params["head"]["norm_f"]}, x_out)
+            pooled = x_out.mean(axis=1)
+            return head_mod.apply(
+                {"params": params["head"]["head"]}, pooled.astype(jnp.float32)
+            )
+
+    return _finalize_vit(mesh, tx, forward, create_state, rng)

@@ -27,6 +27,10 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", type=int, default=1)
     ap.add_argument("--model", type=int, default=1)
+    ap.add_argument("--pipe", type=int, default=1,
+                    help="GPipe stages over the encoder blocks")
+    ap.add_argument("--microbatches", type=int, default=0,
+                    help="microbatches when --pipe > 1 (default: --pipe)")
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--epochs", type=int, default=3)
@@ -67,11 +71,12 @@ def main() -> None:
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
         fsdp=args.fsdp,
     )
-    spec = LMMeshSpec(data=args.data, model=args.model)
+    spec = LMMeshSpec(data=args.data, model=args.model, pipe=args.pipe)
     tx = build_optimizer(args.lr, weight_decay=0.05, grad_clip_norm=1.0)
-    fns = make_vit_step_fns(cfg, spec, tx, jax.random.key(0), args.batch)
-    print(f"mesh=(data={args.data}, model={args.model}) fsdp={args.fsdp} "
-          f"patches={cfg.num_patches}")
+    fns = make_vit_step_fns(cfg, spec, tx, jax.random.key(0), args.batch,
+                            num_microbatches=args.microbatches)
+    print(f"mesh=(data={args.data}, model={args.model}, pipe={args.pipe}) "
+          f"fsdp={args.fsdp} patches={cfg.num_patches}")
 
     dc = DataConfig(
         image_size=args.image_size,

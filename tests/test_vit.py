@@ -141,6 +141,41 @@ def test_overfits_tiny_batch():
     assert float(m["accuracy"]) == 1.0
 
 
+def test_pipeline_matches_single():
+    """DP x PP ViT (data=2, pipe=2, 2 microbatches) must reproduce the
+    single-device run: same loss, same post-Adam parameters."""
+    cfg = _cfg()
+    tx = optax.adam(1e-3)
+    imgs, labels = _batch()
+
+    single = make_vit_step_fns(cfg, LMMeshSpec(), tx, jax.random.key(0), 8,
+                               devices=jax.devices()[:1])
+    s1, m_ref = single.train(single.init_state(), imgs, labels)
+    p_ref = jax.device_get(s1.params)
+
+    pp = make_vit_step_fns(cfg, LMMeshSpec(data=2, pipe=2), tx,
+                           jax.random.key(0), 8, devices=jax.devices()[:4],
+                           num_microbatches=2)
+    t1, m = pp.train(pp.init_state(), imgs, labels)
+    assert abs(float(m["loss"]) - float(m_ref["loss"])) < 1e-5
+    # compare per-layer: stage-major stacked blocks vs flat block{i}
+    pp_params = jax.device_get(t1.params)
+    for p in range(2):
+        for j in range(1):  # 2 layers / 2 stages
+            flat = p_ref[f"block{p * 1 + j}"]
+            stacked = jax.tree.map(lambda x: x[p, j], pp_params["blocks"])
+            err = jax.tree.reduce(max, jax.tree.map(
+                lambda a, b: float(np.max(np.abs(a - b))), flat, stacked))
+            assert err < 1e-4, (p, j, err)
+    for src, dst in ((p_ref["patch_embed"], pp_params["embed"]["patch_embed"]),
+                     (p_ref["pos_embed"], pp_params["embed"]["pos_embed"]),
+                     (p_ref["norm_f"], pp_params["head"]["norm_f"]),
+                     (p_ref["head"], pp_params["head"]["head"])):
+        err = jax.tree.reduce(max, jax.tree.map(
+            lambda a, b: float(np.max(np.abs(a - b))), src, dst))
+        assert err < 1e-4
+
+
 def test_eval_matches_train_logits():
     cfg = _cfg()
     fns = make_vit_step_fns(cfg, LMMeshSpec(data=2), optax.adam(1e-3),

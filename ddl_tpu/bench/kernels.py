@@ -1,0 +1,88 @@
+"""Kernel microbenchmarks: Pallas flash attention vs XLA dense lowering.
+
+Reproduces PERF.md's kernel table on real hardware:
+
+    python -m ddl_tpu.bench.kernels                 # fwd/bwd sweep over T
+    python -m ddl_tpu.bench.kernels --blocks        # block-size sweep
+
+All timings use the true device fence (``utils/timing.fence``) and chained
+iterations so per-call dispatch latency amortises (PERF.md methodology).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddl_tpu.ops.attention import dense_attention
+from ddl_tpu.ops.flash_attention import flash_attention
+from ddl_tpu.utils.timing import fence
+
+__all__ = ["time_chained", "attention_sweep", "block_sweep"]
+
+
+def time_chained(fn, x0, iters: int) -> float:
+    """Mean ms/call over ``iters`` chained calls (each consumes the last
+    result, so the device cannot overlap them away)."""
+    fence(fn(x0))  # compile + warm
+    t0 = time.perf_counter()
+    o = x0
+    for _ in range(iters):
+        o = fn(o)
+    fence(o)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def attention_sweep(seq_lens=(1024, 2048, 4096, 8192), b=2, h=8, d=64):
+    rows = []
+    for t in seq_lens:
+        q0 = jnp.asarray(
+            np.random.default_rng(0).normal(size=(b, t, h, d)), jnp.bfloat16
+        )
+        fns = {
+            "flash_fwd": (jax.jit(lambda x: flash_attention(x, x, x, causal=True)), 20),
+            "dense_fwd": (jax.jit(lambda x: dense_attention(x, x, x, causal=True)), 20),
+            "flash_bwd": (jax.jit(jax.grad(
+                lambda x: flash_attention(x, x, x, causal=True)
+                .astype(jnp.float32).sum())), 10),
+            "dense_bwd": (jax.jit(jax.grad(
+                lambda x: dense_attention(x, x, x, causal=True)
+                .astype(jnp.float32).sum())), 10),
+        }
+        row = {"T": t}
+        for name, (fn, iters) in fns.items():
+            row[name + "_ms"] = round(time_chained(fn, q0, iters), 2)
+        rows.append(row)
+        print(row, flush=True)
+    return rows
+
+
+def block_sweep(t=8192, b=2, h=8, d=64):
+    q0 = jnp.asarray(
+        np.random.default_rng(0).normal(size=(b, t, h, d)), jnp.bfloat16
+    )
+    rows = []
+    for bq, bk in ((128, 128), (256, 256), (512, 512), (1024, 1024)):
+        fn = jax.jit(
+            lambda x, bq=bq, bk=bk: flash_attention(
+                x, x, x, causal=True, block_q=bq, block_k=bk
+            )
+        )
+        ms = round(time_chained(fn, q0, 20), 2)
+        rows.append({"block_q": bq, "block_k": bk, "ms": ms})
+        print(rows[-1], flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--blocks", action="store_true", help="block-size sweep")
+    args = ap.parse_args()
+    if args.blocks:
+        block_sweep()
+    else:
+        attention_sweep()

@@ -1,0 +1,77 @@
+"""LM training throughput benchmark (PERF.md's tokens/sec table).
+
+    python -m ddl_tpu.bench.lm                  # GPT-2-small-ish, T=1024
+    python -m ddl_tpu.bench.lm --seq-len 4096 --batch 2 --flash
+
+True-fenced steady-state timing of the full train step (fwd + bwd +
+AdamW) on the current default backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ddl_tpu.models.transformer import LMConfig
+from ddl_tpu.parallel.sharding import LMMeshSpec
+from ddl_tpu.train.lm_steps import make_lm_step_fns
+from ddl_tpu.utils.timing import fence
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=50304)
+    ap.add_argument("--flash", action="store_true")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    cfg = LMConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_layers=args.layers,
+        n_heads=args.d_model // 64,
+        head_dim=64,
+        d_ff=4 * args.d_model,
+        compute_dtype="bfloat16",
+        flash=args.flash,
+        remat=True,
+    )
+    fns = make_lm_step_fns(
+        cfg, LMMeshSpec(), optax.adamw(3e-4), jax.random.key(0),
+        args.batch, args.seq_len,
+    )
+    state = fns.init_state()
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, args.vocab, (args.batch, args.seq_len + 1))
+    )
+    inp, tgt = toks[:, :-1], toks[:, 1:]
+    for _ in range(3):
+        state, m = fns.train(state, inp, tgt)
+    fence(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        state, m = fns.train(state, inp, tgt)
+    fence(m["loss"])
+    dt = (time.perf_counter() - t0) / args.iters
+    print(json.dumps({
+        "ms_per_step": round(dt * 1e3, 1),
+        "tokens_per_sec": round(args.batch * args.seq_len / dt),
+        "seq_len": args.seq_len,
+        "batch": args.batch,
+        "flash": args.flash,
+        "loss": round(float(m["loss"]), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,13 +52,21 @@ def make_vit_step_fns(
     batch: int,
     devices=None,
     num_microbatches: int = 0,
+    accum_steps: int = 1,
 ) -> ViTStepFns:
     if spec.seq > 1 or spec.expert > 1:
         raise ValueError(
             "ViT steps shard over (data, model, pipe); got "
             f"seq={spec.seq} expert={spec.expert}"
         )
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if spec.pipe > 1:
+        if accum_steps > 1:
+            raise ValueError(
+                "accum_steps > 1 is the non-pipelined path's microbatching; "
+                "with spec.pipe > 1 use num_microbatches instead"
+            )
         return _make_vit_pipeline_step_fns(
             cfg, spec, tx, rng, batch,
             num_microbatches=num_microbatches or spec.pipe,
@@ -66,6 +74,13 @@ def make_vit_step_fns(
         )
     if num_microbatches > 1:
         raise ValueError("num_microbatches needs spec.pipe > 1")
+    if accum_steps > 1 and (
+        batch % accum_steps or (batch // accum_steps) % spec.data
+    ):
+        raise ValueError(
+            f"batch {batch} must split into accum_steps={accum_steps} chunks "
+            f"divisible by mesh data={spec.data}"
+        )
     if batch % spec.data:
         raise ValueError(f"batch {batch} must divide by mesh data={spec.data}")
     mesh = build_lm_mesh(spec, devices)
@@ -94,12 +109,17 @@ def make_vit_step_fns(
         with nn.logical_axis_rules(rules):
             return model.apply({"params": params}, x)
 
-    return _finalize_vit(mesh, tx, forward, create_state, rng)
+    return _finalize_vit(mesh, tx, forward, create_state, rng,
+                         accum_steps=accum_steps)
 
 
-def _finalize_vit(mesh, tx, forward, create_state, rng) -> ViTStepFns:
+def _finalize_vit(mesh, tx, forward, create_state, rng,
+                  accum_steps: int = 1) -> ViTStepFns:
     """Shared jit tail for the plain and pipelined ViT paths: wraps a
-    ``forward(params, images) -> logits`` and a ``create_state(rng)``."""
+    ``forward(params, images) -> logits`` and a ``create_state(rng)``.
+    ``accum_steps > 1``: gradient accumulation over equal batch chunks
+    inside one jitted step (identical update to the full-batch step;
+    see ``lm_steps.finalize_step_fns``)."""
 
     def loss_fn(params, images, labels):
         logits = forward(params, images)
@@ -107,10 +127,28 @@ def _finalize_vit(mesh, tx, forward, create_state, rng) -> ViTStepFns:
         acc = (jnp.argmax(logits, -1) == labels).mean()
         return loss, (logits, {"loss": loss, "accuracy": acc})
 
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
     def train_step(state, images, labels):
-        (_, (_, metrics)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, images, labels
-        )
+        if accum_steps == 1:
+            (_, (_, metrics)), grads = grad_fn(state.params, images, labels)
+        else:
+            from ddl_tpu.train.lm_steps import accumulate_grads
+
+            k = accum_steps
+            b = images.shape[0]
+            chunk_sh = NamedSharding(
+                mesh, P(None, "data", *([None] * (images.ndim - 1)))
+            )
+            img_c = jax.lax.with_sharding_constraint(
+                images.reshape(k, b // k, *images.shape[1:]), chunk_sh
+            )
+            lab_c = jax.lax.with_sharding_constraint(
+                labels.reshape(k, b // k), NamedSharding(mesh, P(None, "data"))
+            )
+            grads, metrics = accumulate_grads(
+                grad_fn, state.params, (img_c, lab_c), k
+            )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         return (
             state.replace(

@@ -32,6 +32,8 @@ def main() -> None:
                     help="pipeline stages over the decoder layers")
     ap.add_argument("--microbatches", type=int, default=0,
                     help="GPipe microbatches when --pipe > 1 (default: --pipe)")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="gradient-accumulation chunks per step (pipe=1 only)")
     ap.add_argument("--experts", type=int, default=0, help="0 = dense MLP")
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--attn", default=None, choices=["dense", "ring", "ulysses"],
@@ -113,7 +115,7 @@ def main() -> None:
     )
     fns = make_lm_step_fns(
         cfg, spec, tx, jax.random.key(0), args.batch, args.seq_len,
-        num_microbatches=args.microbatches,
+        num_microbatches=args.microbatches, accum_steps=args.accum,
     )
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
 

@@ -31,6 +31,8 @@ def main() -> None:
                     help="GPipe stages over the encoder blocks")
     ap.add_argument("--microbatches", type=int, default=0,
                     help="microbatches when --pipe > 1 (default: --pipe)")
+    ap.add_argument("--accum", type=int, default=1,
+                    help="gradient-accumulation chunks per step (pipe=1 only)")
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--epochs", type=int, default=3)
@@ -74,7 +76,8 @@ def main() -> None:
     spec = LMMeshSpec(data=args.data, model=args.model, pipe=args.pipe)
     tx = build_optimizer(args.lr, weight_decay=0.05, grad_clip_norm=1.0)
     fns = make_vit_step_fns(cfg, spec, tx, jax.random.key(0), args.batch,
-                            num_microbatches=args.microbatches)
+                            num_microbatches=args.microbatches,
+                            accum_steps=args.accum)
     print(f"mesh=(data={args.data}, model={args.model}, pipe={args.pipe}) "
           f"fsdp={args.fsdp} patches={cfg.num_patches}")
 

@@ -76,6 +76,11 @@ class LMConfig:
     # False = bidirectional attention (encoder use, e.g. the ViT family —
     # models/vit.py); LM training/decoding requires the causal default.
     causal: bool = True
+    # Residual dropout after the attention and MLP sublayers (0 = off; adds
+    # no parameters, so checkpoints are layout-compatible either way).
+    # Training passes deterministic=False + a 'dropout' rng; eval/decode
+    # leave the default deterministic=True.
+    dropout_rate: float = 0.0
 
     @property
     def dtype(self):
@@ -340,22 +345,23 @@ class Block(nn.Module):
     attn_core: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, kv_cache=None, offset=None):
+    def __call__(self, x, kv_cache=None, offset=None, deterministic=True):
         cfg = self.cfg
+        drop = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)
         attn = Attention(cfg, self.attn_core, name="attn")
         h = RMSNorm(cfg.dtype, name="norm_attn")(x)
         if kv_cache is None:
-            x = x + attn(h)
+            x = x + drop(attn(h))
             new_cache = None
         else:
             a, new_cache = attn(h, kv_cache, offset)
-            x = x + a
+            x = x + drop(a)
         h = RMSNorm(cfg.dtype, name="norm_mlp")(x)
         if cfg.num_experts > 0:
             y, aux = MoeMlp(cfg, name="moe")(h)
         else:
             y, aux = Mlp(cfg, name="mlp")(h), jnp.zeros((), jnp.float32)
-        x = x + y
+        x = x + drop(y)
         return (x, aux) if kv_cache is None else (x, aux, new_cache)
 
 
@@ -405,16 +411,18 @@ class TransformerLM(nn.Module):
     attn_core: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, deterministic: bool = True):
         cfg = self.cfg
         x = make_embed(cfg)(tokens)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         block = Block
         if cfg.remat:
-            block = nn.remat(Block)
+            block = nn.remat(Block, static_argnums=(4,))
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layers):
-            x, aux = block(cfg, self.attn_core, name=f"block{i}")(x)
+            x, aux = block(cfg, self.attn_core, name=f"block{i}")(
+                x, None, None, deterministic
+            )
             aux_total = aux_total + aux
         return apply_final_norm_and_head(cfg, x), aux_total
 

@@ -36,6 +36,7 @@ class ViTConfig:
     compute_dtype: str = "bfloat16"
     remat: bool = True
     fsdp: bool = False
+    dropout_rate: float = 0.0  # residual dropout inside the blocks
 
     @property
     def num_patches(self) -> int:
@@ -60,6 +61,7 @@ class ViTConfig:
             remat=self.remat,
             fsdp=self.fsdp,
             causal=False,
+            dropout_rate=self.dropout_rate,
         )
 
     @property
@@ -107,7 +109,7 @@ class ViT(nn.Module):
     attn_core: Optional[callable] = None
 
     @nn.compact
-    def __call__(self, images):
+    def __call__(self, images, deterministic: bool = True):
         cfg = self.cfg
         bc = cfg.block_config()
         b = images.shape[0]
@@ -123,9 +125,11 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
-        block = nn.remat(Block) if cfg.remat else Block
+        block = nn.remat(Block, static_argnums=(4,)) if cfg.remat else Block
         for i in range(cfg.n_layers):
-            x, _aux = block(bc, self.attn_core, name=f"block{i}")(x)
+            x, _aux = block(bc, self.attn_core, name=f"block{i}")(
+                x, None, None, deterministic
+            )
         x = RMSNorm(cfg.dtype, name="norm_f")(x)
         x = x.mean(axis=1)  # mean-pool over patches
         return make_vit_head(cfg)(x.astype(jnp.float32))

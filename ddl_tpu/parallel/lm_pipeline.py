@@ -387,6 +387,12 @@ def make_lm_pipeline_step_fns(
             "causal=False is only implemented for dense attention "
             "(the nested ring/Ulysses cores are built causal)"
         )
+    if cfg.dropout_rate > 0.0:
+        raise ValueError(
+            "dropout is not supported with pipeline parallelism (the blocks "
+            "run inside the manual-over-pipe scan with no dropout rng "
+            "plumbing); train with dropout on the non-pipelined path"
+        )
     if cfg.flash:
         raise ValueError(
             "flash=True is not supported with pipeline parallelism: the "
@@ -524,7 +530,7 @@ def make_lm_pipeline_step_fns(
             opt_state=tx.init(params),
         )
 
-    def loss_fn(params, inputs, targets):
+    def loss_fn(params, inputs, targets, step=None):
         logits, aux = forward(params, inputs)
         ce = _token_ce(logits, targets)
         loss = ce + cfg.moe_aux_weight * aux

@@ -83,6 +83,18 @@ def _token_ce(logits, targets):
     return (lse - picked).mean()
 
 
+def dropout_kwargs(rng: jax.Array, step, rate: float) -> dict:
+    """``model.apply`` kwargs for optional train-mode dropout: active iff a
+    ``step`` is given and ``rate > 0``; the rng is derived from the
+    builder's key (decorrelated from init by the 0x0D0 fold) and the step.
+    Single source shared by the LM and ViT paths."""
+    train = step is not None and rate > 0.0
+    if not train:
+        return {"deterministic": True, "rngs": None}
+    key = jax.random.fold_in(jax.random.fold_in(rng, 0x0D0), step)
+    return {"deterministic": False, "rngs": {"dropout": key}}
+
+
 def accumulate_grads(grad_fn, params, chunked_args, k: int):
     """Mean gradients and metrics of ``grad_fn(params, *chunk)`` over the
     ``k`` leading-axis chunks of ``chunked_args`` — ONE compiled
@@ -116,8 +128,11 @@ def finalize_step_fns(
     accum_steps: int = 1,
 ) -> LMStepFns:
     """Shared tail for the non-pipelined and pipelined LM paths: wrap a
-    ``loss_fn(params, inputs, targets) -> (loss, (logits, metrics))`` and a
-    ``create_state(rng)`` into jitted, donated, mesh-scoped step functions.
+    ``loss_fn(params, inputs, targets, step=None) -> (loss, (logits,
+    metrics))`` and a ``create_state(rng)`` into jitted, donated,
+    mesh-scoped step functions.  ``train`` passes ``state.step`` as
+    ``step`` (dropout rng derivation); eval passes nothing
+    (deterministic).
 
     ``accum_steps > 1`` splits the batch into that many equal chunks and
     accumulates their gradients inside one jitted step (``lax.scan``)
@@ -138,7 +153,9 @@ def finalize_step_fns(
 
     def train_step(state, inputs, targets):
         if accum_steps == 1:
-            (_, (_, metrics)), grads = grad_fn(state.params, inputs, targets)
+            (_, (_, metrics)), grads = grad_fn(
+                state.params, inputs, targets, state.step
+            )
         else:
             k = accum_steps
             b = inputs.shape[0]
@@ -149,8 +166,10 @@ def finalize_step_fns(
             tgt_c = jax.lax.with_sharding_constraint(
                 targets.reshape(k, b // k, *targets.shape[1:]), chunk_sh
             )
+            # distinct dropout streams per chunk
+            steps = state.step * k + jnp.arange(k)
             grads, metrics = accumulate_grads(
-                grad_fn, state.params, (inp_c, tgt_c), k
+                grad_fn, state.params, (inp_c, tgt_c, steps), k
             )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -347,9 +366,15 @@ def make_lm_step_fns(
             opt_state=tx.init(params),
         )
 
-    def loss_fn(params, inputs, targets):
+    def loss_fn(params, inputs, targets, step=None):
+        kw = dropout_kwargs(rng, step, cfg.dropout_rate)
         with nn.logical_axis_rules(rules):
-            logits, aux = model.apply({"params": params}, inputs)
+            logits, aux = model.apply(
+                {"params": params},
+                inputs,
+                deterministic=kw["deterministic"],
+                rngs=kw["rngs"],
+            )
         ce = _token_ce(logits, targets)
         loss = ce + cfg.moe_aux_weight * aux
         return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})

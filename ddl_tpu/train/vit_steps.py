@@ -104,10 +104,18 @@ def make_vit_step_fns(
             opt_state=tx.init(params),
         )
 
-    def forward(params, images):
+    def forward(params, images, step=None):
+        from ddl_tpu.train.lm_steps import dropout_kwargs
+
+        kw = dropout_kwargs(rng, step, cfg.dropout_rate)
         x = normalize_images(images, cfg.dtype)
         with nn.logical_axis_rules(rules):
-            return model.apply({"params": params}, x)
+            return model.apply(
+                {"params": params},
+                x,
+                deterministic=kw["deterministic"],
+                rngs=kw["rngs"],
+            )
 
     return _finalize_vit(mesh, tx, forward, create_state, rng,
                          accum_steps=accum_steps)
@@ -116,13 +124,14 @@ def make_vit_step_fns(
 def _finalize_vit(mesh, tx, forward, create_state, rng,
                   accum_steps: int = 1) -> ViTStepFns:
     """Shared jit tail for the plain and pipelined ViT paths: wraps a
-    ``forward(params, images) -> logits`` and a ``create_state(rng)``.
-    ``accum_steps > 1``: gradient accumulation over equal batch chunks
-    inside one jitted step (identical update to the full-batch step;
-    see ``lm_steps.finalize_step_fns``)."""
+    ``forward(params, images, step=None) -> logits`` (``step`` drives the
+    train-mode dropout rng; eval passes nothing) and a
+    ``create_state(rng)``.  ``accum_steps > 1``: gradient accumulation
+    over equal batch chunks inside one jitted step (identical update to
+    the full-batch step; see ``lm_steps.finalize_step_fns``)."""
 
-    def loss_fn(params, images, labels):
-        logits = forward(params, images)
+    def loss_fn(params, images, labels, step=None):
+        logits = forward(params, images, step)
         loss = cross_entropy_loss(logits, labels)
         acc = (jnp.argmax(logits, -1) == labels).mean()
         return loss, (logits, {"loss": loss, "accuracy": acc})
@@ -131,7 +140,9 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
 
     def train_step(state, images, labels):
         if accum_steps == 1:
-            (_, (_, metrics)), grads = grad_fn(state.params, images, labels)
+            (_, (_, metrics)), grads = grad_fn(
+                state.params, images, labels, state.step
+            )
         else:
             from ddl_tpu.train.lm_steps import accumulate_grads
 
@@ -146,8 +157,9 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
             lab_c = jax.lax.with_sharding_constraint(
                 labels.reshape(k, b // k), NamedSharding(mesh, P(None, "data"))
             )
+            steps = state.step * k + jnp.arange(k)
             grads, metrics = accumulate_grads(
-                grad_fn, state.params, (img_c, lab_c), k
+                grad_fn, state.params, (img_c, lab_c, steps), k
             )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         return (
@@ -213,6 +225,12 @@ def _make_vit_pipeline_step_fns(
     n_stages, M = spec.pipe, num_microbatches
     if M < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {M}")
+    if cfg.dropout_rate > 0.0:
+        raise ValueError(
+            "dropout is not supported with pipeline parallelism (no dropout "
+            "rng plumbing inside the manual-over-pipe scan); train with "
+            "dropout on the non-pipelined path"
+        )
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers {cfg.n_layers} % pipe {n_stages} != 0")
     if batch % M:
@@ -280,7 +298,7 @@ def _make_vit_pipeline_step_fns(
 
     mb_spec = NamedSharding(mesh, P(None, "data"))
 
-    def forward(params, images):
+    def forward(params, images, step=None):
         x = normalize_images(images, cfg.dtype)
         with nn.logical_axis_rules(rules):
             x = conv_mod.apply({"params": params["embed"]["patch_embed"]}, x)

@@ -34,6 +34,8 @@ def main() -> None:
                     help="GPipe microbatches when --pipe > 1 (default: --pipe)")
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation chunks per step (pipe=1 only)")
+    ap.add_argument("--dropout", type=float, default=0.0,
+                    help="residual dropout rate (pipe=1 only)")
     ap.add_argument("--experts", type=int, default=0, help="0 = dense MLP")
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--attn", default=None, choices=["dense", "ring", "ulysses"],
@@ -99,6 +101,7 @@ def main() -> None:
         or (("ulysses" if args.flash else "ring") if args.seq > 1 else "dense"),
         flash=args.flash,
         fsdp=args.fsdp,
+        dropout_rate=args.dropout,
     )
     spec = LMMeshSpec(
         args.data, args.seq, args.model, args.expert_axis, pipe=args.pipe

@@ -33,6 +33,8 @@ def main() -> None:
                     help="microbatches when --pipe > 1 (default: --pipe)")
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation chunks per step (pipe=1 only)")
+    ap.add_argument("--dropout", type=float, default=0.0,
+                    help="residual dropout rate (pipe=1 only)")
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--epochs", type=int, default=3)
@@ -72,6 +74,7 @@ def main() -> None:
         d_ff=4 * args.d_model,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
         fsdp=args.fsdp,
+        dropout_rate=args.dropout,
     )
     spec = LMMeshSpec(data=args.data, model=args.model, pipe=args.pipe)
     tx = build_optimizer(args.lr, weight_decay=0.05, grad_clip_norm=1.0)

@@ -90,12 +90,29 @@ from ddl_tpu.train.lm_steps import (
 __all__ = [
     "make_lm_pipeline_step_fns",
     "make_blocks_pipeline",
+    "make_blocks_pipeline_1f1b",
     "split_lm_params",
     "merge_lm_params",
     "convert_lm_state",
     "abstract_lm_state",
     "saved_pipe_stages",
 ]
+
+
+def _make_stage_fn(block_mod: nn.Module):
+    """Stage forward: scan ``block_mod`` over the stage's stacked layer
+    params.  Returns ``(y, aux)`` with ``aux`` the f32 sum of the stage's
+    per-layer aux losses (MoE load balancing)."""
+
+    def stage_fn(stage_blocks, x):
+        def layer(carry, p):
+            y, aux = block_mod.apply({"params": p}, carry)
+            return y, aux
+
+        y, auxs = lax.scan(layer, x, stage_blocks)
+        return y, auxs.astype(jnp.float32).sum()
+
+    return stage_fn
 
 
 def make_blocks_pipeline(
@@ -123,14 +140,7 @@ def make_blocks_pipeline(
     """
     M = num_microbatches
     d = d_model
-
-    def stage_fn(stage_blocks, x):
-        def layer(carry, p):
-            y, aux = block_mod.apply({"params": p}, carry)
-            return y, aux
-
-        y, auxs = lax.scan(layer, x, stage_blocks)
-        return y, auxs.sum()
+    stage_fn = _make_stage_fn(block_mod)
 
     def pipeline_body(blocks_stacked, x_mb):
         stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
@@ -169,6 +179,194 @@ def make_blocks_pipeline(
         mesh=mesh,
         in_specs=(P(PIPE_AXIS), P()),
         out_specs=(P(PIPE_AXIS), P(PIPE_AXIS)),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )
+
+
+def make_blocks_pipeline_1f1b(
+    mesh: Mesh,
+    block_mod: nn.Module,
+    head_loss,
+    *,
+    n_stages: int,
+    num_microbatches: int,
+    mb: int,
+    d_model: int,
+    compute_dtype,
+    aux_cotangent: float,
+    zero_metrics,
+):
+    """One-forward-one-backward interleaved schedule over the uniform block
+    stack — the forward AND backward pipeline in a single scan, with the loss
+    fused into the last stage (the piece GPipe-by-autodiff keeps outside).
+
+    Because forward and backward interleave, this cannot be expressed as
+    autodiff through the forward scan (that *is* GPipe); the backward is
+    hand-written with per-tick ``jax.vjp``, the same construction as the CNN
+    pipeline's 1F1B (``parallel/pipeline.py::per_device_train_1f1b``), lifted
+    to the partial-manual region: everything inside a stage stays GSPMD-auto
+    over data/seq/model/expert while ticks and hops are manual over ``pipe``.
+
+    Schedule: at tick ``t`` the device at pipe coordinate ``s`` runs the
+    forward of microbatch ``t - s`` and the backward of microbatch
+    ``t - (2(P-1) - s)``; on the last stage these coincide, and the loss
+    epilogue supplies the output cotangent in place of the (absent) next
+    stage's reverse hop.  Activations ride a forward ``ppermute``,
+    cotangents the reverse one; stage inputs wait for their backward in a
+    ring buffer of depth ``min(2(P-1)+1, M)`` — O(P), independent of the
+    microbatch count, vs the GPipe scan's O(M) saved per-tick stage inputs —
+    and the schedule closes in ``M + 2(P-1)`` ticks vs autodiff-GPipe's
+    ``2(M + P - 1)``.  The O(P) bound covers the *stage-activation*
+    residency only: the embedded input ``x_mb`` and its cotangent
+    accumulator ``dx_acc`` are full-batch ``(M, mb, T, d)`` buffers under
+    either schedule — they are the embed/head edge, not pipeline state.
+
+    ``head_loss(head_params, y, tgt) -> (loss_contribution, metrics)`` is the
+    caller's last-stage epilogue (e.g. final-norm + vocab projection + CE/M
+    for the LM); ``metrics`` must match ``zero_metrics`` in structure and is
+    accumulated over microbatches.  ``aux_cotangent`` is the weight each
+    stage's summed aux loss carries in the total loss (MoE balancing:
+    ``moe_aux_weight / M``).
+
+    Returns ``pipeline(blocks_stacked, head_params, x_mb, tgt_mb) ->
+    (d_blocks, d_head, dx_mb, metrics, aux_sum)`` where ``d_blocks`` is
+    ``P('pipe')``-stacked like its primal, and ``d_head``/``dx_mb``/
+    ``metrics``/``aux_sum`` are pipe-replicated (``dx_mb`` is the cotangent
+    of the embedded input — the caller backpropagates it through the
+    embedding with its own ``jax.vjp``, closing the gradient path that
+    autodiff's shard_map transpose handles on the GPipe path).  Gradients are
+    bit-compatible with the GPipe schedule: same math, same microbatch order
+    (asserted by ``tests/test_lm_pipeline.py``).
+    """
+    P_, M = n_stages, num_microbatches
+    last = P_ - 1
+    d = d_model
+    stage_fn = _make_stage_fn(block_mod)
+    # A microbatch's stage input is written at tick f+s and consumed by its
+    # backward at tick f+2(P-1)-s: lifetime 2(P-1-s) ticks, so depth
+    # 2(P-1)+1 (stage 0's worst case) always suffices; M slots suffice when
+    # M is smaller because at most M microbatches are in flight.
+    depth = min(2 * last + 1, M)
+
+    def pipeline_body(blocks_stacked, head_params, x_mb, tgt_mb):
+        stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
+        s = lax.axis_index(PIPE_AXIS)
+        t_len = x_mb.shape[2]
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, resid, dx_acc, g_blocks, g_head, met, aux = carry
+            f_idx = jnp.clip(t - s, 0, M - 1)
+            fwd_valid = (t >= s) & (t - s < M)
+            off = 2 * last - s
+            b_idx = jnp.clip(t - off, 0, M - 1)
+            bwd_valid = (t >= off) & (t - off < M)
+
+            x_first = lax.dynamic_index_in_dim(x_mb, f_idx, 0, keepdims=False)
+            x_in = jnp.where(s == 0, x_first, fwd_buf)
+            resid = jnp.where(
+                fwd_valid,
+                lax.dynamic_update_index_in_dim(resid, x_in, f_idx % depth, 0),
+                resid,
+            )
+            x_b = lax.dynamic_index_in_dim(resid, b_idx % depth, 0, keepdims=False)
+            tgt_b = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, b_idx, 0, keepdims=False),
+                tgt_mb,
+            )
+
+            # Every collective-bearing computation runs UNCONDITIONALLY on
+            # every device: the forward-for-handoff and the stage vjp both
+            # contain the nested seq cores' ppermute / all_to_all (and the
+            # MoE dispatch), which XLA compiles as single whole-mesh
+            # channel ops — inside a branch that only some pipe coordinates
+            # take, the other coordinates never join the rendezvous and the
+            # program deadlocks (observed).  Only the head epilogue sits in
+            # a cond: its collectives (TP/data/seq all-reduces from GSPMD)
+            # are per-group ops whose groups lie within one pipe
+            # coordinate, so every participant agrees on the branch.
+            out, _ = stage_fn(stage_blocks, x_in)
+            (y_b, aux_b), stage_vjp = jax.vjp(stage_fn, stage_blocks, x_b)
+
+            def last_branch(y):
+                # the loss supplies the output cotangent: vjp through
+                # head_loss in place of the (absent) next stage's hop
+                _, head_vjp, m = jax.vjp(
+                    lambda hp, yy: head_loss(hp, yy, tgt_b),
+                    head_params,
+                    y,
+                    has_aux=True,
+                )
+                dh, g_y = head_vjp(jnp.ones((), jnp.float32))
+                return dh, g_y.astype(y.dtype), m
+
+            def mid_branch(y):
+                # cotangent arrived from stage s+1 on the reverse hop
+                dh = jax.tree.map(jnp.zeros_like, head_params)
+                return dh, bwd_buf.astype(y.dtype), zero_metrics
+
+            dh, g_y, m = lax.cond(s == last, last_branch, mid_branch, y_b)
+            db, dx = stage_vjp(
+                (g_y, jnp.asarray(aux_cotangent, jnp.float32))
+            )
+
+            def acc(old, new):
+                return jax.tree.map(
+                    lambda o, n: o + jnp.where(bwd_valid, n, jnp.zeros_like(n)),
+                    old,
+                    new,
+                )
+
+            g_blocks, g_head, met = acc(g_blocks, db), acc(g_head, dh), acc(met, m)
+            aux = aux + jnp.where(bwd_valid, aux_b, 0.0)
+            dx_acc = jnp.where(
+                bwd_valid & (s == 0),
+                lax.dynamic_update_index_in_dim(
+                    dx_acc, dx.astype(compute_dtype), b_idx, 0
+                ),
+                dx_acc,
+            )
+            fwd_buf = lax.ppermute(
+                out.astype(compute_dtype),
+                PIPE_AXIS,
+                [(i, i + 1) for i in range(last)],
+            )
+            bwd_buf = lax.ppermute(
+                dx.astype(compute_dtype),
+                PIPE_AXIS,
+                [(i + 1, i) for i in range(last)],
+            )
+            return (fwd_buf, bwd_buf, resid, dx_acc, g_blocks, g_head, met, aux), None
+
+        buf0 = jnp.zeros((mb, t_len, d), compute_dtype)
+        init = (
+            buf0,
+            buf0,
+            jnp.zeros((depth, mb, t_len, d), compute_dtype),
+            jnp.zeros((M, mb, t_len, d), compute_dtype),
+            jax.tree.map(jnp.zeros_like, stage_blocks),
+            jax.tree.map(jnp.zeros_like, head_params),
+            zero_metrics,
+            jnp.zeros((), jnp.float32),
+        )
+        (_, _, _, dx_acc, g_blocks, g_head, met, aux), _ = lax.scan(
+            tick, init, jnp.arange(M + 2 * last)
+        )
+        # stage grads stay pipe-stacked like their primal; everything else
+        # lives on one coordinate (head/metrics on the last, dx on the
+        # first) and the psum broadcasts it pipe-replicated
+        g_blocks = jax.tree.map(lambda g: g[None], g_blocks)
+        g_head = jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), g_head)
+        dx_acc = lax.psum(dx_acc, PIPE_AXIS)
+        met = jax.tree.map(lambda x: lax.psum(x, PIPE_AXIS), met)
+        aux = lax.psum(aux, PIPE_AXIS)
+        return g_blocks, g_head, dx_acc, met, aux
+
+    return jax.shard_map(
+        pipeline_body,
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P(), P(), P()),
+        out_specs=(P(PIPE_AXIS), P(), P(), P(), P()),
         axis_names={PIPE_AXIS},
         check_vma=False,
     )
@@ -374,12 +572,22 @@ def make_lm_pipeline_step_fns(
     seq_len: int,
     num_microbatches: int,
     devices=None,
+    schedule: str = "gpipe",
 ) -> LMStepFns:
     """Pipeline-parallel LM step functions (same interface as
-    ``make_lm_step_fns``).  Requires ``spec.pipe > 1``."""
+    ``make_lm_step_fns``).  Requires ``spec.pipe > 1``.
+
+    ``schedule``: ``"gpipe"`` (all forwards then all backwards, derived by
+    autodiff of the forward scan) or ``"1f1b"`` (explicit interleaved
+    forward/backward, ``make_blocks_pipeline_1f1b`` — O(pipe) instead of
+    O(microbatches) *stage-activation* residency; the embed/head edge
+    buffers stay O(batch) under both schedules — same gradients).
+    Evaluation always uses the forward-only GPipe schedule."""
     n_stages, M = spec.pipe, num_microbatches
     if n_stages < 2:
         raise ValueError("make_lm_pipeline_step_fns needs spec.pipe >= 2")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     if not cfg.causal and cfg.attn_impl != "dense":
@@ -536,4 +744,64 @@ def make_lm_pipeline_step_fns(
         loss = ce + cfg.moe_aux_weight * aux
         return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})
 
-    return finalize_step_fns(mesh, tx, loss_fn, create_state, rng)
+    manual_grad_fn = None
+    if schedule == "1f1b":
+        # Loss inside the manual region: per-microbatch CE on the last
+        # stage, contributing ce/M to the full-batch mean; the raw ce rides
+        # out as a metric.
+        def head_loss(head_p, y, tgt):
+            with nn.logical_axis_rules(rules):
+                logits = head_mod.apply({"params": head_p}, y)
+            # one-hot CE instead of _token_ce's take_along_axis: the gather
+            # does not partition inside the manual-over-pipe subgroup when
+            # seq and model are both sharded (GSPMD CHECK failure); the
+            # elementwise/reduce form partitions cleanly and is the same
+            # math
+            logits = logits.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            onehot = jax.nn.one_hot(tgt, logits.shape[-1], dtype=logits.dtype)
+            ce = (lse - (logits * onehot).sum(-1)).mean()
+            return ce / M, ce
+
+        pipeline_1f1b = make_blocks_pipeline_1f1b(
+            mesh,
+            block_mod,
+            head_loss,
+            n_stages=n_stages,
+            num_microbatches=M,
+            mb=mb,
+            d_model=d,
+            compute_dtype=compute_dtype,
+            aux_cotangent=cfg.moe_aux_weight / M,
+            zero_metrics=jnp.zeros((), jnp.float32),
+        )
+
+        def manual_grad_fn(params, inputs, targets, step=None):
+            with nn.logical_axis_rules(rules):
+                x, embed_vjp = jax.vjp(
+                    lambda ep: embed_mod.apply({"params": ep}, inputs),
+                    params["embed"],
+                )
+                x_mb = lax.with_sharding_constraint(
+                    x.reshape(M, mb, seq_len, d), mb_spec
+                )
+                tgt_mb = lax.with_sharding_constraint(
+                    targets.reshape(M, mb, seq_len),
+                    NamedSharding(mesh, P(None, "data", "seq")),
+                )
+                g_blocks, g_head, dx_mb, ce_sum, aux_sum = pipeline_1f1b(
+                    params["blocks"], params["head"], x_mb, tgt_mb
+                )
+                # close the gradient path GPipe's shard_map transpose handles
+                (g_embed,) = embed_vjp(
+                    dx_mb.reshape(batch, seq_len, d).astype(x.dtype)
+                )
+            ce = ce_sum / M
+            moe_aux = aux_sum / M
+            loss = ce + cfg.moe_aux_weight * moe_aux
+            grads = {"embed": g_embed, "blocks": g_blocks, "head": g_head}
+            return grads, {"loss": loss, "ce": ce, "moe_aux": moe_aux}
+
+    return finalize_step_fns(
+        mesh, tx, loss_fn, create_state, rng, manual_grad_fn=manual_grad_fn
+    )

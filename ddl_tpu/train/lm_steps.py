@@ -126,6 +126,7 @@ def finalize_step_fns(
     create_state,
     rng: jax.Array,
     accum_steps: int = 1,
+    manual_grad_fn=None,
 ) -> LMStepFns:
     """Shared tail for the non-pipelined and pipelined LM paths: wrap a
     ``loss_fn(params, inputs, targets, step=None) -> (loss, (logits,
@@ -143,6 +144,12 @@ def finalize_step_fns(
     in batch composition, so chunked routing statistics make it a close
     but not bitwise-equal approximation.
 
+    ``manual_grad_fn(params, inputs, targets, step) -> (grads, metrics)``,
+    when given, replaces autodiff of ``loss_fn`` in the train step — for
+    paths that compute their gradients explicitly (the 1F1B pipeline
+    schedule, whose interleaved backward cannot be derived by differentiating
+    a forward pass).  ``loss_fn`` still drives evaluation.
+
     ``jax.set_mesh`` wraps every call because ``nn.with_logical_constraint``
     lowers to bare-PartitionSpec sharding constraints, which resolve against
     the ambient mesh at trace time.
@@ -152,7 +159,11 @@ def finalize_step_fns(
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def train_step(state, inputs, targets):
-        if accum_steps == 1:
+        if manual_grad_fn is not None:
+            grads, metrics = manual_grad_fn(
+                state.params, inputs, targets, state.step
+            )
+        elif accum_steps == 1:
             (_, (_, metrics)), grads = grad_fn(
                 state.params, inputs, targets, state.step
             )
@@ -226,6 +237,7 @@ def make_lm_step_fns(
     devices=None,
     num_microbatches: int = 0,
     accum_steps: int = 1,
+    pipeline_schedule: str = "gpipe",
 ) -> LMStepFns:
     """Build the sharded train state and jitted step functions.
 
@@ -261,6 +273,12 @@ def make_lm_step_fns(
             seq_len,
             num_microbatches=num_microbatches or spec.pipe,
             devices=devices,
+            schedule=pipeline_schedule,
+        )
+    if pipeline_schedule != "gpipe":
+        raise ValueError(
+            f"pipeline_schedule={pipeline_schedule!r} requires a pipe mesh "
+            "axis (spec.pipe > 1)"
         )
     if num_microbatches > 1:
         raise ValueError(

@@ -31,7 +31,12 @@ def main() -> None:
     ap.add_argument("--pipe", type=int, default=1,
                     help="pipeline stages over the decoder layers")
     ap.add_argument("--microbatches", type=int, default=0,
-                    help="GPipe microbatches when --pipe > 1 (default: --pipe)")
+                    help="pipeline microbatches when --pipe > 1 (default: --pipe)")
+    ap.add_argument("--pipeline-schedule", default="gpipe",
+                    choices=["gpipe", "1f1b"],
+                    help="pipeline schedule when --pipe > 1: gpipe (all "
+                    "forwards then all backwards) or 1f1b (interleaved, "
+                    "O(pipe) stage-activation residency)")
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation chunks per step (pipe=1 only)")
     ap.add_argument("--dropout", type=float, default=0.0,
@@ -119,6 +124,7 @@ def main() -> None:
     fns = make_lm_step_fns(
         cfg, spec, tx, jax.random.key(0), args.batch, args.seq_len,
         num_microbatches=args.microbatches, accum_steps=args.accum,
+        pipeline_schedule=args.pipeline_schedule,
     )
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
 

@@ -5,7 +5,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["softmax_cross_entropy", "cross_entropy_loss"]
+__all__ = [
+    "softmax_cross_entropy",
+    "cross_entropy_loss",
+    "onehot_cross_entropy_mean",
+]
 
 
 def softmax_cross_entropy(logits, labels):
@@ -19,3 +23,17 @@ def softmax_cross_entropy(logits, labels):
 def cross_entropy_loss(logits, labels):
     """Mean cross-entropy — the training objective."""
     return softmax_cross_entropy(logits, labels).mean()
+
+
+def onehot_cross_entropy_mean(logits, labels):
+    """Mean softmax cross-entropy in the one-hot elementwise form (returns
+    ``(mean_ce, f32_logits)``).  Same math as ``cross_entropy_loss`` but
+    without ``take_along_axis``: the gather does not partition inside a
+    manual-over-pipe shard_map subgroup when the class and token axes are
+    both sharded (GSPMD CHECK failure) — the 1F1B pipeline's last-stage
+    loss (``parallel/lm_pipeline.py``, ``train/vit_steps.py``) uses this
+    form."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+    return (lse - (logits * onehot).sum(-1)).mean(), logits

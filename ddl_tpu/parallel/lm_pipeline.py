@@ -750,17 +750,11 @@ def make_lm_pipeline_step_fns(
         # stage, contributing ce/M to the full-batch mean; the raw ce rides
         # out as a metric.
         def head_loss(head_p, y, tgt):
+            from ddl_tpu.ops.losses import onehot_cross_entropy_mean
+
             with nn.logical_axis_rules(rules):
                 logits = head_mod.apply({"params": head_p}, y)
-            # one-hot CE instead of _token_ce's take_along_axis: the gather
-            # does not partition inside the manual-over-pipe subgroup when
-            # seq and model are both sharded (GSPMD CHECK failure); the
-            # elementwise/reduce form partitions cleanly and is the same
-            # math
-            logits = logits.astype(jnp.float32)
-            lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            onehot = jax.nn.one_hot(tgt, logits.shape[-1], dtype=logits.dtype)
-            ce = (lse - (logits * onehot).sum(-1)).mean()
+            ce, _ = onehot_cross_entropy_mean(logits, tgt)
             return ce / M, ce
 
         pipeline_1f1b = make_blocks_pipeline_1f1b(

@@ -256,6 +256,8 @@ def make_lm_step_fns(
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
     if spec.pipe > 1:
         if accum_steps > 1:
             raise ValueError(

@@ -53,6 +53,7 @@ def make_vit_step_fns(
     devices=None,
     num_microbatches: int = 0,
     accum_steps: int = 1,
+    pipeline_schedule: str = "gpipe",
 ) -> ViTStepFns:
     if spec.seq > 1 or spec.expert > 1:
         raise ValueError(
@@ -61,6 +62,8 @@ def make_vit_step_fns(
         )
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
     if spec.pipe > 1:
         if accum_steps > 1:
             raise ValueError(
@@ -71,6 +74,12 @@ def make_vit_step_fns(
             cfg, spec, tx, rng, batch,
             num_microbatches=num_microbatches or spec.pipe,
             devices=devices,
+            schedule=pipeline_schedule,
+        )
+    if pipeline_schedule != "gpipe":
+        raise ValueError(
+            f"pipeline_schedule={pipeline_schedule!r} requires a pipe mesh "
+            "axis (spec.pipe > 1)"
         )
     if num_microbatches > 1:
         raise ValueError("num_microbatches needs spec.pipe > 1")
@@ -122,13 +131,16 @@ def make_vit_step_fns(
 
 
 def _finalize_vit(mesh, tx, forward, create_state, rng,
-                  accum_steps: int = 1) -> ViTStepFns:
+                  accum_steps: int = 1, manual_grad_fn=None) -> ViTStepFns:
     """Shared jit tail for the plain and pipelined ViT paths: wraps a
     ``forward(params, images, step=None) -> logits`` (``step`` drives the
     train-mode dropout rng; eval passes nothing) and a
     ``create_state(rng)``.  ``accum_steps > 1``: gradient accumulation
     over equal batch chunks inside one jitted step (identical update to
-    the full-batch step; see ``lm_steps.finalize_step_fns``)."""
+    the full-batch step; see ``lm_steps.finalize_step_fns``).
+    ``manual_grad_fn(params, images, labels, step) -> (grads, metrics)``
+    replaces autodiff in the train step (the 1F1B pipeline schedule);
+    ``forward`` still drives evaluation."""
 
     def loss_fn(params, images, labels, step=None):
         logits = forward(params, images, step)
@@ -139,7 +151,11 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def train_step(state, images, labels):
-        if accum_steps == 1:
+        if manual_grad_fn is not None:
+            grads, metrics = manual_grad_fn(
+                state.params, images, labels, state.step
+            )
+        elif accum_steps == 1:
             (_, (_, metrics)), grads = grad_fn(
                 state.params, images, labels, state.step
             )
@@ -207,6 +223,7 @@ def _make_vit_pipeline_step_fns(
     batch: int,
     num_microbatches: int,
     devices=None,
+    schedule: str = "gpipe",
 ) -> ViTStepFns:
     """Pipeline-parallel ViT: the encoder blocks run as a GPipe schedule
     over the ``pipe`` mesh axis (the shared clock loop,
@@ -223,6 +240,8 @@ def _make_vit_pipeline_step_fns(
     from ddl_tpu.parallel.sharding import PIPE_AXIS
 
     n_stages, M = spec.pipe, num_microbatches
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if M < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {M}")
     if cfg.dropout_rate > 0.0:
@@ -298,13 +317,16 @@ def _make_vit_pipeline_step_fns(
 
     mb_spec = NamedSharding(mesh, P(None, "data"))
 
-    def forward(params, images, step=None):
+    def embed_fn(embed_params, images):
         x = normalize_images(images, cfg.dtype)
+        x = conv_mod.apply({"params": embed_params["patch_embed"]}, x)
+        x = x.reshape(batch, T, d)
+        x = x + embed_params["pos_embed"].astype(cfg.dtype)
+        return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+    def forward(params, images, step=None):
         with nn.logical_axis_rules(rules):
-            x = conv_mod.apply({"params": params["embed"]["patch_embed"]}, x)
-            x = x.reshape(batch, T, d)
-            x = x + params["embed"]["pos_embed"].astype(cfg.dtype)
-            x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+            x = embed_fn(params["embed"], images)
             x = x.reshape(M, mb, T, d)
             x = jax.lax.with_sharding_constraint(x, mb_spec)
             acc, _aux = pipeline(params["blocks"], x)
@@ -315,4 +337,50 @@ def _make_vit_pipeline_step_fns(
                 {"params": params["head"]["head"]}, pooled.astype(jnp.float32)
             )
 
-    return _finalize_vit(mesh, tx, forward, create_state, rng)
+    manual_grad_fn = None
+    if schedule == "1f1b":
+        from ddl_tpu.parallel.lm_pipeline import make_blocks_pipeline_1f1b
+
+        def head_loss(head_p, y, tgt):
+            from ddl_tpu.ops.losses import onehot_cross_entropy_mean
+
+            with nn.logical_axis_rules(rules):
+                x = norm_mod.apply({"params": head_p["norm_f"]}, y)
+                pooled = x.mean(axis=1)
+                logits = head_mod.apply(
+                    {"params": head_p["head"]}, pooled.astype(jnp.float32)
+                )
+            ce, logits = onehot_cross_entropy_mean(logits, tgt)
+            acc = (jnp.argmax(logits, -1) == tgt).mean()
+            return ce / M, jnp.stack([ce, acc])
+
+        pipeline_1f1b = make_blocks_pipeline_1f1b(
+            mesh, block_mod, head_loss,
+            n_stages=n_stages, num_microbatches=M, mb=mb,
+            d_model=d, compute_dtype=cfg.dtype,
+            aux_cotangent=0.0,  # ViT blocks have no MoE aux
+            zero_metrics=jnp.zeros((2,), jnp.float32),
+        )
+
+        def manual_grad_fn(params, images, labels, step=None):
+            with nn.logical_axis_rules(rules):
+                x, embed_vjp = jax.vjp(
+                    lambda ep: embed_fn(ep, images), params["embed"]
+                )
+                x_mb = jax.lax.with_sharding_constraint(
+                    x.reshape(M, mb, T, d), mb_spec
+                )
+                lab_mb = jax.lax.with_sharding_constraint(
+                    labels.reshape(M, mb), NamedSharding(mesh, P(None, "data"))
+                )
+                g_blocks, g_head, dx_mb, met, _aux = pipeline_1f1b(
+                    params["blocks"], params["head"], x_mb, lab_mb
+                )
+                (g_embed,) = embed_vjp(
+                    dx_mb.reshape(batch, T, d).astype(x.dtype)
+                )
+            grads = {"embed": g_embed, "blocks": g_blocks, "head": g_head}
+            return grads, {"loss": met[0] / M, "accuracy": met[1] / M}
+
+    return _finalize_vit(mesh, tx, forward, create_state, rng,
+                         manual_grad_fn=manual_grad_fn)

@@ -31,6 +31,10 @@ def main() -> None:
                     help="GPipe stages over the encoder blocks")
     ap.add_argument("--microbatches", type=int, default=0,
                     help="microbatches when --pipe > 1 (default: --pipe)")
+    ap.add_argument("--pipeline-schedule", default="gpipe",
+                    choices=["gpipe", "1f1b"],
+                    help="pipeline schedule when --pipe > 1 (1f1b: "
+                    "interleaved, O(pipe) stage-activation residency)")
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation chunks per step (pipe=1 only)")
     ap.add_argument("--dropout", type=float, default=0.0,
@@ -80,7 +84,8 @@ def main() -> None:
     tx = build_optimizer(args.lr, weight_decay=0.05, grad_clip_norm=1.0)
     fns = make_vit_step_fns(cfg, spec, tx, jax.random.key(0), args.batch,
                             num_microbatches=args.microbatches,
-                            accum_steps=args.accum)
+                            accum_steps=args.accum,
+                            pipeline_schedule=args.pipeline_schedule)
     print(f"mesh=(data={args.data}, model={args.model}, pipe={args.pipe}) "
           f"fsdp={args.fsdp} patches={cfg.num_patches}")
 

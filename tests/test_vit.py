@@ -176,6 +176,31 @@ def test_pipeline_matches_single():
         assert err < 1e-4
 
 
+def test_pipeline_1f1b_matches_gpipe():
+    """The shared 1F1B clock loop drives the ViT pipeline too: same
+    gradients as the GPipe schedule on a DP x TP x PP mesh."""
+    cfg = _cfg()
+    tx = optax.adam(1e-3)
+    imgs, labels = _batch()
+    out = {}
+    for sched in ("gpipe", "1f1b"):
+        fns = make_vit_step_fns(
+            cfg, LMMeshSpec(data=2, model=2, pipe=2), tx, jax.random.key(0),
+            8, devices=jax.devices()[:8], num_microbatches=2,
+            pipeline_schedule=sched,
+        )
+        s1, m = fns.train(fns.init_state(), imgs, labels)
+        out[sched] = (
+            float(m["loss"]), float(m["accuracy"]), jax.device_get(s1.params)
+        )
+    assert abs(out["gpipe"][0] - out["1f1b"][0]) < 1e-5
+    assert abs(out["gpipe"][1] - out["1f1b"][1]) < 1e-6
+    err = jax.tree.reduce(max, jax.tree.map(
+        lambda a, b: float(np.max(np.abs(a - b))),
+        out["gpipe"][2], out["1f1b"][2]))
+    assert err < 1e-5, err
+
+
 def test_eval_matches_train_logits():
     cfg = _cfg()
     fns = make_vit_step_fns(cfg, LMMeshSpec(data=2), optax.adam(1e-3),

@@ -74,6 +74,7 @@ from ddl_tpu.models.transformer import (
     apply_final_norm_and_head,
     make_embed,
 )
+from ddl_tpu.ops.losses import onehot_cross_entropy_mean
 from ddl_tpu.parallel.sharding import (
     PIPE_AXIS,
     LMMeshSpec,
@@ -84,6 +85,7 @@ from ddl_tpu.train.lm_steps import (
     LMStepFns,
     LMTrainState,
     _token_ce,
+    dropout_step_key,
     finalize_step_fns,
 )
 
@@ -99,17 +101,57 @@ __all__ = [
 ]
 
 
-def _make_stage_fn(block_mod: nn.Module):
+def _mb_stage_key(step_key, mb_idx, s):
+    """Dropout key for one (microbatch, stage) — the single fold chain both
+    schedules share.  GPipe-vs-1F1B mask equality (and hence their gradient
+    parity with dropout on, ``tests/test_dropout.py``) requires this exact
+    derivation at every call site; never fork it per schedule."""
+    return jax.random.fold_in(jax.random.fold_in(step_key, mb_idx), s)
+
+
+def _make_stage_fn(block_mod: nn.Module, dropout: bool = False):
     """Stage forward: scan ``block_mod`` over the stage's stacked layer
     params.  Returns ``(y, aux)`` with ``aux`` the f32 sum of the stage's
-    per-layer aux losses (MoE load balancing)."""
+    per-layer aux losses (MoE load balancing).
 
-    def stage_fn(stage_blocks, x):
-        def layer(carry, p):
-            y, aux = block_mod.apply({"params": p}, carry)
+    With ``dropout=True`` the returned ``stage_fn(stage_blocks, x, key)``
+    takes a per-(microbatch, stage) base key and folds the layer index in
+    per scan step — the mask is a pure function of that key, so every
+    recomputation of the same microbatch's forward (GPipe's autodiff
+    replay, 1F1B's backward-tick vjp) reproduces it exactly."""
+    if not dropout:
+
+        def stage_fn(stage_blocks, x):
+            def layer(carry, p):
+                # full positional signature (x, kv_cache, offset,
+                # deterministic): nn.remat's static_argnums for
+                # `deterministic` indexes positional args
+                y, aux = block_mod.apply({"params": p}, carry, None, None, True)
+                return y, aux
+
+            y, auxs = lax.scan(layer, x, stage_blocks)
+            return y, auxs.astype(jnp.float32).sum()
+
+        return stage_fn
+
+    def stage_fn(stage_blocks, x, key):
+        lps = jax.tree.leaves(stage_blocks)[0].shape[0]
+
+        def layer(carry, xs):
+            p, i = xs
+            # deterministic rides positionally (arg 4) so nn.remat's
+            # static_argnums sees it as a Python bool, not a tracer
+            y, aux = block_mod.apply(
+                {"params": p},
+                carry,
+                None,
+                None,
+                False,
+                rngs={"dropout": jax.random.fold_in(key, i)},
+            )
             return y, aux
 
-        y, auxs = lax.scan(layer, x, stage_blocks)
+        y, auxs = lax.scan(layer, x, (stage_blocks, jnp.arange(lps)))
         return y, auxs.astype(jnp.float32).sum()
 
     return stage_fn
@@ -124,6 +166,7 @@ def make_blocks_pipeline(
     mb: int,
     d_model: int,
     compute_dtype,
+    dropout: bool = False,
 ):
     """The GPipe clock loop over a stack of uniform decoder/encoder blocks,
     as a partial-manual shard_map (manual over ``pipe`` only) — shared by
@@ -137,12 +180,18 @@ def make_blocks_pipeline(
     per-microbatch outputs (callers slice ``[-1]``) and ``aux_vec`` the
     ``(pipe,)`` per-stage aux-loss vector.  See the module docstring for
     the schedule design.
+
+    With ``dropout=True`` the callable takes a trailing per-step base key
+    (``pipeline(blocks_stacked, x_mb, step_key)``) and each (microbatch,
+    stage, layer) gets a deterministic mask folded from it (bubble-tick
+    draws land on clamped microbatch indices whose outputs are overwritten
+    or never read, so they are harmless).
     """
     M = num_microbatches
     d = d_model
-    stage_fn = _make_stage_fn(block_mod)
+    stage_fn = _make_stage_fn(block_mod, dropout)
 
-    def pipeline_body(blocks_stacked, x_mb):
+    def pipeline_body(blocks_stacked, x_mb, *step_key):
         stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
         s = lax.axis_index(PIPE_AXIS)
         t_len = x_mb.shape[2]
@@ -151,11 +200,16 @@ def make_blocks_pipeline(
 
         def tick(carry, t):
             buf, acc, aux = carry
+            mb_idx = jnp.clip(t - s, 0, M - 1)
             x_first = lax.dynamic_index_in_dim(
                 x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
             x_in = jnp.where(s == 0, x_first, buf)
-            out, aux_t = stage_fn(stage_blocks, x_in)
+            if dropout:
+                key = _mb_stage_key(step_key[0], mb_idx, s)
+                out, aux_t = stage_fn(stage_blocks, x_in, key)
+            else:
+                out, aux_t = stage_fn(stage_blocks, x_in)
             valid = (t >= s) & (t - s < M)
             aux = aux + jnp.where(valid, aux_t, 0.0)
             # Off-schedule writes land on clamped indices; the valid write
@@ -177,7 +231,7 @@ def make_blocks_pipeline(
     return jax.shard_map(
         pipeline_body,
         mesh=mesh,
-        in_specs=(P(PIPE_AXIS), P()),
+        in_specs=(P(PIPE_AXIS), P()) + ((P(),) if dropout else ()),
         out_specs=(P(PIPE_AXIS), P(PIPE_AXIS)),
         axis_names={PIPE_AXIS},
         check_vma=False,
@@ -196,6 +250,7 @@ def make_blocks_pipeline_1f1b(
     compute_dtype,
     aux_cotangent: float,
     zero_metrics,
+    dropout: bool = False,
 ):
     """One-forward-one-backward interleaved schedule over the uniform block
     stack — the forward AND backward pipeline in a single scan, with the loss
@@ -242,14 +297,14 @@ def make_blocks_pipeline_1f1b(
     P_, M = n_stages, num_microbatches
     last = P_ - 1
     d = d_model
-    stage_fn = _make_stage_fn(block_mod)
+    raw_stage_fn = _make_stage_fn(block_mod, dropout)
     # A microbatch's stage input is written at tick f+s and consumed by its
     # backward at tick f+2(P-1)-s: lifetime 2(P-1-s) ticks, so depth
     # 2(P-1)+1 (stage 0's worst case) always suffices; M slots suffice when
     # M is smaller because at most M microbatches are in flight.
     depth = min(2 * last + 1, M)
 
-    def pipeline_body(blocks_stacked, head_params, x_mb, tgt_mb):
+    def pipeline_body(blocks_stacked, head_params, x_mb, tgt_mb, *step_key):
         stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
         s = lax.axis_index(PIPE_AXIS)
         t_len = x_mb.shape[2]
@@ -261,6 +316,19 @@ def make_blocks_pipeline_1f1b(
             off = 2 * last - s
             b_idx = jnp.clip(t - off, 0, M - 1)
             bwd_valid = (t >= off) & (t - off < M)
+
+            if dropout:
+                # the same (microbatch, stage) key on the forward tick and
+                # on the backward tick's recompute — identical masks, exact
+                # gradients
+                fwd_stage_fn = lambda blocks, x: raw_stage_fn(
+                    blocks, x, _mb_stage_key(step_key[0], f_idx, s)
+                )
+                bwd_stage_fn = lambda blocks, x: raw_stage_fn(
+                    blocks, x, _mb_stage_key(step_key[0], b_idx, s)
+                )
+            else:
+                fwd_stage_fn = bwd_stage_fn = raw_stage_fn
 
             x_first = lax.dynamic_index_in_dim(x_mb, f_idx, 0, keepdims=False)
             x_in = jnp.where(s == 0, x_first, fwd_buf)
@@ -285,8 +353,8 @@ def make_blocks_pipeline_1f1b(
             # a cond: its collectives (TP/data/seq all-reduces from GSPMD)
             # are per-group ops whose groups lie within one pipe
             # coordinate, so every participant agrees on the branch.
-            out, _ = stage_fn(stage_blocks, x_in)
-            (y_b, aux_b), stage_vjp = jax.vjp(stage_fn, stage_blocks, x_b)
+            out, _ = fwd_stage_fn(stage_blocks, x_in)
+            (y_b, aux_b), stage_vjp = jax.vjp(bwd_stage_fn, stage_blocks, x_b)
 
             def last_branch(y):
                 # the loss supplies the output cotangent: vjp through
@@ -365,7 +433,7 @@ def make_blocks_pipeline_1f1b(
     return jax.shard_map(
         pipeline_body,
         mesh=mesh,
-        in_specs=(P(PIPE_AXIS), P(), P(), P()),
+        in_specs=(P(PIPE_AXIS), P(), P(), P()) + ((P(),) if dropout else ()),
         out_specs=(P(PIPE_AXIS), P(), P(), P(), P()),
         axis_names={PIPE_AXIS},
         check_vma=False,
@@ -595,12 +663,6 @@ def make_lm_pipeline_step_fns(
             "causal=False is only implemented for dense attention "
             "(the nested ring/Ulysses cores are built causal)"
         )
-    if cfg.dropout_rate > 0.0:
-        raise ValueError(
-            "dropout is not supported with pipeline parallelism (the blocks "
-            "run inside the manual-over-pipe scan with no dropout rng "
-            "plumbing); train with dropout on the non-pipelined path"
-        )
     if cfg.flash:
         raise ValueError(
             "flash=True is not supported with pipeline parallelism: the "
@@ -669,31 +731,42 @@ def make_lm_pipeline_step_fns(
         )
     else:
         attn_core = None
-    block_cls = nn.remat(Block) if cfg.remat else Block
+    block_cls = nn.remat(Block, static_argnums=(4,)) if cfg.remat else Block
     block_mod = block_cls(cfg, attn_core)
     embed_mod = _Embed(cfg)
     head_mod = _Head(cfg)
     compute_dtype = cfg.dtype
     d = cfg.d_model
 
-    pipeline = make_blocks_pipeline(
-        mesh,
-        block_mod,
+    use_dropout = cfg.dropout_rate > 0.0
+    pipe_kwargs = dict(
         n_stages=n_stages,
         num_microbatches=M,
         mb=mb,
         d_model=d,
         compute_dtype=compute_dtype,
     )
+    # deterministic instance (eval always; train when dropout is off)
+    pipeline = make_blocks_pipeline(mesh, block_mod, **pipe_kwargs)
+    pipeline_drop = (
+        make_blocks_pipeline(mesh, block_mod, dropout=True, **pipe_kwargs)
+        if use_dropout
+        else None
+    )
 
     mb_spec = NamedSharding(mesh, P(None, "data", "seq"))
 
-    def forward(params, tokens):
+    def forward(params, tokens, step=None):
         with nn.logical_axis_rules(rules):
             x = embed_mod.apply({"params": params["embed"]}, tokens)  # (B,T,D)
             x = x.reshape(M, mb, seq_len, d)
             x = lax.with_sharding_constraint(x, mb_spec)
-            acc, aux_vec = pipeline(params["blocks"], x)
+            if use_dropout and step is not None:
+                acc, aux_vec = pipeline_drop(
+                    params["blocks"], x, dropout_step_key(rng, step)
+                )
+            else:
+                acc, aux_vec = pipeline(params["blocks"], x)
             x_out = acc[-1].reshape(batch, seq_len, d)
             logits = head_mod.apply({"params": params["head"]}, x_out)
         # Each (stage, microbatch) aux term is a mean over that microbatch's
@@ -739,7 +812,7 @@ def make_lm_pipeline_step_fns(
         )
 
     def loss_fn(params, inputs, targets, step=None):
-        logits, aux = forward(params, inputs)
+        logits, aux = forward(params, inputs, step)
         ce = _token_ce(logits, targets)
         loss = ce + cfg.moe_aux_weight * aux
         return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})
@@ -750,8 +823,6 @@ def make_lm_pipeline_step_fns(
         # stage, contributing ce/M to the full-batch mean; the raw ce rides
         # out as a metric.
         def head_loss(head_p, y, tgt):
-            from ddl_tpu.ops.losses import onehot_cross_entropy_mean
-
             with nn.logical_axis_rules(rules):
                 logits = head_mod.apply({"params": head_p}, y)
             ce, _ = onehot_cross_entropy_mean(logits, tgt)
@@ -768,6 +839,7 @@ def make_lm_pipeline_step_fns(
             compute_dtype=compute_dtype,
             aux_cotangent=cfg.moe_aux_weight / M,
             zero_metrics=jnp.zeros((), jnp.float32),
+            dropout=use_dropout,
         )
 
         def manual_grad_fn(params, inputs, targets, step=None):
@@ -783,8 +855,11 @@ def make_lm_pipeline_step_fns(
                     targets.reshape(M, mb, seq_len),
                     NamedSharding(mesh, P(None, "data", "seq")),
                 )
+                key_args = (
+                    (dropout_step_key(rng, step),) if use_dropout else ()
+                )
                 g_blocks, g_head, dx_mb, ce_sum, aux_sum = pipeline_1f1b(
-                    params["blocks"], params["head"], x_mb, tgt_mb
+                    params["blocks"], params["head"], x_mb, tgt_mb, *key_args
                 )
                 # close the gradient path GPipe's shard_map transpose handles
                 (g_embed,) = embed_vjp(

@@ -83,16 +83,25 @@ def _token_ce(logits, targets):
     return (lse - picked).mean()
 
 
+def dropout_step_key(rng: jax.Array, step) -> jax.Array:
+    """Per-step dropout base key, decorrelated from init by the 0x0D0 fold.
+    The non-pipelined paths hand it to flax as the ``dropout`` rng stream;
+    the pipeline schedules fold in (microbatch, stage, layer) so a
+    microbatch's mask is identical wherever and whenever its forward is
+    (re)computed — forward-for-handoff, GPipe's autodiff replay, and 1F1B's
+    backward-tick recompute all agree."""
+    return jax.random.fold_in(jax.random.fold_in(rng, 0x0D0), step)
+
+
 def dropout_kwargs(rng: jax.Array, step, rate: float) -> dict:
     """``model.apply`` kwargs for optional train-mode dropout: active iff a
     ``step`` is given and ``rate > 0``; the rng is derived from the
-    builder's key (decorrelated from init by the 0x0D0 fold) and the step.
-    Single source shared by the LM and ViT paths."""
+    builder's key via ``dropout_step_key``.  Single source shared by the LM
+    and ViT paths."""
     train = step is not None and rate > 0.0
     if not train:
         return {"deterministic": True, "rngs": None}
-    key = jax.random.fold_in(jax.random.fold_in(rng, 0x0D0), step)
-    return {"deterministic": False, "rngs": {"dropout": key}}
+    return {"deterministic": False, "rngs": {"dropout": dropout_step_key(rng, step)}}
 
 
 def accumulate_grads(grad_fn, params, chunked_args, k: int):

@@ -233,23 +233,19 @@ def _make_vit_pipeline_step_fns(
     and TP over ``model`` — the DP x PP hybrid of the reference's
     north-star config (``ddp_n_pp.py``), on a transformer vision model."""
     from ddl_tpu.models.transformer import Block, RMSNorm
+    from ddl_tpu.ops.losses import onehot_cross_entropy_mean
     from ddl_tpu.parallel.lm_pipeline import (
         make_blocks_pipeline,
         stack_block_params,
     )
     from ddl_tpu.parallel.sharding import PIPE_AXIS
+    from ddl_tpu.train.lm_steps import dropout_step_key
 
     n_stages, M = spec.pipe, num_microbatches
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if M < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {M}")
-    if cfg.dropout_rate > 0.0:
-        raise ValueError(
-            "dropout is not supported with pipeline parallelism (no dropout "
-            "rng plumbing inside the manual-over-pipe scan); train with "
-            "dropout on the non-pipelined path"
-        )
     if cfg.n_layers % n_stages:
         raise ValueError(f"n_layers {cfg.n_layers} % pipe {n_stages} != 0")
     if batch % M:
@@ -260,14 +256,20 @@ def _make_vit_pipeline_step_fns(
     mesh = build_lm_mesh(spec, devices)
     rules = lm_logical_rules(cfg.fsdp)
     bc = cfg.block_config()
-    block_cls = nn.remat(Block) if cfg.remat else Block
+    block_cls = nn.remat(Block, static_argnums=(4,)) if cfg.remat else Block
     block_mod = block_cls(bc, None)
     T, d = cfg.num_patches, cfg.d_model
 
-    pipeline = make_blocks_pipeline(
-        mesh, block_mod,
+    use_dropout = cfg.dropout_rate > 0.0
+    pipe_kwargs = dict(
         n_stages=n_stages, num_microbatches=M, mb=mb,
         d_model=d, compute_dtype=cfg.dtype,
+    )
+    pipeline = make_blocks_pipeline(mesh, block_mod, **pipe_kwargs)
+    pipeline_drop = (
+        make_blocks_pipeline(mesh, block_mod, dropout=True, **pipe_kwargs)
+        if use_dropout
+        else None
     )
 
     # the same submodule constructors ViT composes, applied with the
@@ -329,7 +331,12 @@ def _make_vit_pipeline_step_fns(
             x = embed_fn(params["embed"], images)
             x = x.reshape(M, mb, T, d)
             x = jax.lax.with_sharding_constraint(x, mb_spec)
-            acc, _aux = pipeline(params["blocks"], x)
+            if use_dropout and step is not None:
+                acc, _aux = pipeline_drop(
+                    params["blocks"], x, dropout_step_key(rng, step)
+                )
+            else:
+                acc, _aux = pipeline(params["blocks"], x)
             x_out = acc[-1].reshape(batch, T, d)
             x_out = norm_mod.apply({"params": params["head"]["norm_f"]}, x_out)
             pooled = x_out.mean(axis=1)
@@ -342,8 +349,6 @@ def _make_vit_pipeline_step_fns(
         from ddl_tpu.parallel.lm_pipeline import make_blocks_pipeline_1f1b
 
         def head_loss(head_p, y, tgt):
-            from ddl_tpu.ops.losses import onehot_cross_entropy_mean
-
             with nn.logical_axis_rules(rules):
                 x = norm_mod.apply({"params": head_p["norm_f"]}, y)
                 pooled = x.mean(axis=1)
@@ -360,6 +365,7 @@ def _make_vit_pipeline_step_fns(
             d_model=d, compute_dtype=cfg.dtype,
             aux_cotangent=0.0,  # ViT blocks have no MoE aux
             zero_metrics=jnp.zeros((2,), jnp.float32),
+            dropout=use_dropout,
         )
 
         def manual_grad_fn(params, images, labels, step=None):
@@ -373,8 +379,11 @@ def _make_vit_pipeline_step_fns(
                 lab_mb = jax.lax.with_sharding_constraint(
                     labels.reshape(M, mb), NamedSharding(mesh, P(None, "data"))
                 )
+                key_args = (
+                    (dropout_step_key(rng, step),) if use_dropout else ()
+                )
                 g_blocks, g_head, dx_mb, met, _aux = pipeline_1f1b(
-                    params["blocks"], params["head"], x_mb, lab_mb
+                    params["blocks"], params["head"], x_mb, lab_mb, *key_args
                 )
                 (g_embed,) = embed_vjp(
                     dx_mb.reshape(batch, T, d).astype(x.dtype)

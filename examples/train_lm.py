@@ -40,7 +40,7 @@ def main() -> None:
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation chunks per step (pipe=1 only)")
     ap.add_argument("--dropout", type=float, default=0.0,
-                    help="residual dropout rate (pipe=1 only)")
+                    help="residual dropout rate")
     ap.add_argument("--experts", type=int, default=0, help="0 = dense MLP")
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--attn", default=None, choices=["dense", "ring", "ulysses"],

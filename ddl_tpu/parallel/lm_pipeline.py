@@ -49,11 +49,12 @@ the ring / Ulysses attention cores become inner ``shard_map``s that inherit
 the context mesh (no ``mesh=`` argument) and are manual over ``seq`` only —
 their ``ppermute`` / ``all_to_all`` collectives run over the ``seq`` axis
 while batch and heads stay auto-partitioned over ``data``/``model`` by
-GSPMD, inside the outer manual-over-``pipe`` region.  ``flash=True`` stays
-unsupported here: a Pallas call cannot be auto-partitioned over the
-remaining axes, so it requires the fully-manual region of the non-pipelined
-path.  ``n_layers`` must divide evenly into ``pipe`` stages and the batch
-into ``num_microbatches * data`` shards.
+GSPMD, inside the outer manual-over-``pipe`` region.  ``flash=True``
+composes the same way but needs the nested region *fully* manual over
+(data, seq, model): GSPMD cannot auto-partition a Pallas custom call, so
+the kernel instead runs on fully-local operands — the non-pipelined path's
+manual attention region, minus ``pipe``.  ``n_layers`` must divide evenly
+into ``pipe`` stages and the batch into ``num_microbatches * data`` shards.
 """
 
 from __future__ import annotations
@@ -658,17 +659,26 @@ def make_lm_pipeline_step_fns(
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
-    if not cfg.causal and cfg.attn_impl != "dense":
+    if not cfg.causal and (cfg.attn_impl != "dense" or cfg.flash):
         raise ValueError(
-            "causal=False is only implemented for dense attention "
-            "(the nested ring/Ulysses cores are built causal)"
+            "causal=False is only implemented for the XLA dense attention "
+            "path (the nested ring/Ulysses/flash cores are built causal)"
         )
-    if cfg.flash:
+    if cfg.flash and cfg.attn_impl == "ring":
         raise ValueError(
-            "flash=True is not supported with pipeline parallelism: the "
-            "Pallas kernel needs the fully-manual attention region of the "
-            "non-pipelined path (GSPMD cannot auto-partition a custom call "
-            "over the data/model axes inside the manual-over-pipe region)"
+            "flash=True is not supported with attn_impl='ring' "
+            "(the ring core is already blockwise online-softmax)"
+        )
+    if cfg.flash and cfg.attn_impl == "dense" and spec.seq > 1:
+        raise ValueError(
+            "flash=True with attn_impl='dense' requires mesh seq=1 "
+            "(the kernel attends within one device's sequence; use "
+            "attn_impl='ulysses' to combine flash with sequence parallelism)"
+        )
+    if cfg.flash and cfg.n_heads % spec.model:
+        raise ValueError(
+            f"n_heads {cfg.n_heads} % mesh model={spec.model} != 0 (the "
+            "flash kernel runs head-local inside a fully-manual region)"
         )
     if cfg.attn_impl == "ulysses" and cfg.n_heads % spec.seq:
         raise ValueError(
@@ -698,8 +708,46 @@ def make_lm_pipeline_step_fns(
     # argument (they inherit the context mesh, in which 'pipe' is already
     # manual), manual over 'seq' only, specs naming only 'seq' — batch and
     # heads remain auto-partitioned over data/model by GSPMD.
+    #
+    # With ``flash=True`` the nested region must instead be manual over
+    # every axis the kernel's operands touch (data, seq, model): GSPMD
+    # cannot auto-partition a Pallas custom call, but a fully-local call
+    # inside a fully-manual nested region needs no partitioning at all —
+    # the same construction as the non-pipelined path's manual attention,
+    # minus ``pipe`` (already manual in the enclosing region).
     seq_spec = P(None, "seq")
-    if cfg.attn_impl == "ring":
+    manual_spec = P("data", "seq", "model", None)
+    if cfg.flash:
+        from functools import partial
+
+        from ddl_tpu.ops.flash_attention import flash_attention
+
+        if cfg.attn_impl == "ulysses":
+            if (cfg.n_heads // spec.model) % spec.seq:
+                raise ValueError(
+                    f"local head count {cfg.n_heads // spec.model} "
+                    f"(n_heads/model) % mesh seq={spec.seq} != 0 for "
+                    "flash-under-Ulysses (heads are model-local in the "
+                    "fully-manual region)"
+                )
+            from ddl_tpu.parallel.ulysses import ulysses_attention
+
+            inner = partial(
+                ulysses_attention,
+                axis_name="seq",
+                causal=True,
+                attn_fn=flash_attention,
+            )
+        else:  # dense + flash, seq=1: the kernel is the whole core
+            inner = partial(flash_attention, causal=True)
+        attn_core = jax.shard_map(
+            inner,
+            in_specs=(manual_spec,) * 3,
+            out_specs=manual_spec,
+            axis_names={"data", "seq", "model"},
+            check_vma=False,
+        )
+    elif cfg.attn_impl == "ring":
         from ddl_tpu.parallel.ring_attention import ring_attention
 
         # The ring coordinate rides in as data (a P('seq')-sharded arange):

@@ -1,12 +1,21 @@
-"""Worker process for the multi-host integration test (test_multihost.py).
+"""Worker process for the multi-host integration tests (test_multihost.py).
 
-Runs the FULL CNN Trainer as one of two cooperating processes: the
-launcher env contract (``DDL_COORDINATOR``/``DDL_NUM_PROCESSES``/
-``DDL_PROCESS_ID`` — ``launch.bootstrap``), Gloo-backed
-``jax.distributed.initialize`` on CPU, per-process data sharding
-(``ShardedEpochSampler``), cross-process global-batch assembly
-(``shard_batch`` -> ``make_array_from_process_local_data``), and
-cross-process metric gathers (``_to_host`` -> ``process_allgather``).
+Runs one of two cooperating processes (launcher env contract
+``DDL_COORDINATOR``/``DDL_NUM_PROCESSES``/``DDL_PROCESS_ID`` —
+``launch.bootstrap``; Gloo-backed ``jax.distributed.initialize`` on CPU;
+4 simulated devices each -> one 8-device global mesh).  Two modes via
+``DDL_TEST_MODE``:
+
+* ``cnn`` (default) — the FULL CNN Trainer: per-process data sharding
+  (``ShardedEpochSampler``), cross-process global-batch assembly
+  (``shard_batch`` -> ``make_array_from_process_local_data``), and
+  cross-process metric gathers (``_to_host`` -> ``process_allgather``).
+* ``lm`` — the transformer family on a multi-host (data, pipe, model)
+  mesh with FSDP and the 1F1B pipeline schedule, in two placement phases
+  so both the data-axis collectives (FSDP all-gathers, DP gradient
+  reduction) and the pipe-axis 1F1B ppermutes cross the process boundary
+  (see ``main_lm``).
+
 Not collected by pytest (no ``test_`` prefix).
 """
 
@@ -25,11 +34,104 @@ from ddl_tpu.config import preset  # noqa: E402
 from ddl_tpu.train import Trainer  # noqa: E402
 
 
+def checksum_params(params) -> str:
+    """sha256 over the GLOBAL value of every leaf (gathered to every
+    process), so two processes agreeing means the sharded state agrees."""
+    import hashlib
+
+    import numpy as np
+
+    from ddl_tpu.train.trainer import _to_host
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(params):
+        h.update(np.ascontiguousarray(_to_host(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def main_lm(info) -> None:
+    """Two phases over a (data=2, pipe=2, model=2) mesh, differing only in
+    which mesh axis spans the two processes (with 8 process-major devices
+    exactly one 2-sized axis can cross the boundary):
+
+    * phase A — default device order: ``data`` is outermost, so the DP
+      gradient reduction and the FSDP all-gather/reduce-scatter cross the
+      process boundary; pipe/model stay intra-process.
+    * phase B — devices permuted so ``pipe`` carries the process bit: the
+      1F1B stage-handoff ``ppermute``s (and cotangent reverse hops) cross
+      the boundary — the DCN-placement analog of the reference's
+      inter-node pipeline edge.  TP all-reduces remain intra-process in
+      both phases, the realistic placement for a model axis.
+    """
+    import numpy as np
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    B, T = 8, 16
+    cfg = LMConfig(
+        vocab_size=32, d_model=32, n_layers=4, n_heads=4, head_dim=8,
+        d_ff=64, compute_dtype="float32", remat=True, fsdp=True,
+    )
+    spec = LMMeshSpec(data=2, model=2, pipe=2)
+    all_devs = jax.devices()
+    # build_lm_mesh reshapes the device list as (data, pipe, seq, expert,
+    # model), flat index d*4 + p*2 + m.  Handing it device id p*4 + d*2 + m
+    # at that position puts the process bit (id >= 4) on the pipe axis.
+    pipe_cross = [
+        all_devs[p * 4 + d * 2 + m]
+        for d in (0, 1) for p in (0, 1) for m in (0, 1)
+    ]
+    sums = []
+    for devices in (None, pipe_cross):
+        fns = make_lm_step_fns(
+            cfg, spec, optax.adam(1e-2), jax.random.key(0), B, T,
+            num_microbatches=2, pipeline_schedule="1f1b", devices=devices,
+        )
+        tok_sharding = jax.sharding.NamedSharding(
+            fns.mesh, jax.sharding.PartitionSpec("data", "seq")
+        )
+
+        def globalize(arr):
+            # both processes draw the same global batch (same seed); each
+            # contributes the shards it addresses
+            return jax.make_array_from_callback(
+                arr.shape, tok_sharding, lambda idx: arr[idx]
+            )
+
+        state = fns.init_state()
+        rng = np.random.default_rng(7)
+        for _ in range(3):
+            toks = rng.integers(0, 32, (B, T + 1))
+            state, m = fns.train(
+                state, globalize(toks[:, :-1]), globalize(toks[:, 1:])
+            )
+            assert np.isfinite(float(m["loss"])), m
+        ev = fns.evaluate(
+            state, globalize(toks[:, :-1]), globalize(toks[:, 1:])
+        )
+        assert np.isfinite(float(ev["loss"])), ev
+        sums.append(checksum_params(state.params))
+    # the two phases run the same math on the same data — placement must
+    # not change the result, and both processes must agree
+    assert sums[0] == sums[1], sums
+    print(
+        f"WORKER_OK process={info['process_index']} checksum={sums[0]}",
+        flush=True,
+    )
+
+
 def main() -> None:
     bootstrap()  # reads DDL_COORDINATOR / DDL_NUM_PROCESSES / DDL_PROCESS_ID
     info = world_info()
     assert info["process_count"] == 2, info
     assert info["global_device_count"] == 8, info
+
+    if os.environ.get("DDL_TEST_MODE") == "lm":
+        main_lm(info)
+        return
 
     cfg = preset(
         "dp",
@@ -54,19 +156,12 @@ def main() -> None:
     trainer = Trainer(cfg)
     trainer.train()
     # Every process computed from the same global batches, so the final
-    # state must agree bit-for-bit; hash the raw bytes of every leaf (via
-    # the multihost gather, so each process sees the full global arrays).
-    import hashlib
-
-    import numpy as np
-
-    from ddl_tpu.train.trainer import _to_host
-
-    h = hashlib.sha256()
-    for leaf in jax.tree.leaves(trainer.state.params):
-        h.update(np.ascontiguousarray(_to_host(leaf)).tobytes())
-    print(f"WORKER_OK process={info['process_index']} checksum={h.hexdigest()}",
-          flush=True)
+    # state must agree bit-for-bit on its global value.
+    print(
+        f"WORKER_OK process={info['process_index']} "
+        f"checksum={checksum_params(trainer.state.params)}",
+        flush=True,
+    )
 
 
 if __name__ == "__main__":

@@ -1,11 +1,15 @@
-"""True multi-process integration test of the distributed stack.
+"""True multi-process integration tests of the distributed stack.
 
 The reference cannot test its distributed paths without a live NCCL
 cluster (SURVEY.md §4 — "nothing mocks NCCL").  Here two ACTUAL processes
 form a world over Gloo on CPU (4 simulated devices each -> one 8-device
-global mesh) and run the full DP Trainer end-to-end: launcher env
-bootstrap, cross-process global-batch assembly, metric allgathers.  Both
-workers must finish and agree bit-for-bit on the final parameters.
+global mesh) and run end-to-end: the full DP CNN Trainer (launcher env
+bootstrap, cross-process global-batch assembly, metric allgathers) and
+the LM family on a multi-host (data, pipe, model) FSDP mesh under the
+1F1B schedule, in two device-placement phases so the data-axis
+collectives AND the pipe-axis stage-handoff ppermutes each cross the
+process boundary (multihost_worker.main_lm).  Both workers must finish
+and agree bit-for-bit on the global value of every parameter.
 """
 
 import os
@@ -13,6 +17,8 @@ import socket
 import subprocess
 import sys
 from pathlib import Path
+
+import pytest
 
 
 WORKER = Path(__file__).parent / "multihost_worker.py"
@@ -24,7 +30,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_dp_trainer(tmp_path):
+@pytest.mark.parametrize("mode", ["cnn", "lm"])
+def test_two_process_world(mode, tmp_path):
     port = _free_port()
     env_base = {
         k: v for k, v in os.environ.items()
@@ -38,6 +45,7 @@ def test_two_process_dp_trainer(tmp_path):
             DDL_NUM_PROCESSES="2",
             DDL_PROCESS_ID=str(pid),
             DDL_TEST_LOG_DIR=str(tmp_path / "logs"),
+            DDL_TEST_MODE=mode,
         )
         # output to files, not pipes: a worker filling an undrained pipe
         # would block mid-collective and stall the whole world

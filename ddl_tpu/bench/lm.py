@@ -32,6 +32,11 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--vocab", type=int, default=50304)
     ap.add_argument("--flash", action="store_true")
+    ap.add_argument("--remat-policy", default="full",
+                    choices=["full", "dots", "dots_no_batch"],
+                    help="what the per-block checkpoint may save instead of "
+                    "recomputing (LMConfig.remat_policy)")
+    ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
 
@@ -44,7 +49,8 @@ def main() -> None:
         d_ff=4 * args.d_model,
         compute_dtype="bfloat16",
         flash=args.flash,
-        remat=True,
+        remat=not args.no_remat,
+        remat_policy=args.remat_policy,
     )
     fns = make_lm_step_fns(
         cfg, LMMeshSpec(), optax.adamw(3e-4), jax.random.key(0),
@@ -69,6 +75,7 @@ def main() -> None:
         "seq_len": args.seq_len,
         "batch": args.batch,
         "flash": args.flash,
+        "remat": "off" if args.no_remat else args.remat_policy,
         "loss": round(float(m["loss"]), 3),
     }))
 

@@ -40,6 +40,7 @@ __all__ = [
     "make_embed",
     "make_lm_head",
     "apply_final_norm_and_head",
+    "remat_block",
 ]
 
 
@@ -72,6 +73,14 @@ class LMConfig:
     # each head group after the all-to-all.  'ring' is already blockwise.
     flash: bool = False
     remat: bool = True
+    # What the per-block jax.checkpoint may keep instead of recomputing
+    # (active only with remat=True): 'full' recomputes everything (minimum
+    # memory), 'dots' saves matmul outputs (jax.checkpoint_policies
+    # .checkpoint_dots — recompute only the cheap elementwise work),
+    # 'dots_no_batch' saves only contraction results with no batch dims
+    # (weights-stationary intermediates).  A speed/HBM dial: 'dots' trades
+    # activation memory back for backward-pass FLOPs.
+    remat_policy: str = "full"
     fsdp: bool = False
     # False = bidirectional attention (encoder use, e.g. the ViT family —
     # models/vit.py); LM training/decoding requires the causal default.
@@ -85,6 +94,30 @@ class LMConfig:
     @property
     def dtype(self):
         return jnp.dtype(self.compute_dtype)
+
+
+def remat_block(cfg) -> type:
+    """The Block class under this config's remat settings — the single
+    construction every builder (TransformerLM, ViT, the pipeline step
+    factories) must use so remat semantics cannot drift between paths.
+    ``static_argnums=(4,)`` keeps ``deterministic`` a Python bool through
+    the checkpoint wrapper."""
+    if not cfg.remat:
+        return Block
+    policies = {
+        "full": None,
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if cfg.remat_policy not in policies:
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r} "
+            f"(expected one of {sorted(policies)})"
+        )
+    policy = policies[cfg.remat_policy]
+    if policy is None:
+        return nn.remat(Block, static_argnums=(4,))
+    return nn.remat(Block, static_argnums=(4,), policy=policy)
 
 
 def _rope(x, theta: float, positions=None):
@@ -415,9 +448,7 @@ class TransformerLM(nn.Module):
         cfg = self.cfg
         x = make_embed(cfg)(tokens)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=(4,))
+        block = remat_block(cfg)
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layers):
             x, aux = block(cfg, self.attn_core, name=f"block{i}")(

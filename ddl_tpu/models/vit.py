@@ -18,7 +18,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ddl_tpu.models.transformer import Block, LMConfig, RMSNorm
+from ddl_tpu.models.transformer import LMConfig, RMSNorm, remat_block
 
 __all__ = ["ViTConfig", "ViT", "make_patch_embed", "make_vit_head"]
 
@@ -35,6 +35,7 @@ class ViTConfig:
     d_ff: int = 1536
     compute_dtype: str = "bfloat16"
     remat: bool = True
+    remat_policy: str = "full"  # see LMConfig.remat_policy
     fsdp: bool = False
     dropout_rate: float = 0.0  # residual dropout inside the blocks
 
@@ -59,6 +60,7 @@ class ViTConfig:
             d_ff=self.d_ff,
             compute_dtype=self.compute_dtype,
             remat=self.remat,
+            remat_policy=self.remat_policy,
             fsdp=self.fsdp,
             causal=False,
             dropout_rate=self.dropout_rate,
@@ -125,7 +127,7 @@ class ViT(nn.Module):
         )
         x = x + pos.astype(cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
-        block = nn.remat(Block, static_argnums=(4,)) if cfg.remat else Block
+        block = remat_block(bc)
         for i in range(cfg.n_layers):
             x, _aux = block(bc, self.attn_core, name=f"block{i}")(
                 x, None, None, deterministic

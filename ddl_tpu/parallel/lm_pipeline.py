@@ -69,11 +69,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddl_tpu.models.transformer import (
-    Block,
     LMConfig,
     TransformerLM,
     apply_final_norm_and_head,
     make_embed,
+    remat_block,
 )
 from ddl_tpu.ops.losses import onehot_cross_entropy_mean
 from ddl_tpu.parallel.sharding import (
@@ -779,7 +779,7 @@ def make_lm_pipeline_step_fns(
         )
     else:
         attn_core = None
-    block_cls = nn.remat(Block, static_argnums=(4,)) if cfg.remat else Block
+    block_cls = remat_block(cfg)
     block_mod = block_cls(cfg, attn_core)
     embed_mod = _Embed(cfg)
     head_mod = _Head(cfg)

@@ -232,7 +232,7 @@ def _make_vit_pipeline_step_fns(
     the manual region in plain GSPMD land.  Composes with DP over ``data``
     and TP over ``model`` — the DP x PP hybrid of the reference's
     north-star config (``ddp_n_pp.py``), on a transformer vision model."""
-    from ddl_tpu.models.transformer import Block, RMSNorm
+    from ddl_tpu.models.transformer import RMSNorm, remat_block
     from ddl_tpu.ops.losses import onehot_cross_entropy_mean
     from ddl_tpu.parallel.lm_pipeline import (
         make_blocks_pipeline,
@@ -256,7 +256,7 @@ def _make_vit_pipeline_step_fns(
     mesh = build_lm_mesh(spec, devices)
     rules = lm_logical_rules(cfg.fsdp)
     bc = cfg.block_config()
-    block_cls = nn.remat(Block, static_argnums=(4,)) if cfg.remat else Block
+    block_cls = remat_block(bc)
     block_mod = block_cls(bc, None)
     T, d = cfg.num_patches, cfg.d_model
 

@@ -108,6 +108,23 @@ class TestStrategyEquivalence:
 
 
 class TestLearning:
+    def test_remat_policy_invariance(self):
+        """remat and its save policy change scheduling, never math: every
+        setting must reproduce the no-remat run's losses and parameters."""
+        import pytest
+
+        from ddl_tpu.models.transformer import remat_block
+
+        ref, ref_losses = run_steps(tiny_cfg(remat=False), LMMeshSpec())
+        for policy in ("full", "dots", "dots_no_batch"):
+            state, losses = run_steps(
+                tiny_cfg(remat=True, remat_policy=policy), LMMeshSpec()
+            )
+            np.testing.assert_allclose(losses, ref_losses, atol=1e-6)
+            assert_state_close(state, ref, atol=1e-6)
+        with pytest.raises(ValueError, match="remat_policy"):
+            remat_block(tiny_cfg(remat=True, remat_policy="typo"))
+
     def test_lm_memorizes_periodic_sequences(self):
         """Next-token loss collapses on x[t+1] = x[t] + 1 (mod V) data."""
         cfg = tiny_cfg()

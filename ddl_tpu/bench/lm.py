@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ddl_tpu.models.transformer import LMConfig
+from ddl_tpu.models.transformer import LMConfig, REMAT_POLICIES
 from ddl_tpu.parallel.sharding import LMMeshSpec
 from ddl_tpu.train.lm_steps import make_lm_step_fns
 from ddl_tpu.utils.timing import fence
@@ -33,7 +33,7 @@ def main() -> None:
     ap.add_argument("--vocab", type=int, default=50304)
     ap.add_argument("--flash", action="store_true")
     ap.add_argument("--remat-policy", default="full",
-                    choices=["full", "dots", "dots_no_batch"],
+                    choices=list(REMAT_POLICIES),
                     help="what the per-block checkpoint may save instead of "
                     "recomputing (LMConfig.remat_policy)")
     ap.add_argument("--no-remat", action="store_true")

@@ -35,6 +35,7 @@ from ddl_tpu.ops.attention import dense_attention
 
 __all__ = [
     "LMConfig",
+    "REMAT_POLICIES",
     "TransformerLM",
     "count_lm_params",
     "make_embed",
@@ -96,12 +97,16 @@ class LMConfig:
         return jnp.dtype(self.compute_dtype)
 
 
+REMAT_POLICIES = ("full", "dots", "dots_no_batch")
+
+
 def remat_block(cfg) -> type:
     """The Block class under this config's remat settings — the single
     construction every builder (TransformerLM, ViT, the pipeline step
     factories) must use so remat semantics cannot drift between paths.
     ``static_argnums=(4,)`` keeps ``deterministic`` a Python bool through
-    the checkpoint wrapper."""
+    the checkpoint wrapper.  Valid policy names: ``REMAT_POLICIES`` (the
+    CLIs use it for their argparse choices)."""
     if not cfg.remat:
         return Block
     policies = {
@@ -109,6 +114,7 @@ def remat_block(cfg) -> type:
         "dots": jax.checkpoint_policies.checkpoint_dots,
         "dots_no_batch": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
     }
+    assert set(policies) == set(REMAT_POLICIES)
     if cfg.remat_policy not in policies:
         raise ValueError(
             f"unknown remat_policy {cfg.remat_policy!r} "

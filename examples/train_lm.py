@@ -48,7 +48,7 @@ def main() -> None:
     ap.add_argument("--flash", action="store_true",
                     help="use the Pallas flash-attention kernel (dense/ulysses)")
     ap.add_argument("--remat-policy", default="full",
-                    choices=["full", "dots", "dots_no_batch"],
+                    choices=["full", "dots", "dots_no_batch"],  # REMAT_POLICIES
                     help="per-block checkpoint policy (speed/HBM dial; "
                     "'dots' keeps matmul outputs, ~6%% faster backward)")
     ap.add_argument("--no-remat", action="store_true",

@@ -94,11 +94,13 @@ __all__ = [
     "make_lm_pipeline_step_fns",
     "make_blocks_pipeline",
     "make_blocks_pipeline_1f1b",
+    "make_blocks_pipeline_interleaved",
     "split_lm_params",
     "merge_lm_params",
     "convert_lm_state",
     "abstract_lm_state",
     "saved_pipe_stages",
+    "saved_virtual_stages",
 ]
 
 
@@ -227,6 +229,103 @@ def make_blocks_pipeline(
 
         init = (buf0, acc0, jnp.zeros((), jnp.float32))
         (_, acc, aux), _ = lax.scan(tick, init, jnp.arange(M + n_stages - 1))
+        return acc[None], aux[None]
+
+    return jax.shard_map(
+        pipeline_body,
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P()) + ((P(),) if dropout else ()),
+        out_specs=(P(PIPE_AXIS), P(PIPE_AXIS)),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )
+
+
+def make_blocks_pipeline_interleaved(
+    mesh: Mesh,
+    block_mod: nn.Module,
+    *,
+    n_stages: int,
+    virtual: int,
+    num_microbatches: int,
+    mb: int,
+    d_model: int,
+    compute_dtype,
+    dropout: bool = False,
+):
+    """Interleaved (virtual-stage) pipeline clock loop: device ``s`` holds
+    ``V = virtual`` non-contiguous layer chunks — global stage
+    ``sigma = c*P + s`` — so each microbatch laps the device ring V times
+    (Megatron-LM's interleaved schedule).  The pipeline fill/drain bubble
+    shrinks by V: the schedule closes in ``M*V + P - 1`` ticks of
+    1/V-stage work vs GPipe's ``M + P - 1`` ticks of full-stage work —
+    same total compute, bubble fraction (P-1)/(MV+P-1) vs (P-1)/(M+P-1) —
+    at the cost of V-1 extra wrap hops per microbatch.
+
+    Schedule: microbatches advance in groups of P (``M % P == 0``
+    required).  Within group ``g``, device ``s`` runs chunk ``c`` on
+    group-microbatch ``r`` at tick ``t = g*V*P + c*P + r + s`` — unit
+    ``(m, sigma)`` depends on ``(m, sigma-1)`` finishing one tick earlier
+    on device ``s-1`` (or on device P-1's previous chunk via the wrap hop
+    P-1 -> 0), and consecutive groups tile with no inter-group bubble.
+    The boundary ``ppermute`` is the full ring including the wrap; the
+    backward schedule is autodiff through the scan, as in
+    ``make_blocks_pipeline``.
+
+    Interface matches ``make_blocks_pipeline`` with ``blocks_stacked``
+    shaped ``(P, V, layers_per_chunk, ...)`` sharded ``P('pipe', ...)``;
+    the caller slices ``acc[-1]`` for the last global stage's outputs.
+    """
+    P_, V, M = n_stages, virtual, num_microbatches
+    d = d_model
+    stage_fn = _make_stage_fn(block_mod, dropout)
+
+    def pipeline_body(blocks_stacked, x_mb, *step_key):
+        local_chunks = jax.tree.map(lambda a: a[0], blocks_stacked)  # (V,lps,..)
+        s = lax.axis_index(PIPE_AXIS)
+        t_len = x_mb.shape[2]
+        VP = V * P_
+        buf0 = jnp.zeros((mb, t_len, d), compute_dtype)
+        acc0 = jnp.zeros((M, mb, t_len, d), compute_dtype)
+
+        def tick(carry, t):
+            buf, acc, aux = carry
+            rel = t - s
+            g = jnp.clip(rel // VP, 0, M // P_ - 1)
+            u = jnp.clip(rel - g * VP, 0, VP - 1)
+            c = u // P_
+            r = u - c * P_
+            m = jnp.clip(g * P_ + r, 0, M - 1)
+            valid = (rel >= 0) & (rel < M * V)
+            chunk = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                local_chunks,
+            )
+            x_first = lax.dynamic_index_in_dim(x_mb, m, 0, keepdims=False)
+            x_in = jnp.where((s == 0) & (c == 0), x_first, buf)
+            if dropout:
+                key = _mb_stage_key(step_key[0], m, c * P_ + s)
+                out, aux_t = stage_fn(chunk, x_in, key)
+            else:
+                out, aux_t = stage_fn(chunk, x_in)
+            aux = aux + jnp.where(valid, aux_t, 0.0)
+            # Last-global-stage output lands at acc[m].  As in the plain
+            # GPipe loop, no masking: within a group every chunk writes the
+            # same m range in increasing-u order, so chunk V-1's valid
+            # write is last; later groups only touch later m; only the
+            # last pipe coordinate's acc is ever read.
+            acc = lax.dynamic_update_index_in_dim(acc, out, m, 0)
+            # full ring: the wrap P-1 -> 0 carries the chunk c -> c+1
+            # boundary back to device 0
+            buf = lax.ppermute(
+                out, PIPE_AXIS, [(i, (i + 1) % P_) for i in range(P_)]
+            )
+            return (buf, acc, aux), None
+
+        init = (buf0, acc0, jnp.zeros((), jnp.float32))
+        (_, acc, aux), _ = lax.scan(
+            tick, init, jnp.arange(M * V + P_ - 1)
+        )
         return acc[None], aux[None]
 
     return jax.shard_map(
@@ -465,50 +564,84 @@ class _Head(nn.Module):
         return apply_final_norm_and_head(self.cfg, x)
 
 
-def stack_block_params(full_params: Any, n_stages: int):
-    """Stack a param tree's ``block{i}`` subtrees to
-    ``(n_stages, layers_per_stage, ...)``, stage-major in layer order
-    (stage p owns layers ``[p*Lps, (p+1)*Lps)``) — the unit every blocks
-    pipeline shards ``P('pipe', ...)``.  Shared by the LM and ViT splits."""
+def stack_block_params(full_params: Any, n_stages: int, virtual: int = 1):
+    """Stack a param tree's ``block{i}`` subtrees into the pipeline layout —
+    the unit every blocks pipeline shards ``P('pipe', ...)``.  Shared by the
+    LM and ViT splits.
+
+    ``virtual == 1``: ``(n_stages, layers_per_stage, ...)``, stage-major
+    (stage p owns layers ``[p*Lps, (p+1)*Lps)``).
+
+    ``virtual > 1`` (interleaved schedule): ``(n_stages, virtual,
+    layers_per_chunk, ...)`` with the Megatron virtual-stage assignment —
+    global stage ``sigma = c*n_stages + s`` lives at ``[s, c]``, so device
+    ``s`` owns the *non-contiguous* layer chunks ``{c*P+s : c}`` and a
+    microbatch visits every device V times."""
     layer_keys = sorted(
         (k for k in full_params if k.startswith("block")),
         key=lambda k: int(k.removeprefix("block")),
     )
-    lps = len(layer_keys) // n_stages
-    return jax.tree.map(
-        lambda *xs: jnp.stack(xs).reshape(n_stages, lps, *xs[0].shape),
-        *(full_params[k] for k in layer_keys),
-    )
+    lps = len(layer_keys) // (n_stages * virtual)
+
+    def gather(*xs):
+        a = jnp.stack(xs)
+        if virtual == 1:
+            return a.reshape(n_stages, lps, *xs[0].shape)
+        # layer ell = (c*P + s)*lps + j  ->  reshape (V, P, lps) indexes
+        # [c, s, j]; transpose to the device-major (P, V, lps) layout
+        a = a.reshape(virtual, n_stages, lps, *xs[0].shape)
+        return a.transpose(1, 0, *range(2, a.ndim))
+
+    return jax.tree.map(gather, *(full_params[k] for k in layer_keys))
 
 
-def split_lm_params(full_params: Any, n_stages: int) -> dict:
+def split_lm_params(full_params: Any, n_stages: int, virtual: int = 1) -> dict:
     """Restructure a full ``TransformerLM`` param tree into the pipeline
-    layout ``{embed, blocks, head}`` (see ``stack_block_params``)."""
+    layout ``{embed, blocks, head}`` (see ``stack_block_params``).  With
+    ``virtual > 1`` the stack nests under ``blocks["interleaved"]`` — a
+    structural marker, so a snapshot records its own virtual-stage count
+    (leading dims alone cannot distinguish (P, V, lps) from (P, lps);
+    parameter ranks vary)."""
+    blocks = stack_block_params(full_params, n_stages, virtual)
     return {
         "embed": {"embed": full_params["embed"]},
-        "blocks": stack_block_params(full_params, n_stages),
+        "blocks": {"interleaved": blocks} if virtual > 1 else blocks,
         "head": {"norm_f": full_params["norm_f"], "lm_head": full_params["lm_head"]},
     }
 
 
 def merge_lm_params(pp_params: dict) -> dict:
     """Inverse of ``split_lm_params``: pipeline layout ``{embed, blocks,
-    head}`` back to the flat ``TransformerLM`` tree (``block{i}`` keyed,
-    stage-major layer order)."""
+    head}`` back to the flat ``TransformerLM`` tree (``block{i}`` keyed).
+    The interleaved layout is self-describing (the ``"interleaved"``
+    wrapper plus the stack's (P, V, lps) leading dims)."""
     blocks = pp_params["blocks"]
-    shape_leaf = jax.tree.leaves(blocks)[0]
-    n_stages, lps = shape_leaf.shape[:2]
     full = {
         "embed": pp_params["embed"]["embed"],
         "norm_f": pp_params["head"]["norm_f"],
         "lm_head": pp_params["head"]["lm_head"],
     }
-    for p in range(n_stages):
-        for j in range(lps):
-            full[f"block{p * lps + j}"] = jax.tree.map(
-                lambda x: x[p, j], blocks
-            )
+    if not _is_interleaved_blocks(blocks):
+        shape_leaf = jax.tree.leaves(blocks)[0]
+        n_stages, lps = shape_leaf.shape[:2]
+        for p in range(n_stages):
+            for j in range(lps):
+                full[f"block{p * lps + j}"] = jax.tree.map(
+                    lambda x: x[p, j], blocks
+                )
+        return full
+    blocks = blocks["interleaved"]
+    n_stages, virtual, lps = jax.tree.leaves(blocks)[0].shape[:3]
+    for c in range(virtual):
+        for s in range(n_stages):
+            for j in range(lps):
+                ell = (c * n_stages + s) * lps + j
+                full[f"block{ell}"] = jax.tree.map(lambda x: x[s, c, j], blocks)
     return full
+
+
+def _is_interleaved_blocks(blocks) -> bool:
+    return isinstance(blocks, dict) and "interleaved" in blocks
 
 
 def _is_pipeline_tree(x) -> bool:
@@ -551,11 +684,26 @@ def saved_pipe_stages(params: Any) -> int:
     return 1
 
 
+def saved_virtual_stages(params: Any) -> int:
+    """Virtual-stage (interleaved) count a params tree was written with
+    (1 = plain stage-contiguous layout).  Like ``saved_pipe_stages``, works
+    on metadata trees — the interleaved layout is marked structurally by
+    the ``blocks["interleaved"]`` wrapper, so a resuming run discovers it
+    from the snapshot itself."""
+    if _is_pipeline_tree(params) and _is_interleaved_blocks(params["blocks"]):
+        return int(
+            jax.tree.leaves(params["blocks"]["interleaved"])[0].shape[1]
+        )
+    saved_pipe_stages(params)  # layout sanity check
+    return 1
+
+
 def abstract_lm_state(
     cfg: LMConfig,
     tx: optax.GradientTransformation,
     n_stages: int = 1,
     mesh: Mesh | None = None,
+    virtual: int = 1,
 ) -> LMTrainState:
     """Shape/dtype skeleton of an ``LMTrainState`` in the given layout
     (``n_stages=1`` = full, ``>1`` = pipeline), for use as a restore target
@@ -575,7 +723,7 @@ def abstract_lm_state(
     def build(rng):
         params = nn.meta.unbox(model.init(rng, dummy)["params"])
         if n_stages > 1:
-            params = split_lm_params(params, n_stages)
+            params = split_lm_params(params, n_stages, virtual)
         return LMTrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -596,6 +744,7 @@ def convert_lm_state(
     state: LMTrainState,
     *,
     n_stages: int | None = None,
+    virtual: int = 1,
     like: LMTrainState | None = None,
 ) -> LMTrainState:
     """Convert an ``LMTrainState`` between the full (non-pipelined) and
@@ -603,8 +752,9 @@ def convert_lm_state(
     optimizer state (Adam ``mu``/``nu`` mirror the param tree, so the same
     structural transform applies).
 
-    Pass ``n_stages`` to go full -> pipeline; omit it to go pipeline ->
-    full.  ``like`` (a state from the destination step functions'
+    Pass ``n_stages`` (and ``virtual`` for the interleaved schedule) to go
+    full -> pipeline; omit ``n_stages`` to go pipeline -> full (interleaved
+    layouts self-describe via the ``blocks["interleaved"]`` wrapper).  ``like`` (a state from the destination step functions'
     ``init_state``) re-places the converted arrays onto the destination
     mesh/shardings — required when the source and destination meshes
     differ.  Together with Orbax's mesh-elastic restore (``checkpoint.py``)
@@ -622,7 +772,7 @@ def convert_lm_state(
     else:
         if not _is_full_tree(state.params):
             raise ValueError("state is not in full layout")
-        convert = lambda p: split_lm_params(p, n_stages)
+        convert = lambda p: split_lm_params(p, n_stages, virtual)
     out = state.replace(
         params=convert(state.params),
         opt_state=_map_param_subtrees(state.opt_state, convert),
@@ -642,9 +792,17 @@ def make_lm_pipeline_step_fns(
     num_microbatches: int,
     devices=None,
     schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> LMStepFns:
     """Pipeline-parallel LM step functions (same interface as
     ``make_lm_step_fns``).  Requires ``spec.pipe > 1``.
+
+    ``virtual_stages > 1`` selects the interleaved schedule
+    (``make_blocks_pipeline_interleaved``): each device holds that many
+    non-contiguous layer chunks, shrinking the pipeline bubble by the same
+    factor.  Requires ``n_layers % (pipe * virtual_stages) == 0`` and
+    ``num_microbatches % pipe == 0``; gpipe schedule only (the 1F1B
+    interleave is not implemented for virtual stages).
 
     ``schedule``: ``"gpipe"`` (all forwards then all backwards, derived by
     autodiff of the forward scan) or ``"1f1b"`` (explicit interleaved
@@ -653,10 +811,23 @@ def make_lm_pipeline_step_fns(
     buffers stay O(batch) under both schedules — same gradients).
     Evaluation always uses the forward-only GPipe schedule."""
     n_stages, M = spec.pipe, num_microbatches
+    V = virtual_stages
     if n_stages < 2:
         raise ValueError("make_lm_pipeline_step_fns needs spec.pipe >= 2")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if V < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {V}")
+    if V > 1 and schedule != "gpipe":
+        raise ValueError(
+            "virtual_stages > 1 (interleaved schedule) is only implemented "
+            "for schedule='gpipe'"
+        )
+    if V > 1 and M % n_stages:
+        raise ValueError(
+            f"num_microbatches {M} % pipe {n_stages} != 0 (the interleaved "
+            "schedule advances microbatches in groups of pipe)"
+        )
     if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     if not cfg.causal and (cfg.attn_impl != "dense" or cfg.flash):
@@ -685,8 +856,10 @@ def make_lm_pipeline_step_fns(
             f"n_heads {cfg.n_heads} % mesh seq={spec.seq} != 0 (the nested "
             "Ulysses all-to-all splits the global head dim across seq)"
         )
-    if cfg.n_layers % n_stages:
-        raise ValueError(f"n_layers {cfg.n_layers} % pipe {n_stages} != 0")
+    if cfg.n_layers % (n_stages * V):
+        raise ValueError(
+            f"n_layers {cfg.n_layers} % (pipe {n_stages} * virtual {V}) != 0"
+        )
     if M < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {M}")
     if batch % M:
@@ -700,7 +873,6 @@ def make_lm_pipeline_step_fns(
         raise ValueError(
             f"num_experts {cfg.num_experts} % mesh expert={spec.expert} != 0"
         )
-    lps = cfg.n_layers // n_stages
     mesh = build_lm_mesh(spec, devices)
     rules = lm_logical_rules(cfg.fsdp)
 
@@ -794,15 +966,26 @@ def make_lm_pipeline_step_fns(
         d_model=d,
         compute_dtype=compute_dtype,
     )
+    if V > 1:
+        from functools import partial as _partial
+
+        make_pipe = _partial(
+            make_blocks_pipeline_interleaved, virtual=V
+        )
+    else:
+        make_pipe = make_blocks_pipeline
     # deterministic instance (eval always; train when dropout is off)
-    pipeline = make_blocks_pipeline(mesh, block_mod, **pipe_kwargs)
+    pipeline = make_pipe(mesh, block_mod, **pipe_kwargs)
     pipeline_drop = (
-        make_blocks_pipeline(mesh, block_mod, dropout=True, **pipe_kwargs)
+        make_pipe(mesh, block_mod, dropout=True, **pipe_kwargs)
         if use_dropout
         else None
     )
 
     mb_spec = NamedSharding(mesh, P(None, "data", "seq"))
+
+    def blocks_of(params):
+        return params["blocks"]["interleaved"] if V > 1 else params["blocks"]
 
     def forward(params, tokens, step=None):
         with nn.logical_axis_rules(rules):
@@ -811,10 +994,10 @@ def make_lm_pipeline_step_fns(
             x = lax.with_sharding_constraint(x, mb_spec)
             if use_dropout and step is not None:
                 acc, aux_vec = pipeline_drop(
-                    params["blocks"], x, dropout_step_key(rng, step)
+                    blocks_of(params), x, dropout_step_key(rng, step)
                 )
             else:
-                acc, aux_vec = pipeline(params["blocks"], x)
+                acc, aux_vec = pipeline(blocks_of(params), x)
             x_out = acc[-1].reshape(batch, seq_len, d)
             logits = head_mod.apply({"params": params["head"]}, x_out)
         # Each (stage, microbatch) aux term is a mean over that microbatch's
@@ -830,7 +1013,7 @@ def make_lm_pipeline_step_fns(
 
     def init_params(rng):
         full = nn.meta.unbox(full_model.init(rng, dummy)["params"])
-        return split_lm_params(full, n_stages)
+        return split_lm_params(full, n_stages, V)
 
     # Shardings: embed/head from the logical rule table; stacked blocks get
     # ('pipe', None) prepended to each leaf's rule-resolved spec.
@@ -838,12 +1021,14 @@ def make_lm_pipeline_step_fns(
     logical = nn.get_partition_spec(abs_params)
     mesh_sharding = nn.logical_to_mesh_sharding(logical, mesh, rules)
     block0 = mesh_sharding["block0"]
+    stack_dims = (None,) * (1 if V == 1 else 2)  # (lps,) or (V, lps)
     blocks_sharding = jax.tree.map(
-        lambda sh: NamedSharding(mesh, P(PIPE_AXIS, None, *sh.spec)), block0
+        lambda sh: NamedSharding(mesh, P(PIPE_AXIS, *stack_dims, *sh.spec)),
+        block0,
     )
     param_shardings = {
         "embed": {"embed": mesh_sharding["embed"]},
-        "blocks": blocks_sharding,
+        "blocks": {"interleaved": blocks_sharding} if V > 1 else blocks_sharding,
         "head": {
             "norm_f": mesh_sharding["norm_f"],
             "lm_head": mesh_sharding["lm_head"],

@@ -247,6 +247,7 @@ def make_lm_step_fns(
     num_microbatches: int = 0,
     accum_steps: int = 1,
     pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> LMStepFns:
     """Build the sharded train state and jitted step functions.
 
@@ -285,11 +286,17 @@ def make_lm_step_fns(
             num_microbatches=num_microbatches or spec.pipe,
             devices=devices,
             schedule=pipeline_schedule,
+            virtual_stages=virtual_stages,
         )
     if pipeline_schedule != "gpipe":
         raise ValueError(
             f"pipeline_schedule={pipeline_schedule!r} requires a pipe mesh "
             "axis (spec.pipe > 1)"
+        )
+    if virtual_stages != 1:
+        raise ValueError(
+            f"virtual_stages={virtual_stages} requires a pipe mesh axis "
+            "(spec.pipe > 1)"
         )
     if num_microbatches > 1:
         raise ValueError(

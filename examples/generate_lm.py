@@ -67,6 +67,7 @@ def main() -> None:
         abstract_lm_state,
         convert_lm_state,
         saved_pipe_stages,
+        saved_virtual_stages,
     )
     from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh
 
@@ -86,15 +87,18 @@ def main() -> None:
 
     saved_md = snapshot_metadata(args.checkpoint_dir, args.job_id, args.step)
     saved_pipe = saved_pipe_stages(saved_md["state"]["params"])
+    saved_virtual = saved_virtual_stages(saved_md["state"]["params"])
     # Adam's state structure is lr-independent, so any lr builds the right
     # restore skeleton; only params are used for decoding anyway.
     state, _ = load_snapshot(
         args.checkpoint_dir, args.job_id, args.step,
-        abstract_lm_state(cfg, optax.adam(1e-3), saved_pipe, mesh=mesh),
+        abstract_lm_state(cfg, optax.adam(1e-3), saved_pipe, mesh=mesh,
+                          virtual=saved_virtual),
     )
     if saved_pipe > 1:
         state = convert_lm_state(state)  # pipeline layout -> full
-    print(f"loaded step {int(state.step)} (saved pipe={saved_pipe})")
+    print(f"loaded step {int(state.step)} (saved pipe={saved_pipe} "
+          f"virtual={saved_virtual})")
 
     gen = make_lm_generator(
         cfg,

@@ -37,6 +37,11 @@ def main() -> None:
                     help="pipeline schedule when --pipe > 1: gpipe (all "
                     "forwards then all backwards) or 1f1b (interleaved, "
                     "O(pipe) stage-activation residency)")
+    ap.add_argument("--virtual-stages", type=int, default=1,
+                    help="interleaved pipeline: layer chunks per device "
+                    "(>1 shrinks the bubble by that factor; gpipe schedule, "
+                    "needs layers %% (pipe*V) == 0 and microbatches %% pipe "
+                    "== 0)")
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation chunks per step (pipe=1 only)")
     ap.add_argument("--dropout", type=float, default=0.0,
@@ -135,6 +140,7 @@ def main() -> None:
         cfg, spec, tx, jax.random.key(0), args.batch, args.seq_len,
         num_microbatches=args.microbatches, accum_steps=args.accum,
         pipeline_schedule=args.pipeline_schedule,
+        virtual_stages=args.virtual_stages,
     )
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
 
@@ -227,14 +233,19 @@ def main() -> None:
     start = 0
     if args.checkpoint_dir and args.resume_step is not None:
         from ddl_tpu.checkpoint import load_snapshot, snapshot_metadata
-        from ddl_tpu.parallel.lm_pipeline import saved_pipe_stages
+        from ddl_tpu.parallel.lm_pipeline import (
+            saved_pipe_stages,
+            saved_virtual_stages,
+        )
 
-        # The snapshot itself records its layout — no flag to get wrong.
+        # The snapshot itself records its layout (pipe stages AND
+        # interleaved virtual count) — no flag to get wrong.
         saved_md = snapshot_metadata(
             args.checkpoint_dir, args.job_id, args.resume_step
         )
         saved_pipe = saved_pipe_stages(saved_md["state"]["params"])
-        if saved_pipe == args.pipe:
+        saved_virtual = saved_virtual_stages(saved_md["state"]["params"])
+        if saved_pipe == args.pipe and saved_virtual == args.virtual_stages:
             state, _ = load_snapshot(
                 args.checkpoint_dir, args.job_id, args.resume_step, state
             )
@@ -253,17 +264,23 @@ def main() -> None:
 
             restored, _ = load_snapshot(
                 args.checkpoint_dir, args.job_id, args.resume_step,
-                abstract_lm_state(cfg, tx, saved_pipe, mesh=fns.mesh),
+                abstract_lm_state(
+                    cfg, tx, saved_pipe, mesh=fns.mesh, virtual=saved_virtual
+                ),
             )
             if args.pipe > 1:
                 if saved_pipe > 1:  # restage: merge, then re-split below
                     restored = convert_lm_state(restored)
-                state = convert_lm_state(restored, n_stages=args.pipe, like=state)
+                state = convert_lm_state(
+                    restored, n_stages=args.pipe,
+                    virtual=args.virtual_stages, like=state,
+                )
             else:  # saved_pipe > 1 here (layouts differ): merge + place
                 state = convert_lm_state(restored, like=state)
             print(
-                f"resumed across layouts (saved pipe={saved_pipe} -> "
-                f"run pipe={args.pipe})"
+                f"resumed across layouts (saved pipe={saved_pipe} "
+                f"virtual={saved_virtual} -> run pipe={args.pipe} "
+                f"virtual={args.virtual_stages})"
             )
         start = int(state.step)
         print(f"continuing from step {start}")

@@ -95,6 +95,7 @@ __all__ = [
     "make_blocks_pipeline",
     "make_blocks_pipeline_1f1b",
     "make_blocks_pipeline_interleaved",
+    "blocks_pipeline_api",
     "split_lm_params",
     "merge_lm_params",
     "convert_lm_state",
@@ -336,6 +337,22 @@ def make_blocks_pipeline_interleaved(
         axis_names={PIPE_AXIS},
         check_vma=False,
     )
+
+
+def blocks_pipeline_api(virtual: int):
+    """(make_pipe, wrap_blocks, blocks_of) for a virtual-stage count — the
+    single source both step builders (LM and ViT) use to pick the clock
+    loop and apply/strip the self-describing ``{"interleaved": ...}``
+    layout marker, so the three pieces cannot drift apart."""
+    if virtual > 1:
+        from functools import partial
+
+        return (
+            partial(make_blocks_pipeline_interleaved, virtual=virtual),
+            lambda blocks: {"interleaved": blocks},
+            lambda blocks: blocks["interleaved"],
+        )
+    return make_blocks_pipeline, (lambda b: b), (lambda b: b)
 
 
 def make_blocks_pipeline_1f1b(
@@ -966,14 +983,7 @@ def make_lm_pipeline_step_fns(
         d_model=d,
         compute_dtype=compute_dtype,
     )
-    if V > 1:
-        from functools import partial as _partial
-
-        make_pipe = _partial(
-            make_blocks_pipeline_interleaved, virtual=V
-        )
-    else:
-        make_pipe = make_blocks_pipeline
+    make_pipe, wrap_blocks, unwrap_blocks = blocks_pipeline_api(V)
     # deterministic instance (eval always; train when dropout is off)
     pipeline = make_pipe(mesh, block_mod, **pipe_kwargs)
     pipeline_drop = (
@@ -985,7 +995,7 @@ def make_lm_pipeline_step_fns(
     mb_spec = NamedSharding(mesh, P(None, "data", "seq"))
 
     def blocks_of(params):
-        return params["blocks"]["interleaved"] if V > 1 else params["blocks"]
+        return unwrap_blocks(params["blocks"])
 
     def forward(params, tokens, step=None):
         with nn.logical_axis_rules(rules):
@@ -1028,7 +1038,7 @@ def make_lm_pipeline_step_fns(
     )
     param_shardings = {
         "embed": {"embed": mesh_sharding["embed"]},
-        "blocks": {"interleaved": blocks_sharding} if V > 1 else blocks_sharding,
+        "blocks": wrap_blocks(blocks_sharding),
         "head": {
             "norm_f": mesh_sharding["norm_f"],
             "lm_head": mesh_sharding["lm_head"],

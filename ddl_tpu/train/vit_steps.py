@@ -54,6 +54,7 @@ def make_vit_step_fns(
     num_microbatches: int = 0,
     accum_steps: int = 1,
     pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> ViTStepFns:
     if spec.seq > 1 or spec.expert > 1:
         raise ValueError(
@@ -75,11 +76,17 @@ def make_vit_step_fns(
             num_microbatches=num_microbatches or spec.pipe,
             devices=devices,
             schedule=pipeline_schedule,
+            virtual_stages=virtual_stages,
         )
     if pipeline_schedule != "gpipe":
         raise ValueError(
             f"pipeline_schedule={pipeline_schedule!r} requires a pipe mesh "
             "axis (spec.pipe > 1)"
+        )
+    if virtual_stages != 1:
+        raise ValueError(
+            f"virtual_stages={virtual_stages} requires a pipe mesh axis "
+            "(spec.pipe > 1)"
         )
     if num_microbatches > 1:
         raise ValueError("num_microbatches needs spec.pipe > 1")
@@ -224,6 +231,7 @@ def _make_vit_pipeline_step_fns(
     num_microbatches: int,
     devices=None,
     schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> ViTStepFns:
     """Pipeline-parallel ViT: the encoder blocks run as a GPipe schedule
     over the ``pipe`` mesh axis (the shared clock loop,
@@ -242,12 +250,27 @@ def _make_vit_pipeline_step_fns(
     from ddl_tpu.train.lm_steps import dropout_step_key
 
     n_stages, M = spec.pipe, num_microbatches
+    V = virtual_stages
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if V < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {V}")
+    if V > 1 and schedule != "gpipe":
+        raise ValueError(
+            "virtual_stages > 1 (interleaved schedule) is only implemented "
+            "for schedule='gpipe'"
+        )
+    if V > 1 and M % n_stages:
+        raise ValueError(
+            f"num_microbatches {M} % pipe {n_stages} != 0 (the interleaved "
+            "schedule advances microbatches in groups of pipe)"
+        )
     if M < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {M}")
-    if cfg.n_layers % n_stages:
-        raise ValueError(f"n_layers {cfg.n_layers} % pipe {n_stages} != 0")
+    if cfg.n_layers % (n_stages * V):
+        raise ValueError(
+            f"n_layers {cfg.n_layers} % (pipe {n_stages} * virtual {V}) != 0"
+        )
     if batch % M:
         raise ValueError(f"batch {batch} % microbatches {M} != 0")
     mb = batch // M
@@ -265,9 +288,12 @@ def _make_vit_pipeline_step_fns(
         n_stages=n_stages, num_microbatches=M, mb=mb,
         d_model=d, compute_dtype=cfg.dtype,
     )
-    pipeline = make_blocks_pipeline(mesh, block_mod, **pipe_kwargs)
+    from ddl_tpu.parallel.lm_pipeline import blocks_pipeline_api
+
+    make_pipe, wrap_blocks, unwrap_blocks = blocks_pipeline_api(V)
+    pipeline = make_pipe(mesh, block_mod, **pipe_kwargs)
     pipeline_drop = (
-        make_blocks_pipeline(mesh, block_mod, dropout=True, **pipe_kwargs)
+        make_pipe(mesh, block_mod, dropout=True, **pipe_kwargs)
         if use_dropout
         else None
     )
@@ -281,10 +307,11 @@ def _make_vit_pipeline_step_fns(
     head_mod = make_vit_head(cfg)
 
     def split_vit_params(full):
+        blocks = stack_block_params(full, n_stages, V)
         return {
             "embed": {"patch_embed": full["patch_embed"],
                       "pos_embed": full["pos_embed"]},
-            "blocks": stack_block_params(full, n_stages),
+            "blocks": wrap_blocks(blocks),
             "head": {"norm_f": full["norm_f"], "head": full["head"]},
         }
 
@@ -295,13 +322,15 @@ def _make_vit_pipeline_step_fns(
     logical = nn.get_partition_spec(abs_params)
     mesh_sharding = nn.logical_to_mesh_sharding(logical, mesh, rules)
     block0 = mesh_sharding["block0"]
+    stack_dims = (None,) * (1 if V == 1 else 2)
     blocks_sharding = jax.tree.map(
-        lambda sh: NamedSharding(mesh, P(PIPE_AXIS, None, *sh.spec)), block0
+        lambda sh: NamedSharding(mesh, P(PIPE_AXIS, *stack_dims, *sh.spec)),
+        block0,
     )
     param_shardings = {
         "embed": {"patch_embed": mesh_sharding["patch_embed"],
                   "pos_embed": mesh_sharding["pos_embed"]},
-        "blocks": blocks_sharding,
+        "blocks": wrap_blocks(blocks_sharding),
         "head": {"norm_f": mesh_sharding["norm_f"],
                  "head": mesh_sharding["head"]},
     }
@@ -326,6 +355,9 @@ def _make_vit_pipeline_step_fns(
         x = x + embed_params["pos_embed"].astype(cfg.dtype)
         return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 
+    def blocks_of(params):
+        return unwrap_blocks(params["blocks"])
+
     def forward(params, images, step=None):
         with nn.logical_axis_rules(rules):
             x = embed_fn(params["embed"], images)
@@ -333,10 +365,10 @@ def _make_vit_pipeline_step_fns(
             x = jax.lax.with_sharding_constraint(x, mb_spec)
             if use_dropout and step is not None:
                 acc, _aux = pipeline_drop(
-                    params["blocks"], x, dropout_step_key(rng, step)
+                    blocks_of(params), x, dropout_step_key(rng, step)
                 )
             else:
-                acc, _aux = pipeline(params["blocks"], x)
+                acc, _aux = pipeline(blocks_of(params), x)
             x_out = acc[-1].reshape(batch, T, d)
             x_out = norm_mod.apply({"params": params["head"]["norm_f"]}, x_out)
             pooled = x_out.mean(axis=1)

@@ -35,6 +35,9 @@ def main() -> None:
                     choices=["gpipe", "1f1b"],
                     help="pipeline schedule when --pipe > 1 (1f1b: "
                     "interleaved, O(pipe) stage-activation residency)")
+    ap.add_argument("--virtual-stages", type=int, default=1,
+                    help="interleaved pipeline: layer chunks per device "
+                    "(>1 shrinks the bubble by that factor)")
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation chunks per step (pipe=1 only)")
     ap.add_argument("--dropout", type=float, default=0.0,
@@ -85,7 +88,8 @@ def main() -> None:
     fns = make_vit_step_fns(cfg, spec, tx, jax.random.key(0), args.batch,
                             num_microbatches=args.microbatches,
                             accum_steps=args.accum,
-                            pipeline_schedule=args.pipeline_schedule)
+                            pipeline_schedule=args.pipeline_schedule,
+                            virtual_stages=args.virtual_stages)
     print(f"mesh=(data={args.data}, model={args.model}, pipe={args.pipe}) "
           f"fsdp={args.fsdp} patches={cfg.num_patches}")
 

@@ -210,3 +210,32 @@ def test_eval_matches_train_logits():
     logits = fns.evaluate(state, imgs)
     assert logits.shape == (8, 5)
     assert bool(jnp.isfinite(jnp.asarray(logits)).all())
+
+
+def test_pipeline_interleaved_matches_single():
+    """Interleaved virtual stages for the ViT pipeline (shared clock loop,
+    self-describing blocks['interleaved'] layout): DP x PP, V=2 over 4
+    encoder layers, exact single-device parity."""
+    cfg = _cfg(n_layers=4)
+    tx = optax.adam(1e-3)
+    imgs, labels = _batch()
+    single = make_vit_step_fns(cfg, LMMeshSpec(), tx, jax.random.key(0), 8,
+                               devices=jax.devices()[:1])
+    s1, m_ref = single.train(single.init_state(), imgs, labels)
+
+    pp = make_vit_step_fns(cfg, LMMeshSpec(data=2, pipe=2), tx,
+                           jax.random.key(0), 8, devices=jax.devices()[:4],
+                           num_microbatches=2, virtual_stages=2)
+    t1, m = pp.train(pp.init_state(), imgs, labels)
+    assert abs(float(m["loss"]) - float(m_ref["loss"])) < 1e-5
+    pp_params = jax.device_get(t1.params)
+    blocks = pp_params["blocks"]["interleaved"]
+    ref = jax.device_get(s1.params)
+    # layer ell = (c*2 + s)*1 + 0 lives at [s, c]
+    for ell in range(4):
+        s_, c_ = ell % 2, ell // 2
+        stacked = jax.tree.map(lambda x: x[s_, c_, 0], blocks)
+        err = jax.tree.reduce(max, jax.tree.map(
+            lambda a, b: float(np.max(np.abs(a - b))),
+            ref[f"block{ell}"], stacked))
+        assert err < 1e-4, (ell, err)

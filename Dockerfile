@@ -1,0 +1,28 @@
+# Builds the `ddl-tpu:latest` image the launcher manifests reference
+# (ddl_tpu/launcher/tpu_pod.py JobSpec.image) — the analog of the
+# reference's pytorch/pytorch base image (reference Dockerfile:1-8), but
+# TPU-native: jax[tpu] brings libtpu; one container runs on every host of
+# the pod slice (one process per host, jax.distributed.initialize).
+FROM python:3.12-slim
+
+# build toolchain for the native C++ loader core (ddl_tpu/native)
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /workspace
+COPY requirements.txt .
+# jax[tpu] pulls libtpu from the Google releases index
+RUN pip install --no-cache-dir -r requirements.txt \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+COPY pyproject.toml README.md ./
+COPY ddl_tpu ddl_tpu
+COPY examples examples
+COPY tests tests
+COPY bench.py .
+RUN pip install --no-cache-dir --no-deps -e .
+# importing ddl_tpu.native auto-builds libddl_loader.so via its Makefile;
+# the Python fallback path keeps the image usable if only this build fails
+RUN python -c "import ddl_tpu.native" || true
+
+ENTRYPOINT ["python", "-m", "ddl_tpu.cli"]

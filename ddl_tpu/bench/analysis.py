@@ -68,6 +68,28 @@ def final_epoch_quality(log_dir: Path, final_epoch: int | None = None) -> dict:
     }
 
 
+THROUGHPUT_METRICS = ["steps_per_sec", "tokens_per_sec", "img_per_sec"]
+
+
+def throughput_per_job(log_dir: Path) -> dict[str, dict[str, float]]:
+    """Mean throughput per job across whichever rate metrics it logged —
+    covers all three families (CNN steps_per_sec, LM tokens_per_sec, ViT
+    img_per_sec).  No analog in the reference notebook, which derives
+    steps/sec offline from epoch_time."""
+    out: dict[str, dict[str, float]] = {}
+    for job_dir in sorted((log_dir / "by_job_id").glob("*")):
+        rates = {}
+        for metric in THROUGHPUT_METRICS:
+            f = job_dir / f"{metric}.csv"
+            if f.exists():
+                rows = read_metric_csv(f)
+                if rows:
+                    rates[metric] = float(np.mean([r["value"] for r in rows]))
+        if rates:
+            out[job_dir.name] = rates
+    return out
+
+
 def comm_time_summary(log_dir: Path) -> dict[str, dict]:
     """Per-job mean round-trip excluding iteration 0 (notebook cell 9)."""
     f = log_dir / "communication_time.csv"
@@ -101,6 +123,9 @@ def main(argv=None):
     print("== final-epoch quality per strategy ==")
     for s, metrics in final_epoch_quality(log_dir).items():
         print(f"  {s}: " + " ".join(f"{m}={v:.4f}" for m, v in metrics.items()))
+    print("== mean throughput per job ==")
+    for job, rates in throughput_per_job(log_dir).items():
+        print(f"  {job}: " + " ".join(f"{m}={v:.1f}" for m, v in rates.items()))
     print("== communication round-trip per job ==")
     for job, r in comm_time_summary(log_dir).items():
         print(f"  {job}: mean={r['mean_ms']:.3f}ms init={r['init_ms']:.1f}ms n={r['iterations']}")

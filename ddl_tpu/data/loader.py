@@ -40,6 +40,7 @@ class DataLoader:
         num_workers: int = 2,
         prefetch_depth: int = 2,
         seed: int = 0,
+        pad_last_batch: bool = False,
     ) -> None:
         self.dataset = dataset
         self.batch_size = batch_size
@@ -49,6 +50,11 @@ class DataLoader:
         self.num_workers = max(0, num_workers)
         self.prefetch_depth = max(1, prefetch_depth)
         self.drop_last = drop_last
+        # pad the final partial batch with -1 sentinels up to batch_size, so
+        # every batch has the same static shape (one compiled SPMD eval fn)
+        # and the consumer masks rows with label -1 (deterministic
+        # full-coverage eval, reference single.py:199-258)
+        self.pad_last_batch = pad_last_batch
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
@@ -58,6 +64,22 @@ class DataLoader:
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def _collate(self, idxs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        idxs = np.asarray(idxs)
+        n_pad = int((idxs < 0).sum())
+        if n_pad:
+            # sentinel (-1) indices: zero image, label -1 (mask-out rows)
+            valid = idxs[idxs >= 0]
+            if len(valid):
+                images, labels = self._collate(valid)
+            else:
+                img0 = np.asarray(self.dataset[0][0])
+                images = np.zeros((0, *img0.shape), img0.dtype)
+                labels = np.zeros((0,), np.int32)
+            images = np.concatenate(
+                [images, np.zeros((n_pad, *images.shape[1:]), images.dtype)]
+            )
+            labels = np.concatenate([labels, np.full((n_pad,), -1, np.int32)])
+            return images, labels
         images = self._collate_native(idxs)
         if images is None:
             if self.num_workers > 0:
@@ -98,7 +120,12 @@ class DataLoader:
         for b in range(n_full):
             yield idxs[b * self.batch_size : (b + 1) * self.batch_size]
         if not self.drop_last and n_full * self.batch_size < len(idxs):
-            yield idxs[n_full * self.batch_size :]
+            tail = idxs[n_full * self.batch_size :]
+            if self.pad_last_batch:
+                tail = np.concatenate(
+                    [tail, np.full(self.batch_size - len(tail), -1, tail.dtype)]
+                )
+            yield tail
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Yield collated (uint8 images, int32 labels), prefetching ahead."""

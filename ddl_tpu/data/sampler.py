@@ -12,6 +12,13 @@ Semantics match torch's: with ``drop_last`` the tail that does not divide by
 ``num_shards`` is dropped; without it, indices wrap around to pad every shard
 to equal length (so all shards stay in lock-step — a collective-deadlock
 guard torch needs for NCCL and we need just as much for SPMD).
+
+For *evaluation*, wrap-around padding double-counts the wrapped samples, so
+``pad_mode="sentinel"`` pads with ``-1`` instead: every real index appears
+exactly once across all shards, and the loader materialises sentinel rows as
+zero images with label ``-1`` for the consumer to mask out — the
+SPMD-friendly analog of the reference evaluating every test sample
+(``single.py:199-258``) under static batch shapes.
 """
 
 from __future__ import annotations
@@ -30,9 +37,13 @@ class ShardedEpochSampler:
         shuffle: bool = True,
         drop_last: bool = True,
         seed: int = 0,
+        pad_mode: str = "wrap",
     ) -> None:
         if not (0 <= shard_rank < num_shards):
             raise ValueError(f"shard_rank {shard_rank} out of range for {num_shards}")
+        if pad_mode not in ("wrap", "sentinel"):
+            raise ValueError(f"pad_mode must be 'wrap' or 'sentinel', got {pad_mode!r}")
+        self.pad_mode = pad_mode
         self.num_examples = num_examples
         self.num_shards = num_shards
         self.shard_rank = shard_rank
@@ -63,10 +74,14 @@ class ShardedEpochSampler:
         if self.drop_last:
             order = order[:total]
         else:
-            # wrap-around padding so every shard has equal length
+            # pad so every shard has equal length: wrap-around (torch
+            # semantics) or -1 sentinels (exactly-once eval coverage)
             pad = total - len(order)
             if pad > 0:
-                order = np.concatenate([order, order[:pad]])
+                fill = order[:pad] if self.pad_mode == "wrap" else np.full(
+                    pad, -1, order.dtype
+                )
+                order = np.concatenate([order, fill])
         return order[self.shard_rank :: self.num_shards]
 
     def __iter__(self):

@@ -30,7 +30,26 @@ def force_cpu_devices(n: int) -> None:
     the examples' ``--cpu-devices`` flags and mirrored by tests/conftest.py).
     Safe any time before the JAX backend initialises, even after ``import
     jax``; ``config.update`` is preferred over the ``JAX_PLATFORMS`` env var,
-    which can hang under externally-registered platform plugins."""
+    which can hang under externally-registered platform plugins.  A no-op
+    when the backend is already up on ``n``+ CPU devices (so callers can
+    self-bootstrap without fighting tests/conftest.py)."""
+    initialized = False
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:
+        pass
+    if initialized:
+        devs = jax.devices()
+        if devs and devs[0].platform == "cpu" and len(devs) >= n:
+            return  # already simulating enough CPU devices
+        raise RuntimeError(
+            f"force_cpu_devices({n}) called after the JAX backend "
+            f"initialized on {len(devs)} {devs[0].platform if devs else '?'} "
+            "device(s); platform flags are no-ops post-init — call this "
+            "before any jax.devices()/computation"
+        )
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + f" --xla_force_host_platform_device_count={n}"

@@ -404,21 +404,42 @@ class Block(nn.Module):
         return (x, aux) if kv_cache is None else (x, aux, new_cache)
 
 
-def make_embed(cfg: LMConfig) -> nn.Embed:
+class TokenEmbed(nn.Module):
+    """Token embedding with an explicit ZeRO-style lookup.
+
+    Same param tree as ``nn.Embed`` (``embed/embedding``), but the (possibly
+    FSDP/TP-sharded) table is constrained to *replicated* right before the
+    gather: XLA then inserts one small all-gather of the (V, D) table and the
+    gather itself stays fully local, with its output sharded by the token
+    sharding.  Without this, GSPMD cannot repartition a gather whose operand
+    is sharded on the offset dim and falls back to involuntary full
+    rematerialization of the (B, T, D) output every step
+    (``spmd_partitioner.cc:652`` warnings on fsdp pipeline meshes — a silent
+    multi-chip perf tax on the LM input edge)."""
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        table = self.param(
+            "embedding",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")
+            ),
+            (cfg.vocab_size, cfg.d_model),
+            jnp.float32,
+        )
+        table = nn.with_logical_constraint(table, (None, None))
+        return jnp.take(table, tokens, axis=0).astype(cfg.dtype)
+
+
+def make_embed(cfg: LMConfig) -> TokenEmbed:
     """The token embedding ('embed' in the param tree) — single source of
     truth shared by ``TransformerLM`` and the pipeline's stage-0 prologue
     (``parallel/lm_pipeline.py``), so full-model and pipelined param trees
     restructure 1:1."""
-    return nn.Embed(
-        cfg.vocab_size,
-        cfg.d_model,
-        dtype=cfg.dtype,
-        param_dtype=jnp.float32,
-        embedding_init=nn.with_logical_partitioning(
-            nn.initializers.normal(0.02), ("vocab", "embed")
-        ),
-        name="embed",
-    )
+    return TokenEmbed(cfg, name="embed")
 
 
 def make_lm_head(cfg: LMConfig) -> nn.Dense:

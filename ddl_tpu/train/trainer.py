@@ -28,7 +28,7 @@ from ddl_tpu.models import build_stages, stage_boundary_shapes
 from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
 from ddl_tpu.train.state import create_train_state, make_optimizer
 from ddl_tpu.train.steps import make_dp_step_fns
-from ddl_tpu.utils import MetricLogger, classification_metrics, cross_entropy
+from ddl_tpu.utils import MetricLogger, masked_classification_eval
 from ddl_tpu.utils.memory import hbm_stats
 
 __all__ = ["Trainer", "resolve_job_id"]
@@ -128,25 +128,26 @@ class Trainer:
             num_workers=cfg.data.num_workers,
             drop_last=cfg.data.drop_last,
         )
+        # Eval is deterministic and full-coverage: ordered (no shuffle), no
+        # dropped tail — sentinel padding keeps batch shapes static (one
+        # compiled eval fn) and every test sample is counted exactly once,
+        # the SPMD analog of the reference evaluating everything
+        # (single.py:199-258).  Round 1 inherited shuffle+drop_last here,
+        # which made eval metrics (and the QWK save gate) a shifting subset.
         self.test_loader = DataLoader(
             test_ds,
             per_proc_eval,
             sampler=ShardedEpochSampler(
                 len(test_ds), n_proc, proc,
-                shuffle=cfg.data.shuffle, drop_last=True,
+                shuffle=False, drop_last=False, pad_mode="sentinel",
                 seed=cfg.train.seed + 1,
             ),
             num_workers=cfg.data.num_workers,
-            drop_last=True,
+            drop_last=False,
+            pad_last_batch=True,
         )
-        if len(self.test_loader) == 0:
-            raise ValueError(
-                f"eval set ({len(test_ds)} examples) yields zero full "
-                f"batches at eval_batch_size={cfg.data.eval_batch_size} "
-                f"across {n_proc} process(es); eval batches must be full "
-                "(static SPMD shapes) — shrink data.eval_batch_size or "
-                "grow the test split"
-            )
+        if len(test_ds) == 0:
+            raise ValueError("empty eval set")
 
         self.logger = MetricLogger(
             cfg.train.log_dir,
@@ -222,7 +223,11 @@ class Trainer:
         return mean_loss, accuracy, steps
 
     def evaluate(self, epoch: int) -> dict:
-        """Eval loop -> metric dict (reference ``_evaluate``, single.py:199-251)."""
+        """Eval loop -> metric dict (reference ``_evaluate``, single.py:199-251).
+
+        Deterministic and full-coverage: rows padded to static shape carry
+        label -1 and are masked out, so metrics are computed over every test
+        sample exactly once and are epoch-order invariant."""
         self.test_loader.set_epoch(epoch)
         logits, targets = [], []
         for images, labels in self.test_loader:
@@ -231,11 +236,7 @@ class Trainer:
             targets.append(gl)
         all_logits = np.concatenate([_to_host(l) for l in logits])
         all_targets = np.concatenate([_to_host(t) for t in targets])
-        metrics = {"val_loss": cross_entropy(all_logits, all_targets)}
-        metrics.update(
-            classification_metrics(all_targets, np.argmax(all_logits, axis=-1))
-        )
-        return metrics
+        return masked_classification_eval(all_logits, all_targets)
 
     def train(self, max_epochs: int | None = None, guard=None) -> None:
         from ddl_tpu.utils.preemption import PreemptionGuard

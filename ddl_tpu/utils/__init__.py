@@ -3,6 +3,7 @@ from ddl_tpu.utils.metrics import (
     classification_metrics,
     cross_entropy,
     f1_score,
+    masked_classification_eval,
     precision_score,
     quadratic_weighted_kappa,
     recall_score,
@@ -15,6 +16,7 @@ __all__ = [
     "classification_metrics",
     "cross_entropy",
     "f1_score",
+    "masked_classification_eval",
     "precision_score",
     "quadratic_weighted_kappa",
     "recall_score",

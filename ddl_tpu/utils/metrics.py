@@ -25,6 +25,7 @@ __all__ = [
     "quadratic_weighted_kappa",
     "cross_entropy",
     "classification_metrics",
+    "masked_classification_eval",
 ]
 
 
@@ -150,3 +151,18 @@ def classification_metrics(y_true, y_pred, labels=None) -> dict:
         "weighted_recall": _average(r, support, "weighted"),
         "qwk": quadratic_weighted_kappa(y_true, y_pred, labels),
     }
+
+
+def masked_classification_eval(logits: np.ndarray, targets: np.ndarray) -> dict:
+    """Full val metric dict over the non-padded rows.
+
+    The deterministic full-coverage eval contract (shared by the CNN Trainer
+    and the ViT loop): rows sentinel-padded to static SPMD shapes carry
+    label ``-1`` and are dropped here, so every real sample is scored exactly
+    once and ``val_examples`` records how many that was."""
+    valid = targets >= 0
+    logits, targets = logits[valid], targets[valid]
+    metrics = {"val_loss": cross_entropy(logits, targets)}
+    metrics.update(classification_metrics(targets, np.argmax(logits, axis=-1)))
+    metrics["val_examples"] = float(len(targets))
+    return metrics

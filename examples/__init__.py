@@ -1,0 +1,2 @@
+"""Example entry points (importable for tests; each script is also directly
+runnable: ``python examples/train_lm.py ...``)."""

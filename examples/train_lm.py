@@ -52,8 +52,9 @@ def main() -> None:
                     help="attention impl (default: ring when --seq > 1, else dense)")
     ap.add_argument("--flash", action="store_true",
                     help="use the Pallas flash-attention kernel (dense/ulysses)")
+    # validated against models.transformer.REMAT_POLICIES after parsing —
+    # heavy imports stay deferred until --cpu-devices is handled
     ap.add_argument("--remat-policy", default="full",
-                    choices=["full", "dots", "dots_no_batch"],  # REMAT_POLICIES
                     help="per-block checkpoint policy (speed/HBM dial; "
                     "'dots' keeps matmul outputs, ~6%% faster backward)")
     ap.add_argument("--no-remat", action="store_true",
@@ -92,6 +93,11 @@ def main() -> None:
                     "any pipeline layout — the saved layout is read from the "
                     "snapshot's metadata)")
     ap.add_argument("--job-id", default="lm")
+    ap.add_argument("--log-dir", default=None,
+                    help="write the shared MetricLogger CSV suite (loss, "
+                    "tokens_per_sec, val_loss/val_ppl, epoch_time) under "
+                    "this dir so ddl_tpu.bench.analysis aggregates LM runs "
+                    "alongside the CNN/ViT families")
     args = ap.parse_args()
 
     if args.cpu_devices:
@@ -102,9 +108,12 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.models.transformer import REMAT_POLICIES, LMConfig
     from ddl_tpu.parallel.sharding import LMMeshSpec
     from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    if args.remat_policy not in REMAT_POLICIES:
+        ap.error(f"--remat-policy must be one of {REMAT_POLICIES}")
 
     cfg = LMConfig(
         vocab_size=256,
@@ -143,6 +152,12 @@ def main() -> None:
         virtual_stages=args.virtual_stages,
     )
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
+
+    logger = None
+    if args.log_dir and jax.process_index() == 0:
+        from ddl_tpu.utils import MetricLogger
+
+        logger = MetricLogger(args.log_dir, args.job_id)
 
     if args.corpus:
         # real corpus: memmapped token windows, host-sharded per process;
@@ -284,7 +299,7 @@ def main() -> None:
             )
         start = int(state.step)
         print(f"continuing from step {start}")
-    def eval_heldout():
+    def eval_heldout(step):
         import math
 
         def to_global(x):
@@ -301,8 +316,12 @@ def main() -> None:
         ce = float(np.mean(ces))
         print(f"  heldout: ce {ce:.4f} ppl {math.exp(ce):.2f} "
               f"({len(ces)} batches)")
+        if logger is not None:
+            logger.log("val_loss", ce, step)
+            logger.log("val_ppl", math.exp(ce), step)
 
     t0 = time.perf_counter()
+    t_window, window_start = t0, start
     for i in range(start, args.steps):
         inp, tgt = sample_batch(i)
         state, m = fns.train(state, inp, tgt)
@@ -311,16 +330,36 @@ def main() -> None:
                 f"step {i:4d} loss {float(m['loss']):.4f} "
                 f"ce {float(m['ce']):.4f} moe_aux {float(m['moe_aux']):.4f}"
             )
+            if logger is not None:
+                logger.log("loss", float(m["loss"]), i)
+                logger.log("ce", float(m["ce"]), i)
+                now = time.perf_counter()
+                if i > window_start:  # steady-state window rate
+                    sps = (i - window_start) / (now - t_window)
+                    logger.log("steps_per_sec", sps, i)
+                    logger.log(
+                        "tokens_per_sec", sps * args.batch * args.seq_len, i
+                    )
+                t_window, window_start = now, i
+        aux_work = False
         if (args.corpus and args.eval_every and eval_batches
                 and (i + 1) % args.eval_every == 0):
-            eval_heldout()
+            eval_heldout(i)
+            aux_work = True
         if args.checkpoint_dir and (i + 1) % args.save_every == 0:
             from ddl_tpu.checkpoint import save_snapshot
 
             save_snapshot(args.checkpoint_dir, args.job_id, i + 1, state)
+            aux_work = True
+        if aux_work:
+            # keep eval/checkpoint walls out of the logged steady-state rate
+            t_window, window_start = time.perf_counter(), i + 1
     steps_run = args.steps - start
     dt = time.perf_counter() - t0
     print(f"{steps_run} steps in {dt:.1f}s ({steps_run / dt:.2f} steps/s)")
+    if logger is not None:
+        # whole run as one "epoch" row so epoch_time_per_job covers LM jobs
+        logger.log("epoch_time", dt, 0)
 
 
 if __name__ == "__main__":

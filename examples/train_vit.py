@@ -54,6 +54,12 @@ def main() -> None:
                     help="synthetic train examples (when no real dataset)")
     ap.add_argument("--num-test", type=int, default=64)
     ap.add_argument("--cpu-devices", type=int, default=0)
+    ap.add_argument("--job-id", default="vit")
+    ap.add_argument("--log-dir", default=None,
+                    help="write the shared MetricLogger CSV suite (loss, "
+                    "img_per_sec, val_loss/val_accuracy/qwk, epoch_time) so "
+                    "ddl_tpu.bench.analysis aggregates ViT runs alongside "
+                    "the CNN/LM families")
     args = ap.parse_args()
 
     if args.cpu_devices:
@@ -69,7 +75,7 @@ def main() -> None:
     from ddl_tpu.parallel.sharding import LMMeshSpec
     from ddl_tpu.train.state import build_optimizer
     from ddl_tpu.train.vit_steps import make_vit_step_fns
-    from ddl_tpu.utils.metrics import classification_metrics
+    from ddl_tpu.utils.metrics import masked_classification_eval
 
     cfg = ViTConfig(
         image_size=args.image_size,
@@ -106,10 +112,23 @@ def main() -> None:
         train_ds, args.batch // n_proc,
         sampler=ShardedEpochSampler(len(train_ds), n_proc, proc, seed=0),
     )
+    # deterministic full-coverage eval: ordered, sentinel-padded to static
+    # shapes, padded rows (label -1) masked out — same contract as the CNN
+    # Trainer's eval loop
     test_loader = DataLoader(
         test_ds, args.batch // n_proc,
-        sampler=ShardedEpochSampler(len(test_ds), n_proc, proc, seed=1),
+        sampler=ShardedEpochSampler(
+            len(test_ds), n_proc, proc,
+            shuffle=False, drop_last=False, pad_mode="sentinel", seed=1,
+        ),
+        drop_last=False, pad_last_batch=True,
     )
+
+    logger = None
+    if args.log_dir and proc == 0:
+        from ddl_tpu.utils import MetricLogger
+
+        logger = MetricLogger(args.log_dir, args.job_id)
 
     state = fns.init_state()
     for epoch in range(args.epochs):
@@ -122,17 +141,23 @@ def main() -> None:
             losses.append(float(m["loss"]))
             steps += 1
         dt = time.perf_counter() - t0
-        preds, targets = [], []
+        logits, targets = [], []
         for images, labels in test_loader:
             gi, gl = shard_batch(fns.mesh, images, labels)
-            preds.append(np.argmax(np.asarray(fns.evaluate(state, gi)), -1))
+            logits.append(np.asarray(fns.evaluate(state, gi)))
             targets.append(np.asarray(gl))
-        mets = classification_metrics(
-            np.concatenate(targets), np.concatenate(preds)
+        mets = masked_classification_eval(
+            np.concatenate(logits), np.concatenate(targets)
         )
         print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
               f"({steps} steps, {dt:.1f}s, {steps / dt:.2f} steps/s) | "
               f"val_acc {mets['val_accuracy']:.4f} qwk {mets['qwk']:.4f}")
+        if logger is not None:
+            logger.log("loss", float(np.mean(losses)), epoch)
+            logger.log("epoch_time", dt, epoch)
+            logger.log("steps_per_sec", steps / dt, epoch)
+            logger.log("img_per_sec", steps * args.batch / dt, epoch)
+            logger.log_many(mets, epoch)
 
 
 if __name__ == "__main__":

@@ -80,6 +80,180 @@ def test_conversion_overlays_and_transposes(staged):
     )
 
 
+# ---------------------------------------------------------------------------
+# Real-layout validation (VERDICT round 1, Missing #1): the fixtures above are
+# built by inverting our own key mapping, so a systematic naming/transpose bug
+# would cancel out.  The tests below break that circularity without network
+# access (no pretrained download): the key manifest is written from
+# torchvision's *published* naming/shapes, and forward parity is checked
+# against an independent functional-torch DenseNet evaluated straight off the
+# state dict (reference builds exactly this model: ``single.py:297-299``).
+# ---------------------------------------------------------------------------
+
+DN121 = dict(growth=32, blocks=(6, 12, 24, 16), init_features=64, bn_size=4)
+
+
+def _torchvision_densenet121_manifest() -> dict[str, tuple]:
+    """torchvision densenet121 state_dict keys -> shapes, generated from the
+    published architecture constants — independent of ddl_tpu code."""
+    g, blocks, ninit, bn = (
+        DN121["growth"], DN121["blocks"], DN121["init_features"], DN121["bn_size"]
+    )
+    keys: dict[str, tuple] = {}
+
+    def bnorm(prefix, c):
+        keys[f"{prefix}.weight"] = (c,)
+        keys[f"{prefix}.bias"] = (c,)
+        keys[f"{prefix}.running_mean"] = (c,)
+        keys[f"{prefix}.running_var"] = (c,)
+        keys[f"{prefix}.num_batches_tracked"] = ()
+
+    keys["features.conv0.weight"] = (ninit, 3, 7, 7)
+    bnorm("features.norm0", ninit)
+    c = ninit
+    for b, n_layers in enumerate(blocks, start=1):
+        for layer in range(1, n_layers + 1):
+            cin = c + (layer - 1) * g
+            p = f"features.denseblock{b}.denselayer{layer}"
+            bnorm(f"{p}.norm1", cin)
+            keys[f"{p}.conv1.weight"] = (bn * g, cin, 1, 1)
+            bnorm(f"{p}.norm2", bn * g)
+            keys[f"{p}.conv2.weight"] = (g, bn * g, 3, 3)
+        c += n_layers * g
+        if b < len(blocks):
+            bnorm(f"features.transition{b}.norm", c)
+            keys[f"features.transition{b}.conv.weight"] = (c // 2, c, 1, 1)
+            c //= 2
+    bnorm("features.norm5", c)
+    keys["classifier.weight"] = (1000, c)
+    keys["classifier.bias"] = (1000,)
+    return keys
+
+
+def _random_real_sd(manifest, seed=0):
+    """Fill the real manifest with bounded random values (kaiming-ish conv
+    scales keep 121 layers of activations finite in float32)."""
+    rng = np.random.default_rng(seed)
+    sd = {}
+    for key, shape in manifest.items():
+        if key.endswith("num_batches_tracked"):
+            sd[key] = np.asarray(100, np.int64)
+        elif key.endswith("running_var"):
+            sd[key] = rng.uniform(0.5, 1.5, shape).astype(np.float32)
+        elif key.endswith("running_mean"):
+            sd[key] = rng.normal(0, 0.1, shape).astype(np.float32)
+        elif ".weight" in key and len(shape) == 4:
+            fan_in = shape[1] * shape[2] * shape[3]
+            sd[key] = rng.normal(0, (2.0 / fan_in) ** 0.5, shape).astype(np.float32)
+        elif key == "classifier.weight":
+            sd[key] = rng.normal(0, shape[1] ** -0.5, shape).astype(np.float32)
+        else:  # bn weight/bias, classifier bias
+            sd[key] = (
+                rng.uniform(0.5, 1.5, shape) if key.endswith("norm.weight")
+                or ".weight" in key else rng.normal(0, 0.1, shape)
+            ).astype(np.float32)
+    return sd
+
+
+def _torch_densenet121_forward(sd, x_nchw):
+    """Functional-torch DenseNet121 evaluated directly off the state dict
+    (mirrors the published torchvision forward; independent of our Flax)."""
+    import torch
+    import torch.nn.functional as F
+
+    t = {k: torch.as_tensor(v) for k, v in sd.items()}
+
+    def bn(x, p):
+        return F.batch_norm(
+            x, t[p + ".running_mean"], t[p + ".running_var"],
+            t[p + ".weight"], t[p + ".bias"], training=False, eps=1e-5,
+        )
+
+    x = torch.as_tensor(x_nchw)
+    x = F.conv2d(x, t["features.conv0.weight"], stride=2, padding=3)
+    x = F.max_pool2d(F.relu(bn(x, "features.norm0")), 3, stride=2, padding=1)
+    for b, n_layers in enumerate(DN121["blocks"], start=1):
+        feats = [x]
+        for layer in range(1, n_layers + 1):
+            p = f"features.denseblock{b}.denselayer{layer}"
+            inp = torch.cat(feats, 1)
+            y = F.conv2d(F.relu(bn(inp, p + ".norm1")), t[p + ".conv1.weight"])
+            y = F.conv2d(
+                F.relu(bn(y, p + ".norm2")), t[p + ".conv2.weight"], padding=1
+            )
+            feats.append(y)
+        x = torch.cat(feats, 1)
+        if b < len(DN121["blocks"]):
+            p = f"features.transition{b}"
+            x = F.conv2d(F.relu(bn(x, p + ".norm")), t[p + ".conv.weight"])
+            x = F.avg_pool2d(x, 2, stride=2)
+    x = F.relu(bn(x, "features.norm5"))
+    x = F.adaptive_avg_pool2d(x, 1).flatten(1)
+    return (
+        F.linear(x, t["classifier.weight"], t["classifier.bias"]).numpy()
+    )
+
+
+@pytest.fixture(scope="module")
+def full_staged_1000():
+    """Full densenet121 with the 1000-class torch head (so every tensor,
+    classifier included, must convert)."""
+    from ddl_tpu.config import ModelConfig
+
+    cfg = ModelConfig(num_classes=1000, split_blocks=(), remat=False)
+    stages = build_stages(cfg, num_stages=1)
+    params, batch_stats = init_stages(stages, jax.random.key(0), image_size=64)
+    return stages, params, batch_stats
+
+
+def test_real_layout_key_parity(full_staged_1000):
+    """Our tree's torch-key image must equal torchvision's documented key
+    set exactly (minus the stats-only num_batches_tracked counters)."""
+    _, params, batch_stats = full_staged_1000
+    ours = set()
+    for tree in (*params, *batch_stats):
+        for path, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            ours.add(_torch_key(path, is_stats=False))
+    manifest = {
+        k for k in _torchvision_densenet121_manifest()
+        if not k.endswith("num_batches_tracked")
+    }
+    assert ours == manifest, (
+        f"missing from ours: {sorted(manifest - ours)[:5]} | "
+        f"extra in ours: {sorted(ours - manifest)[:5]}"
+    )
+
+
+def test_real_layout_forward_parity(full_staged_1000, tmp_path):
+    """Converted-Flax forward == functional-torch forward on the same real
+    state dict, to float tolerance — catches any transpose/key bug on the
+    genuine torchvision layout."""
+    torch = pytest.importorskip("torch")
+
+    from ddl_tpu.models import forward_stages
+    from ddl_tpu.models.convert import load_torch_checkpoint
+
+    stages, params, batch_stats = full_staged_1000
+    sd = _random_real_sd(_torchvision_densenet121_manifest())
+    pth = tmp_path / "dn121.pth"
+    torch.save({k: torch.as_tensor(v) for k, v in sd.items()}, pth)
+
+    new_params, new_stats, skipped = load_torch_checkpoint(
+        str(pth), params, batch_stats
+    )
+    assert skipped == [], f"unconverted tensors: {skipped[:5]}"
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(0, 1, (2, 64, 64, 3)).astype(np.float32)
+    import jax.numpy as jnp
+
+    ours, _ = forward_stages(
+        stages, new_params, new_stats, jnp.asarray(x), train=False
+    )
+    theirs = _torch_densenet121_forward(sd, x.transpose(0, 3, 1, 2))
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-3, atol=2e-3)
+
+
 def test_converted_model_still_runs(staged, tiny_model_cfg):
     import jax.numpy as jnp
 

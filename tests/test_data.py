@@ -36,6 +36,27 @@ class TestShardedEpochSampler:
         assert lengths == {3}  # ceil(10/4), equal on every shard
         assert set(flat) == set(range(n))  # every example appears
 
+    def test_sentinel_padding_covers_each_example_exactly_once(self):
+        n, shards = 10, 4
+        flat = []
+        lengths = set()
+        for r in range(shards):
+            s = ShardedEpochSampler(
+                n, shards, r, shuffle=False, drop_last=False, pad_mode="sentinel"
+            )
+            idx = s.indices()
+            lengths.add(len(idx))
+            flat.extend(idx)
+        assert lengths == {3}  # equal shards (lock-step)
+        flat = np.asarray(flat)
+        real = flat[flat >= 0]
+        assert sorted(real) == list(range(n))  # exactly once, no wrap dupes
+        assert (flat < 0).sum() == 3 * shards - n
+
+    def test_bad_pad_mode_raises(self):
+        with pytest.raises(ValueError):
+            ShardedEpochSampler(10, pad_mode="nope")
+
     def test_epoch_reshuffles(self):
         s = ShardedEpochSampler(100, 2, 0, shuffle=True, seed=1)
         s.set_epoch(0)
@@ -140,3 +161,30 @@ class TestDataLoader:
         dl.set_epoch(1)
         b = np.concatenate([l for _, l in dl])
         assert not np.array_equal(a, b)
+
+    def test_pad_last_batch_static_shapes_full_coverage(self):
+        """Eval-mode loading: every batch has the static batch_size shape,
+        padded rows carry label -1 + zero image, and every real sample
+        appears exactly once."""
+        d = SyntheticAptosDataset(13, image_size=8, seed=0)
+        dl = DataLoader(
+            d,
+            batch_size=5,
+            sampler=ShardedEpochSampler(
+                13, shuffle=False, drop_last=False, pad_mode="sentinel"
+            ),
+            num_workers=0,
+            drop_last=False,
+            pad_last_batch=True,
+        )
+        batches = list(dl)
+        assert len(batches) == 3
+        labels = np.concatenate([l for _, l in batches])
+        images = np.concatenate([i for i, _ in batches])
+        assert all(i.shape == (5, 8, 8, 3) for i, _ in batches)  # static
+        assert (labels >= 0).sum() == 13 and (labels == -1).sum() == 2
+        assert (images[labels == -1] == 0).all()
+        # real rows are the dataset in order, exactly once
+        real = images[labels >= 0]
+        expect = np.stack([d[i][0] for i in range(13)])
+        np.testing.assert_array_equal(real, expect)

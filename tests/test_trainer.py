@@ -92,6 +92,22 @@ def test_trainer_end_to_end(tmp_path, strategy, mesh):
     assert latest_epoch(cfg.train.checkpoint_dir, trainer.job_id) is not None
 
 
+def test_eval_full_coverage_and_epoch_invariant(tmp_path):
+    """Eval counts every test sample exactly once and is deterministic
+    across epochs (the SPMD analog of the reference evaluating everything,
+    single.py:199-258) — round 1 evaluated a per-epoch-reshuffled subset,
+    which made the QWK save gate noisy by construction."""
+    from ddl_tpu.train import Trainer
+
+    cfg = _tiny_cfg(tmp_path, "single", MeshConfig(1, 1))
+    cfg.data.synthetic_num_test = 29  # not divisible by eval_batch_size=16
+    trainer = Trainer(cfg, datasets=_datasets(cfg))
+    m0 = trainer.evaluate(0)
+    m5 = trainer.evaluate(5)
+    assert m0["val_examples"] == 29.0  # full coverage, padding masked out
+    assert m0 == m5  # epoch-order invariant
+
+
 def test_resume_from_snapshot(tmp_path):
     from ddl_tpu.checkpoint import latest_epoch
     from ddl_tpu.train import Trainer

@@ -40,6 +40,18 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
 
+    # persistent compile cache (same as bench.py): repeated runs — and the
+    # cost-analysis AOT compile, which bypasses jit's in-memory executable
+    # cache — skip the multi-ten-second XLA compile
+    import os
+
+    cache_dir = os.environ.get("DDL_COMPILE_CACHE", "/tmp/ddl_tpu_xla_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     cfg = LMConfig(
         vocab_size=args.vocab,
         d_model=args.d_model,
@@ -69,7 +81,7 @@ def main() -> None:
         state, m = fns.train(state, inp, tgt)
     fence(m["loss"])
     dt = (time.perf_counter() - t0) / args.iters
-    print(json.dumps({
+    out = {
         "ms_per_step": round(dt * 1e3, 1),
         "tokens_per_sec": round(args.batch * args.seq_len / dt),
         "seq_len": args.seq_len,
@@ -77,7 +89,13 @@ def main() -> None:
         "flash": args.flash,
         "remat": "off" if args.no_remat else args.remat_policy,
         "loss": round(float(m["loss"]), 3),
-    }))
+    }
+    from ddl_tpu.bench.mfu import append_mfu
+
+    # executed FLOPs: equals MFU with remat off, HFU otherwise
+    append_mfu(out, fns.train, dt, state, inp, tgt,
+               key="mfu" if args.no_remat else "hfu")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,94 @@
+"""FLOPs accounting for bench rows: exact per-step FLOPs and MFU.
+
+The reference publishes only wall-clock epoch times (``ipynb/main.ipynb``
+cell 3) — a number that says nothing about how much of the accelerator is
+used.  Here every bench row can also report
+
+* ``tflops``: executed FLOPs per step from XLA's own cost analysis of the
+  compiled program (``jit(...).lower().compile().cost_analysis()`` — the
+  same machinery ``tools/split_explorer.py`` uses for stage balance), and
+* ``mfu``: executed FLOP/s divided by the chip's peak dense bf16 FLOP/s.
+
+Note on remat: cost analysis counts the FLOPs the program *executes*, so
+with activation rematerialisation enabled the ratio is hardware-FLOPs
+utilization (HFU) — it includes the recompute.  For rows with remat off
+(the single-chip headline benches) executed == model FLOPs and the ratio
+is the classic MFU.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = [
+    "device_peak_flops",
+    "compiled_step_flops",
+    "mfu",
+    "append_mfu",
+    "PEAK_BF16_FLOPS",
+]
+
+# jax device_kind prefix -> peak dense bf16 FLOP/s (public spec sheets)
+PEAK_BF16_FLOPS = {
+    "TPU v6": 918e12,  # v6e / Trillium
+    "TPU v5p": 459e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 197e12,  # bare "TPU v5" device_kind strings are v5e in practice
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 46e12,
+}
+
+
+def device_peak_flops(device=None) -> float | None:
+    """Peak dense bf16 FLOP/s for ``device`` (default: first device), or
+    None when unknown (CPU, unlisted kind) — callers then omit the MFU
+    column rather than print a wrong one."""
+    d = device if device is not None else jax.devices()[0]
+    kind = str(getattr(d, "device_kind", "")).strip()
+    # longest prefix wins so "TPU v5p" does not fall through to "TPU v5"
+    for k in sorted(PEAK_BF16_FLOPS, key=len, reverse=True):
+        if kind.lower().startswith(k.lower()):
+            return PEAK_BF16_FLOPS[k]
+    return None
+
+
+def compiled_step_flops(fn, *args) -> float:
+    """Exact executed FLOPs of one invocation of ``fn(*args)``.
+
+    ``fn`` may be a jitted function or a plain callable (jitted here).
+    Returns NaN when the backend's cost analysis is unavailable."""
+    try:
+        lowered = (
+            fn.lower(*args) if hasattr(fn, "lower") else jax.jit(fn).lower(*args)
+        )
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception:
+        return float("nan")
+
+
+def mfu(flops_per_step: float, step_time_s: float, device=None) -> float | None:
+    """Fraction of peak dense bf16 FLOP/s achieved; None when peak unknown."""
+    peak = device_peak_flops(device)
+    if peak is None or not step_time_s > 0 or not flops_per_step > 0:
+        return None
+    return flops_per_step / step_time_s / peak
+
+
+def append_mfu(out: dict, fn, step_time_s: float, *args, key: str = "mfu") -> dict:
+    """Add ``tflops_per_step`` (whenever cost analysis works) and ``key``
+    (only when the chip's peak is known) to a bench result dict — the one
+    reporting path shared by bench.py / bench.lm / bench.vit.  ``key`` is
+    ``"mfu"`` when executed == model FLOPs (no remat) and ``"hfu"``
+    otherwise (see module docstring)."""
+    flops = compiled_step_flops(fn, *args)
+    if flops > 0:  # NaN-safe: NaN > 0 is False
+        out["tflops_per_step"] = round(flops / 1e12, 2)
+        u = mfu(flops, step_time_s)
+        if u is not None:
+            out[key] = round(u, 4)
+    return out

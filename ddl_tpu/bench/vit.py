@@ -39,6 +39,17 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=30)
     args = ap.parse_args()
 
+    # persistent compile cache (same as bench.py / bench.lm): the
+    # cost-analysis AOT compile bypasses jit's in-memory cache
+    import os
+
+    cache_dir = os.environ.get("DDL_COMPILE_CACHE", "/tmp/ddl_tpu_xla_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     cfg = ViTConfig(
         image_size=args.image_size,
         patch_size=args.patch,
@@ -69,13 +80,18 @@ def main() -> None:
         state, m = fns.train(state, imgs, labels)
     fence(m["loss"])
     dt = (time.perf_counter() - t0) / args.iters
-    print(json.dumps({
+    out = {
         "ms_per_step": round(dt * 1e3, 1),
         "images_per_sec": round(args.batch / dt),
         "batch": args.batch,
         "remat": "off" if args.no_remat else args.remat_policy,
         "loss": round(float(m["loss"]), 3),
-    }))
+    }
+    from ddl_tpu.bench.mfu import append_mfu
+
+    append_mfu(out, fns.train, dt, state, imgs, labels,
+               key="mfu" if args.no_remat else "hfu")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -23,7 +23,38 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["MeshSpec", "build_mesh", "DATA_AXIS", "PIPE_AXIS"]
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "with_ambient_mesh",
+    "DATA_AXIS",
+    "PIPE_AXIS",
+]
+
+
+def with_ambient_mesh(mesh: Mesh, fn):
+    """Wrap ``fn`` so every call runs under ``jax.set_mesh(mesh)``.
+
+    ``nn.with_logical_constraint`` lowers to bare-PartitionSpec sharding
+    constraints that resolve against the ambient mesh at trace time, so the
+    jitted step functions (``train/lm_steps.py``, ``train/vit_steps.py``)
+    need the mesh installed around both execution *and* lowering.  When
+    ``fn`` is a jit, its ``.lower`` is re-exported under the same mesh so
+    FLOPs accounting (``bench.mfu.compiled_step_flops``) can cost-analyse
+    the compiled step — ``set_mesh`` cannot be entered inside a jit trace.
+    """
+
+    def wrapped(*args):
+        with jax.set_mesh(mesh):
+            return fn(*args)
+
+    if hasattr(fn, "lower"):
+        def lower(*args):
+            with jax.set_mesh(mesh):
+                return fn.lower(*args)
+
+        wrapped.lower = lower
+    return wrapped
 
 DATA_AXIS = "data"
 PIPE_AXIS = "pipe"

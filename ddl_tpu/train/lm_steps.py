@@ -205,12 +205,10 @@ def finalize_step_fns(
         acc = (jnp.argmax(logits, -1) == targets).mean()
         return dict(metrics, accuracy=acc)
 
-    def _with_mesh(fn):
-        def wrapped(*args):
-            with jax.set_mesh(mesh):
-                return fn(*args)
+    from ddl_tpu.parallel.mesh import with_ambient_mesh
 
-        return wrapped
+    def _with_mesh(fn):
+        return with_ambient_mesh(mesh, fn)
 
     create = _with_mesh(jax.jit(create_state))
     train = _with_mesh(

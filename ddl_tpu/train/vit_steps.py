@@ -200,12 +200,10 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
     img_sharding = NamedSharding(mesh, P("data"))
     replicated = NamedSharding(mesh, P())
 
-    def _with_mesh(fn):
-        def wrapped(*args):
-            with jax.set_mesh(mesh):
-                return fn(*args)
+    from ddl_tpu.parallel.mesh import with_ambient_mesh
 
-        return wrapped
+    def _with_mesh(fn):
+        return with_ambient_mesh(mesh, fn)
 
     return ViTStepFns(
         train=_with_mesh(jax.jit(

@@ -175,6 +175,61 @@ class TestGraftEntry:
         jax.jit(fn).lower(*args)  # raises on any tracing/sharding error
 
 
+class TestMfu:
+    def test_compiled_step_flops_exact(self):
+        import jax.numpy as jnp
+
+        from ddl_tpu.bench.mfu import compiled_step_flops
+
+        n = 64
+        flops = compiled_step_flops(lambda x: x @ x, jnp.ones((n, n)))
+        assert flops == 2 * n**3  # XLA counts 2mnk for a matmul
+
+    def test_peak_lookup_prefix_precedence(self):
+        from ddl_tpu.bench.mfu import PEAK_BF16_FLOPS, device_peak_flops
+
+        class FakeDev:
+            def __init__(self, kind):
+                self.device_kind = kind
+
+        assert device_peak_flops(FakeDev("TPU v5 lite")) == PEAK_BF16_FLOPS["TPU v5 lite"]
+        assert device_peak_flops(FakeDev("TPU v5p")) == PEAK_BF16_FLOPS["TPU v5p"]
+        assert device_peak_flops(FakeDev("TPU v4")) == PEAK_BF16_FLOPS["TPU v4"]
+        assert device_peak_flops(FakeDev("cpu")) is None
+
+    def test_mfu_on_cpu_is_none(self):
+        from ddl_tpu.bench.mfu import mfu
+
+        assert mfu(1e12, 0.01) is None  # CPU device: peak unknown
+
+    def test_step_fns_expose_lower(self):
+        """The set_mesh wrappers re-export jit's .lower so cost analysis
+        can reach the compiled step (lm_steps/vit_steps _with_mesh)."""
+        import jax
+        import optax
+
+        from ddl_tpu.models.transformer import LMConfig
+        from ddl_tpu.parallel.sharding import LMMeshSpec
+        from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+        cfg = LMConfig(
+            vocab_size=32, d_model=32, n_layers=1, n_heads=2, head_dim=16,
+            d_ff=64, compute_dtype="float32", remat=False,
+        )
+        fns = make_lm_step_fns(
+            cfg, LMMeshSpec(), optax.adam(1e-3), jax.random.key(0), 2, 8
+        )
+        assert hasattr(fns.train, "lower")
+        import jax.numpy as jnp
+
+        from ddl_tpu.bench.mfu import compiled_step_flops
+
+        state = fns.init_state()
+        toks = jnp.zeros((2, 8), jnp.int32)
+        flops = compiled_step_flops(fns.train, state, toks, toks)
+        assert flops > 0
+
+
 class TestMemoryStats:
     def test_graceful_none_without_stats(self):
         from ddl_tpu.utils.memory import hbm_stats

@@ -31,7 +31,8 @@ def main() -> None:
     ap.add_argument("--d-model", type=int, default=768)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--vocab", type=int, default=50304)
-    ap.add_argument("--flash", action="store_true")
+    ap.add_argument("--flash", nargs="?", const="on", default="off",
+                    choices=["on", "off", "auto"])
     ap.add_argument("--remat-policy", default="full",
                     choices=list(REMAT_POLICIES),
                     help="what the per-block checkpoint may save instead of "
@@ -40,17 +41,9 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
 
-    # persistent compile cache (same as bench.py): repeated runs — and the
-    # cost-analysis AOT compile, which bypasses jit's in-memory executable
-    # cache — skip the multi-ten-second XLA compile
-    import os
+    from ddl_tpu.utils.compile_cache import enable_compile_cache
 
-    cache_dir = os.environ.get("DDL_COMPILE_CACHE", "/tmp/ddl_tpu_xla_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    enable_compile_cache()
 
     cfg = LMConfig(
         vocab_size=args.vocab,
@@ -60,10 +53,16 @@ def main() -> None:
         head_dim=64,
         d_ff=4 * args.d_model,
         compute_dtype="bfloat16",
-        flash=args.flash,
+        flash={"on": True, "off": False, "auto": "auto"}[args.flash],
         remat=not args.no_remat,
         remat_policy=args.remat_policy,
     )
+    if cfg.flash == "auto":
+        from ddl_tpu.parallel.sharding import resolve_auto_flash
+
+        resolved_flash = resolve_auto_flash(cfg, LMMeshSpec(), args.seq_len)
+    else:
+        resolved_flash = bool(cfg.flash)
     fns = make_lm_step_fns(
         cfg, LMMeshSpec(), optax.adamw(3e-4), jax.random.key(0),
         args.batch, args.seq_len,
@@ -86,7 +85,8 @@ def main() -> None:
         "tokens_per_sec": round(args.batch * args.seq_len / dt),
         "seq_len": args.seq_len,
         "batch": args.batch,
-        "flash": args.flash,
+        "flash": resolved_flash,  # the path auto actually picked
+        "flash_mode": args.flash,
         "remat": "off" if args.no_remat else args.remat_policy,
         "loss": round(float(m["loss"]), 3),
     }

@@ -39,16 +39,9 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=30)
     args = ap.parse_args()
 
-    # persistent compile cache (same as bench.py / bench.lm): the
-    # cost-analysis AOT compile bypasses jit's in-memory cache
-    import os
+    from ddl_tpu.utils.compile_cache import enable_compile_cache
 
-    cache_dir = os.environ.get("DDL_COMPILE_CACHE", "/tmp/ddl_tpu_xla_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    enable_compile_cache()
 
     cfg = ViTConfig(
         image_size=args.image_size,

@@ -72,7 +72,11 @@ class LMConfig:
     # per-device attention: with 'dense' it replaces the O(T^2) score
     # materialisation (requires seq mesh axis 1), with 'ulysses' it runs on
     # each head group after the all-to-all.  'ring' is already blockwise.
-    flash: bool = False
+    # "auto" picks per run: flash when the training sequence length is at
+    # or past the measured crossover and the composition supports the
+    # kernel, dense otherwise (resolved by train/lm_steps.py against the
+    # run's seq_len; PERF.md records the crossover measurements).
+    flash: bool | str = False
     remat: bool = True
     # What the per-block jax.checkpoint may keep instead of recomputing
     # (active only with remat=True): 'full' recomputes everything (minimum

@@ -81,6 +81,7 @@ from ddl_tpu.parallel.sharding import (
     LMMeshSpec,
     build_lm_mesh,
     lm_logical_rules,
+    normalize_flash,
 )
 from ddl_tpu.train.lm_steps import (
     LMStepFns,
@@ -828,6 +829,7 @@ def make_lm_pipeline_step_fns(
     O(microbatches) *stage-activation* residency; the embed/head edge
     buffers stay O(batch) under both schedules — same gradients).
     Evaluation always uses the forward-only GPipe schedule."""
+    cfg = normalize_flash(cfg, spec, seq_len)  # resolve flash="auto"
     n_stages, M = spec.pipe, num_microbatches
     V = virtual_stages
     if n_stages < 2:

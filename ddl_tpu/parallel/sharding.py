@@ -35,11 +35,59 @@ __all__ = [
     "LMMeshSpec",
     "build_lm_mesh",
     "lm_logical_rules",
+    "resolve_auto_flash",
+    "normalize_flash",
+    "FLASH_AUTO_MIN_T",
     "SEQ_AXIS",
     "MODEL_AXIS",
     "EXPERT_AXIS",
     "PIPE_AXIS",
 ]
+
+# Training-step crossover for flash="auto", measured on one v5e chip
+# (PERF.md): at T=512 the XLA dense path wins (78.6 vs 86.0 ms/step,
+# batch 16); from T=1024 the Pallas kernel wins (93.9 vs 107.6 ms at
+# batch 8) and the gap grows with T (backward dominates training, and
+# flash backward wins at every measured length).
+FLASH_AUTO_MIN_T = 1024
+
+
+def resolve_auto_flash(cfg, spec: "LMMeshSpec", seq_len: int) -> bool:
+    """Resolve ``LMConfig.flash == "auto"`` to a concrete bool for a run.
+
+    Lives here (not ``train/lm_steps.py``) so both the flat-step and the
+    pipeline factories can share it without an import cycle.  Picks the
+    Pallas kernel only where it is both *supported* — causal; not 'ring',
+    which is already blockwise; not dense-with-sharded-seq, where the
+    kernel cannot see the full sequence; heads divisible over ``model``,
+    which the head-parallel manual core requires — and *measured faster*
+    (training ``seq_len`` at or past ``FLASH_AUTO_MIN_T``).  Ulysses
+    attends the full sequence per head group after its all-to-all, so the
+    global ``seq_len`` is the right scale for every supported impl."""
+    if not cfg.causal or cfg.attn_impl == "ring":
+        return False
+    if cfg.attn_impl == "dense" and spec.seq > 1:
+        return False
+    if cfg.n_heads % spec.model:
+        return False  # manual core shards heads over 'model'
+    return seq_len >= FLASH_AUTO_MIN_T
+
+
+def normalize_flash(cfg, spec: "LMMeshSpec", seq_len: int):
+    """Return ``cfg`` with ``flash`` resolved to a concrete bool.
+
+    Called at the top of every step-fn factory (flat and pipeline) so no
+    downstream check ever sees the "auto" string — and so a stray string
+    like ``flash='off'`` fails loudly instead of being truthy."""
+    if cfg.flash == "auto":
+        return dataclasses.replace(
+            cfg, flash=resolve_auto_flash(cfg, spec, seq_len)
+        )
+    if isinstance(cfg.flash, str):
+        raise ValueError(
+            f"flash must be True, False, or 'auto'; got {cfg.flash!r}"
+        )
+    return cfg
 
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"

@@ -27,7 +27,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddl_tpu.models.transformer import LMConfig, TransformerLM
 from ddl_tpu.ops.flash_attention import flash_attention
 from ddl_tpu.parallel.ring_attention import make_ring_self_attention
-from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
+from ddl_tpu.parallel.sharding import (
+    FLASH_AUTO_MIN_T,  # noqa: F401  (re-exported: measured dispatch bound)
+    LMMeshSpec,
+    build_lm_mesh,
+    lm_logical_rules,
+    normalize_flash,
+    resolve_auto_flash,  # noqa: F401  (re-exported for tests/tools)
+)
 from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
 
 __all__ = [
@@ -266,6 +273,7 @@ def make_lm_step_fns(
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if pipeline_schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
+    cfg = normalize_flash(cfg, spec, seq_len)
     if spec.pipe > 1:
         if accum_steps > 1:
             raise ValueError(

@@ -1,0 +1,26 @@
+"""Persistent XLA compile cache setup, shared by the bench entry points.
+
+Repeated bench runs — and the cost-analysis AOT compile in
+``bench.mfu.compiled_step_flops``, which bypasses jit's in-memory
+executable cache — skip the multi-ten-second XLA compile when the
+persistent cache is on.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_compile_cache"]
+
+
+def enable_compile_cache(default_dir: str = "/tmp/ddl_tpu_xla_cache") -> None:
+    """Point JAX's persistent compilation cache at ``$DDL_COMPILE_CACHE``
+    (or ``default_dir``); a no-op on backends without cache support."""
+    import jax
+
+    cache_dir = os.environ.get("DDL_COMPILE_CACHE", default_dir)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass

@@ -50,8 +50,11 @@ def main() -> None:
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--attn", default=None, choices=["dense", "ring", "ulysses"],
                     help="attention impl (default: ring when --seq > 1, else dense)")
-    ap.add_argument("--flash", action="store_true",
-                    help="use the Pallas flash-attention kernel (dense/ulysses)")
+    ap.add_argument("--flash", nargs="?", const="on", default="off",
+                    choices=["on", "off", "auto"],
+                    help="Pallas flash-attention kernel (dense/ulysses): "
+                    "'--flash' / '--flash on' forces it, '--flash auto' "
+                    "picks per run from the measured seq-len crossover")
     # validated against models.transformer.REMAT_POLICIES after parsing —
     # heavy imports stay deferred until --cpu-devices is handled
     ap.add_argument("--remat-policy", default="full",
@@ -115,6 +118,11 @@ def main() -> None:
     if args.remat_policy not in REMAT_POLICIES:
         ap.error(f"--remat-policy must be one of {REMAT_POLICIES}")
 
+    flash = {"on": True, "off": False, "auto": "auto"}[args.flash]
+    # Default attention core: ring when the sequence axis is sharded (the
+    # tuned SP default), ulysses only when flash is *forced* (the kernel
+    # cannot nest in ring).  flash=auto keeps the ring default — pass
+    # --attn ulysses explicitly to let auto pick flash-ulysses under SP.
     cfg = LMConfig(
         vocab_size=256,
         d_model=args.d_model,
@@ -125,8 +133,8 @@ def main() -> None:
         num_experts=args.experts,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
         attn_impl=args.attn
-        or (("ulysses" if args.flash else "ring") if args.seq > 1 else "dense"),
-        flash=args.flash,
+        or (("ulysses" if flash is True else "ring") if args.seq > 1 else "dense"),
+        flash=flash,
         remat=not args.no_remat,
         remat_policy=args.remat_policy,
         fsdp=args.fsdp,

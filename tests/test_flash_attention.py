@@ -119,3 +119,78 @@ def test_flash_bf16_finite():
     out = flash_attention(q, q, q, causal=True, block_q=16, block_k=16)
     assert out.dtype == jnp.bfloat16
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+
+def test_flash_auto_resolution():
+    """flash="auto" picks the kernel only past the measured train-step
+    crossover (PERF.md: dense wins at T=512, flash from T=1024) and only
+    where the composition supports it."""
+    import dataclasses
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import FLASH_AUTO_MIN_T, resolve_auto_flash
+
+    base = LMConfig(
+        vocab_size=32, d_model=32, n_layers=1, n_heads=4, head_dim=8,
+        d_ff=64, flash="auto",
+    )
+    spec = LMMeshSpec()
+    assert resolve_auto_flash(base, spec, FLASH_AUTO_MIN_T - 1) is False
+    assert resolve_auto_flash(base, spec, FLASH_AUTO_MIN_T) is True
+    # unsupported compositions stay dense regardless of length
+    ring = dataclasses.replace(base, attn_impl="ring")
+    assert resolve_auto_flash(ring, LMMeshSpec(seq=2), 8192) is False
+    assert resolve_auto_flash(base, LMMeshSpec(seq=2), 8192) is False
+    bidir = dataclasses.replace(base, causal=False)
+    assert resolve_auto_flash(bidir, spec, 8192) is False
+    # ulysses attends the full sequence per head group: supported
+    uly = dataclasses.replace(base, attn_impl="ulysses")
+    assert resolve_auto_flash(uly, LMMeshSpec(seq=2), 8192) is True
+    # heads must shard over 'model' for the manual core: fall back to dense
+    assert resolve_auto_flash(base, LMMeshSpec(model=3), 8192) is False
+
+
+def test_flash_rejects_unknown_string():
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    cfg = LMConfig(
+        vocab_size=32, d_model=32, n_layers=1, n_heads=4, head_dim=8,
+        d_ff=64, compute_dtype="float32", remat=False, flash="off",
+    )
+    with pytest.raises(ValueError, match="flash must be"):
+        make_lm_step_fns(
+            cfg, LMMeshSpec(), optax.adam(1e-3), jax.random.key(0), 4, 16
+        )
+
+
+def test_flash_auto_short_seq_trains_dense():
+    """auto at short T resolves to the dense path and steps fine — in
+    particular the auto+ring composition must resolve instead of hitting
+    the flash/ring ValueError."""
+    import jax
+    import numpy as np
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    for attn, spec in (("dense", LMMeshSpec()), ("ring", LMMeshSpec(seq=2))):
+        cfg = LMConfig(
+            vocab_size=32, d_model=32, n_layers=1, n_heads=4, head_dim=8,
+            d_ff=64, compute_dtype="float32", remat=False,
+            attn_impl=attn, flash="auto",
+        )
+        fns = make_lm_step_fns(
+            cfg, spec, optax.adam(1e-3), jax.random.key(0), 4, 16,
+        )
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, 32, (4, 17))
+        )
+        state, m = fns.train(fns.init_state(), toks[:, :-1], toks[:, 1:])
+        assert np.isfinite(float(m["loss"]))

@@ -35,7 +35,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ddl_tpu.utils.timing import fence
 
-__all__ = ["PingPongResult", "ping_pong", "collective_bandwidth", "run_comm_bench"]
+__all__ = [
+    "PingPongResult",
+    "ping_pong",
+    "collective_bandwidth",
+    "axis_bandwidth_sweep",
+    "run_comm_bench",
+]
+
+COLLECTIVE_OPS = ("psum", "all_gather", "reduce_scatter", "ppermute", "all_to_all")
 
 DEFAULT_PAYLOAD_ELEMS = 1024 * 1024  # 4 MiB fp32, reference communication_time.py:18
 
@@ -114,30 +122,55 @@ def collective_bandwidth(
     mesh: Mesh | None = None,
     payload_elems: int = DEFAULT_PAYLOAD_ELEMS,
     iterations: int = 50,
+    axis: str | None = None,
 ) -> dict:
-    """Algorithmic bandwidth of psum / all_gather / ppermute over the mesh.
+    """Algorithmic bandwidth of one collective over one mesh axis.
+
+    ``axis`` defaults to the mesh's first axis; on a multi-axis mesh the
+    collective runs *within* the groups of that axis (the other axes stay
+    idle), which is exactly how the training programs issue them — so a
+    per-axis sweep attributes link bandwidth to the mesh axis that will
+    carry each collective (DP grads on ``data``, Ulysses ``all_to_all`` on
+    ``seq``, TP all-reduce on ``model``, stage handoff on ``pipe``).
 
     algbw = bytes_moved_per_device / time; for psum the standard convention
     bytes = 2 * (n-1)/n * payload (reduce-scatter + all-gather phases).
     """
     mesh = mesh or _ring_mesh()
-    n = mesh.devices.size
+    axis = axis or mesh.axis_names[0]
+    n = mesh.shape[axis]
+    # tiled reduce_scatter/all_to_all need the per-device shard divisible
+    # by the axis size — round up so odd axis sizes (3, 5, 6 on real pods)
+    # measure instead of aborting; payload_bytes reports the actual size
+    payload_elems = -(-payload_elems // n) * n
     ring = [(i, (i + 1) % n) for i in range(n)]
 
     if op == "psum":
-        body, out_spec = (lambda v: lax.psum(v, "ring")), P("ring")
+        body, out_spec = (lambda v: lax.psum(v, axis)), P(axis)
     elif op == "all_gather":
-        body, out_spec = (lambda v: lax.all_gather(v, "ring", tiled=True)), P()
+        body, out_spec = (lambda v: lax.all_gather(v, axis, tiled=True)), P()
     elif op == "reduce_scatter":
-        body, out_spec = (lambda v: lax.psum_scatter(v, "ring", tiled=True)), P("ring")
+        body, out_spec = (lambda v: lax.psum_scatter(v, axis, tiled=True)), P(axis)
     elif op == "ppermute":
-        body, out_spec = (lambda v: lax.ppermute(v, "ring", ring)), P("ring")
+        body, out_spec = (lambda v: lax.ppermute(v, axis, ring)), P(axis)
+    elif op == "all_to_all":
+        # the Ulysses hot collective (parallel/ulysses.py): each device
+        # splits its shard n ways and exchanges — (n-1)/n of it crosses
+        # the links
+        body, out_spec = (
+            lambda v: lax.all_to_all(v, axis, 0, 0, tiled=True),
+            P(axis),
+        )
     else:
         raise ValueError(op)
 
     fn = jax.jit(
         jax.shard_map(
-            body, mesh=mesh, in_specs=P("ring"), out_specs=out_spec, check_vma=False
+            body,
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=out_spec,
+            check_vma=False,
         )
     )
     x = jnp.ones((n * payload_elems,), jnp.float32)
@@ -154,12 +187,13 @@ def collective_bandwidth(
     elif op == "all_gather":
         # per-device shard is payload_bytes; gathered result n * payload
         moved = (n - 1) / n * (payload_bytes * n)
-    elif op == "reduce_scatter":
+    elif op in ("reduce_scatter", "all_to_all"):
         moved = (n - 1) / n * payload_bytes
     else:
         moved = payload_bytes
     return {
         "op": op,
+        "axis": axis,
         "devices": n,
         "payload_bytes": payload_bytes,
         "mean_ms": elapsed * 1e3,
@@ -167,10 +201,37 @@ def collective_bandwidth(
     }
 
 
+def axis_bandwidth_sweep(
+    mesh: Mesh,
+    ops: tuple[str, ...] = COLLECTIVE_OPS,
+    payload_elems: int = DEFAULT_PAYLOAD_ELEMS,
+    iterations: int = 50,
+) -> dict[str, dict[str, dict]]:
+    """Run every collective over every non-trivial axis of ``mesh``.
+
+    Returns ``{axis: {op: collective_bandwidth result}}`` — on a real pod
+    this shows which axes ride ICI vs DCN (the reference measured exactly
+    this split by hand: ~10.6 GB/s intra-node vs ~0.23 GB/s inter-node,
+    SURVEY.md §6), so shardings can be laid out to put the chatty
+    collectives on the fast axes."""
+    out: dict[str, dict[str, dict]] = {}
+    for axis in mesh.axis_names:
+        if mesh.shape[axis] < 2:
+            continue
+        out[axis] = {
+            op: collective_bandwidth(
+                op, mesh, payload_elems, iterations, axis=axis
+            )
+            for op in ops
+        }
+    return out
+
+
 def run_comm_bench(
     log_dir: str | os.PathLike = "training_logs",
     job_id: str | None = None,
     iterations: int = 1000,
+    payload_elems: int = DEFAULT_PAYLOAD_ELEMS,
 ) -> dict:
     """Full microbenchmark: ping-pong CSV (reference-compatible rows) +
     collective bandwidth sweep.  Returns a summary dict."""
@@ -181,24 +242,60 @@ def run_comm_bench(
 
     summary: dict = {"job_id": job_id, "devices": len(jax.devices())}
     if len(jax.devices()) >= 2:
-        pp = ping_pong(iterations=iterations)
+        pp = ping_pong(iterations=iterations, payload_elems=payload_elems)
         with open(os.path.join(log_dir, "communication_time.csv"), "a") as f:
             for i, t in enumerate(pp.times_ms):
                 f.write(f"{job_id},{i},{t}\n")
         summary["ping_pong_mean_ms"] = pp.mean_ms
         summary["ping_pong_one_way_gbps"] = pp.one_way_gbps
-        for op in ("psum", "all_gather", "reduce_scatter", "ppermute"):
-            r = collective_bandwidth(op)
+        for op in COLLECTIVE_OPS:
+            r = collective_bandwidth(op, payload_elems=payload_elems)
             summary[f"{op}_gbps"] = r["algbw_gbps"]
             summary[f"{op}_ms"] = r["mean_ms"]
     else:
         # Single-chip: report HBM-loopback psum as a degenerate datapoint.
-        r = collective_bandwidth("psum", mesh=_ring_mesh(1))
+        r = collective_bandwidth(
+            "psum", mesh=_ring_mesh(1), payload_elems=payload_elems
+        )
         summary["psum_ms"] = r["mean_ms"]
     return summary
 
 
 if __name__ == "__main__":
+    import argparse
     import json
 
-    print(json.dumps(run_comm_bench(), indent=2))
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--iterations", type=int, default=None,
+                    help="samples per measurement (default: 1000 flat, "
+                    "100 per op/axis with --mesh)")
+    ap.add_argument("--payload-elems", type=int, default=DEFAULT_PAYLOAD_ELEMS)
+    ap.add_argument(
+        "--mesh", default=None,
+        help="per-axis sweep over a named mesh, e.g. 'data=2,seq=2,model=2' "
+        "(axis sizes must multiply to <= device count); omitted = flat "
+        "2-device ping-pong + single-axis collective sweep",
+    )
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="simulate N CPU devices (dev/test)")
+    args = ap.parse_args()
+    if args.cpu_devices:
+        from ddl_tpu.launch import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
+
+    if args.mesh:
+        axes = dict(kv.split("=") for kv in args.mesh.split(","))
+        names, sizes = tuple(axes), tuple(int(v) for v in axes.values())
+        need = int(np.prod(sizes))
+        mesh = Mesh(np.array(jax.devices()[:need]).reshape(sizes), names)
+        sweep = axis_bandwidth_sweep(
+            mesh, payload_elems=args.payload_elems,
+            iterations=args.iterations or 100,
+        )
+        print(json.dumps(sweep, indent=2))
+    else:
+        print(json.dumps(run_comm_bench(
+            iterations=args.iterations or 1000,
+            payload_elems=args.payload_elems,
+        ), indent=2))

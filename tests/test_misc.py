@@ -113,12 +113,39 @@ class TestCommBench:
         assert np.isfinite(r.mean_ms) and r.mean_ms > 0
         assert r.one_way_gbps > 0
 
-    @pytest.mark.parametrize("op", ["psum", "all_gather", "reduce_scatter", "ppermute"])
+    @pytest.mark.parametrize(
+        "op", ["psum", "all_gather", "reduce_scatter", "ppermute", "all_to_all"]
+    )
     def test_collective_bandwidth(self, op):
         from ddl_tpu.bench.comm import collective_bandwidth
 
         r = collective_bandwidth(op, payload_elems=1024, iterations=3)
         assert np.isfinite(r["algbw_gbps"]) and r["algbw_gbps"] > 0
+
+    def test_axis_sweep_covers_every_nontrivial_axis(self):
+        """Per-axis attribution (the Ulysses all_to_all rides 'seq', DP
+        grads ride 'data'): every axis with size > 1 gets every op; size-1
+        axes are skipped."""
+        import jax
+        from jax.sharding import Mesh
+
+        from ddl_tpu.bench.comm import COLLECTIVE_OPS, axis_bandwidth_sweep
+
+        mesh = Mesh(
+            np.array(jax.devices()[:8]).reshape(2, 1, 4),
+            ("data", "pipe", "model"),
+        )
+        sweep = axis_bandwidth_sweep(mesh, payload_elems=512, iterations=2)
+        assert set(sweep) == {"data", "model"}  # pipe=1 skipped
+        for axis, per_op in sweep.items():
+            assert set(per_op) == set(COLLECTIVE_OPS)
+            for op, r in per_op.items():
+                assert r["axis"] == axis
+                assert np.isfinite(r["algbw_gbps"]) and r["algbw_gbps"] > 0, (
+                    axis, op,
+                )
+        assert sweep["data"]["psum"]["devices"] == 2
+        assert sweep["model"]["psum"]["devices"] == 4
 
     def test_run_comm_bench_writes_reference_csv(self, tmp_path):
         from ddl_tpu.bench.comm import run_comm_bench

@@ -288,6 +288,12 @@ if __name__ == "__main__":
         axes = dict(kv.split("=") for kv in args.mesh.split(","))
         names, sizes = tuple(axes), tuple(int(v) for v in axes.values())
         need = int(np.prod(sizes))
+        have = len(jax.devices())
+        if need > have:
+            ap.error(
+                f"--mesh {args.mesh} needs {need} devices, have {have} "
+                "(axis sizes must multiply to <= device count)"
+            )
         mesh = Mesh(np.array(jax.devices()[:need]).reshape(sizes), names)
         sweep = axis_bandwidth_sweep(
             mesh, payload_elems=args.payload_elems,

@@ -369,6 +369,7 @@ def make_blocks_pipeline_1f1b(
     aux_cotangent: float,
     zero_metrics,
     dropout: bool = False,
+    virtual: int = 1,
 ):
     """One-forward-one-backward interleaved schedule over the uniform block
     stack — the forward AND backward pipeline in a single scan, with the loss
@@ -412,51 +413,131 @@ def make_blocks_pipeline_1f1b(
     numerically equivalent to the GPipe schedule (tested to 1e-5 by
     ``tests/test_lm_pipeline.py``): same math and microbatch order, though
     the last-stage CE uses a different formulation.
+
+    ``virtual > 1`` runs the *interleaved* 1F1B (Megatron's combined
+    schedule): device ``s`` holds ``V`` non-contiguous chunks (global stage
+    ``sigma = c*P + s``, same placement as
+    ``make_blocks_pipeline_interleaved``), the forward follows that
+    schedule's group-of-P timing ``t_f = g*V*P + c*P + r + s``, and the
+    backward mirrors it at ``t_b = (VP-1) + g*V*P + (V-1-c)*P + r +
+    (P-1-s)`` — for ``V = 1`` these reduce exactly to the timetable above
+    (``t_b = m + 2(P-1) - s``).  The schedule closes in ``MV + VP + P - 2``
+    ticks of 1/V-stage fwd+bwd work vs autodiff-interleaved-GPipe's
+    ``2(MV + P - 1)``, and stage-input residency is ``V * min(2VP, M)``
+    microbatch buffers vs the GPipe scan's ``M * V``.  Requires
+    ``M % P == 0`` (microbatches advance in groups of P, like the
+    interleaved forward); ``blocks_stacked`` leaves are
+    ``(P, V, layers_per_chunk, ...)``.
     """
-    P_, M = n_stages, num_microbatches
+    P_, V, M = n_stages, virtual, num_microbatches
     last = P_ - 1
+    VP = V * P_
     d = d_model
     raw_stage_fn = _make_stage_fn(block_mod, dropout)
-    # A microbatch's stage input is written at tick f+s and consumed by its
-    # backward at tick f+2(P-1)-s: lifetime 2(P-1-s) ticks, so depth
-    # 2(P-1)+1 (stage 0's worst case) always suffices; M slots suffice when
-    # M is smaller because at most M microbatches are in flight.
-    depth = min(2 * last + 1, M)
+    if V == 1:
+        # A microbatch's stage input is written at tick f+s and consumed by
+        # its backward at tick f+2(P-1)-s: lifetime 2(P-1-s) ticks, so depth
+        # 2(P-1)+1 (stage 0's worst case) always suffices; M slots suffice
+        # when M is smaller because at most M microbatches are in flight.
+        depth = min(2 * last + 1, M)
+        n_ticks = M + 2 * last
+        # forward handoff crosses stage boundaries only; no wrap traffic
+        fwd_ring = [(i, i + 1) for i in range(last)]
+        bwd_ring = [(i + 1, i) for i in range(last)]
+    else:
+        # interleaved: worst-case input lifetime is 2VP-2 ticks (chunk 0,
+        # device 0); consecutive microbatches of one chunk are >= 1 tick
+        # apart, so min(2VP, M) slots (both multiples of P) suffice.
+        depth = min(2 * VP, M)
+        n_ticks = M * V + VP + P_ - 2
+        # full rings: the wrap carries chunk boundaries (c -> c+1 forward
+        # on P-1 -> 0, and the reverse on 0 -> P-1)
+        fwd_ring = [(i, (i + 1) % P_) for i in range(P_)]
+        bwd_ring = [((i + 1) % P_, i) for i in range(P_)]
 
     def pipeline_body(blocks_stacked, head_params, x_mb, tgt_mb, *step_key):
-        stage_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
+        local_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
         s = lax.axis_index(PIPE_AXIS)
         t_len = x_mb.shape[2]
 
         def tick(carry, t):
             fwd_buf, bwd_buf, resid, dx_acc, g_blocks, g_head, met, aux = carry
-            f_idx = jnp.clip(t - s, 0, M - 1)
-            fwd_valid = (t >= s) & (t - s < M)
-            off = 2 * last - s
-            b_idx = jnp.clip(t - off, 0, M - 1)
-            bwd_valid = (t >= off) & (t - off < M)
+            if V == 1:
+                c_f = c_b = 0
+                f_idx = jnp.clip(t - s, 0, M - 1)
+                fwd_valid = (t >= s) & (t - s < M)
+                off = 2 * last - s
+                b_idx = jnp.clip(t - off, 0, M - 1)
+                bwd_valid = (t >= off) & (t - off < M)
+                chunk_f = chunk_b = local_blocks
+            else:
+                rel_f = t - s
+                g_f = jnp.clip(rel_f // VP, 0, M // P_ - 1)
+                u_f = jnp.clip(rel_f - g_f * VP, 0, VP - 1)
+                c_f = u_f // P_
+                f_idx = jnp.clip(g_f * P_ + (u_f - c_f * P_), 0, M - 1)
+                fwd_valid = (rel_f >= 0) & (rel_f < M * V)
+                rel_b = t - (VP - 1) - (last - s)
+                g_b = jnp.clip(rel_b // VP, 0, M // P_ - 1)
+                u_b = jnp.clip(rel_b - g_b * VP, 0, VP - 1)
+                cp = u_b // P_
+                c_b = (V - 1) - cp
+                b_idx = jnp.clip(g_b * P_ + (u_b - cp * P_), 0, M - 1)
+                bwd_valid = (rel_b >= 0) & (rel_b < M * V)
+                chunk_f = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(
+                        a, c_f, 0, keepdims=False
+                    ),
+                    local_blocks,
+                )
+                chunk_b = jax.tree.map(
+                    lambda a: lax.dynamic_index_in_dim(
+                        a, c_b, 0, keepdims=False
+                    ),
+                    local_blocks,
+                )
 
             if dropout:
-                # the same (microbatch, stage) key on the forward tick and
-                # on the backward tick's recompute — identical masks, exact
-                # gradients
+                # the same (microbatch, global stage) key on the forward
+                # tick and on the backward tick's recompute — identical
+                # masks, exact gradients (matches interleaved GPipe keying)
                 fwd_stage_fn = lambda blocks, x: raw_stage_fn(
-                    blocks, x, _mb_stage_key(step_key[0], f_idx, s)
+                    blocks, x, _mb_stage_key(step_key[0], f_idx, c_f * P_ + s)
                 )
                 bwd_stage_fn = lambda blocks, x: raw_stage_fn(
-                    blocks, x, _mb_stage_key(step_key[0], b_idx, s)
+                    blocks, x, _mb_stage_key(step_key[0], b_idx, c_b * P_ + s)
                 )
             else:
                 fwd_stage_fn = bwd_stage_fn = raw_stage_fn
 
             x_first = lax.dynamic_index_in_dim(x_mb, f_idx, 0, keepdims=False)
-            x_in = jnp.where(s == 0, x_first, fwd_buf)
-            resid = jnp.where(
-                fwd_valid,
-                lax.dynamic_update_index_in_dim(resid, x_in, f_idx % depth, 0),
-                resid,
-            )
-            x_b = lax.dynamic_index_in_dim(resid, b_idx % depth, 0, keepdims=False)
+            x_in = jnp.where((s == 0) & (c_f == 0), x_first, fwd_buf)
+            if V == 1:
+                resid = jnp.where(
+                    fwd_valid,
+                    lax.dynamic_update_index_in_dim(
+                        resid, x_in, f_idx % depth, 0
+                    ),
+                    resid,
+                )
+                x_b = lax.dynamic_index_in_dim(
+                    resid, b_idx % depth, 0, keepdims=False
+                )
+            else:
+                resid = jnp.where(
+                    fwd_valid,
+                    lax.dynamic_update_slice(
+                        resid,
+                        x_in[None, None].astype(resid.dtype),
+                        (c_f, f_idx % depth, 0, 0, 0),
+                    ),
+                    resid,
+                )
+                x_b = lax.dynamic_slice(
+                    resid,
+                    (c_b, b_idx % depth, 0, 0, 0),
+                    (1, 1, mb, t_len, d),
+                )[0, 0]
             tgt_b = jax.tree.map(
                 lambda a: lax.dynamic_index_in_dim(a, b_idx, 0, keepdims=False),
                 tgt_mb,
@@ -472,8 +553,8 @@ def make_blocks_pipeline_1f1b(
             # a cond: its collectives (TP/data/seq all-reduces from GSPMD)
             # are per-group ops whose groups lie within one pipe
             # coordinate, so every participant agrees on the branch.
-            out, _ = fwd_stage_fn(stage_blocks, x_in)
-            (y_b, aux_b), stage_vjp = jax.vjp(bwd_stage_fn, stage_blocks, x_b)
+            out, _ = fwd_stage_fn(chunk_f, x_in)
+            (y_b, aux_b), stage_vjp = jax.vjp(bwd_stage_fn, chunk_b, x_b)
 
             def last_branch(y):
                 # the loss supplies the output cotangent: vjp through
@@ -492,7 +573,10 @@ def make_blocks_pipeline_1f1b(
                 dh = jax.tree.map(jnp.zeros_like, head_params)
                 return dh, bwd_buf.astype(y.dtype), zero_metrics
 
-            dh, g_y, m = lax.cond(s == last, last_branch, mid_branch, y_b)
+            # head epilogue on the last GLOBAL stage (device P-1, chunk V-1)
+            dh, g_y, m = lax.cond(
+                (s == last) & (c_b == V - 1), last_branch, mid_branch, y_b
+            )
             db, dx = stage_vjp(
                 (g_y, jnp.asarray(aux_cotangent, jnp.float32))
             )
@@ -504,40 +588,54 @@ def make_blocks_pipeline_1f1b(
                     new,
                 )
 
-            g_blocks, g_head, met = acc(g_blocks, db), acc(g_head, dh), acc(met, m)
+            if V == 1:
+                g_blocks = acc(g_blocks, db)
+            else:
+                # scatter-accumulate this tick's chunk gradient at c_b
+                g_blocks = jax.tree.map(
+                    lambda g, n: lax.dynamic_update_index_in_dim(
+                        g,
+                        lax.dynamic_index_in_dim(g, c_b, 0, keepdims=False)
+                        + jnp.where(bwd_valid, n, jnp.zeros_like(n)),
+                        c_b,
+                        0,
+                    ),
+                    g_blocks,
+                    db,
+                )
+            g_head, met = acc(g_head, dh), acc(met, m)
             aux = aux + jnp.where(bwd_valid, aux_b, 0.0)
             dx_acc = jnp.where(
-                bwd_valid & (s == 0),
+                bwd_valid & (s == 0) & (c_b == 0),
                 lax.dynamic_update_index_in_dim(
                     dx_acc, dx.astype(compute_dtype), b_idx, 0
                 ),
                 dx_acc,
             )
             fwd_buf = lax.ppermute(
-                out.astype(compute_dtype),
-                PIPE_AXIS,
-                [(i, i + 1) for i in range(last)],
+                out.astype(compute_dtype), PIPE_AXIS, fwd_ring
             )
             bwd_buf = lax.ppermute(
-                dx.astype(compute_dtype),
-                PIPE_AXIS,
-                [(i + 1, i) for i in range(last)],
+                dx.astype(compute_dtype), PIPE_AXIS, bwd_ring
             )
             return (fwd_buf, bwd_buf, resid, dx_acc, g_blocks, g_head, met, aux), None
 
         buf0 = jnp.zeros((mb, t_len, d), compute_dtype)
+        resid_shape = (
+            (depth, mb, t_len, d) if V == 1 else (V, depth, mb, t_len, d)
+        )
         init = (
             buf0,
             buf0,
-            jnp.zeros((depth, mb, t_len, d), compute_dtype),
+            jnp.zeros(resid_shape, compute_dtype),
             jnp.zeros((M, mb, t_len, d), compute_dtype),
-            jax.tree.map(jnp.zeros_like, stage_blocks),
+            jax.tree.map(jnp.zeros_like, local_blocks),
             jax.tree.map(jnp.zeros_like, head_params),
             zero_metrics,
             jnp.zeros((), jnp.float32),
         )
         (_, _, _, dx_acc, g_blocks, g_head, met, aux), _ = lax.scan(
-            tick, init, jnp.arange(M + 2 * last)
+            tick, init, jnp.arange(n_ticks)
         )
         # stage grads stay pipe-stacked like their primal; everything else
         # lives on one coordinate (head/metrics on the last, dx on the
@@ -838,11 +936,6 @@ def make_lm_pipeline_step_fns(
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if V < 1:
         raise ValueError(f"virtual_stages must be >= 1, got {V}")
-    if V > 1 and schedule != "gpipe":
-        raise ValueError(
-            "virtual_stages > 1 (interleaved schedule) is only implemented "
-            "for schedule='gpipe'"
-        )
     if V > 1 and M % n_stages:
         raise ValueError(
             f"num_microbatches {M} % pipe {n_stages} != 0 (the interleaved "
@@ -1086,6 +1179,7 @@ def make_lm_pipeline_step_fns(
             aux_cotangent=cfg.moe_aux_weight / M,
             zero_metrics=jnp.zeros((), jnp.float32),
             dropout=use_dropout,
+            virtual=V,
         )
 
         def manual_grad_fn(params, inputs, targets, step=None):
@@ -1105,7 +1199,7 @@ def make_lm_pipeline_step_fns(
                     (dropout_step_key(rng, step),) if use_dropout else ()
                 )
                 g_blocks, g_head, dx_mb, ce_sum, aux_sum = pipeline_1f1b(
-                    params["blocks"], params["head"], x_mb, tgt_mb, *key_args
+                    blocks_of(params), params["head"], x_mb, tgt_mb, *key_args
                 )
                 # close the gradient path GPipe's shard_map transpose handles
                 (g_embed,) = embed_vjp(
@@ -1114,7 +1208,11 @@ def make_lm_pipeline_step_fns(
             ce = ce_sum / M
             moe_aux = aux_sum / M
             loss = ce + cfg.moe_aux_weight * moe_aux
-            grads = {"embed": g_embed, "blocks": g_blocks, "head": g_head}
+            grads = {
+                "embed": g_embed,
+                "blocks": wrap_blocks(g_blocks),
+                "head": g_head,
+            }
             return grads, {"loss": loss, "ce": ce, "moe_aux": moe_aux}
 
     return finalize_step_fns(

@@ -253,11 +253,6 @@ def _make_vit_pipeline_step_fns(
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if V < 1:
         raise ValueError(f"virtual_stages must be >= 1, got {V}")
-    if V > 1 and schedule != "gpipe":
-        raise ValueError(
-            "virtual_stages > 1 (interleaved schedule) is only implemented "
-            "for schedule='gpipe'"
-        )
     if V > 1 and M % n_stages:
         raise ValueError(
             f"num_microbatches {M} % pipe {n_stages} != 0 (the interleaved "
@@ -396,6 +391,7 @@ def _make_vit_pipeline_step_fns(
             aux_cotangent=0.0,  # ViT blocks have no MoE aux
             zero_metrics=jnp.zeros((2,), jnp.float32),
             dropout=use_dropout,
+            virtual=V,
         )
 
         def manual_grad_fn(params, images, labels, step=None):
@@ -413,12 +409,17 @@ def _make_vit_pipeline_step_fns(
                     (dropout_step_key(rng, step),) if use_dropout else ()
                 )
                 g_blocks, g_head, dx_mb, met, _aux = pipeline_1f1b(
-                    params["blocks"], params["head"], x_mb, lab_mb, *key_args
+                    blocks_of(params), params["head"],
+                    x_mb, lab_mb, *key_args
                 )
                 (g_embed,) = embed_vjp(
                     dx_mb.reshape(batch, T, d).astype(x.dtype)
                 )
-            grads = {"embed": g_embed, "blocks": g_blocks, "head": g_head}
+            grads = {
+                "embed": g_embed,
+                "blocks": wrap_blocks(g_blocks),
+                "head": g_head,
+            }
             return grads, {"loss": met[0] / M, "accuracy": met[1] / M}
 
     return _finalize_vit(mesh, tx, forward, create_state, rng,

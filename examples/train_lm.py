@@ -39,9 +39,9 @@ def main() -> None:
                     "O(pipe) stage-activation residency)")
     ap.add_argument("--virtual-stages", type=int, default=1,
                     help="interleaved pipeline: layer chunks per device "
-                    "(>1 shrinks the bubble by that factor; gpipe schedule, "
-                    "needs layers %% (pipe*V) == 0 and microbatches %% pipe "
-                    "== 0)")
+                    "(>1 shrinks the bubble by that factor; composes with "
+                    "either --pipeline-schedule; needs layers %% (pipe*V) "
+                    "== 0 and microbatches %% pipe == 0)")
     ap.add_argument("--accum", type=int, default=1,
                     help="gradient-accumulation chunks per step (pipe=1 only)")
     ap.add_argument("--dropout", type=float, default=0.0,

@@ -239,3 +239,25 @@ def test_pipeline_interleaved_matches_single():
             lambda a, b: float(np.max(np.abs(a - b))),
             ref[f"block{ell}"], stacked))
         assert err < 1e-4, (ell, err)
+
+
+def test_pipeline_interleaved_1f1b_matches_interleaved_gpipe():
+    """The combined interleaved-1F1B schedule on the ViT pipeline (shared
+    clock loop with the LM): same gradients as interleaved GPipe."""
+    cfg = _cfg(n_layers=4, dropout_rate=0.1)
+    tx = optax.adam(1e-3)
+    imgs, labels = _batch()
+    out = {}
+    for sched in ("gpipe", "1f1b"):
+        fns = make_vit_step_fns(cfg, LMMeshSpec(data=2, pipe=2), tx,
+                                jax.random.key(0), 8,
+                                devices=jax.devices()[:4],
+                                num_microbatches=4, virtual_stages=2,
+                                pipeline_schedule=sched)
+        s1, m = fns.train(fns.init_state(), imgs, labels)
+        out[sched] = (float(m["loss"]), jax.device_get(s1.params))
+    assert abs(out["gpipe"][0] - out["1f1b"][0]) < 1e-5
+    err = jax.tree.reduce(max, jax.tree.map(
+        lambda a, b: float(np.max(np.abs(a - b))),
+        out["gpipe"][1], out["1f1b"][1]))
+    assert err < 5e-5

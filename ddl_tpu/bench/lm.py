@@ -30,6 +30,8 @@ def main() -> None:
     ap.add_argument("--seq-len", type=int, default=1024)
     ap.add_argument("--d-model", type=int, default=768)
     ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention K/V head count (0 = MHA)")
     ap.add_argument("--vocab", type=int, default=50304)
     ap.add_argument("--flash", nargs="?", const="on", default="off",
                     choices=["on", "off", "auto"])
@@ -50,6 +52,7 @@ def main() -> None:
         d_model=args.d_model,
         n_layers=args.layers,
         n_heads=args.d_model // 64,
+        n_kv_heads=args.kv_heads,
         head_dim=64,
         d_ff=4 * args.d_model,
         compute_dtype="bfloat16",

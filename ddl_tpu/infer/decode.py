@@ -72,9 +72,14 @@ class LMDecode(nn.Module):
 def init_kv_cache(
     cfg: LMConfig, batch: int, max_len: int, dtype=None
 ) -> tuple:
-    """Per-layer zeroed ``(k, v)`` buffers of shape (B, max_len, H, Dh)."""
+    """Per-layer zeroed ``(k, v)`` buffers of shape (B, max_len, Hkv, Dh).
+
+    With grouped-query attention (``cfg.n_kv_heads``) the cache holds only
+    the K/V heads — an ``n_heads/n_kv_heads``-times smaller buffer, which
+    is GQA's decode-bandwidth win (the grouped ``dense_attention`` reads it
+    without re-materialising full heads)."""
     dtype = dtype or cfg.dtype
-    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
     zero = jnp.zeros(shape, dtype)
     return tuple((zero, zero) for _ in range(cfg.n_layers))
 
@@ -121,6 +126,9 @@ def make_lm_generator(
             raise ValueError(
                 f"top_k {top_k} out of range [1, vocab_size={cfg.vocab_size}]"
             )
+    from ddl_tpu.parallel.sharding import validate_kv_head_sharding
+
+    validate_kv_head_sharding(cfg, spec or LMMeshSpec())
     if mesh is None:
         mesh = build_lm_mesh(spec or LMMeshSpec(), devices)
     rules = lm_logical_rules(cfg.fsdp)

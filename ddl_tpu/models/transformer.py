@@ -52,6 +52,13 @@ class LMConfig:
     n_layers: int = 4
     n_heads: int = 8
     head_dim: int = 32
+    # Grouped-query attention: number of K/V heads (0 = n_heads, i.e.
+    # classic multi-head).  Each K/V head serves n_heads/n_kv_heads query
+    # heads — smaller K/V projections and an n_heads/n_kv_heads-times
+    # smaller decode cache (the Llama-2/Mistral recipe).  Must divide
+    # n_heads; with tensor parallelism it must also divide by the model
+    # axis so every shard holds whole K/V heads.
+    n_kv_heads: int = 0
     d_ff: int = 1024
     # MoE: 0 = dense MLP in every block; >0 = every block is a top-k MoE
     # with this many experts.
@@ -95,6 +102,17 @@ class LMConfig:
     # Training passes deterministic=False + a 'dropout' rng; eval/decode
     # leave the default deterministic=True.
     dropout_rate: float = 0.0
+
+    def __post_init__(self):
+        if self.n_kv_heads and self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads {self.n_heads} must divide by n_kv_heads "
+                f"{self.n_kv_heads} (grouped-query attention)"
+            )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
     @property
     def dtype(self):
@@ -189,18 +207,20 @@ class Attention(nn.Module):
             nn.initializers.lecun_normal(), ("embed", "heads")
         )
 
-        def proj(name):
+        def proj(name, heads):
             y = nn.Dense(
-                cfg.n_heads * cfg.head_dim,
+                heads * cfg.head_dim,
                 use_bias=False,
                 dtype=cfg.dtype,
                 param_dtype=jnp.float32,
                 kernel_init=qkv_kernel,
                 name=name,
             )(x)
-            return y.reshape(b, t, cfg.n_heads, cfg.head_dim)
+            return y.reshape(b, t, heads, cfg.head_dim)
 
-        q, k, v = proj("q"), proj("k"), proj("v")
+        q = proj("q", cfg.n_heads)
+        k = proj("k", cfg.kv_heads)
+        v = proj("v", cfg.kv_heads)
         positions = None
         if kv_cache is not None:
             positions = offset + jnp.arange(t)
@@ -211,6 +231,15 @@ class Attention(nn.Module):
         k = nn.with_logical_constraint(k, spec)
         v = nn.with_logical_constraint(v, spec)
         if kv_cache is None:
+            if cfg.kv_heads != cfg.n_heads and self.attn_core is not None:
+                # the manual cores (ring/Ulysses/flash) are written for
+                # equal head counts: broadcast each K/V head over its query
+                # group up front (XLA fuses the broadcast into the core's
+                # matmuls; the projection/cache savings are unaffected).
+                # The default dense core groups natively — no repeat.
+                g = cfg.n_heads // cfg.kv_heads
+                k = jnp.repeat(k, g, axis=2)
+                v = jnp.repeat(v, g, axis=2)
             core = self.attn_core or partial(dense_attention, causal=cfg.causal)
             o = nn.with_logical_constraint(core(q, k, v), spec)
             new_cache = None

@@ -17,16 +17,33 @@ __all__ = ["dense_attention"]
 
 
 def dense_attention(q, k, v, causal: bool = False, mask=None):
-    """Full softmax attention. q: (B, Tq, H, D), k/v: (B, Tk, H, D) ->
+    """Full softmax attention. q: (B, Tq, H, D), k/v: (B, Tk, Hkv, D) ->
     (B, Tq, H, D).  ``mask`` is an explicit (Tq, Tk) bool mask (True =
     attend) for cross-length cases like KV-cache decode; ``causal`` builds
-    the square tril mask."""
-    d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    the square tril mask.
+
+    Grouped-query attention: when ``Hkv < H`` (``H % Hkv == 0``), each K/V
+    head serves a group of ``H/Hkv`` query heads.  The grouping is done by
+    reshaping the query — the K/V tensors are never materialised at H heads,
+    so a (B, L, Hkv, D) decode cache is read as-is at its reduced bandwidth.
+    """
+    b, tq, h, d = q.shape
+    hkv = k.shape[2]
     if causal and mask is None:
-        t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
+        mask = jnp.tril(jnp.ones((tq, tq), bool))
+    scale = jnp.sqrt(jnp.asarray(d, q.dtype))
+    if hkv == h:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / scale
+        if mask is not None:
+            scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if h % hkv:
+        raise ValueError(f"q heads {h} must divide by kv heads {hkv}")
+    g = h // hkv
+    qg = q.reshape(b, tq, hkv, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) / scale
     if mask is not None:
-        scores = jnp.where(mask[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, tq, h, d)

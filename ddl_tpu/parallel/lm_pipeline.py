@@ -82,6 +82,7 @@ from ddl_tpu.parallel.sharding import (
     build_lm_mesh,
     lm_logical_rules,
     normalize_flash,
+    validate_kv_head_sharding,
 )
 from ddl_tpu.train.lm_steps import (
     LMStepFns,
@@ -928,6 +929,7 @@ def make_lm_pipeline_step_fns(
     buffers stay O(batch) under both schedules — same gradients).
     Evaluation always uses the forward-only GPipe schedule."""
     cfg = normalize_flash(cfg, spec, seq_len)  # resolve flash="auto"
+    validate_kv_head_sharding(cfg, spec)
     n_stages, M = spec.pipe, num_microbatches
     V = virtual_stages
     if n_stages < 2:

@@ -37,6 +37,7 @@ __all__ = [
     "lm_logical_rules",
     "resolve_auto_flash",
     "normalize_flash",
+    "validate_kv_head_sharding",
     "FLASH_AUTO_MIN_T",
     "SEQ_AXIS",
     "MODEL_AXIS",
@@ -71,6 +72,18 @@ def resolve_auto_flash(cfg, spec: "LMMeshSpec", seq_len: int) -> bool:
     if cfg.n_heads % spec.model:
         return False  # manual core shards heads over 'model'
     return seq_len >= FLASH_AUTO_MIN_T
+
+
+def validate_kv_head_sharding(cfg, spec: "LMMeshSpec") -> None:
+    """Grouped-query attention under tensor parallelism: every model-axis
+    shard must hold whole K/V heads.  One check shared by all three TP
+    entry points (flat steps, pipeline steps, decode generator) so the
+    invariant is enforced consistently."""
+    if spec.model > 1 and cfg.kv_heads % spec.model:
+        raise ValueError(
+            f"n_kv_heads {cfg.kv_heads} must divide by mesh "
+            f"model={spec.model} (each shard must hold whole K/V heads)"
+        )
 
 
 def normalize_flash(cfg, spec: "LMMeshSpec", seq_len: int):

@@ -34,6 +34,7 @@ from ddl_tpu.parallel.sharding import (
     lm_logical_rules,
     normalize_flash,
     resolve_auto_flash,  # noqa: F401  (re-exported for tests/tools)
+    validate_kv_head_sharding,
 )
 from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
 
@@ -274,6 +275,7 @@ def make_lm_step_fns(
     if pipeline_schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
     cfg = normalize_flash(cfg, spec, seq_len)
+    validate_kv_head_sharding(cfg, spec)
     if spec.pipe > 1:
         if accum_steps > 1:
             raise ValueError(

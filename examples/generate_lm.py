@@ -35,6 +35,8 @@ def main() -> None:
                     help="tensor-parallel axis for decode")
     ap.add_argument("--d-model", type=int, default=128)
     ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="must match the training run's --kv-heads (GQA)")
     ap.add_argument("--experts", type=int, default=0)
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--prompt-text", default=None,
@@ -76,6 +78,7 @@ def main() -> None:
         d_model=args.d_model,
         n_layers=args.layers,
         n_heads=8,
+        n_kv_heads=args.kv_heads,
         head_dim=args.d_model // 8,
         d_ff=4 * args.d_model,
         num_experts=args.experts,

@@ -86,6 +86,10 @@ def main() -> None:
                     help=">0 enables global-norm gradient clipping")
     ap.add_argument("--d-model", type=int, default=128)
     ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention: K/V head count "
+                    "(0 = same as query heads; must divide the 8 query "
+                    "heads — smaller K/V projections and decode cache)")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="simulate N CPU devices (dev/test)")
     ap.add_argument("--checkpoint-dir", default=None,
@@ -128,6 +132,7 @@ def main() -> None:
         d_model=args.d_model,
         n_layers=args.layers,
         n_heads=8,
+        n_kv_heads=args.kv_heads,
         head_dim=args.d_model // 8,
         d_ff=4 * args.d_model,
         num_experts=args.experts,

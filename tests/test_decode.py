@@ -171,3 +171,25 @@ def test_top_k_sampling_restricts_support():
         np.asarray(greedy(params, prompt)),
         np.asarray(k1(params, prompt, jax.random.key(3))),
     )
+
+
+def test_gqa_incremental_matches_full_forward():
+    """Grouped-query attention (n_kv_heads < n_heads): the reduced-head KV
+    cache and grouped dense_attention reproduce the training forward's
+    logits token by token."""
+    cfg = _cfg(n_heads=4, n_kv_heads=2)
+    b, t = 2, 6
+    params = _params(cfg, b, t)
+    toks = jnp.asarray(np.random.default_rng(1).integers(0, 32, (b, t)))
+    ref_logits, _ = TransformerLM(cfg, None).apply({"params": params}, toks)
+
+    caches = init_kv_cache(cfg, b, t)
+    assert caches[0][0].shape == (b, t, 2, 8)  # Hkv=2, half the MHA cache
+    dec = LMDecode(cfg)
+    for i in range(t):
+        logits, caches = dec.apply(
+            {"params": params}, toks[:, i : i + 1], caches, i
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(ref_logits[:, i]), atol=1e-5
+        )

@@ -57,3 +57,32 @@ def test_softmax_cross_entropy_gradient_is_softmax_minus_onehot():
     p /= p.sum()
     p[2] -= 1
     np.testing.assert_allclose(np.asarray(g[0]), p, atol=1e-6)
+
+
+class TestGroupedDenseAttention:
+    def test_grouped_matches_repeated_kv(self):
+        """GQA grouping == materially repeating each K/V head over its
+        query group (the definition), causal and masked variants."""
+        from ddl_tpu.ops.attention import dense_attention
+
+        rng = np.random.default_rng(0)
+        b, t, h, hkv, d = 2, 8, 6, 2, 4
+        q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(b, t, hkv, d)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(b, t, hkv, d)), jnp.float32)
+        grouped = dense_attention(q, k, v, causal=True)
+        repeated = dense_attention(
+            q, jnp.repeat(k, h // hkv, 2), jnp.repeat(v, h // hkv, 2),
+            causal=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(grouped), np.asarray(repeated), atol=1e-6
+        )
+
+    def test_indivisible_heads_raise(self):
+        from ddl_tpu.ops.attention import dense_attention
+
+        q = jnp.zeros((1, 4, 6, 4))
+        kv = jnp.zeros((1, 4, 4, 4))
+        with pytest.raises(ValueError, match="divide"):
+            dense_attention(q, kv, kv, causal=True)

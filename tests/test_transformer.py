@@ -106,6 +106,32 @@ class TestStrategyEquivalence:
         )
         np.testing.assert_allclose(ref_losses, par_losses, atol=1e-4)
 
+    def test_gqa_sharded_matches_single(self):
+        """Grouped-query attention under TP: single device == (data=2,
+        model=2), and the ring core (which sees broadcast K/V heads) ==
+        dense — same GQA math everywhere."""
+        cfg = tiny_cfg(n_kv_heads=2)
+        ref, ref_losses = run_steps(cfg, LMMeshSpec())
+        # K/V projections really are reduced: (d_model, Hkv*Dh)
+        k_kernel = ref.params["block0"]["attn"]["k"]["kernel"]
+        assert k_kernel.shape == (32, 2 * 8)
+        par, par_losses = run_steps(cfg, LMMeshSpec(data=2, model=2))
+        np.testing.assert_allclose(ref_losses, par_losses, atol=1e-4)
+        assert_state_close(ref, par, atol=1e-4)
+        ring, ring_losses = run_steps(
+            tiny_cfg(n_kv_heads=2, attn_impl="ring"), LMMeshSpec(seq=2)
+        )
+        np.testing.assert_allclose(ref_losses, ring_losses, atol=1e-4)
+
+    def test_gqa_tp_requires_whole_kv_heads(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            make_lm_step_fns(
+                tiny_cfg(n_kv_heads=2), LMMeshSpec(model=4),
+                optax.adam(1e-3), jax.random.key(0), 4, 16,
+            )
+
 
 class TestLearning:
     def test_remat_policy_invariance(self):

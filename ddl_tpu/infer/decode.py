@@ -38,7 +38,12 @@ from ddl_tpu.models.transformer import (
     apply_final_norm_and_head,
     make_embed,
 )
-from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
+from ddl_tpu.parallel.sharding import (
+    LMMeshSpec,
+    build_lm_mesh,
+    lm_logical_rules,
+    validate_kv_head_sharding,
+)
 
 __all__ = ["LMDecode", "init_kv_cache", "make_lm_generator"]
 
@@ -126,8 +131,6 @@ def make_lm_generator(
             raise ValueError(
                 f"top_k {top_k} out of range [1, vocab_size={cfg.vocab_size}]"
             )
-    from ddl_tpu.parallel.sharding import validate_kv_head_sharding
-
     validate_kv_head_sharding(cfg, spec or LMMeshSpec())
     if mesh is None:
         mesh = build_lm_mesh(spec or LMMeshSpec(), devices)

@@ -35,7 +35,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30
 
@@ -178,10 +178,6 @@ def _dkdv_kernel(
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return out
 
 
 def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
@@ -216,18 +212,25 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     return out, lse
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, block_q, block_k,
+                       interpret):
+    """Shared backward: the two flash kernels with
+    ``ds = p * (dp - (delta - dlse))``.
 
-
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, do):
-    q, k, v, out, lse = residuals
+    With ``dlse=None`` this is the classic flash backward (cotangent on the
+    output only).  A nonzero ``dlse`` (cotangent on the per-row logsumexp,
+    layout (bh, 1, t)) arises when the caller consumes lse — the ring
+    schedule's cross-block combination does — and enters the kernels purely
+    through the delta term: d lse_i/d s_ij = p_ij, so the correction folds
+    into the same ``p * (...)`` product the kernels already compute.
+    """
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[:, None, :]  # (bh, 1, t) — same row-stat layout as lse
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
@@ -265,7 +268,25 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, do):
     return dq, dk, dv
 
 
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, residuals, cts):
+    do, dlse = cts
+    q, k, v, out, lse = residuals
+    return _flash_bwd_kernels(
+        q, k, v, out, lse, do, dlse, causal, block_q, block_k, interpret
+    )
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def flash_attention(
@@ -297,5 +318,42 @@ def flash_attention(
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
-    out = _flash(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
+    # one custom_vjp for both public entry points: dropping lse here hands
+    # its backward a zero cotangent, which the shared kernels fold away
+    out, _ = _flash_lse(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+):
+    """Flash attention that also returns the per-row logsumexp.
+
+    q, k, v: (B, T, H, D) -> (out (B, T, H, D), lse (B, H, T) float32) with
+    ``lse = log sum_j exp(q_i . k_j / sqrt(D))`` over the visible keys.
+    Two partial attentions over disjoint key sets combine exactly as
+    ``lse = logaddexp(lse1, lse2); out = out1*exp(lse1-lse) +
+    out2*exp(lse2-lse)`` — the blockwise composition the ring schedule
+    uses to run this kernel per K/V ring hop
+    (``parallel/ring_attention.py``).  Differentiable in out AND lse
+    (shared backward kernels; the lse cotangent folds into delta)."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    b, t, h, d = q.shape
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(t, block_k)
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out, lse = _flash_lse(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
+    return (
+        out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
+        lse.reshape(b, h, t),
+    )

@@ -950,11 +950,6 @@ def make_lm_pipeline_step_fns(
             "causal=False is only implemented for the XLA dense attention "
             "path (the nested ring/Ulysses/flash cores are built causal)"
         )
-    if cfg.flash and cfg.attn_impl == "ring":
-        raise ValueError(
-            "flash=True is not supported with attn_impl='ring' "
-            "(the ring core is already blockwise online-softmax)"
-        )
     if cfg.flash and cfg.attn_impl == "dense" and spec.seq > 1:
         raise ValueError(
             "flash=True with attn_impl='dense' requires mesh seq=1 "
@@ -1009,31 +1004,53 @@ def make_lm_pipeline_step_fns(
 
         from ddl_tpu.ops.flash_attention import flash_attention
 
-        if cfg.attn_impl == "ulysses":
-            if (cfg.n_heads // spec.model) % spec.seq:
-                raise ValueError(
-                    f"local head count {cfg.n_heads // spec.model} "
-                    f"(n_heads/model) % mesh seq={spec.seq} != 0 for "
-                    "flash-under-Ulysses (heads are model-local in the "
-                    "fully-manual region)"
-                )
-            from ddl_tpu.parallel.ulysses import ulysses_attention
+        if cfg.attn_impl == "ring":
+            from ddl_tpu.parallel.ring_attention import ring_attention
 
-            inner = partial(
-                ulysses_attention,
-                axis_name="seq",
-                causal=True,
-                attn_fn=flash_attention,
+            # flash inside ring, fully-manual like the other flash cores;
+            # the ring coordinate rides in as data (axis_index cannot
+            # lower inside nested manual regions)
+            ring_flash_sm = jax.shard_map(
+                lambda q, k, v, pos: ring_attention(
+                    q, k, v, axis_name="seq", causal=True, pos=pos[0],
+                    use_flash=True,
+                ),
+                in_specs=(manual_spec,) * 3 + (P("seq"),),
+                out_specs=manual_spec,
+                axis_names={"data", "seq", "model"},
+                check_vma=False,
             )
-        else:  # dense + flash, seq=1: the kernel is the whole core
-            inner = partial(flash_attention, causal=True)
-        attn_core = jax.shard_map(
-            inner,
-            in_specs=(manual_spec,) * 3,
-            out_specs=manual_spec,
-            axis_names={"data", "seq", "model"},
-            check_vma=False,
-        )
+
+            def attn_core(q, k, v):
+                return ring_flash_sm(
+                    q, k, v, jnp.arange(spec.seq, dtype=jnp.int32)
+                )
+        else:
+            if cfg.attn_impl == "ulysses":
+                if (cfg.n_heads // spec.model) % spec.seq:
+                    raise ValueError(
+                        f"local head count {cfg.n_heads // spec.model} "
+                        f"(n_heads/model) % mesh seq={spec.seq} != 0 for "
+                        "flash-under-Ulysses (heads are model-local in the "
+                        "fully-manual region)"
+                    )
+                from ddl_tpu.parallel.ulysses import ulysses_attention
+
+                inner = partial(
+                    ulysses_attention,
+                    axis_name="seq",
+                    causal=True,
+                    attn_fn=flash_attention,
+                )
+            else:  # dense + flash, seq=1: the kernel is the whole core
+                inner = partial(flash_attention, causal=True)
+            attn_core = jax.shard_map(
+                inner,
+                in_specs=(manual_spec,) * 3,
+                out_specs=manual_spec,
+                axis_names={"data", "seq", "model"},
+                check_vma=False,
+            )
     elif cfg.attn_impl == "ring":
         from ddl_tpu.parallel.ring_attention import ring_attention
 

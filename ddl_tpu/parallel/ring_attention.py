@@ -48,7 +48,16 @@ def _block_attention(q, k, v, mask, scale):
     return acc, blk_max, p.sum(axis=-1)
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False, pos=None):
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    causal: bool = False,
+    pos=None,
+    use_flash: bool = False,
+    flash_block: int = 512,
+):
     """Attention over a ring-sharded sequence (call inside ``shard_map``).
 
     Per-device shapes: q, k, v: (B, T_local, H, D) — the local sequence
@@ -62,7 +71,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, pos=None):
     lower inside nested manual regions (its lowering binds every other mesh
     axis, colliding with the parent's bound axes; see
     ``parallel/lm_pipeline.py``).
+
+    ``use_flash=True`` runs each per-device block through the Pallas flash
+    kernel (``ops/flash_attention.flash_attention_with_lse``) instead of
+    materialising the (T_local x T_local) score block, and combines blocks
+    by logsumexp — flash *inside* ring: the kernel's online softmax within
+    a device, the ring's across devices.  This matters when T_local is
+    itself long (e.g. T=128k over 8 devices leaves 16k per device).
     """
+    if use_flash:
+        return _ring_attention_flash(
+            q, k, v, axis_name, causal, pos, flash_block
+        )
     n = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name) if pos is None else pos
     b, t, h, d = q.shape
@@ -104,12 +124,51 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, pos=None):
     return acc / denom
 
 
+def _ring_attention_flash(q, k, v, axis_name, causal, pos, block):
+    """Flash-per-block ring: the diagonal block (step 0, always the
+    device's own K/V under the ring source rule ``src = (s - i) mod n``)
+    runs with the kernel's causal mask; every later block is either fully
+    visible (``src < s``) or fully future (gated to lse = -inf so it
+    contributes nothing while the compute stays uniform SPMD)."""
+    from ddl_tpu.ops.flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name) if pos is None else pos
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    out0, lse0 = flash_attention_with_lse(
+        q, k, v, causal=causal, block_q=block, block_k=block
+    )
+
+    def step(carry, i):
+        k_blk, v_blk, o_run, lse_run = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        o_blk, lse_blk = flash_attention_with_lse(
+            q, k_blk, v_blk, causal=False, block_q=block, block_k=block
+        )
+        if causal:
+            src = (s - i) % n
+            lse_blk = jnp.where(src < s, lse_blk, _NEG_INF)
+        lse_new = jnp.logaddexp(lse_run, lse_blk)
+        w_run = jnp.exp(lse_run - lse_new).transpose(0, 2, 1)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
+        o_run = o_run * w_run + o_blk.astype(jnp.float32) * w_blk
+        return (k_blk, v_blk, o_run, lse_new), None
+
+    init = (k, v, out0.astype(jnp.float32), lse0)
+    (_, _, o, _), _ = lax.scan(step, init, jnp.arange(1, n))
+    return o.astype(q.dtype)
+
+
 def make_ring_self_attention(
     mesh: Mesh,
     axis_name: str = "seq",
     causal: bool = False,
     spec: P | None = None,
     jit: bool = True,
+    use_flash: bool = False,
+    flash_block: int = 512,
 ):
     """Global-array entry point: (B, T, H, D) q/k/v sharded over T.
 
@@ -122,7 +181,13 @@ def make_ring_self_attention(
     if spec is None:
         spec = P(None, axis_name)
     fn = jax.shard_map(
-        partial(ring_attention, axis_name=axis_name, causal=causal),
+        partial(
+            ring_attention,
+            axis_name=axis_name,
+            causal=causal,
+            use_flash=use_flash,
+            flash_block=flash_block,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -58,13 +58,16 @@ def resolve_auto_flash(cfg, spec: "LMMeshSpec", seq_len: int) -> bool:
 
     Lives here (not ``train/lm_steps.py``) so both the flat-step and the
     pipeline factories can share it without an import cycle.  Picks the
-    Pallas kernel only where it is both *supported* — causal; not 'ring',
-    which is already blockwise; not dense-with-sharded-seq, where the
-    kernel cannot see the full sequence; heads divisible over ``model``,
-    which the head-parallel manual core requires — and *measured faster*
-    (training ``seq_len`` at or past ``FLASH_AUTO_MIN_T``).  Ulysses
-    attends the full sequence per head group after its all-to-all, so the
-    global ``seq_len`` is the right scale for every supported impl."""
+    Pallas kernel only where it is both *supported* — causal; not
+    dense-with-sharded-seq, where the kernel cannot see the full sequence;
+    heads divisible over ``model``, which the head-parallel manual core
+    requires — and *measured faster* (training ``seq_len`` at or past
+    ``FLASH_AUTO_MIN_T``).  Ulysses attends the full sequence per head
+    group after its all-to-all, so the global ``seq_len`` is the right
+    scale.  'ring' is deliberately excluded from auto even though
+    flash-inside-ring is supported (``flash=True`` + ``attn_impl='ring'``):
+    its crossover depends on T_local and has no multi-chip measurement yet
+    — opt in explicitly for long per-device sequences (PERF.md)."""
     if not cfg.causal or cfg.attn_impl == "ring":
         return False
     if cfg.attn_impl == "dense" and spec.seq > 1:

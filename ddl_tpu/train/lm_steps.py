@@ -69,15 +69,20 @@ class LMStepFns(NamedTuple):
     mesh: Mesh
 
 
-def make_ring_core(mesh: Mesh, causal: bool = True) -> Callable:
+def make_ring_core(
+    mesh: Mesh, causal: bool = True, use_flash: bool = False
+) -> Callable:
     """Ring-attention core for injection into ``TransformerLM``: batch local
     per ``data`` shard, heads local per ``model`` shard, K/V rotating over
-    the ``seq`` ring (``parallel/ring_attention.py``)."""
+    the ``seq`` ring (``parallel/ring_attention.py``).  ``use_flash`` runs
+    each per-device block through the Pallas kernel (flash inside ring —
+    the long-context composition where T_local is itself long)."""
     return make_ring_self_attention(
         mesh,
         causal=causal,
         spec=P("data", "seq", "model", None),
         jit=False,
+        use_flash=use_flash,
     )
 
 
@@ -353,11 +358,6 @@ def make_lm_step_fns(
             f"num_experts {cfg.num_experts} must divide by mesh "
             f"expert={spec.expert}"
         )
-    if cfg.flash and cfg.attn_impl == "ring":
-        raise ValueError(
-            "flash=True is not supported with attn_impl='ring' "
-            "(the ring core is already blockwise online-softmax)"
-        )
     if cfg.flash and cfg.attn_impl == "dense" and spec.seq > 1:
         raise ValueError(
             "flash=True with attn_impl='dense' requires mesh seq=1 "
@@ -368,7 +368,7 @@ def make_lm_step_fns(
     rules = lm_logical_rules(cfg.fsdp)
     manual_spec = P("data", "seq", "model", None)
     if cfg.attn_impl == "ring":
-        attn_core = make_ring_core(mesh)
+        attn_core = make_ring_core(mesh, use_flash=bool(cfg.flash))
     elif cfg.attn_impl == "ulysses":
         attn_core = make_ulysses_self_attention(
             mesh,

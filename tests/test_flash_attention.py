@@ -101,11 +101,9 @@ def test_lm_flash_rejects_bad_combos():
         vocab_size=32, d_model=32, n_layers=1, n_heads=4, head_dim=8,
         d_ff=64, compute_dtype="float32", remat=False, flash=True,
     )
-    with pytest.raises(ValueError, match="ring"):
-        make_lm_step_fns(
-            LMConfig(**base, attn_impl="ring"), LMMeshSpec(seq=2),
-            optax.adam(1e-3), jax.random.key(0), 4, 16,
-        )
+    # flash + ring is no longer an error: the per-device blocks run
+    # through the kernel (flash inside ring, see
+    # test_ring_flash_matches_ring_dense / test_lm_ring_flash_matches_dense)
     with pytest.raises(ValueError, match="ulysses"):
         make_lm_step_fns(
             LMConfig(**base, attn_impl="dense"), LMMeshSpec(seq=2),
@@ -194,3 +192,116 @@ def test_flash_auto_short_seq_trains_dense():
         )
         state, m = fns.train(fns.init_state(), toks[:, :-1], toks[:, 1:])
         assert np.isfinite(float(m["loss"]))
+
+
+def test_flash_with_lse_matches_dense_logsumexp():
+    """flash_attention_with_lse: out == dense attention, lse == the true
+    per-row logsumexp of the scaled scores; both differentiable including
+    a nonzero lse cotangent (the ring-combination consumption pattern)."""
+    from ddl_tpu.ops.flash_attention import flash_attention_with_lse
+
+    rng = np.random.default_rng(5)
+    b, t, h, d = 2, 32, 2, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        for _ in range(3)
+    )
+
+    def dense_ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32)
+        )
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s, -1e30)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)  # (B, H, T)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v
+        )
+        return out, lse
+
+    out_f, lse_f = flash_attention_with_lse(
+        q, k, v, causal=True, block_q=16, block_k=16
+    )
+    out_d, lse_d = dense_ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse_f), np.asarray(lse_d), atol=1e-5)
+
+    # gradient parity with BOTH cotangents live (out and lse)
+    co = jnp.asarray(rng.normal(size=out_d.shape), jnp.float32)
+    cl = jnp.asarray(rng.normal(size=lse_d.shape), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o, l = flash_attention_with_lse(
+            q, k, v, causal=True, block_q=16, block_k=16
+        )
+        return (o * co).sum() + (l * cl).sum()
+
+    def loss_dense(q, k, v):
+        o, l = dense_ref(q, k, v)
+        return (o * co).sum() + (l * cl).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_ring_dense(causal):
+    """Flash-inside-ring == the dense-block ring over a 4-device seq mesh,
+    forward and gradients."""
+    from jax.sharding import Mesh
+
+    from ddl_tpu.parallel.ring_attention import make_ring_self_attention
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    rng = np.random.default_rng(7)
+    b, t, h, d = 2, 64, 2, 8  # T_local = 16
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        for _ in range(3)
+    )
+    dense_ring = make_ring_self_attention(mesh, causal=causal)
+    flash_ring = make_ring_self_attention(
+        mesh, causal=causal, use_flash=True, flash_block=16
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash_ring(q, k, v)), np.asarray(dense_ring(q, k, v)),
+        atol=1e-5,
+    )
+    co = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
+    gd = jax.grad(lambda *a: (dense_ring(*a) * co).sum(), (0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda *a: (flash_ring(*a) * co).sum(), (0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4)
+
+
+def test_lm_ring_flash_matches_dense():
+    """Full LM train step: attn_impl='ring' + flash=True == flash=False
+    (same gradients) on a (data=2, seq=2) mesh."""
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    states = {}
+    for flash in (False, True):
+        cfg = LMConfig(
+            vocab_size=32, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, compute_dtype="float32", remat=False,
+            attn_impl="ring", flash=flash,
+        )
+        fns = make_lm_step_fns(
+            cfg, LMMeshSpec(data=2, seq=2), optax.adam(1e-3),
+            jax.random.key(0), 4, 32, devices=jax.devices()[:4],
+        )
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, 32, (4, 33))
+        )
+        s1, m = fns.train(fns.init_state(), toks[:, :-1], toks[:, 1:])
+        states[flash] = (float(m["loss"]), jax.device_get(s1.params))
+    assert abs(states[False][0] - states[True][0]) < 1e-5
+    err = jax.tree.reduce(max, jax.tree.map(
+        lambda a, b: float(np.max(np.abs(a - b))),
+        states[False][1], states[True][1]))
+    assert err < 1e-4

@@ -391,6 +391,18 @@ def test_lm_pipeline_flash_attention(sched):
     assert _maxerr(split_lm_params(jax.device_get(s_ref.params), 2),
                    jax.device_get(s1.params)) < 1e-3
 
+    # flash inside ring inside the pipeline: same single-device reference
+    ring_cfg = dataclasses.replace(cfg, flash=True, attn_impl="ring")
+    fns_r = make_lm_step_fns(
+        ring_cfg, LMMeshSpec(pipe=2, seq=2, model=2), tx, rng, B, 16,
+        devices=jax.devices()[:8], num_microbatches=2,
+        pipeline_schedule=sched,
+    )
+    s_r, m_r = fns_r.train(fns_r.init_state(), inp, tgt)
+    assert abs(float(m_r["loss"]) - float(m_ref["loss"])) < 1e-4
+    assert _maxerr(split_lm_params(jax.device_get(s_ref.params), 2),
+                   jax.device_get(s_r.params)) < 1e-3
+
 
 def test_lm_pipeline_checkpoint_interop(tmp_path):
     """The parallelism topology is a resume-time choice: a snapshot from a

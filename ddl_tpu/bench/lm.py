@@ -32,6 +32,8 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="grouped-query attention K/V head count (0 = MHA)")
+    ap.add_argument("--attn-window", type=int, default=0,
+                    help="sliding-window attention size (0 = full causal)")
     ap.add_argument("--vocab", type=int, default=50304)
     ap.add_argument("--flash", nargs="?", const="on", default="off",
                     choices=["on", "off", "auto"])
@@ -53,6 +55,7 @@ def main() -> None:
         n_layers=args.layers,
         n_heads=args.d_model // 64,
         n_kv_heads=args.kv_heads,
+        attn_window=args.attn_window,
         head_dim=64,
         d_ff=4 * args.d_model,
         compute_dtype="bfloat16",

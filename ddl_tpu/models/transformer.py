@@ -84,6 +84,13 @@ class LMConfig:
     # kernel, dense otherwise (resolved by train/lm_steps.py against the
     # run's seq_len; PERF.md records the crossover measurements).
     flash: bool | str = False
+    # Sliding-window attention (the Mistral recipe): each position attends
+    # only the last attn_window positions (0 = unbounded causal history).
+    # Requires causal=True.  Supported by the dense core, the flash kernel
+    # (band-masked block skip), Ulysses (full sequence per head group),
+    # the dense-block ring (global-position band across ring hops), and
+    # the decode cache; flash-in-ring with a window is rejected.
+    attn_window: int = 0
     remat: bool = True
     # What the per-block jax.checkpoint may keep instead of recomputing
     # (active only with remat=True): 'full' recomputes everything (minimum
@@ -108,6 +115,17 @@ class LMConfig:
             raise ValueError(
                 f"n_heads {self.n_heads} must divide by n_kv_heads "
                 f"{self.n_kv_heads} (grouped-query attention)"
+            )
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {self.attn_window} "
+                "(0 = full causal history)"
+            )
+        if self.attn_window and not self.causal:
+            raise ValueError(
+                "attn_window > 0 requires causal=True (sliding causal "
+                "window); bidirectional encoders have no decode order to "
+                "window over"
             )
 
     @property
@@ -240,7 +258,9 @@ class Attention(nn.Module):
                 g = cfg.n_heads // cfg.kv_heads
                 k = jnp.repeat(k, g, axis=2)
                 v = jnp.repeat(v, g, axis=2)
-            core = self.attn_core or partial(dense_attention, causal=cfg.causal)
+            core = self.attn_core or partial(
+                dense_attention, causal=cfg.causal, window=cfg.attn_window
+            )
             o = nn.with_logical_constraint(core(q, k, v), spec)
             new_cache = None
         else:
@@ -252,7 +272,10 @@ class Attention(nn.Module):
             # queries at global positions offset+i attend keys <= that
             # position; padded cache slots beyond offset+t are masked out.
             key_pos = jnp.arange(ck.shape[1])
-            mask = key_pos[None, :] <= (offset + jnp.arange(t))[:, None]  # (T, L)
+            q_pos = (offset + jnp.arange(t))[:, None]
+            mask = key_pos[None, :] <= q_pos  # (T, L)
+            if cfg.attn_window:
+                mask &= key_pos[None, :] > q_pos - cfg.attn_window
             o = dense_attention(q, ck, cv, mask=mask)
             o = nn.with_logical_constraint(o, spec)
             new_cache = (ck, cv)

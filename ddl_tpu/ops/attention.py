@@ -16,11 +16,12 @@ import jax.numpy as jnp
 __all__ = ["dense_attention"]
 
 
-def dense_attention(q, k, v, causal: bool = False, mask=None):
+def dense_attention(q, k, v, causal: bool = False, mask=None, window: int = 0):
     """Full softmax attention. q: (B, Tq, H, D), k/v: (B, Tk, Hkv, D) ->
     (B, Tq, H, D).  ``mask`` is an explicit (Tq, Tk) bool mask (True =
     attend) for cross-length cases like KV-cache decode; ``causal`` builds
-    the square tril mask.
+    the square tril mask, banded to the last ``window`` positions when
+    ``window > 0`` (sliding-window attention).
 
     Grouped-query attention: when ``Hkv < H`` (``H % Hkv == 0``), each K/V
     head serves a group of ``H/Hkv`` query heads.  The grouping is done by
@@ -29,8 +30,15 @@ def dense_attention(q, k, v, causal: bool = False, mask=None):
     """
     b, tq, h, d = q.shape
     hkv = k.shape[2]
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     if causal and mask is None:
         mask = jnp.tril(jnp.ones((tq, tq), bool))
+        if window:
+            # sliding window: row q sees keys in (q - window, q]
+            mask &= ~jnp.tril(jnp.ones((tq, tq), bool), -window)
     scale = jnp.sqrt(jnp.asarray(d, q.dtype))
     if hkv == h:
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / scale

@@ -47,14 +47,33 @@ def _pick_block(t: int, requested: int) -> int:
     return max(block, 1)
 
 
-def _causal_mask(i, j, bq, bk, s):
+def _causal_mask(i, j, bq, bk, s, window=0):
+    """Causal (and, with ``window > 0``, sliding-window) score mask: row
+    q attends keys in ``(q - window, q]`` — ``window = 0`` means
+    unbounded history (plain causal)."""
     q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    keep = k_pos <= q_pos
+    if window:
+        keep &= k_pos > q_pos - window
+    return jnp.where(keep, s, _NEG_INF)
+
+
+def _qk_live(i, j, bq, bk, causal, window):
+    """Whether the (q block i, k block j) tile intersects the visible band
+    (the block-skip predicate; window extends causal's future-skip with a
+    past-skip)."""
+    if not causal:
+        return True
+    live = j * bk <= i * bq + bq - 1
+    if window:
+        live &= j * bk + bk - 1 > i * bq - window
+    return live
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scale, causal
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scale,
+    causal, window=0,
 ):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -67,8 +86,8 @@ def _fwd_kernel(
         m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
         l_sc[:] = jnp.zeros_like(l_sc)
 
-    # causal: K/V blocks strictly in the future contribute nothing — skip
-    live = (j * bk <= i * bq + bq - 1) if causal else True
+    # K/V blocks outside the visible band contribute nothing — skip
+    live = _qk_live(i, j, bq, bk, causal, window)
 
     @pl.when(live)
     def _():
@@ -77,7 +96,7 @@ def _fwd_kernel(
         v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(i, j, bq, bk, s)
+            s = _causal_mask(i, j, bq, bk, s, window)
         m = m_sc[:]
         blk_max = s.max(axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
@@ -97,7 +116,8 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale, causal
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale,
+    causal, window=0,
 ):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -108,7 +128,7 @@ def _dq_kernel(
     def _():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    live = (j * bk <= i * bq + bq - 1) if causal else True
+    live = _qk_live(i, j, bq, bk, causal, window)
 
     @pl.when(live)
     def _():
@@ -120,7 +140,7 @@ def _dq_kernel(
         delta = delta_ref[0, 0][:, None]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(i, j, bq, bk, s)
+            s = _causal_mask(i, j, bq, bk, s, window)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -135,7 +155,7 @@ def _dq_kernel(
 
 def _dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_sc, dv_sc, *, scale, causal,
+    dk_sc, dv_sc, *, scale, causal, window=0,
 ):
     # grid: (bh, k_blocks, q_blocks) — innermost walks Q blocks
     j, i = pl.program_id(1), pl.program_id(2)
@@ -148,8 +168,8 @@ def _dkdv_kernel(
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    # causal: Q blocks strictly before this K/V block never attend to it
-    live = (i * bq + bq - 1 >= j * bk) if causal else True
+    # Q blocks outside this K/V block's visible band contribute nothing
+    live = _qk_live(i, j, bq, bk, causal, window)
 
     @pl.when(live)
     def _():
@@ -161,7 +181,7 @@ def _dkdv_kernel(
         delta_blk = delta_ref[0, 0][:, None]
         s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(i, j, bq, bk, s)
+            s = _causal_mask(i, j, bq, bk, s, window)
         p = jnp.exp(s - lse_blk)
         dv_sc[:] = dv_sc[:] + jnp.dot(
             p.T, do_blk, preferred_element_type=jnp.float32
@@ -180,11 +200,11 @@ def _dkdv_kernel(
 
 
 
-def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd_impl(q, k, v, causal, window, block_q, block_k, interpret):
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, window=window),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             # row stats ride in a (bh, 1, t) layout: the (1, 1, block_q)
@@ -212,8 +232,8 @@ def _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     return out, lse
 
 
-def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, block_q, block_k,
-                       interpret):
+def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
+                       block_q, block_k, interpret):
     """Shared backward: the two flash kernels with
     ``ds = p * (dp - (delta - dlse))``.
 
@@ -237,7 +257,7 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, block_q, block_k,
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, window=window),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         grid=(bh, t // block_q, t // block_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
@@ -251,7 +271,7 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, block_q, block_k,
     kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     row_spec_t = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkdv_kernel, scale=scale, causal=causal),
+        functools.partial(_dkdv_kernel, scale=scale, causal=causal, window=window),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), k.dtype),
             jax.ShapeDtypeStruct((bh, t, d), v.dtype),
@@ -268,21 +288,22 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, window, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, causal, window, block_q, block_k, interpret)
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+def _flash_lse_vjp_fwd(q, k, v, causal, window, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, window, block_q, block_k, interpret)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_vjp_bwd(causal, block_q, block_k, interpret, residuals, cts):
+def _flash_lse_vjp_bwd(causal, window, block_q, block_k, interpret, residuals, cts):
     do, dlse = cts
     q, k, v, out, lse = residuals
     return _flash_bwd_kernels(
-        q, k, v, out, lse, do, dlse, causal, block_q, block_k, interpret
+        q, k, v, out, lse, do, dlse, causal, window, block_q, block_k,
+        interpret
     )
 
 
@@ -294,11 +315,17 @@ def flash_attention(
     k,
     v,
     causal: bool = False,
+    window: int = 0,
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool | None = None,
 ):
     """Flash attention. q, k, v: (B, T, H, D) -> (B, T, H, D).
+
+    ``window > 0`` (requires ``causal``) restricts each row to the last
+    ``window`` positions — sliding-window attention, with blocks fully
+    outside the band skipped like causal's future blocks, so compute drops
+    from O(T^2) toward O(T * window).
 
     Differentiable (custom VJP, flash backward).  Block sizes are clamped to
     the sequence length and halved until they divide it; pick powers of two.
@@ -309,6 +336,10 @@ def flash_attention(
     interpreter mode off-TPU so the kernel runs on the CPU-simulated mesh
     (tests) and compiled on real chips.
     """
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True (sliding causal window)")
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     b, t, h, d = q.shape
@@ -320,7 +351,9 @@ def flash_attention(
 
     # one custom_vjp for both public entry points: dropping lse here hands
     # its backward a zero cotangent, which the shared kernels fold away
-    out, _ = _flash_lse(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
+    out, _ = _flash_lse(
+        fold(q), fold(k), fold(v), causal, window, bq, bk, interpret
+    )
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
@@ -329,6 +362,7 @@ def flash_attention_with_lse(
     k,
     v,
     causal: bool = False,
+    window: int = 0,
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool | None = None,
@@ -343,6 +377,10 @@ def flash_attention_with_lse(
     uses to run this kernel per K/V ring hop
     (``parallel/ring_attention.py``).  Differentiable in out AND lse
     (shared backward kernels; the lse cotangent folds into delta)."""
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True (sliding causal window)")
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     b, t, h, d = q.shape
@@ -352,7 +390,9 @@ def flash_attention_with_lse(
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
-    out, lse = _flash_lse(fold(q), fold(k), fold(v), causal, bq, bk, interpret)
+    out, lse = _flash_lse(
+        fold(q), fold(k), fold(v), causal, window, bq, bk, interpret
+    )
     return (
         out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
         lse.reshape(b, h, t),

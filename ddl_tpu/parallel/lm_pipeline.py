@@ -950,6 +950,12 @@ def make_lm_pipeline_step_fns(
             "causal=False is only implemented for the XLA dense attention "
             "path (the nested ring/Ulysses/flash cores are built causal)"
         )
+    if cfg.flash and cfg.attn_impl == "ring" and cfg.attn_window:
+        raise ValueError(
+            "attn_window inside flash-in-ring is not implemented (the "
+            "kernel's band mask assumes one global coordinate space); use "
+            "the dense-block ring (flash=False) or Ulysses with a window"
+        )
     if cfg.flash and cfg.attn_impl == "dense" and spec.seq > 1:
         raise ValueError(
             "flash=True with attn_impl='dense' requires mesh seq=1 "
@@ -1013,7 +1019,7 @@ def make_lm_pipeline_step_fns(
             ring_flash_sm = jax.shard_map(
                 lambda q, k, v, pos: ring_attention(
                     q, k, v, axis_name="seq", causal=True, pos=pos[0],
-                    use_flash=True,
+                    use_flash=True, window=cfg.attn_window,
                 ),
                 in_specs=(manual_spec,) * 3 + (P("seq"),),
                 out_specs=manual_spec,
@@ -1041,9 +1047,12 @@ def make_lm_pipeline_step_fns(
                     axis_name="seq",
                     causal=True,
                     attn_fn=flash_attention,
+                    window=cfg.attn_window,
                 )
             else:  # dense + flash, seq=1: the kernel is the whole core
-                inner = partial(flash_attention, causal=True)
+                inner = partial(
+                    flash_attention, causal=True, window=cfg.attn_window
+                )
             attn_core = jax.shard_map(
                 inner,
                 in_specs=(manual_spec,) * 3,
@@ -1058,7 +1067,8 @@ def make_lm_pipeline_step_fns(
         # lax.axis_index cannot lower inside nested manual regions.
         ring_sm = jax.shard_map(
             lambda q, k, v, pos: ring_attention(
-                q, k, v, axis_name="seq", causal=True, pos=pos[0]
+                q, k, v, axis_name="seq", causal=True, pos=pos[0],
+                window=cfg.attn_window,
             ),
             in_specs=(seq_spec,) * 3 + (P("seq"),),
             out_specs=seq_spec,
@@ -1075,7 +1085,8 @@ def make_lm_pipeline_step_fns(
         from ddl_tpu.parallel.ulysses import ulysses_attention
 
         attn_core = jax.shard_map(
-            partial(ulysses_attention, axis_name="seq", causal=True),
+            partial(ulysses_attention, axis_name="seq", causal=True,
+                    window=cfg.attn_window),
             in_specs=(seq_spec,) * 3,
             out_specs=seq_spec,
             axis_names={"seq"},

@@ -57,6 +57,7 @@ def ring_attention(
     pos=None,
     use_flash: bool = False,
     flash_block: int = 512,
+    window: int = 0,
 ):
     """Attention over a ring-sharded sequence (call inside ``shard_map``).
 
@@ -79,7 +80,16 @@ def ring_attention(
     a device, the ring's across devices.  This matters when T_local is
     itself long (e.g. T=128k over 8 devices leaves 16k per device).
     """
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
     if use_flash:
+        if window:
+            raise ValueError(
+                "sliding window inside flash-in-ring is not implemented "
+                "(the kernel's band mask assumes one global coordinate "
+                "space); use the dense-block ring (flash=False) with "
+                "attn_window, or Ulysses"
+            )
         return _ring_attention_flash(
             q, k, v, axis_name, causal, pos, flash_block
         )
@@ -98,6 +108,10 @@ def ring_attention(
             q_pos = s * t + local_pos
             kv_pos = src * t + local_pos
             mask = kv_pos[None, :] <= q_pos[:, None]
+            if window:
+                # sliding window on GLOBAL positions: keys older than
+                # window drop out even across ring blocks
+                mask &= kv_pos[None, :] > q_pos[:, None] - window
         else:
             mask = jnp.ones((t, t), bool)
         blk_acc, blk_max, blk_sum = _block_attention(q, k_blk, v_blk, mask, scale)
@@ -169,6 +183,7 @@ def make_ring_self_attention(
     jit: bool = True,
     use_flash: bool = False,
     flash_block: int = 512,
+    window: int = 0,
 ):
     """Global-array entry point: (B, T, H, D) q/k/v sharded over T.
 
@@ -187,6 +202,7 @@ def make_ring_self_attention(
             causal=causal,
             use_flash=use_flash,
             flash_block=flash_block,
+            window=window,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -30,7 +30,8 @@ from ddl_tpu.ops.attention import dense_attention
 __all__ = ["ulysses_attention", "make_ulysses_self_attention"]
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, attn_fn=None):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      attn_fn=None, window: int = 0):
     """Attention over a sequence-sharded batch (call inside ``shard_map``).
 
     Per-device shapes: q, k, v: (B, T_local, H, D) with the *local* head
@@ -54,7 +55,10 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, attn_fn=Non
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     attn = attn_fn if attn_fn is not None else dense_attention
-    out = attn(fwd(q), fwd(k), fwd(v), causal=causal)
+    # after the all-to-all each head group holds the FULL sequence, so a
+    # sliding window is just the inner attention's window
+    kwargs = {"window": window} if window else {}
+    out = attn(fwd(q), fwd(k), fwd(v), causal=causal, **kwargs)
     return bwd(out)
 
 
@@ -65,6 +69,7 @@ def make_ulysses_self_attention(
     spec: P | None = None,
     jit: bool = True,
     attn_fn=None,
+    window: int = 0,
 ):
     """Global-array entry point mirroring ``make_ring_self_attention``.
 
@@ -75,7 +80,8 @@ def make_ulysses_self_attention(
     if spec is None:
         spec = P(None, axis_name)
     fn = jax.shard_map(
-        partial(ulysses_attention, axis_name=axis_name, causal=causal, attn_fn=attn_fn),
+        partial(ulysses_attention, axis_name=axis_name, causal=causal,
+                attn_fn=attn_fn, window=window),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

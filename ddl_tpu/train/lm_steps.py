@@ -70,7 +70,8 @@ class LMStepFns(NamedTuple):
 
 
 def make_ring_core(
-    mesh: Mesh, causal: bool = True, use_flash: bool = False
+    mesh: Mesh, causal: bool = True, use_flash: bool = False,
+    window: int = 0,
 ) -> Callable:
     """Ring-attention core for injection into ``TransformerLM``: batch local
     per ``data`` shard, heads local per ``model`` shard, K/V rotating over
@@ -83,6 +84,7 @@ def make_ring_core(
         spec=P("data", "seq", "model", None),
         jit=False,
         use_flash=use_flash,
+        window=window,
     )
 
 
@@ -358,6 +360,12 @@ def make_lm_step_fns(
             f"num_experts {cfg.num_experts} must divide by mesh "
             f"expert={spec.expert}"
         )
+    if cfg.flash and cfg.attn_impl == "ring" and cfg.attn_window:
+        raise ValueError(
+            "attn_window inside flash-in-ring is not implemented (the "
+            "kernel's band mask assumes one global coordinate space); use "
+            "the dense-block ring (flash=False) or Ulysses with a window"
+        )
     if cfg.flash and cfg.attn_impl == "dense" and spec.seq > 1:
         raise ValueError(
             "flash=True with attn_impl='dense' requires mesh seq=1 "
@@ -368,7 +376,9 @@ def make_lm_step_fns(
     rules = lm_logical_rules(cfg.fsdp)
     manual_spec = P("data", "seq", "model", None)
     if cfg.attn_impl == "ring":
-        attn_core = make_ring_core(mesh, use_flash=bool(cfg.flash))
+        attn_core = make_ring_core(
+            mesh, use_flash=bool(cfg.flash), window=cfg.attn_window
+        )
     elif cfg.attn_impl == "ulysses":
         attn_core = make_ulysses_self_attention(
             mesh,
@@ -376,13 +386,14 @@ def make_lm_step_fns(
             spec=manual_spec,
             jit=False,
             attn_fn=flash_attention if cfg.flash else None,
+            window=cfg.attn_window,
         )
     elif cfg.flash:
         # dense + flash: manual shard_map so the Pallas call sees the local
         # (batch, full seq, local heads) block — GSPMD cannot partition a
         # custom kernel, so it must live inside the manual region.
         attn_core = jax.shard_map(
-            partial(flash_attention, causal=True),
+            partial(flash_attention, causal=True, window=cfg.attn_window),
             mesh=mesh,
             in_specs=(manual_spec,) * 3,
             out_specs=manual_spec,

@@ -90,6 +90,9 @@ def main() -> None:
                     help="grouped-query attention: K/V head count "
                     "(0 = same as query heads; must divide the 8 query "
                     "heads — smaller K/V projections and decode cache)")
+    ap.add_argument("--attn-window", type=int, default=0,
+                    help="sliding-window attention: each position attends "
+                    "only the last N positions (0 = full causal history)")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="simulate N CPU devices (dev/test)")
     ap.add_argument("--checkpoint-dir", default=None,
@@ -133,6 +136,7 @@ def main() -> None:
         n_layers=args.layers,
         n_heads=8,
         n_kv_heads=args.kv_heads,
+        attn_window=args.attn_window,
         head_dim=args.d_model // 8,
         d_ff=4 * args.d_model,
         num_experts=args.experts,

@@ -528,11 +528,8 @@ def test_split_lm_params_stage_major():
 def test_lm_pipeline_validation_errors():
     tx = optax.adam(1e-2)
     rng = jax.random.key(0)
-    with pytest.raises(ValueError, match="ring"):
-        make_lm_pipeline_step_fns(
-            _cfg(flash=True, attn_impl="ring"), LMMeshSpec(pipe=2), tx,
-            rng, B, T, 2, devices=jax.devices()[:2],
-        )
+    # flash + ring is supported (flash-in-ring,
+    # test_lm_pipeline_flash_attention) — no longer a validation error
     with pytest.raises(ValueError, match="seq=1"):
         make_lm_pipeline_step_fns(
             _cfg(flash=True), LMMeshSpec(pipe=2, seq=2), tx, rng, B, T, 2,

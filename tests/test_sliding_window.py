@@ -1,0 +1,210 @@
+"""Sliding-window attention (LMConfig.attn_window, the Mistral recipe):
+band-masked causal attention across every core — dense, flash kernel
+(block-skip), ring (global-position band across hops), Ulysses, and the
+decode cache — all equal to the dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl_tpu.ops.attention import dense_attention
+from ddl_tpu.ops.flash_attention import flash_attention
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    shape = (2, 64, 2, 8)
+    return tuple(
+        jnp.asarray(rng.normal(size=shape), jnp.float32) for _ in range(3)
+    )
+
+
+def _dense_banded(q, k, v, window):
+    """Independent reference: explicit band mask fed to dense_attention."""
+    t = q.shape[1]
+    pos = np.arange(t)
+    mask = (pos[None, :] <= pos[:, None]) & (pos[None, :] > pos[:, None] - window)
+    return dense_attention(q, k, v, mask=jnp.asarray(mask))
+
+
+def test_dense_window_matches_explicit_band(qkv):
+    q, k, v = qkv
+    out = dense_attention(q, k, v, causal=True, window=W)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_dense_banded(q, k, v, W)), atol=1e-6
+    )
+    # window >= T degenerates to plain causal
+    full = dense_attention(q, k, v, causal=True, window=4096)
+    plain = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(plain), atol=1e-6)
+    with pytest.raises(ValueError, match="causal"):
+        dense_attention(q, k, v, causal=False, window=W)
+
+
+@pytest.mark.parametrize("window", [4, 8, 24])
+def test_flash_window_matches_dense(qkv, window):
+    """Band-masked kernel (incl. block skipping: window 4 < block 16 skips
+    whole past blocks) == dense band, forward and gradients."""
+    q, k, v = qkv
+    out = flash_attention(
+        q, k, v, causal=True, window=window, block_q=16, block_k=16
+    )
+    want = _dense_banded(q, k, v, window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+    cot = jnp.asarray(np.random.default_rng(1).normal(size=q.shape), jnp.float32)
+    gf = jax.grad(
+        lambda *a: (flash_attention(
+            *a, causal=True, window=window, block_q=16, block_k=16
+        ) * cot).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    gd = jax.grad(
+        lambda *a: (_dense_banded(*a, window) * cot).sum(), (0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_ring_window_matches_dense(qkv):
+    """The ring's global-position band: window spans ring-block boundaries."""
+    from jax.sharding import Mesh
+
+    from ddl_tpu.parallel.ring_attention import make_ring_self_attention
+
+    q, k, v = qkv
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    ring = make_ring_self_attention(mesh, causal=True, window=W)
+    np.testing.assert_allclose(
+        np.asarray(ring(q, k, v)), np.asarray(_dense_banded(q, k, v, W)),
+        atol=1e-5,
+    )
+
+
+def test_ulysses_window_matches_dense(qkv):
+    from jax.sharding import Mesh
+
+    from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
+
+    q, k, v = qkv
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    uly = make_ulysses_self_attention(mesh, causal=True, window=W)
+    np.testing.assert_allclose(
+        np.asarray(uly(q, k, v)), np.asarray(_dense_banded(q, k, v, W)),
+        atol=1e-5,
+    )
+
+
+def test_ring_flash_window_rejected():
+    from jax.sharding import Mesh
+
+    from ddl_tpu.parallel.ring_attention import make_ring_self_attention
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    fn = make_ring_self_attention(
+        mesh, causal=True, use_flash=True, window=W
+    )
+    x = jnp.zeros((1, 16, 2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="flash-in-ring"):
+        fn(x, x, x)
+
+
+def test_config_window_requires_causal():
+    from ddl_tpu.models.transformer import LMConfig
+
+    with pytest.raises(ValueError, match="attn_window"):
+        LMConfig(causal=False, attn_window=W)
+    with pytest.raises(ValueError, match=">= 0"):
+        LMConfig(attn_window=-1)
+
+
+def test_ring_flash_window_rejected_at_factory():
+    """The unsupported combination fails at step-fn construction, not
+    buried in a first-trace shard_map traceback."""
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    cfg = LMConfig(
+        vocab_size=32, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+        d_ff=64, compute_dtype="float32", remat=False,
+        attn_impl="ring", flash=True, attn_window=W,
+    )
+    with pytest.raises(ValueError, match="flash-in-ring"):
+        make_lm_step_fns(
+            cfg, LMMeshSpec(seq=2), optax.adam(1e-3), jax.random.key(0),
+            4, 32, devices=jax.devices()[:2],
+        )
+
+
+def test_lm_windowed_decode_matches_training_forward():
+    """End to end: a windowed LM's cached incremental decode reproduces its
+    training forward token by token (both paths apply the same band)."""
+    from ddl_tpu.infer import LMDecode, init_kv_cache
+    from ddl_tpu.models.transformer import LMConfig, TransformerLM
+
+    cfg = LMConfig(
+        vocab_size=32, d_model=16, n_layers=2, n_heads=2, head_dim=8,
+        d_ff=32, compute_dtype="float32", remat=False, attn_window=4,
+    )
+    b, t = 2, 12  # window 4 << t: the band actually bites
+    model = TransformerLM(cfg, None)
+    import flax.linen as nn
+
+    dummy = jnp.zeros((b, t), jnp.int32)
+    params = nn.meta.unbox(model.init(jax.random.key(0), dummy)["params"])
+    toks = jnp.asarray(np.random.default_rng(2).integers(0, 32, (b, t)))
+    ref_logits, _ = model.apply({"params": params}, toks)
+
+    # windowed must differ from unwindowed (sanity that the band applies)
+    import dataclasses
+
+    full_model = TransformerLM(dataclasses.replace(cfg, attn_window=0), None)
+    full_logits, _ = full_model.apply({"params": params}, toks)
+    assert float(np.abs(np.asarray(ref_logits - full_logits)).max()) > 1e-3
+
+    caches = init_kv_cache(cfg, b, t)
+    dec = LMDecode(cfg)
+    for i in range(t):
+        logits, caches = dec.apply(
+            {"params": params}, toks[:, i : i + 1], caches, i
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(ref_logits[:, i]), atol=1e-5
+        )
+
+
+def test_lm_windowed_training_sharded_matches_single():
+    """Windowed LM under (data=2, seq=2) ring SP == single device."""
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    losses = {}
+    for name, spec, attn in (
+        ("single", LMMeshSpec(), "dense"),
+        ("ring", LMMeshSpec(data=2, seq=2), "ring"),
+        ("ulysses", LMMeshSpec(data=2, seq=2), "ulysses"),
+    ):
+        cfg = LMConfig(
+            vocab_size=32, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, compute_dtype="float32", remat=False,
+            attn_impl=attn, attn_window=8,
+        )
+        fns = make_lm_step_fns(
+            cfg, spec, optax.adam(1e-3), jax.random.key(0), 4, 32,
+            devices=jax.devices()[: spec.num_devices],
+        )
+        toks = jnp.asarray(np.random.default_rng(0).integers(0, 32, (4, 33)))
+        _, m = fns.train(fns.init_state(), toks[:, :-1], toks[:, 1:])
+        losses[name] = float(m["loss"])
+    assert abs(losses["single"] - losses["ring"]) < 1e-4
+    assert abs(losses["single"] - losses["ulysses"]) < 1e-4

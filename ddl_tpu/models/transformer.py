@@ -271,12 +271,26 @@ class Attention(nn.Module):
             cv = nn.with_logical_constraint(cv, spec)
             # queries at global positions offset+i attend keys <= that
             # position; padded cache slots beyond offset+t are masked out.
-            key_pos = jnp.arange(ck.shape[1])
             q_pos = (offset + jnp.arange(t))[:, None]
-            mask = key_pos[None, :] <= q_pos  # (T, L)
+            span = ck.shape[1]
+            ak, av = ck, cv
+            start = 0
+            if cfg.attn_window and cfg.attn_window + t - 1 < ck.shape[1]:
+                # windowed decode reads an O(window) slice, not the whole
+                # cache: the span (window + t - 1) covers every key any of
+                # the t queries can see, and the positional mask below
+                # handles the clamped warm-up region exactly.
+                span = cfg.attn_window + t - 1
+                start = jnp.clip(
+                    offset + t - span, 0, ck.shape[1] - span
+                )
+                ak = jax.lax.dynamic_slice_in_dim(ck, start, span, axis=1)
+                av = jax.lax.dynamic_slice_in_dim(cv, start, span, axis=1)
+            key_pos = start + jnp.arange(span)
+            mask = key_pos[None, :] <= q_pos  # (T, span)
             if cfg.attn_window:
                 mask &= key_pos[None, :] > q_pos - cfg.attn_window
-            o = dense_attention(q, ck, cv, mask=mask)
+            o = dense_attention(q, ak, av, mask=mask)
             o = nn.with_logical_constraint(o, spec)
             new_cache = (ck, cv)
         out = nn.Dense(

@@ -37,6 +37,9 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="must match the training run's --kv-heads (GQA)")
+    ap.add_argument("--attn-window", type=int, default=0,
+                    help="must match the training run's --attn-window "
+                    "(sliding-window decode reads an O(window) cache slice)")
     ap.add_argument("--experts", type=int, default=0)
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--prompt-text", default=None,
@@ -79,6 +82,7 @@ def main() -> None:
         n_layers=args.layers,
         n_heads=8,
         n_kv_heads=args.kv_heads,
+        attn_window=args.attn_window,
         head_dim=args.d_model // 8,
         d_ff=4 * args.d_model,
         num_experts=args.experts,

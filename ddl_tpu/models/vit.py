@@ -31,6 +31,8 @@ class ViTConfig:
     d_model: int = 384
     n_layers: int = 12
     n_heads: int = 6
+    # grouped-query attention (0 = multi-head); see LMConfig.n_kv_heads
+    n_kv_heads: int = 0
     head_dim: int = 64
     d_ff: int = 1536
     compute_dtype: str = "bfloat16"
@@ -56,6 +58,7 @@ class ViTConfig:
             d_model=self.d_model,
             n_layers=self.n_layers,
             n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads,
             head_dim=self.head_dim,
             d_ff=self.d_ff,
             compute_dtype=self.compute_dtype,

@@ -22,7 +22,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddl_tpu.models.vit import ViT, ViTConfig
 from ddl_tpu.ops import normalize_images
 from ddl_tpu.ops.losses import cross_entropy_loss
-from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
+from ddl_tpu.parallel.sharding import (
+    LMMeshSpec,
+    build_lm_mesh,
+    lm_logical_rules,
+    validate_kv_head_sharding,
+)
 
 __all__ = ["ViTTrainState", "ViTStepFns", "make_vit_step_fns"]
 
@@ -61,6 +66,7 @@ def make_vit_step_fns(
             "ViT steps shard over (data, model, pipe); got "
             f"seq={spec.seq} expert={spec.expert}"
         )
+    validate_kv_head_sharding(cfg.block_config(), spec)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if pipeline_schedule not in ("gpipe", "1f1b"):

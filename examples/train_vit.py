@@ -50,6 +50,8 @@ def main() -> None:
     ap.add_argument("--patch", type=int, default=16)
     ap.add_argument("--d-model", type=int, default=384)
     ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention K/V head count (0 = MHA)")
     ap.add_argument("--num-train", type=int, default=256,
                     help="synthetic train examples (when no real dataset)")
     ap.add_argument("--num-test", type=int, default=64)
@@ -83,6 +85,7 @@ def main() -> None:
         d_model=args.d_model,
         n_layers=args.layers,
         n_heads=max(2, args.d_model // 64),
+        n_kv_heads=args.kv_heads,
         head_dim=64 if args.d_model >= 128 else args.d_model // 2,
         d_ff=4 * args.d_model,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",

@@ -214,3 +214,13 @@ class TestRouting:
         cfg = tiny_cfg(compute_dtype="bfloat16", num_experts=2, expert_top_k=1)
         _, losses = run_steps(cfg, LMMeshSpec(data=2, model=2, expert=2), n_steps=1)
         assert np.isfinite(losses).all()
+
+
+def test_gqa_ulysses_matches_single():
+    """GQA + Ulysses SP: the broadcast K/V heads ride the all-to-all like
+    full heads; sharded == single device."""
+    cfg = tiny_cfg(n_kv_heads=2, attn_impl="ulysses")
+    ref, ref_losses = run_steps(tiny_cfg(n_kv_heads=2), LMMeshSpec())
+    par, par_losses = run_steps(cfg, LMMeshSpec(data=2, seq=2))
+    np.testing.assert_allclose(ref_losses, par_losses, atol=1e-4)
+    assert_state_close(ref, par, atol=1e-4)

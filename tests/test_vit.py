@@ -261,3 +261,29 @@ def test_pipeline_interleaved_1f1b_matches_interleaved_gpipe():
         lambda a, b: float(np.max(np.abs(a - b))),
         out["gpipe"][1], out["1f1b"][1]))
     assert err < 5e-5
+
+
+def test_vit_gqa_sharded_matches_single():
+    """Grouped-query attention in the ViT encoder (bidirectional blocks,
+    n_kv_heads pass-through via block_config): TP-sharded == single, loss
+    AND post-Adam params (the reduced K/V kernels' gradients shard too)."""
+    cfg = _cfg(n_kv_heads=2)
+    tx = optax.adam(1e-3)
+    imgs, labels = _batch()
+    out = {}
+    for name, spec in (("single", LMMeshSpec()), ("tp", LMMeshSpec(data=2, model=2))):
+        fns = make_vit_step_fns(cfg, spec, tx, jax.random.key(0), 8,
+                                devices=jax.devices()[: spec.num_devices])
+        s1, m = fns.train(fns.init_state(), imgs, labels)
+        out[name] = (float(m["loss"]), jax.device_get(s1.params))
+    assert abs(out["single"][0] - out["tp"][0]) < 1e-4
+    # reduced K/V projection really in the tree: (d_model, Hkv*Dh)
+    assert out["single"][1]["block0"]["attn"]["k"]["kernel"].shape == (32, 16)
+    err = jax.tree.reduce(max, jax.tree.map(
+        lambda a, b: float(np.max(np.abs(a - b))),
+        out["single"][1], out["tp"][1]))
+    assert err < 1e-4
+
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        make_vit_step_fns(_cfg(n_kv_heads=2), LMMeshSpec(model=4), tx,
+                          jax.random.key(0), 8, devices=jax.devices()[:4])

@@ -34,6 +34,12 @@ def dense_attention(q, k, v, causal: bool = False, mask=None, window: int = 0):
         raise ValueError(f"window must be >= 0, got {window}")
     if window and not causal:
         raise ValueError("window > 0 requires causal=True")
+    if window and mask is not None:
+        # An explicit mask wins over the built-in band; a caller combining
+        # both would silently get full-history attention.  Cross-length
+        # masks (decode) carry absolute key positions this function cannot
+        # see, so the band must be folded into the mask by the caller.
+        raise ValueError("pass window via the explicit mask, not both")
     if causal and mask is None:
         mask = jnp.tril(jnp.ones((tq, tq), bool))
         if window:

@@ -1,0 +1,38 @@
+"""In-place ring-buffer updates for pipeline schedules.
+
+Every pipeline schedule in this package keeps stage-input residency and
+per-microbatch accumulators in ring buffers carried through ``lax.scan``.
+Ticks outside the valid range must leave the buffer untouched — but a
+full-buffer ``jnp.where(valid, updated, old)`` forces XLA to read and
+write the whole buffer every tick, doubling its HBM traffic.  Selecting
+at *slot* granularity instead (invalid ticks re-write the slot with its
+own old value) keeps the carry update in-place: XLA sees a plain
+dynamic-update-slice on the scan carry and aliases it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["masked_slot_update", "masked_slice_update"]
+
+
+def masked_slot_update(buf, value, idx, valid):
+    """``buf[idx] = value if valid else buf[idx]`` along axis 0, in place.
+
+    ``idx`` is clamped by XLA's dynamic-slice semantics, so out-of-range
+    schedule indices are safe as long as ``valid`` masks them.
+    """
+    old = lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+    return lax.dynamic_update_index_in_dim(
+        buf, jnp.where(valid, value.astype(buf.dtype), old), idx, 0
+    )
+
+
+def masked_slice_update(buf, value, start, valid):
+    """N-d variant: ``buf[start : start+value.shape] = value`` when valid."""
+    old = lax.dynamic_slice(buf, start, value.shape)
+    return lax.dynamic_update_slice(
+        buf, jnp.where(valid, value.astype(buf.dtype), old), start
+    )

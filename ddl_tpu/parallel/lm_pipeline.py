@@ -76,6 +76,7 @@ from ddl_tpu.models.transformer import (
     remat_block,
 )
 from ddl_tpu.ops.losses import onehot_cross_entropy_mean
+from ddl_tpu.parallel.buffers import masked_slice_update, masked_slot_update
 from ddl_tpu.parallel.sharding import (
     PIPE_AXIS,
     LMMeshSpec,
@@ -514,25 +515,18 @@ def make_blocks_pipeline_1f1b(
             x_first = lax.dynamic_index_in_dim(x_mb, f_idx, 0, keepdims=False)
             x_in = jnp.where((s == 0) & (c_f == 0), x_first, fwd_buf)
             if V == 1:
-                resid = jnp.where(
-                    fwd_valid,
-                    lax.dynamic_update_index_in_dim(
-                        resid, x_in, f_idx % depth, 0
-                    ),
-                    resid,
+                resid = masked_slot_update(
+                    resid, x_in, f_idx % depth, fwd_valid
                 )
                 x_b = lax.dynamic_index_in_dim(
                     resid, b_idx % depth, 0, keepdims=False
                 )
             else:
-                resid = jnp.where(
-                    fwd_valid,
-                    lax.dynamic_update_slice(
-                        resid,
-                        x_in[None, None].astype(resid.dtype),
-                        (c_f, f_idx % depth, 0, 0, 0),
-                    ),
+                resid = masked_slice_update(
                     resid,
+                    x_in[None, None],
+                    (c_f, f_idx % depth, 0, 0, 0),
+                    fwd_valid,
                 )
                 x_b = lax.dynamic_slice(
                     resid,
@@ -606,12 +600,8 @@ def make_blocks_pipeline_1f1b(
                 )
             g_head, met = acc(g_head, dh), acc(met, m)
             aux = aux + jnp.where(bwd_valid, aux_b, 0.0)
-            dx_acc = jnp.where(
-                bwd_valid & (s == 0) & (c_b == 0),
-                lax.dynamic_update_index_in_dim(
-                    dx_acc, dx.astype(compute_dtype), b_idx, 0
-                ),
-                dx_acc,
+            dx_acc = masked_slot_update(
+                dx_acc, dx, b_idx, bwd_valid & (s == 0) & (c_b == 0)
             )
             fwd_buf = lax.ppermute(
                 out.astype(compute_dtype), PIPE_AXIS, fwd_ring

@@ -62,6 +62,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ddl_tpu.models.densenet import DenseNetStage, apply_stage
 from ddl_tpu.ops import normalize_images, softmax_cross_entropy
+from ddl_tpu.parallel.buffers import masked_slot_update
 from ddl_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS
 from ddl_tpu.train.state import TrainState
 from ddl_tpu.train.steps import StepFns
@@ -171,11 +172,7 @@ def make_pipeline_step_fns(
             emit = valid & (s == n_stages - 1)
             mb_loss = softmax_cross_entropy(logits_mb, labs_mb).mean()
             loss_acc = loss_acc + jnp.where(emit, mb_loss, 0.0)
-            logits_acc = jnp.where(
-                emit,
-                lax.dynamic_update_index_in_dim(logits_acc, logits_mb, out_idx, 0),
-                logits_acc,
-            )
+            logits_acc = masked_slot_update(logits_acc, logits_mb, out_idx, emit)
 
             # Stage handoff: boundary slot i only ever flows device i ->
             # i+1, so each slot gets a single-pair permute (P-1 point-to-
@@ -301,10 +298,9 @@ def make_pipeline_step_fns(
                         )
                     else:
                         out_f, new_stats_i = fwd_only(params[i], x_in)
-                        res_i = lax.dynamic_update_index_in_dim(
-                            resid[i], x_in.astype(compute_dtype), f_idx % depth[i], 0
+                        res_i = masked_slot_update(
+                            resid[i], x_in, f_idx % depth[i], fwd_valid
                         )
-                        res_i = jnp.where(fwd_valid, res_i, resid[i])
                         resid = tuple(res_i if j == i else resid[j] for j in range(last))
                         fwd_bufs = tuple(
                             out_f.astype(compute_dtype) if j == i else fwd_bufs[j]
@@ -323,12 +319,9 @@ def make_pipeline_step_fns(
                         )(out_f)
                         g_out = (g_out / M).astype(out_f.dtype)
                         loss_acc = loss_acc + jnp.where(bwd_valid, loss_mb, 0.0)
-                        logits_acc = jnp.where(
+                        logits_acc = masked_slot_update(
+                            logits_acc, out_f.astype(jnp.float32), b_idx,
                             bwd_valid,
-                            lax.dynamic_update_index_in_dim(
-                                logits_acc, out_f.astype(jnp.float32), b_idx, 0
-                            ),
-                            logits_acc,
                         )
                         # vjp was taken with the (out, stats) pair as output;
                         # stats get a zero cotangent.

@@ -74,6 +74,10 @@ def resolve_auto_flash(cfg, spec: "LMMeshSpec", seq_len: int) -> bool:
         return False
     if cfg.n_heads % spec.model:
         return False  # manual core shards heads over 'model'
+    if cfg.attn_impl == "ulysses" and (cfg.n_heads // spec.model) % spec.seq:
+        # Ulysses re-splits local heads over 'seq' in its all-to-all; flash
+        # under Ulysses needs that split exact, so auto falls back to dense.
+        return False
     return seq_len >= FLASH_AUTO_MIN_T
 
 

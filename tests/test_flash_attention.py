@@ -145,6 +145,9 @@ def test_flash_auto_resolution():
     # ulysses attends the full sequence per head group: supported
     uly = dataclasses.replace(base, attn_impl="ulysses")
     assert resolve_auto_flash(uly, LMMeshSpec(seq=2), 8192) is True
+    # ...but only when the local heads split exactly over 'seq' in the
+    # all-to-all; n_heads=4, model=2 leaves 2 local heads, seq=4 doesn't fit
+    assert resolve_auto_flash(uly, LMMeshSpec(seq=4, model=2), 8192) is False
     # heads must shard over 'model' for the manual core: fall back to dense
     assert resolve_auto_flash(base, LMMeshSpec(model=3), 8192) is False
 

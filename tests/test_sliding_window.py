@@ -43,6 +43,12 @@ def test_dense_window_matches_explicit_band(qkv):
     np.testing.assert_allclose(np.asarray(full), np.asarray(plain), atol=1e-6)
     with pytest.raises(ValueError, match="causal"):
         dense_attention(q, k, v, causal=False, window=W)
+    # an explicit mask would silently override the band: reject the combo
+    with pytest.raises(ValueError, match="explicit mask"):
+        dense_attention(
+            q, k, v, causal=True, window=W,
+            mask=jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool)),
+        )
 
 
 @pytest.mark.parametrize("window", [4, 8, 24])

@@ -1,10 +1,14 @@
+from ddl_tpu.train.loop import BaseTrainer
 from ddl_tpu.train.state import TrainState, create_train_state, make_optimizer
 from ddl_tpu.train.trainer import Trainer, resolve_job_id
 
 __all__ = [
+    "BaseTrainer",
     "TrainState",
     "create_train_state",
     "make_optimizer",
     "Trainer",
     "resolve_job_id",
+    # LMTrainer / ViTTrainer import their model families; reach them via
+    # ddl_tpu.train.lm_trainer / ddl_tpu.train.vit_trainer directly.
 ]

@@ -1,0 +1,413 @@
+"""LM-family trainer: the transformer LM on the shared training loop.
+
+Round 1-2 trained this family from a bespoke loop in ``examples/train_lm.py``
+— 380 lines re-implementing stepping, logging, eval, and checkpointing,
+*without* the aux subsystems the CNN Trainer has (no preemption guard, no
+NaN watchdog, no profiler hook, opt-in CSV).  That reproduced the
+per-script-trainer defect SURVEY.md §1 documents in the reference
+(``single.py:92-269`` vs ``ddp.py:102-326``).  This module puts the
+flagship family on ``train/loop.BaseTrainer`` instead: SIGTERM now leaves
+a resumable snapshot, NaN halts with a pointer at the last good one, CSV
+observability is default-on, and ``examples/train_lm.py`` shrinks to an
+argparse shim.
+
+The LM is step-based, not epoch-based, so a loop *period* here is a step
+window ending at the next cadence boundary — the union of the logging,
+eval, and snapshot cadences' multiples — so each cadence fires exactly at
+its own multiples (no more, no less; coprime cadences do not collapse the
+window to one step).  The CSV 'epoch' column carries the global step at
+the period end; per-window walls log as ``window_time`` while
+``epoch_time`` keeps its whole-run meaning for cross-family aggregation
+(``bench/analysis.epoch_time_per_job``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import math
+import os
+from time import perf_counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddl_tpu import checkpoint as ckpt
+from ddl_tpu.models.transformer import LMConfig
+from ddl_tpu.parallel.sharding import LMMeshSpec
+from ddl_tpu.train.lm_steps import make_lm_step_fns
+from ddl_tpu.train.loop import BaseTrainer
+from ddl_tpu.utils import MetricLogger
+
+__all__ = ["LMRunConfig", "LMTrainer"]
+
+
+@dataclasses.dataclass
+class LMRunConfig:
+    """Run-level settings for the LM family (model/mesh live in
+    ``LMConfig`` / ``LMMeshSpec``; this is everything else the old bespoke
+    loop took from the command line)."""
+
+    batch: int = 16
+    seq_len: int = 256
+    steps: int = 100
+    num_microbatches: int = 0
+    accum_steps: int = 1
+    pipeline_schedule: str = "gpipe"
+    virtual_stages: int = 1
+    # data: token corpus path (.npy or raw text; encoded on first use) or
+    # None for the synthetic Markov-chain byte stream
+    corpus: str | None = None
+    eval_every: int = 0  # held-out eval cadence in steps (0 = off)
+    eval_frac: float = 0.05  # tail fraction of corpus windows held out
+    checkpoint_dir: str | None = None
+    save_every: int = 50  # snapshot cadence in steps
+    resume_step: int | None = None
+    job_id: str = "lm"
+    log_dir: str | None = "training_logs"  # default-on CSV observability
+    log_every: int = 10  # console/CSV cadence in steps
+    halt_on_nan: bool = True
+    preemption_save: bool = True
+    profile_dir: str | None = None
+
+
+class LMTrainer(BaseTrainer):
+    period_label = "window"
+    time_metric = "window_time"  # epoch_time logs once, as whole-run wall
+    best_metric = "val_ppl"
+    best_mode = "min"
+    best_label = "PPL"
+
+    def __init__(
+        self,
+        cfg: LMConfig,
+        spec: LMMeshSpec,
+        tx,
+        run: LMRunConfig,
+        rng: jax.Array | None = None,
+    ) -> None:
+        self.cfg, self.spec, self.run = cfg, spec, run
+        self.job_id = run.job_id
+        self.fns = make_lm_step_fns(
+            cfg, spec, tx, rng if rng is not None else jax.random.key(0),
+            run.batch, run.seq_len,
+            num_microbatches=run.num_microbatches,
+            accum_steps=run.accum_steps,
+            pipeline_schedule=run.pipeline_schedule,
+            virtual_stages=run.virtual_stages,
+        )
+        self.tx = tx
+
+        # periods end at the union of the cadences' multiples, so each
+        # cadence fires exactly at its own multiples (log 10 / eval 4 ->
+        # boundaries 4, 8, 10, 12, ...) and coprime cadences never
+        # collapse the window to single steps
+        if run.log_every < 1:
+            raise ValueError(f"log_every must be >= 1, got {run.log_every}")
+        cadences = [run.log_every]
+        if run.eval_every:
+            cadences.append(run.eval_every)
+        if run.checkpoint_dir and run.save_every:
+            cadences.append(run.save_every)
+        bounds = {run.steps}
+        for c in cadences:
+            bounds.update(range(c, run.steps + 1, c))
+        self._boundaries = sorted(bounds)
+        self.num_periods = len(self._boundaries)
+
+        self._build_data()
+
+        proc = jax.process_index()
+        self.is_logging_process = proc == 0
+        self.logger = (
+            MetricLogger(run.log_dir, run.job_id, global_rank=proc,
+                         local_rank=proc)
+            if run.log_dir
+            else None
+        )
+        self.halt_on_nan = run.halt_on_nan
+        self.preemption_save = run.preemption_save
+        self.profile_dir = run.profile_dir
+        self.save_best = bool(run.checkpoint_dir) and bool(run.eval_every)
+        self.best_value = float("inf")
+
+        self.state = self.fns.init_state()
+        self._start_step = 0
+        if run.checkpoint_dir and run.resume_step is not None:
+            self._resume()
+        # first period whose boundary lies beyond the resume step
+        self.periods_run = bisect.bisect_right(
+            self._boundaries, self._start_step
+        )
+
+    # ------------------------------------------------------------- data
+
+    def _build_data(self) -> None:
+        run = self.run
+        self._eval_batches = None
+        n_proc, proc = jax.process_count(), jax.process_index()
+        self._n_proc = n_proc
+        if run.corpus:
+            # real corpus: memmapped token windows, host-sharded per
+            # process; each process loads 1/n_proc of the global batch and
+            # the shards are assembled into one global jax.Array
+            from ddl_tpu.data.lm_corpus import (
+                TokenBatches,
+                TokenCorpus,
+                encode_text_file,
+            )
+
+            if run.batch % n_proc:
+                raise ValueError(
+                    f"batch {run.batch} must divide by process count {n_proc}"
+                )
+            path = run.corpus
+            if not path.endswith(".npy"):
+                npy = path + ".npy"
+                stale = not os.path.exists(npy) or (
+                    os.path.getmtime(npy) < os.path.getmtime(path)
+                )
+                if stale and proc == 0:  # encode once, one writer
+                    encode_text_file(path, npy)
+                if n_proc > 1:
+                    from jax.experimental import multihost_utils
+
+                    multihost_utils.sync_global_devices("corpus_encode")
+                path = npy
+            corpus = TokenCorpus(path, run.seq_len)
+            if corpus.max_token() >= self.cfg.vocab_size:
+                raise ValueError(
+                    f"corpus has token id {corpus.max_token()} but the "
+                    f"model's vocab_size is {self.cfg.vocab_size}; "
+                    "out-of-range ids would be silently clamped by the "
+                    "embedding gather"
+                )
+            eval_view = None
+            if run.eval_every:
+                train_view, ev = corpus.split(run.eval_frac)
+                if len(ev) >= run.batch:
+                    eval_view = ev
+                else:
+                    # too small to fill one batch: keep every window
+                    print(
+                        f"note: eval split ({len(ev)} windows) smaller than "
+                        f"one batch of {run.batch}; held-out eval disabled — "
+                        "grow eval_frac or shrink batch"
+                    )
+                    train_view = corpus
+            else:
+                train_view = corpus
+            batches = TokenBatches(
+                train_view, run.batch // n_proc, n_proc, proc, seed=0
+            )
+            self._eval_batches = (
+                TokenBatches(eval_view, run.batch // n_proc, n_proc, proc,
+                             shuffle=False, seed=0)
+                if eval_view is not None
+                else None
+            )
+            print(
+                f"corpus: {len(corpus)} windows of {run.seq_len}+1 tokens, "
+                f"{len(batches)} train batches/epoch/host"
+                + (f", {len(self._eval_batches)} eval batches"
+                   if self._eval_batches else "")
+            )
+            self._gspec = None
+            if n_proc > 1:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                self._gspec = NamedSharding(self.fns.mesh, P("data", "seq"))
+
+            def sample_batch(step):
+                # pure in step -> a resumed run continues the stream exactly
+                inp, tgt = batches.batch_at(step)
+                return self._to_global(inp), self._to_global(tgt)
+
+        else:
+            # synthetic corpus: byte sequences from a fixed order-1 Markov
+            # chain — learnable structure with a known entropy floor
+            # (shared with generate_lm.py via ddl_tpu.data.synthetic_lm)
+            from ddl_tpu.data.synthetic_lm import MarkovChain
+
+            if self.cfg.vocab_size < 256:
+                raise ValueError(
+                    f"synthetic Markov stream emits byte ids 0..255 but "
+                    f"vocab_size is {self.cfg.vocab_size}; out-of-range "
+                    "targets corrupt the loss — use vocab_size >= 256 or "
+                    "pass a corpus"
+                )
+            chain = MarkovChain()
+
+            def sample_batch(step):
+                # seeded by step so a resumed run continues the stream
+                # instead of re-consuming batches already trained on
+                rng = np.random.default_rng(1000 + step)
+                seqs = chain.sample(rng, run.batch, run.seq_len + 1)
+                return jnp.asarray(seqs[:, :-1]), jnp.asarray(seqs[:, 1:])
+
+        self._sample_batch = sample_batch
+
+    def _to_global(self, x):
+        # multi-host: assemble host shards into one global array
+        if self._n_proc > 1:
+            return jax.make_array_from_process_local_data(self._gspec, x)
+        return jnp.asarray(x)
+
+    # ----------------------------------------------------------- resume
+
+    def _resume(self) -> None:
+        run = self.run
+        from ddl_tpu.parallel.lm_pipeline import (
+            saved_pipe_stages,
+            saved_virtual_stages,
+        )
+
+        # The snapshot itself records its layout (pipe stages AND
+        # interleaved virtual count) — no flag to get wrong.
+        saved_md = ckpt.snapshot_metadata(
+            run.checkpoint_dir, run.job_id, run.resume_step
+        )
+        saved_pipe = saved_pipe_stages(saved_md["state"]["params"])
+        saved_virtual = saved_virtual_stages(saved_md["state"]["params"])
+        if saved_pipe == self.spec.pipe and saved_virtual == run.virtual_stages:
+            self.state, _ = ckpt.load_snapshot(
+                run.checkpoint_dir, run.job_id, run.resume_step, self.state
+            )
+            print("resumed (snapshots are mesh-independent)")
+        else:
+            # Cross-layout resume: the snapshot was written with a
+            # different pipe stage count (possibly none).  Restore through
+            # an abstract skeleton of the saved layout (no init, no step
+            # functions — the saved run's batch/mesh/flash settings are
+            # irrelevant to the state tree), then restructure params +
+            # optimizer state and re-place onto this run's mesh.
+            from ddl_tpu.parallel.lm_pipeline import (
+                abstract_lm_state,
+                convert_lm_state,
+            )
+
+            restored, _ = ckpt.load_snapshot(
+                run.checkpoint_dir, run.job_id, run.resume_step,
+                abstract_lm_state(
+                    self.cfg, self.tx, saved_pipe, mesh=self.fns.mesh,
+                    virtual=saved_virtual,
+                ),
+            )
+            if self.spec.pipe > 1:
+                if saved_pipe > 1:  # restage: merge, then re-split below
+                    restored = convert_lm_state(restored)
+                self.state = convert_lm_state(
+                    restored, n_stages=self.spec.pipe,
+                    virtual=run.virtual_stages, like=self.state,
+                )
+            else:  # saved_pipe > 1 here (layouts differ): merge + place
+                self.state = convert_lm_state(restored, like=self.state)
+            print(
+                f"resumed across layouts (saved pipe={saved_pipe} "
+                f"virtual={saved_virtual} -> run pipe={self.spec.pipe} "
+                f"virtual={run.virtual_stages})"
+            )
+        self._start_step = int(self.state.step)
+        print(f"continuing from step {self._start_step}")
+
+    # ------------------------------------------------------- loop hooks
+
+    def _period_bounds(self, period: int) -> tuple[int, int]:
+        p0 = self._boundaries[period - 1] if period else 0
+        return max(p0, self._start_step), self._boundaries[period]
+
+    def run_period(self, period: int, guard=None):
+        p0, p1 = self._period_bounds(period)
+        metrics, steps = {}, 0
+        for i in range(p0, p1):
+            inp, tgt = self._sample_batch(i)
+            self.state, m = self.fns.train(self.state, inp, tgt)
+            steps += 1
+            if guard is not None and guard.requested:
+                break
+        if steps:
+            metrics = {k: float(v) for k, v in m.items()}
+        return metrics, steps
+
+    def log_index(self, period: int) -> int:
+        return self._period_bounds(period)[1]
+
+    def format_train_line(self, period, elapsed, steps, m) -> str:
+        p0, p1 = self._period_bounds(period)
+        body = " ".join(f"{k} {v:.4f}" for k, v in m.items())
+        return f"step {p1 - 1:4d} {body} ({steps / elapsed:.2f} steps/s)"
+
+    def format_eval_line(self, period, m) -> str:
+        return (
+            f"  heldout: ce {m['val_loss']:.4f} ppl {m['val_ppl']:.2f}"
+        )
+
+    def rate_metrics(self, steps: int, elapsed: float) -> dict:
+        return {
+            "tokens_per_sec": (steps / elapsed)
+            * self.run.batch
+            * self.run.seq_len
+        }
+
+    def evaluate_period(self, period: int) -> dict | None:
+        run = self.run
+        p1 = self._period_bounds(period)[1]
+        if (
+            self._eval_batches is None
+            or not run.eval_every
+            or p1 % run.eval_every
+        ):
+            return None
+        ces = []
+        for e_inp, e_tgt in self._eval_batches:
+            em = self.fns.evaluate(
+                self.state, self._to_global(e_inp), self._to_global(e_tgt)
+            )
+            ces.append(float(em["ce"]))
+        ce = float(np.mean(ces))
+        return {"val_loss": ce, "val_ppl": math.exp(ce)}
+
+    def snapshot_due(self, period: int) -> bool:
+        if not self.run.checkpoint_dir or not self.run.save_every:
+            return False
+        return self._period_bounds(period)[1] % self.run.save_every == 0
+
+    def save_snapshot(self, period: int) -> None:
+        # label with the true optimizer step (preemption can end a period
+        # early), so resume_step and the training stream line up exactly
+        step = int(jax.device_get(self.state.step))
+        path = ckpt.save_snapshot(
+            self.run.checkpoint_dir, self.job_id, step, self.state
+        )
+        print(f"step {step} | saved snapshot to {path}")
+
+    def last_snapshot_hint(self):
+        if not self.run.checkpoint_dir:
+            return "none (set checkpoint_dir)"
+        return ckpt.latest_epoch(self.run.checkpoint_dir, self.job_id)
+
+    def resume_hint(self, period: int) -> str:
+        step = int(jax.device_get(self.state.step))
+        return f"--job-id {self.job_id} --resume-step {step}"
+
+    # --------------------------------------------------------------- run
+
+    def train(self, max_periods: int | None = None, guard=None) -> None:
+        if self.run.checkpoint_dir is None and self.preemption_save:
+            # nothing to save into: the guard would catch SIGTERM and then
+            # fail in save_snapshot — run unguarded instead
+            self.preemption_save = False
+        t0 = perf_counter()
+        super().train(max_periods, guard)
+        dt = perf_counter() - t0
+        steps_run = int(jax.device_get(self.state.step)) - self._start_step
+        if steps_run:
+            print(
+                f"{steps_run} steps in {dt:.1f}s ({steps_run / dt:.2f} steps/s)"
+            )
+        if self.logger is not None and self.is_logging_process:
+            # whole run as one epoch row, so epoch_time keeps the same unit
+            # across families in bench/analysis.epoch_time_per_job
+            self.logger.log("epoch_time", dt, 0)

@@ -1,0 +1,209 @@
+"""The family-agnostic training loop: one loop for CNN, LM, and ViT.
+
+The reference re-implements its trainer once per entry point (``single.py``
+/ ``ddp.py`` / ``pp.py`` / ``ddp_n_pp.py`` each carry a near-identical
+``Trainer`` class — SURVEY.md §1); round 1-2 of this framework fixed that
+for the CNN family but re-grew the disease for the beyond-parity LM/ViT
+families as bespoke example loops.  This module is the fix: every generic
+concern lives here exactly once —
+
+* the period loop (a period is an epoch for the vision families, a fixed
+  step window for the LM family) with wall-clock timing,
+* default-on CSV metric logging (``utils/csv_logger.MetricLogger``),
+* the NaN watchdog (halt with a pointer at the last good snapshot),
+* the ``jax.profiler`` trace hook (one post-warmup period),
+* preemption handling (SIGTERM → finish the in-flight period → snapshot →
+  clean exit, ``utils/preemption.PreemptionGuard``),
+* snapshot gating: best-eval-metric improvements (QWK for the vision
+  families, val perplexity for the LM) and/or a fixed cadence,
+* HBM watermark logging (``utils/memory.hbm_stats``).
+
+Families subclass :class:`BaseTrainer` and implement only what is genuinely
+family-specific: how to run one period, how to evaluate, and how to write a
+snapshot.  ``train/trainer.py`` (CNN), ``train/lm_trainer.py`` and
+``train/vit_trainer.py`` are the three instantiations.
+"""
+
+from __future__ import annotations
+
+from time import perf_counter
+
+import jax
+import numpy as np
+
+from ddl_tpu.utils.memory import hbm_stats
+
+__all__ = ["BaseTrainer"]
+
+
+class BaseTrainer:
+    """Template-method training loop.
+
+    Subclass contract — attributes (set in ``__init__``):
+      ``state``              the (donated/rebound) train state
+      ``job_id``             job identity for logs and snapshots
+      ``logger``             a ``MetricLogger`` or ``None``
+      ``is_logging_process`` whether this host writes CSV rows
+      ``periods_run``        resume cursor (first period to run)
+      ``num_periods``        total periods in a full run
+      ``halt_on_nan``        raise on non-finite training loss
+      ``preemption_save``    install a SIGTERM guard around the run
+      ``profile_dir``        trace one post-warmup period here (or None)
+      ``save_best``          gate snapshots on eval-metric improvements
+      ``best_metric``        eval-dict key for the gate (or None)
+      ``best_mode``          "max" (accuracy-like) or "min" (loss-like)
+      ``best_value``         current best (init -inf for max, +inf for min)
+
+    and methods:
+      ``run_period(period, guard) -> (train_metrics: dict, steps: int)``
+          run one period, rebinding ``self.state``; poll
+          ``guard.requested`` at step boundaries and stop early when set.
+      ``evaluate_period(period) -> dict | None``
+          eval metrics for this period boundary, or None to skip.
+      ``save_snapshot(period) -> None``
+          write a resumable snapshot for this period.
+      ``wait_for_saves() -> None``
+          block until async snapshot writes commit (default no-op).
+
+    Optional overrides: ``rate_metrics`` (extra throughput rows),
+    ``snapshot_due`` (fixed save cadence), ``format_train_line`` /
+    ``format_eval_line`` (console output), ``period_label``,
+    ``best_label``, ``resume_hint``.
+    """
+
+    period_label = "Epoch"
+    # CSV name for the per-period wall time; step-based families relabel it
+    # (their periods are windows, not epochs) and log their own epoch_time.
+    time_metric = "epoch_time"
+
+    # ---------------------------------------------------------- overrides
+
+    def rate_metrics(self, steps: int, elapsed: float) -> dict:
+        """Extra per-period throughput metrics (tokens/sec, img/sec, ...)."""
+        return {}
+
+    def snapshot_due(self, period: int) -> bool:
+        """Fixed-cadence snapshots, independent of the best-metric gate."""
+        return False
+
+    def wait_for_saves(self) -> None:
+        return None
+
+    @property
+    def best_label(self) -> str:
+        return (self.best_metric or "metric").upper()
+
+    def resume_hint(self, period: int) -> str:
+        return f"job_id={self.job_id} {self.period_label.lower()}={period}"
+
+    def format_train_line(
+        self, period: int, elapsed: float, steps: int, metrics: dict
+    ) -> str:
+        body = " | ".join(f"{k}: {v:.4f}" for k, v in metrics.items())
+        return (
+            f"{self.period_label} {period} | Time: {elapsed:.2f}s | "
+            f"Steps: {steps} | {body}"
+        )
+
+    def format_eval_line(self, period: int, metrics: dict) -> str:
+        body = " | ".join(f"{k}: {v:.4f}" for k, v in metrics.items())
+        return f"{self.period_label} {period} | {body}"
+
+    def log_index(self, period: int) -> int:
+        """CSV 'epoch' column for this period (LM maps periods to steps)."""
+        return period
+
+    # ------------------------------------------------------------- gating
+
+    def _improved(self, eval_metrics: dict | None) -> bool:
+        if (
+            not self.save_best
+            or self.best_metric is None
+            or not eval_metrics
+            or self.best_metric not in eval_metrics
+        ):
+            return False
+        value = float(eval_metrics[self.best_metric])
+        better = value > self.best_value if self.best_mode == "max" else (
+            value < self.best_value
+        )
+        if better:
+            self.best_value = value
+            print(f"New Best Validation {self.best_label}: {value:.4f}")
+        return better
+
+    # ---------------------------------------------------------- the loop
+
+    def train(self, max_periods: int | None = None, guard=None) -> None:
+        from ddl_tpu.utils.preemption import PreemptionGuard
+
+        if guard is None and self.preemption_save:
+            # enter the loop directly (not through self.train) so family
+            # overrides wrapping train() run exactly once
+            with PreemptionGuard() as installed:
+                return self._train_loop(max_periods, installed)
+        return self._train_loop(max_periods, guard)
+
+    def _train_loop(self, max_periods: int | None, guard) -> None:
+        max_periods = max_periods or self.num_periods
+        # Profile one post-warmup period when configured (the reference's
+        # only timing is perf_counter epoch walls, single.py:171-174; this
+        # captures a full XLA device trace instead).
+        profile_period = None
+        if self.profile_dir:
+            profile_period = min(self.periods_run + 1, max_periods - 1)
+        for period in range(self.periods_run, max_periods):
+            if period == profile_period:
+                jax.profiler.start_trace(self.profile_dir)
+            start = perf_counter()
+            train_metrics, steps = self.run_period(period, guard)
+            elapsed = perf_counter() - start
+            if period == profile_period:
+                jax.profiler.stop_trace()
+            loss = train_metrics.get("loss")
+            if self.halt_on_nan and loss is not None and not np.isfinite(loss):
+                raise RuntimeError(
+                    f"Non-finite training loss {loss} at "
+                    f"{self.period_label.lower()} {period}; halting. "
+                    f"Last snapshot: {self.last_snapshot_hint()}"
+                )
+            print(self.format_train_line(period, elapsed, steps, train_metrics))
+            idx = self.log_index(period)
+            if self.logger is not None and self.is_logging_process:
+                self.logger.log_many(train_metrics, idx)
+                self.logger.log(self.time_metric, elapsed, idx)
+                # steps/sec/chip is BASELINE.json's target metric; the
+                # reference only logs epoch_time (steps derived offline).
+                self.logger.log("steps_per_sec", steps / elapsed, idx)
+                self.logger.log_many(self.rate_metrics(steps, elapsed), idx)
+                # HBM watermark (no analog in the reference; utils/memory.py)
+                mem = hbm_stats()
+                if mem is not None:
+                    self.logger.log(
+                        "hbm_peak_bytes", mem["peak_bytes_in_use"], idx
+                    )
+
+            eval_metrics = self.evaluate_period(period)
+            if eval_metrics:
+                print(self.format_eval_line(period, eval_metrics))
+                if self.logger is not None and self.is_logging_process:
+                    self.logger.log_many(eval_metrics, idx)
+
+            if self._improved(eval_metrics) or self.snapshot_due(period):
+                self.save_snapshot(period)
+            self.periods_run = period + 1
+            if guard is not None and guard.requested:
+                # Preempted (SIGTERM): checkpoint what we have and exit
+                # cleanly; the partially-trained period is saved under its
+                # own number, so the relaunch resumes at the next one.
+                self.save_snapshot(period)
+                self.wait_for_saves()
+                print(
+                    f"Preempted at {self.period_label.lower()} {period}; "
+                    f"snapshot committed. Resume with {self.resume_hint(period)}"
+                )
+                return
+        self.wait_for_saves()
+
+    def last_snapshot_hint(self):
+        return "none"

@@ -1,4 +1,4 @@
-"""The Trainer: epoch loop, eval loop, metric logging, checkpointing.
+"""The CNN Trainer: the DenseNet family on the shared training loop.
 
 One trainer for all four reference entry points (``single.py`` / ``ddp.py`` /
 ``pp.py`` / ``ddp_n_pp.py`` each re-implement their own ``Trainer`` class —
@@ -10,12 +10,18 @@ train accuracy, full eval metric suite, CSV logging, QWK-gated snapshot
 up).  Metric aggregation across data-parallel replicas needs no explicit
 ``all_gather`` (reference ``ddp.py:194-199``): step outputs are global
 ``jax.Array``s already, fetched to host once per epoch.
+
+The epoch loop itself — timing, CSV logging, NaN watchdog, profiler hook,
+preemption handling, snapshot gating — lives in ``train/loop.BaseTrainer``,
+shared with the LM (``train/lm_trainer.py``) and ViT
+(``train/vit_trainer.py``) families; this class supplies only the
+CNN-specific pieces (data loaders, step functions, eval metrics, Orbax
+snapshots keyed by epoch).
 """
 
 from __future__ import annotations
 
 import os
-from time import perf_counter
 
 import jax
 import jax.numpy as jnp
@@ -26,10 +32,10 @@ from ddl_tpu.config import Config
 from ddl_tpu.data import DataLoader, ShardedEpochSampler, build_datasets, shard_batch
 from ddl_tpu.models import build_stages, stage_boundary_shapes
 from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
+from ddl_tpu.train.loop import BaseTrainer
 from ddl_tpu.train.state import create_train_state, make_optimizer
 from ddl_tpu.train.steps import make_dp_step_fns
 from ddl_tpu.utils import MetricLogger, masked_classification_eval
-from ddl_tpu.utils.memory import hbm_stats
 
 __all__ = ["Trainer", "resolve_job_id"]
 
@@ -50,7 +56,11 @@ def _to_host(x) -> np.ndarray:
     return np.asarray(x)
 
 
-class Trainer:
+class Trainer(BaseTrainer):
+    best_metric = "qwk"
+    best_mode = "max"
+    best_label = "QWK"
+
     def __init__(self, cfg: Config, mesh=None, datasets=None) -> None:
         cfg.validate()
         self.cfg = cfg
@@ -158,12 +168,28 @@ class Trainer:
         )
         self.is_logging_process = proc == 0
         self.epochs_run = 0
-        self.best_qwk = -1.0
+        # shared-loop knobs (train/loop.BaseTrainer)
+        self.num_periods = cfg.train.max_epochs
+        self.halt_on_nan = cfg.train.halt_on_nan
+        self.preemption_save = cfg.train.preemption_save
+        self.profile_dir = cfg.train.profile_dir
+        self.save_best = cfg.train.save_best_qwk
+        self.best_value = -1.0
         self._snapshot_mgr = None
         if cfg.train.snapshot_job_id is not None:
             self._load_snapshot()
 
     # ------------------------------------------------------------------
+
+    # ``epochs_run`` is this family's public name for the loop's resume
+    # cursor (tests and the CLI read it); keep both views in sync.
+    @property
+    def periods_run(self) -> int:
+        return self.epochs_run
+
+    @periods_run.setter
+    def periods_run(self, value: int) -> None:
+        self.epochs_run = value
 
     def _load_snapshot(self) -> None:
         t = self.cfg.train
@@ -177,7 +203,7 @@ class Trainer:
         )
         print(f"Resuming training from epoch {self.epochs_run}")
 
-    def _save_snapshot(self, epoch: int) -> None:
+    def save_snapshot(self, epoch: int) -> None:
         if self.cfg.train.async_checkpoint:
             if self._snapshot_mgr is None:
                 self._snapshot_mgr = ckpt.SnapshotManager(
@@ -190,10 +216,23 @@ class Trainer:
             )
         print(f"Epoch {epoch} | Saved snapshot to {path}")
 
+    def wait_for_saves(self) -> None:
+        if self._snapshot_mgr is not None:
+            self._snapshot_mgr.wait()
+
+    def last_snapshot_hint(self):
+        return ckpt.latest_epoch(self.cfg.train.checkpoint_dir, self.job_id)
+
+    def resume_hint(self, epoch: int) -> str:
+        return (
+            f"train.snapshot_job_id={self.job_id} "
+            f"train.snapshot_epoch={epoch}"
+        )
+
     # ------------------------------------------------------------------
 
-    def _run_epoch(self, epoch: int, guard=None):
-        """One training epoch; returns (mean_loss, accuracy, steps).
+    def run_period(self, epoch: int, guard=None):
+        """One training epoch; returns (metric dict, steps).
 
         ``guard`` (a ``PreemptionGuard``) stops the epoch after the
         in-flight step when a preemption signal has arrived.
@@ -220,7 +259,7 @@ class Trainer:
         y_pred = np.concatenate([_to_host(p) for p in preds])
         y_true = np.concatenate([_to_host(t) for t in targets])
         accuracy = float(np.mean(y_pred == y_true))
-        return mean_loss, accuracy, steps
+        return {"loss": mean_loss, "train_accuracy": accuracy}, steps
 
     def evaluate(self, epoch: int) -> dict:
         """Eval loop -> metric dict (reference ``_evaluate``, single.py:199-251).
@@ -238,76 +277,19 @@ class Trainer:
         all_targets = np.concatenate([_to_host(t) for t in targets])
         return masked_classification_eval(all_logits, all_targets)
 
-    def train(self, max_epochs: int | None = None, guard=None) -> None:
-        from ddl_tpu.utils.preemption import PreemptionGuard
+    # -------------------------------------------------- loop hooks
 
-        if guard is None and self.cfg.train.preemption_save:
-            with PreemptionGuard() as installed:
-                return self.train(max_epochs, guard=installed)
+    def evaluate_period(self, epoch: int) -> dict:
+        return self.evaluate(epoch)
 
-        max_epochs = max_epochs or self.cfg.train.max_epochs
-        # Profile one post-warmup epoch when configured (the reference's only
-        # timing is perf_counter epoch walls, single.py:171-174; this captures
-        # a full XLA device trace instead).
-        profile_epoch = None
-        if self.cfg.train.profile_dir:
-            profile_epoch = min(self.epochs_run + 1, max_epochs - 1)
-        for epoch in range(self.epochs_run, max_epochs):
-            if epoch == profile_epoch:
-                jax.profiler.start_trace(self.cfg.train.profile_dir)
-            start = perf_counter()
-            mean_loss, accuracy, steps = self._run_epoch(epoch, guard)
-            elapsed = perf_counter() - start
-            if epoch == profile_epoch:
-                jax.profiler.stop_trace()
-            if self.cfg.train.halt_on_nan and not np.isfinite(mean_loss):
-                raise RuntimeError(
-                    f"Non-finite training loss {mean_loss} at epoch {epoch} "
-                    f"(step {int(self.state.step)}); halting. Last snapshot: "
-                    f"{ckpt.latest_epoch(self.cfg.train.checkpoint_dir, self.job_id)}"
-                )
-            print(
-                f"Epoch {epoch} | Time: {elapsed:.2f}s | Steps: {steps} | "
-                f"Loss: {mean_loss:.4f} | Training Accuracy: {accuracy:.4f}"
-            )
-            if self.is_logging_process:
-                self.logger.log("loss", mean_loss, epoch)
-                self.logger.log("train_accuracy", accuracy, epoch)
-                self.logger.log("epoch_time", elapsed, epoch)
-                # steps/sec/chip is BASELINE.json's target metric; the
-                # reference only logs epoch_time (steps derived offline).
-                self.logger.log("steps_per_sec", steps / elapsed, epoch)
-                # HBM watermark (no analog in the reference; utils/memory.py)
-                mem = hbm_stats()
-                if mem is not None:
-                    self.logger.log("hbm_peak_bytes", mem["peak_bytes_in_use"], epoch)
+    def format_train_line(self, epoch, elapsed, steps, m) -> str:
+        return (
+            f"Epoch {epoch} | Time: {elapsed:.2f}s | Steps: {steps} | "
+            f"Loss: {m['loss']:.4f} | Training Accuracy: {m['train_accuracy']:.4f}"
+        )
 
-            metrics = self.evaluate(epoch)
-            print(
-                f"Epoch {epoch} | Validation Loss: {metrics['val_loss']:.4f} | "
-                f"Accuracy: {metrics['val_accuracy']:.4f} | "
-                f"QWK: {metrics['qwk']:.4f}"
-            )
-            if self.is_logging_process:
-                self.logger.log_many(metrics, epoch)
-
-            if self.cfg.train.save_best_qwk and metrics["qwk"] > self.best_qwk:
-                self.best_qwk = metrics["qwk"]
-                print(f"New Best Validation QWK: {self.best_qwk:.4f}")
-                self._save_snapshot(epoch)
-            self.epochs_run = epoch + 1
-            if guard is not None and guard.requested:
-                # Preempted (SIGTERM): checkpoint what we have and exit
-                # cleanly; the partially-trained epoch is saved under its own
-                # number, so the relaunch resumes at the next epoch.
-                self._save_snapshot(epoch)
-                if self._snapshot_mgr is not None:
-                    self._snapshot_mgr.wait()
-                print(
-                    f"Preempted at epoch {epoch}; snapshot committed. Resume "
-                    f"with train.snapshot_job_id={self.job_id} "
-                    f"train.snapshot_epoch={epoch}"
-                )
-                return
-        if self._snapshot_mgr is not None:
-            self._snapshot_mgr.wait()
+    def format_eval_line(self, epoch, m) -> str:
+        return (
+            f"Epoch {epoch} | Validation Loss: {m['val_loss']:.4f} | "
+            f"Accuracy: {m['val_accuracy']:.4f} | QWK: {m['qwk']:.4f}"
+        )

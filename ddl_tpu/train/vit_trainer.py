@@ -1,0 +1,180 @@
+"""ViT-family trainer: the vision transformer on the shared training loop.
+
+Same shape as the CNN Trainer (epoch periods, APTOS-style image loaders,
+masked full-coverage eval, QWK-gated snapshots) but driving the
+transformer-family step functions (``train/vit_steps.py``) over the 5-axis
+LM mesh.  Replaces the bespoke loop that lived in ``examples/train_vit.py``
+through round 2, which had no preemption guard, NaN watchdog, profiler
+hook, or checkpointing at all; the example is now an argparse shim.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from ddl_tpu import checkpoint as ckpt
+from ddl_tpu.config import DataConfig
+from ddl_tpu.data import (
+    DataLoader,
+    ShardedEpochSampler,
+    build_datasets,
+    shard_batch,
+)
+from ddl_tpu.models.vit import ViTConfig
+from ddl_tpu.parallel.sharding import LMMeshSpec
+from ddl_tpu.train.loop import BaseTrainer
+from ddl_tpu.train.vit_steps import make_vit_step_fns
+from ddl_tpu.utils import MetricLogger, masked_classification_eval
+
+__all__ = ["ViTRunConfig", "ViTTrainer"]
+
+
+@dataclasses.dataclass
+class ViTRunConfig:
+    batch: int = 32
+    epochs: int = 3
+    num_microbatches: int = 0
+    accum_steps: int = 1
+    pipeline_schedule: str = "gpipe"
+    virtual_stages: int = 1
+    checkpoint_dir: str | None = "checkpoints"
+    resume_epoch: int | None = None
+    save_best_qwk: bool = True
+    job_id: str = "vit"
+    log_dir: str | None = "training_logs"  # default-on CSV observability
+    halt_on_nan: bool = True
+    preemption_save: bool = True
+    profile_dir: str | None = None
+
+
+class ViTTrainer(BaseTrainer):
+    best_metric = "qwk"
+    best_mode = "max"
+    best_label = "QWK"
+
+    def __init__(
+        self,
+        cfg: ViTConfig,
+        spec: LMMeshSpec,
+        tx,
+        run: ViTRunConfig,
+        data: DataConfig | None = None,
+        datasets=None,
+        rng: jax.Array | None = None,
+    ) -> None:
+        self.cfg, self.spec, self.run = cfg, spec, run
+        self.job_id = run.job_id
+        self.fns = make_vit_step_fns(
+            cfg, spec, tx, rng if rng is not None else jax.random.key(0),
+            run.batch,
+            num_microbatches=run.num_microbatches,
+            accum_steps=run.accum_steps,
+            pipeline_schedule=run.pipeline_schedule,
+            virtual_stages=run.virtual_stages,
+        )
+
+        dc = data if data is not None else DataConfig(
+            image_size=cfg.image_size,
+            global_batch_size=run.batch,
+            eval_batch_size=run.batch,
+        )
+        train_ds, test_ds = (
+            datasets if datasets is not None else build_datasets(dc)
+        )
+        n_proc, proc = jax.process_count(), jax.process_index()
+        self.train_loader = DataLoader(
+            train_ds, run.batch // n_proc,
+            sampler=ShardedEpochSampler(len(train_ds), n_proc, proc, seed=0),
+        )
+        # deterministic full-coverage eval: ordered, sentinel-padded to
+        # static shapes, padded rows (label -1) masked out — same contract
+        # as the CNN Trainer's eval loop
+        self.test_loader = DataLoader(
+            test_ds, run.batch // n_proc,
+            sampler=ShardedEpochSampler(
+                len(test_ds), n_proc, proc,
+                shuffle=False, drop_last=False, pad_mode="sentinel", seed=1,
+            ),
+            drop_last=False, pad_last_batch=True,
+        )
+
+        self.is_logging_process = proc == 0
+        self.logger = (
+            MetricLogger(run.log_dir, run.job_id, global_rank=proc,
+                         local_rank=proc)
+            if run.log_dir
+            else None
+        )
+        self.num_periods = run.epochs
+        self.halt_on_nan = run.halt_on_nan
+        self.preemption_save = run.preemption_save and bool(run.checkpoint_dir)
+        self.profile_dir = run.profile_dir
+        self.save_best = run.save_best_qwk and bool(run.checkpoint_dir)
+        self.best_value = -1.0
+
+        self.state = self.fns.init_state()
+        self.periods_run = 0
+        if run.checkpoint_dir and run.resume_epoch is not None:
+            self.state, self.periods_run = ckpt.load_snapshot(
+                run.checkpoint_dir, run.job_id, run.resume_epoch, self.state
+            )
+            print(f"resumed; continuing at epoch {self.periods_run}")
+
+    # ------------------------------------------------------- loop hooks
+
+    def run_period(self, epoch: int, guard=None):
+        self.train_loader.set_epoch(epoch)
+        losses, steps = [], 0
+        for images, labels in self.train_loader:
+            gi, gl = shard_batch(self.fns.mesh, images, labels)
+            self.state, m = self.fns.train(self.state, gi, gl)
+            losses.append(float(m["loss"]))
+            steps += 1
+            if guard is not None and guard.requested:
+                break
+        if steps == 0:
+            raise RuntimeError("empty epoch: dataset smaller than one batch")
+        return {"loss": float(np.mean(losses))}, steps
+
+    def evaluate_period(self, epoch: int) -> dict:
+        self.test_loader.set_epoch(epoch)
+        logits, targets = [], []
+        for images, labels in self.test_loader:
+            gi, gl = shard_batch(self.fns.mesh, images, labels)
+            logits.append(np.asarray(self.fns.evaluate(self.state, gi)))
+            targets.append(np.asarray(gl))
+        return masked_classification_eval(
+            np.concatenate(logits), np.concatenate(targets)
+        )
+
+    def rate_metrics(self, steps: int, elapsed: float) -> dict:
+        return {"img_per_sec": steps * self.run.batch / elapsed}
+
+    def format_train_line(self, epoch, elapsed, steps, m) -> str:
+        return (
+            f"epoch {epoch}: loss {m['loss']:.4f} ({steps} steps, "
+            f"{elapsed:.1f}s, {steps / elapsed:.2f} steps/s)"
+        )
+
+    def format_eval_line(self, epoch, m) -> str:
+        return (
+            f"epoch {epoch}: val_acc {m['val_accuracy']:.4f} "
+            f"qwk {m['qwk']:.4f}"
+        )
+
+    def save_snapshot(self, epoch: int) -> None:
+        path = ckpt.save_snapshot(
+            self.run.checkpoint_dir, self.job_id, epoch, self.state
+        )
+        print(f"epoch {epoch} | saved snapshot to {path}")
+
+    def last_snapshot_hint(self):
+        if not self.run.checkpoint_dir:
+            return "none (set checkpoint_dir)"
+        return ckpt.latest_epoch(self.run.checkpoint_dir, self.job_id)
+
+    def resume_hint(self, epoch: int) -> str:
+        return f"--job-id {self.job_id} --resume-epoch {epoch}"

@@ -1,9 +1,12 @@
-"""Train the transformer LM family on synthetic byte sequences.
+"""Train the transformer LM family on synthetic byte sequences or a corpus.
 
-Demonstrates the sharding-rule-driven strategy surface the CNN entry points
-cannot express (models/transformer.py): tensor parallelism, ring-attention
-sequence parallelism, MoE expert parallelism, and FSDP — all selected from
-the command line as mesh axis sizes, no code changes.
+Argparse shim over ``ddl_tpu.train.lm_trainer.LMTrainer`` (the shared
+training loop: default-on CSV logging, NaN watchdog, SIGTERM
+checkpoint-and-exit, profiler hook).  Demonstrates the sharding-rule-driven
+strategy surface the CNN entry points cannot express
+(models/transformer.py): tensor parallelism, ring-attention sequence
+parallelism, MoE expert parallelism, and FSDP — all selected from the
+command line as mesh axis sizes, no code changes.
 
     python examples/train_lm.py --data 2 --seq 2 --model 2 --steps 100
     python examples/train_lm.py --experts 4 --expert-axis 2 --fsdp
@@ -17,7 +20,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -96,18 +98,24 @@ def main() -> None:
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="simulate N CPU devices (dev/test)")
     ap.add_argument("--checkpoint-dir", default=None,
-                    help="save a snapshot every --save-every steps")
+                    help="save a snapshot every --save-every steps (and on "
+                    "held-out perplexity improvements / SIGTERM preemption)")
     ap.add_argument("--save-every", type=int, default=50)
     ap.add_argument("--resume-step", type=int, default=None,
                     help="restore the snapshot saved at this step (any mesh, "
                     "any pipeline layout — the saved layout is read from the "
                     "snapshot's metadata)")
     ap.add_argument("--job-id", default="lm")
-    ap.add_argument("--log-dir", default=None,
-                    help="write the shared MetricLogger CSV suite (loss, "
-                    "tokens_per_sec, val_loss/val_ppl, epoch_time) under "
-                    "this dir so ddl_tpu.bench.analysis aggregates LM runs "
-                    "alongside the CNN/ViT families")
+    ap.add_argument("--log-dir", default="training_logs",
+                    help="MetricLogger CSV suite directory (loss, "
+                    "tokens_per_sec, val_loss/val_ppl, epoch_time), "
+                    "default-on so ddl_tpu.bench.analysis aggregates LM "
+                    "runs alongside the CNN/ViT families; '' disables")
+    ap.add_argument("--profile-dir", default=None,
+                    help="capture a jax.profiler trace of one post-warmup "
+                    "step window into this dir")
+    ap.add_argument("--no-halt-on-nan", action="store_true",
+                    help="keep training through non-finite losses")
     args = ap.parse_args()
 
     if args.cpu_devices:
@@ -115,12 +123,11 @@ def main() -> None:
 
         force_cpu_devices(args.cpu_devices)
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from ddl_tpu.models.transformer import REMAT_POLICIES, LMConfig
     from ddl_tpu.parallel.sharding import LMMeshSpec
-    from ddl_tpu.train.lm_steps import make_lm_step_fns
+    from ddl_tpu.train.lm_trainer import LMRunConfig, LMTrainer
+    from ddl_tpu.train.state import build_optimizer
 
     if args.remat_policy not in REMAT_POLICIES:
         ap.error(f"--remat-policy must be one of {REMAT_POLICIES}")
@@ -152,8 +159,6 @@ def main() -> None:
     spec = LMMeshSpec(
         args.data, args.seq, args.model, args.expert_axis, pipe=args.pipe
     )
-    from ddl_tpu.train.state import build_optimizer
-
     tx = build_optimizer(
         args.lr,
         weight_decay=args.weight_decay,
@@ -162,221 +167,28 @@ def main() -> None:
         warmup_steps=args.warmup,
         decay_steps=args.steps if args.cosine else 0,
     )
-    fns = make_lm_step_fns(
-        cfg, spec, tx, jax.random.key(0), args.batch, args.seq_len,
-        num_microbatches=args.microbatches, accum_steps=args.accum,
+    run = LMRunConfig(
+        batch=args.batch,
+        seq_len=args.seq_len,
+        steps=args.steps,
+        num_microbatches=args.microbatches,
+        accum_steps=args.accum,
         pipeline_schedule=args.pipeline_schedule,
         virtual_stages=args.virtual_stages,
+        corpus=args.corpus,
+        eval_every=args.eval_every,
+        eval_frac=args.eval_frac,
+        checkpoint_dir=args.checkpoint_dir,
+        save_every=args.save_every,
+        resume_step=args.resume_step,
+        job_id=args.job_id,
+        log_dir=args.log_dir or None,
+        halt_on_nan=not args.no_halt_on_nan,
+        profile_dir=args.profile_dir,
     )
+    trainer = LMTrainer(cfg, spec, tx, run)
     print(f"mesh={spec} experts={args.experts} fsdp={args.fsdp}")
-
-    logger = None
-    if args.log_dir and jax.process_index() == 0:
-        from ddl_tpu.utils import MetricLogger
-
-        logger = MetricLogger(args.log_dir, args.job_id)
-
-    if args.corpus:
-        # real corpus: memmapped token windows, host-sharded per process;
-        # each process loads 1/n_proc of the global batch and the shards
-        # are assembled into one global jax.Array
-        from ddl_tpu.data.lm_corpus import TokenBatches, TokenCorpus, encode_text_file
-
-        n_proc, proc = jax.process_count(), jax.process_index()
-        if args.batch % n_proc:
-            raise ValueError(
-                f"--batch {args.batch} must divide by process count {n_proc}"
-            )
-        path = args.corpus
-        if not path.endswith(".npy"):
-            npy = path + ".npy"
-            stale = not os.path.exists(npy) or (
-                os.path.getmtime(npy) < os.path.getmtime(path)
-            )
-            if stale and proc == 0:  # encode once, one writer
-                encode_text_file(path, npy)
-            if n_proc > 1:
-                from jax.experimental import multihost_utils
-
-                multihost_utils.sync_global_devices("corpus_encode")
-            path = npy
-        corpus = TokenCorpus(path, args.seq_len)
-        if corpus.max_token() >= cfg.vocab_size:
-            raise ValueError(
-                f"corpus has token id {corpus.max_token()} but the model's "
-                f"vocab_size is {cfg.vocab_size}; out-of-range ids would be "
-                "silently clamped by the embedding gather"
-            )
-        eval_view = None
-        if args.eval_every:
-            train_view, ev = corpus.split(args.eval_frac)
-            if len(ev) >= args.batch:
-                eval_view = ev
-            else:
-                # too small to fill one batch: keep every window for training
-                print(f"note: eval split ({len(ev)} windows) smaller than one "
-                      f"batch of {args.batch}; held-out eval disabled — grow "
-                      "--eval-frac or shrink --batch")
-                train_view = corpus
-        else:
-            train_view = corpus
-        batches = TokenBatches(
-            train_view, args.batch // n_proc, n_proc, proc, seed=0
-        )
-        eval_batches = (
-            TokenBatches(eval_view, args.batch // n_proc, n_proc, proc,
-                         shuffle=False, seed=0)
-            if eval_view is not None
-            else None
-        )
-        print(f"corpus: {len(corpus)} windows of {args.seq_len}+1 tokens, "
-              f"{len(batches)} train batches/epoch/host"
-              + (f", {len(eval_batches)} eval batches" if eval_batches else ""))
-        if n_proc > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            gspec = NamedSharding(fns.mesh, P("data", "seq"))
-
-        def sample_batch(step):
-            # pure in step -> a resumed run continues the stream exactly
-            inp, tgt = batches.batch_at(step)
-            if n_proc > 1:  # host shards -> one global array
-                return (
-                    jax.make_array_from_process_local_data(gspec, inp),
-                    jax.make_array_from_process_local_data(gspec, tgt),
-                )
-            return jnp.asarray(inp), jnp.asarray(tgt)
-    else:
-        # synthetic corpus: byte sequences from a fixed order-1 Markov
-        # chain — learnable structure with a known entropy floor (shared
-        # with generate_lm.py via ddl_tpu.data.synthetic_lm)
-        from ddl_tpu.data.synthetic_lm import MarkovChain
-
-        chain = MarkovChain()
-
-        def sample_batch(step):
-            # seeded by step so a resumed run continues the stream instead
-            # of re-consuming batches the original run already trained on
-            rng = np.random.default_rng(1000 + step)
-            seqs = chain.sample(rng, args.batch, args.seq_len + 1)
-            return jnp.asarray(seqs[:, :-1]), jnp.asarray(seqs[:, 1:])
-
-    state = fns.init_state()
-    start = 0
-    if args.checkpoint_dir and args.resume_step is not None:
-        from ddl_tpu.checkpoint import load_snapshot, snapshot_metadata
-        from ddl_tpu.parallel.lm_pipeline import (
-            saved_pipe_stages,
-            saved_virtual_stages,
-        )
-
-        # The snapshot itself records its layout (pipe stages AND
-        # interleaved virtual count) — no flag to get wrong.
-        saved_md = snapshot_metadata(
-            args.checkpoint_dir, args.job_id, args.resume_step
-        )
-        saved_pipe = saved_pipe_stages(saved_md["state"]["params"])
-        saved_virtual = saved_virtual_stages(saved_md["state"]["params"])
-        if saved_pipe == args.pipe and saved_virtual == args.virtual_stages:
-            state, _ = load_snapshot(
-                args.checkpoint_dir, args.job_id, args.resume_step, state
-            )
-            print("resumed (snapshots are mesh-independent)")
-        else:
-            # Cross-layout resume: the snapshot was written with a
-            # different pipe stage count (possibly none).  Restore through
-            # an abstract skeleton of the saved layout (no init, no step
-            # functions — the saved run's batch/mesh/flash settings are
-            # irrelevant to the state tree), then restructure params +
-            # optimizer state and re-place onto this run's mesh.
-            from ddl_tpu.parallel.lm_pipeline import (
-                abstract_lm_state,
-                convert_lm_state,
-            )
-
-            restored, _ = load_snapshot(
-                args.checkpoint_dir, args.job_id, args.resume_step,
-                abstract_lm_state(
-                    cfg, tx, saved_pipe, mesh=fns.mesh, virtual=saved_virtual
-                ),
-            )
-            if args.pipe > 1:
-                if saved_pipe > 1:  # restage: merge, then re-split below
-                    restored = convert_lm_state(restored)
-                state = convert_lm_state(
-                    restored, n_stages=args.pipe,
-                    virtual=args.virtual_stages, like=state,
-                )
-            else:  # saved_pipe > 1 here (layouts differ): merge + place
-                state = convert_lm_state(restored, like=state)
-            print(
-                f"resumed across layouts (saved pipe={saved_pipe} "
-                f"virtual={saved_virtual} -> run pipe={args.pipe} "
-                f"virtual={args.virtual_stages})"
-            )
-        start = int(state.step)
-        print(f"continuing from step {start}")
-    def eval_heldout(step):
-        import math
-
-        def to_global(x):
-            # multi-host: assemble host shards into one global array, same
-            # as the training batches
-            if n_proc > 1:
-                return jax.make_array_from_process_local_data(gspec, x)
-            return jnp.asarray(x)
-
-        ces = []
-        for e_inp, e_tgt in eval_batches:
-            em = fns.evaluate(state, to_global(e_inp), to_global(e_tgt))
-            ces.append(float(em["ce"]))
-        ce = float(np.mean(ces))
-        print(f"  heldout: ce {ce:.4f} ppl {math.exp(ce):.2f} "
-              f"({len(ces)} batches)")
-        if logger is not None:
-            logger.log("val_loss", ce, step)
-            logger.log("val_ppl", math.exp(ce), step)
-
-    t0 = time.perf_counter()
-    t_window, window_start = t0, start
-    for i in range(start, args.steps):
-        inp, tgt = sample_batch(i)
-        state, m = fns.train(state, inp, tgt)
-        if i % 10 == 0 or i == args.steps - 1:
-            print(
-                f"step {i:4d} loss {float(m['loss']):.4f} "
-                f"ce {float(m['ce']):.4f} moe_aux {float(m['moe_aux']):.4f}"
-            )
-            if logger is not None:
-                logger.log("loss", float(m["loss"]), i)
-                logger.log("ce", float(m["ce"]), i)
-                now = time.perf_counter()
-                if i > window_start:  # steady-state window rate
-                    sps = (i - window_start) / (now - t_window)
-                    logger.log("steps_per_sec", sps, i)
-                    logger.log(
-                        "tokens_per_sec", sps * args.batch * args.seq_len, i
-                    )
-                t_window, window_start = now, i
-        aux_work = False
-        if (args.corpus and args.eval_every and eval_batches
-                and (i + 1) % args.eval_every == 0):
-            eval_heldout(i)
-            aux_work = True
-        if args.checkpoint_dir and (i + 1) % args.save_every == 0:
-            from ddl_tpu.checkpoint import save_snapshot
-
-            save_snapshot(args.checkpoint_dir, args.job_id, i + 1, state)
-            aux_work = True
-        if aux_work:
-            # keep eval/checkpoint walls out of the logged steady-state rate
-            t_window, window_start = time.perf_counter(), i + 1
-    steps_run = args.steps - start
-    dt = time.perf_counter() - t0
-    print(f"{steps_run} steps in {dt:.1f}s ({steps_run / dt:.2f} steps/s)")
-    if logger is not None:
-        # whole run as one "epoch" row so epoch_time_per_job covers LM jobs
-        logger.log("epoch_time", dt, 0)
+    trainer.train()
 
 
 if __name__ == "__main__":

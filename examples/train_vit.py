@@ -1,9 +1,12 @@
 """Train the ViT family on the APTOS-shape image data path.
 
-Second vision model family (models/vit.py): the LM's transformer blocks
-run bidirectionally over a patch sequence, sharded TP over heads/MLP and
-DP over batch by the same logical-axis rule table — where the reference
-supports exactly one vision model (DenseNet121, single.py:297-299).
+Argparse shim over ``ddl_tpu.train.vit_trainer.ViTTrainer`` (the shared
+training loop: default-on CSV logging, NaN watchdog, QWK-gated snapshots,
+SIGTERM checkpoint-and-exit, profiler hook).  Second vision model family
+(models/vit.py): the LM's transformer blocks run bidirectionally over a
+patch sequence, sharded TP over heads/MLP and DP over batch by the same
+logical-axis rule table — where the reference supports exactly one vision
+model (DenseNet121, single.py:297-299).
 
     python examples/train_vit.py --cpu-devices 8 --data 2 --model 2 \
         --image-size 32 --patch 8 --epochs 2
@@ -18,7 +21,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -56,12 +58,21 @@ def main() -> None:
                     help="synthetic train examples (when no real dataset)")
     ap.add_argument("--num-test", type=int, default=64)
     ap.add_argument("--cpu-devices", type=int, default=0)
+    ap.add_argument("--checkpoint-dir", default="checkpoints",
+                    help="QWK-gated / preemption snapshot dir ('' disables)")
+    ap.add_argument("--resume-epoch", type=int, default=None,
+                    help="restore the snapshot saved at this epoch")
     ap.add_argument("--job-id", default="vit")
-    ap.add_argument("--log-dir", default=None,
-                    help="write the shared MetricLogger CSV suite (loss, "
-                    "img_per_sec, val_loss/val_accuracy/qwk, epoch_time) so "
-                    "ddl_tpu.bench.analysis aggregates ViT runs alongside "
-                    "the CNN/LM families")
+    ap.add_argument("--log-dir", default="training_logs",
+                    help="MetricLogger CSV suite directory (loss, "
+                    "img_per_sec, val_loss/val_accuracy/qwk, epoch_time), "
+                    "default-on so ddl_tpu.bench.analysis aggregates ViT "
+                    "runs alongside the CNN/LM families; '' disables")
+    ap.add_argument("--profile-dir", default=None,
+                    help="capture a jax.profiler trace of one post-warmup "
+                    "epoch into this dir")
+    ap.add_argument("--no-halt-on-nan", action="store_true",
+                    help="keep training through non-finite losses")
     args = ap.parse_args()
 
     if args.cpu_devices:
@@ -69,15 +80,12 @@ def main() -> None:
 
         force_cpu_devices(args.cpu_devices)
     import jax
-    import numpy as np
 
     from ddl_tpu.config import DataConfig
-    from ddl_tpu.data import DataLoader, ShardedEpochSampler, build_datasets, shard_batch
     from ddl_tpu.models.vit import ViTConfig
     from ddl_tpu.parallel.sharding import LMMeshSpec
     from ddl_tpu.train.state import build_optimizer
-    from ddl_tpu.train.vit_steps import make_vit_step_fns
-    from ddl_tpu.utils.metrics import masked_classification_eval
+    from ddl_tpu.train.vit_trainer import ViTRunConfig, ViTTrainer
 
     cfg = ViTConfig(
         image_size=args.image_size,
@@ -94,14 +102,20 @@ def main() -> None:
     )
     spec = LMMeshSpec(data=args.data, model=args.model, pipe=args.pipe)
     tx = build_optimizer(args.lr, weight_decay=0.05, grad_clip_norm=1.0)
-    fns = make_vit_step_fns(cfg, spec, tx, jax.random.key(0), args.batch,
-                            num_microbatches=args.microbatches,
-                            accum_steps=args.accum,
-                            pipeline_schedule=args.pipeline_schedule,
-                            virtual_stages=args.virtual_stages)
-    print(f"mesh=(data={args.data}, model={args.model}, pipe={args.pipe}) "
-          f"fsdp={args.fsdp} patches={cfg.num_patches}")
-
+    run = ViTRunConfig(
+        batch=args.batch,
+        epochs=args.epochs,
+        num_microbatches=args.microbatches,
+        accum_steps=args.accum,
+        pipeline_schedule=args.pipeline_schedule,
+        virtual_stages=args.virtual_stages,
+        checkpoint_dir=args.checkpoint_dir or None,
+        resume_epoch=args.resume_epoch,
+        job_id=args.job_id,
+        log_dir=args.log_dir or None,
+        halt_on_nan=not args.no_halt_on_nan,
+        profile_dir=args.profile_dir,
+    )
     dc = DataConfig(
         image_size=args.image_size,
         global_batch_size=args.batch,
@@ -109,58 +123,10 @@ def main() -> None:
         synthetic_num_train=args.num_train,
         synthetic_num_test=args.num_test,
     )
-    train_ds, test_ds = build_datasets(dc)
-    n_proc, proc = jax.process_count(), jax.process_index()
-    train_loader = DataLoader(
-        train_ds, args.batch // n_proc,
-        sampler=ShardedEpochSampler(len(train_ds), n_proc, proc, seed=0),
-    )
-    # deterministic full-coverage eval: ordered, sentinel-padded to static
-    # shapes, padded rows (label -1) masked out — same contract as the CNN
-    # Trainer's eval loop
-    test_loader = DataLoader(
-        test_ds, args.batch // n_proc,
-        sampler=ShardedEpochSampler(
-            len(test_ds), n_proc, proc,
-            shuffle=False, drop_last=False, pad_mode="sentinel", seed=1,
-        ),
-        drop_last=False, pad_last_batch=True,
-    )
-
-    logger = None
-    if args.log_dir and proc == 0:
-        from ddl_tpu.utils import MetricLogger
-
-        logger = MetricLogger(args.log_dir, args.job_id)
-
-    state = fns.init_state()
-    for epoch in range(args.epochs):
-        train_loader.set_epoch(epoch)
-        t0 = time.perf_counter()
-        losses, steps = [], 0
-        for images, labels in train_loader:
-            gi, gl = shard_batch(fns.mesh, images, labels)
-            state, m = fns.train(state, gi, gl)
-            losses.append(float(m["loss"]))
-            steps += 1
-        dt = time.perf_counter() - t0
-        logits, targets = [], []
-        for images, labels in test_loader:
-            gi, gl = shard_batch(fns.mesh, images, labels)
-            logits.append(np.asarray(fns.evaluate(state, gi)))
-            targets.append(np.asarray(gl))
-        mets = masked_classification_eval(
-            np.concatenate(logits), np.concatenate(targets)
-        )
-        print(f"epoch {epoch}: loss {np.mean(losses):.4f} "
-              f"({steps} steps, {dt:.1f}s, {steps / dt:.2f} steps/s) | "
-              f"val_acc {mets['val_accuracy']:.4f} qwk {mets['qwk']:.4f}")
-        if logger is not None:
-            logger.log("loss", float(np.mean(losses)), epoch)
-            logger.log("epoch_time", dt, epoch)
-            logger.log("steps_per_sec", steps / dt, epoch)
-            logger.log("img_per_sec", steps * args.batch / dt, epoch)
-            logger.log_many(mets, epoch)
+    trainer = ViTTrainer(cfg, spec, tx, run, data=dc)
+    print(f"mesh=(data={args.data}, model={args.model}, pipe={args.pipe}) "
+          f"fsdp={args.fsdp} patches={cfg.num_patches}")
+    trainer.train()
 
 
 if __name__ == "__main__":

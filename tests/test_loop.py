@@ -1,0 +1,130 @@
+"""The shared training loop (train/loop.BaseTrainer): the generic concerns
+— NaN watchdog, best-metric snapshot gating, fixed-cadence snapshots,
+preemption — tested family-independently with a stub, plus the LM family's
+period arithmetic (cadence GCD, boundary mapping)."""
+
+import math
+
+import pytest
+
+from ddl_tpu.train.loop import BaseTrainer
+from ddl_tpu.utils.preemption import PreemptionGuard
+
+
+class _Stub(BaseTrainer):
+    period_label = "Epoch"
+
+    def __init__(self, losses, evals=None, *, best_metric=None,
+                 best_mode="max", save_best=True, cadence=0):
+        self.state = None
+        self.job_id = "stub"
+        self.logger = None
+        self.is_logging_process = True
+        self.periods_run = 0
+        self.num_periods = len(losses)
+        self.halt_on_nan = True
+        self.preemption_save = False
+        self.profile_dir = None
+        self.save_best = save_best
+        self.best_metric = best_metric
+        self.best_mode = best_mode
+        self.best_value = -float("inf") if best_mode == "max" else float("inf")
+        self._losses = losses
+        self._evals = evals or {}
+        self._cadence = cadence
+        self.saves = []
+        self.waited = False
+
+    def run_period(self, period, guard=None):
+        if getattr(self, "request_at", None) == period and guard is not None:
+            guard.request()
+        return {"loss": self._losses[period]}, 5
+
+    def evaluate_period(self, period):
+        return self._evals.get(period)
+
+    def snapshot_due(self, period):
+        return bool(self._cadence) and (period + 1) % self._cadence == 0
+
+    def save_snapshot(self, period):
+        self.saves.append(period)
+
+    def wait_for_saves(self):
+        self.waited = True
+
+
+def test_nan_watchdog_halts():
+    t = _Stub([1.0, float("nan"), 0.5])
+    with pytest.raises(RuntimeError, match="Non-finite"):
+        t.train()
+    assert t.periods_run == 1  # the bad period is not committed
+
+
+def test_best_metric_gate_min_mode():
+    evals = {0: {"val_ppl": 9.0}, 1: {"val_ppl": 11.0}, 2: {"val_ppl": 7.0}}
+    t = _Stub([1.0, 1.0, 1.0], evals, best_metric="val_ppl", best_mode="min")
+    t.train()
+    assert t.saves == [0, 2]  # improvement only; the regression is skipped
+    assert t.best_value == 7.0
+    assert t.waited
+
+
+def test_best_metric_gate_max_mode_and_disabled():
+    evals = {0: {"qwk": 0.1}, 1: {"qwk": 0.5}, 2: {"qwk": 0.4}}
+    t = _Stub([1.0] * 3, evals, best_metric="qwk", best_mode="max")
+    t.train()
+    assert t.saves == [0, 1]
+    t2 = _Stub([1.0] * 3, evals, best_metric="qwk", save_best=False)
+    t2.train()
+    assert t2.saves == []
+
+
+def test_fixed_cadence_snapshots():
+    t = _Stub([1.0] * 6, cadence=2)
+    t.train()
+    assert t.saves == [1, 3, 5]
+
+
+def test_preemption_saves_and_stops():
+    t = _Stub([1.0] * 100)
+    t.request_at = 2
+    with PreemptionGuard() as guard:
+        guard_installed = guard
+        t.train(guard=guard)
+    assert t.periods_run == 3  # periods 0..2 ran, then clean exit
+    assert t.saves == [2]
+    assert t.waited
+    assert guard_installed.requested
+
+
+def test_lm_period_arithmetic():
+    """Period boundaries are the union of the cadences' multiples — each
+    cadence fires exactly at its own multiples, and coprime cadences don't
+    collapse the window to single steps (round-2 review finding)."""
+    from ddl_tpu.train.lm_trainer import LMRunConfig, LMTrainer
+
+    run = LMRunConfig(steps=47, log_every=10, eval_every=7,
+                      checkpoint_dir="x", save_every=20)
+    t = object.__new__(LMTrainer)  # period math only; no model build
+    t.run = run
+    bounds = {47}
+    for c in (10, 7, 20):
+        bounds.update(range(c, 48, c))
+    t._boundaries = sorted(bounds)
+    t._start_step = 0
+    # union, not GCD: gcd(10,7,20)=1 but the windows stay multi-step
+    assert t._boundaries == [7, 10, 14, 20, 21, 28, 30, 35, 40, 42, 47]
+    assert t._period_bounds(0) == (0, 7)
+    assert t._period_bounds(1) == (7, 10)
+    assert t._period_bounds(10) == (42, 47)  # final partial window
+    # every eval/save multiple is a boundary; eval fires only at its own
+    ends = {t._period_bounds(p)[1] for p in range(len(t._boundaries))}
+    assert all(m in ends for m in range(7, 47, 7))
+    assert 20 in ends and 40 in ends
+    assert all(e % 7 == 0 for e in ends if not (e % 7))  # sanity
+    # resume mid-stream: the first period starts at the resume step
+    t._start_step = 43
+    assert t._period_bounds(10) == (43, 47)
+    import bisect
+
+    assert bisect.bisect_right(t._boundaries, 42) == 10  # resume cursor

@@ -74,6 +74,7 @@ def test_train_vit_writes_metric_csvs(tmp_path):
         "--d-model", "32", "--layers", "2",
         "--num-train", "24", "--num-test", "13",  # odd test size: padding path
         "--log-dir", str(log_dir), "--job-id", "vit-test",
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
     ])
     job_dir = log_dir / "by_job_id" / "vit-test"
     for metric in (

@@ -72,6 +72,50 @@ def test_guard_flags_and_restores_handler():
         signal.signal(signal.SIGTERM, prev)
 
 
+def test_lm_sigterm_checkpoints_and_resumes(tmp_path):
+    """The flagship LM family survives preemption too: SIGTERM mid-window
+    leaves a step-labelled resumable snapshot, and the relaunch continues
+    the training stream from it (VERDICT round 2, task 1)."""
+    import optax
+
+    from ddl_tpu.checkpoint import latest_epoch as latest_step
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_trainer import LMRunConfig, LMTrainer
+
+    # vocab covers the synthetic Markov byte stream (ids 0..255)
+    cfg = LMConfig(
+        vocab_size=256, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+        d_ff=64, compute_dtype="float32", remat=False,
+    )
+
+    def _run(steps, resume=None):
+        return LMRunConfig(
+            batch=4, seq_len=16, steps=steps, job_id="lm-preempt",
+            checkpoint_dir=str(tmp_path / "ckpt"), save_every=10**9,
+            resume_step=resume, log_dir=str(tmp_path / "logs"),
+        )
+
+    trainer = LMTrainer(cfg, LMMeshSpec(), optax.adam(1e-3), _run(10**6))
+    timer = threading.Timer(1.0, os.kill, (os.getpid(), signal.SIGTERM))
+    timer.start()
+    try:
+        trainer.train()  # returns instead of dying
+    finally:
+        timer.cancel()
+
+    saved = latest_step(tmp_path / "ckpt", "lm-preempt")
+    assert saved is not None and 0 < saved < 10**6
+    assert saved == int(trainer.state.step)
+
+    resumed = LMTrainer(
+        cfg, LMMeshSpec(), optax.adam(1e-3), _run(saved + 5, resume=saved)
+    )
+    assert resumed._start_step == saved
+    resumed.train()
+    assert int(resumed.state.step) == saved + 5
+
+
 def test_sigterm_mid_training_checkpoints_and_resumes(tmp_path, monkeypatch):
     from ddl_tpu.train import Trainer
 

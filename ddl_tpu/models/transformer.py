@@ -249,15 +249,11 @@ class Attention(nn.Module):
         k = nn.with_logical_constraint(k, spec)
         v = nn.with_logical_constraint(v, spec)
         if kv_cache is None:
-            if cfg.kv_heads != cfg.n_heads and self.attn_core is not None:
-                # the manual cores (ring/Ulysses/flash) are written for
-                # equal head counts: broadcast each K/V head over its query
-                # group up front (XLA fuses the broadcast into the core's
-                # matmuls; the projection/cache savings are unaffected).
-                # The default dense core groups natively — no repeat.
-                g = cfg.n_heads // cfg.kv_heads
-                k = jnp.repeat(k, g, axis=2)
-                v = jnp.repeat(v, g, axis=2)
+            # every core is grouped-native (dense groups by query reshape;
+            # flash indexes the shared K/V head per BlockSpec; ring
+            # ppermutes and Ulysses all-to-alls Hkv-head K/V) — K/V are
+            # never broadcast to H heads, so the manual cores' HBM and
+            # collective traffic keep GQA's Hkv/H savings.
             core = self.attn_core or partial(
                 dense_attention, causal=cfg.causal, window=cfg.attn_window
             )

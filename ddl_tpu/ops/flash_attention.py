@@ -23,6 +23,13 @@ Layout: (B, T, H, D) public API; internally heads fold into the grid's
 leading dimension so each program works on one (head, Q-block, K-block)
 cell.  Interpret mode (CPU) is auto-selected off-TPU so the same tests run
 on the simulated mesh.
+
+Grouped-query attention is native: with ``Hkv < H`` K/V heads
+(``H % Hkv == 0``), the K/V BlockSpecs index the shared K/V head for each
+query head's grid row directly — K/V are never materialised at H heads, so
+the K/V tensors (and the dK/dV gradients, which the backward accumulates at
+Hkv granularity over every query head in the group) stay ``H/Hkv`` times
+smaller in HBM than a repeat-then-attend lowering.
 """
 
 from __future__ import annotations
@@ -155,15 +162,18 @@ def _dq_kernel(
 
 def _dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_sc, dv_sc, *, scale, causal, window=0,
+    dk_sc, dv_sc, *, scale, causal, window=0, q_blocks=1,
 ):
-    # grid: (bh, k_blocks, q_blocks) — innermost walks Q blocks
-    j, i = pl.program_id(1), pl.program_id(2)
-    nq = pl.num_programs(2)
+    # grid: (b*kv_heads, k_blocks, group*q_blocks) — the innermost
+    # dimension walks every (query head in the group, Q block) pair, so
+    # dK/dV accumulate over the whole query-head group at Hkv granularity
+    j, iz = pl.program_id(1), pl.program_id(2)
+    nz = pl.num_programs(2)
+    i = iz % q_blocks  # Q-block index within the current group member
     bk = k_ref.shape[1]
     bq = q_ref.shape[1]
 
-    @pl.when(i == 0)
+    @pl.when(iz == 0)
     def _():
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
@@ -192,7 +202,7 @@ def _dkdv_kernel(
             ds.T, q_blk, preferred_element_type=jnp.float32
         )
 
-    @pl.when(i == nq - 1)
+    @pl.when(iz == nz - 1)
     def _():
         dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)  # scale folded into q_blk
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
@@ -200,9 +210,19 @@ def _dkdv_kernel(
 
 
 
-def _flash_fwd_impl(q, k, v, causal, window, block_q, block_k, interpret):
+def _kv_row(b, q_heads, kv_heads):
+    """Folded K/V row serving folded Q/grid row ``b``: same batch, the
+    group's shared K/V head (identity when q_heads == kv_heads)."""
+    g = q_heads // kv_heads
+    return (b // q_heads) * kv_heads + (b % q_heads) // g
+
+
+def _flash_fwd_impl(
+    q, k, v, causal, window, block_q, block_k, interpret, q_heads, kv_heads
+):
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
+    kv_idx = lambda b, i, j: (_kv_row(b, q_heads, kv_heads), j, 0)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, window=window),
         out_shape=(
@@ -215,8 +235,8 @@ def _flash_fwd_impl(q, k, v, causal, window, block_q, block_k, interpret):
         grid=(bh, t // block_q, t // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -233,7 +253,7 @@ def _flash_fwd_impl(q, k, v, causal, window, block_q, block_k, interpret):
 
 
 def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
-                       block_q, block_k, interpret):
+                       block_q, block_k, interpret, q_heads, kv_heads):
     """Shared backward: the two flash kernels with
     ``ds = p * (dp - (delta - dlse))``.
 
@@ -243,8 +263,15 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
     schedule's cross-block combination does — and enters the kernels purely
     through the delta term: d lse_i/d s_ij = p_ij, so the correction folds
     into the same ``p * (...)`` product the kernels already compute.
+
+    Grouped K/V: dQ reads the group's shared K/V row per query head; the
+    dK/dV grid runs at K/V-head granularity with its innermost dimension
+    extended over every (group member, Q block) pair, accumulating the
+    whole group's contribution into one (bkv, t, d) gradient.
     """
     bh, t, d = q.shape
+    bkv = k.shape[0]
+    g = q_heads // kv_heads
     scale = 1.0 / (d ** 0.5)
     delta = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
@@ -252,8 +279,9 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
 
+    kv_idx = lambda b, i, j: (_kv_row(b, q_heads, kv_heads), j, 0)
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), kv_idx)
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
@@ -266,17 +294,30 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    # grid (bh, k_blocks, q_blocks): innermost dimension walks Q blocks
-    q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    row_spec_t = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+    # grid (bkv, k_blocks, g * q_blocks): outermost at K/V-head
+    # granularity, innermost walking every (group member, Q block) pair
+    nq = t // block_q
+
+    def q_row(b, iz):
+        return (b // kv_heads) * q_heads + (b % kv_heads) * g + iz // nq
+
+    q_spec_t = pl.BlockSpec(
+        (1, block_q, d), lambda b, j, iz: (q_row(b, iz), iz % nq, 0)
+    )
+    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, iz: (b, j, 0))
+    row_spec_t = pl.BlockSpec(
+        (1, 1, block_q), lambda b, j, iz: (q_row(b, iz), 0, iz % nq)
+    )
     dk, dv = pl.pallas_call(
-        functools.partial(_dkdv_kernel, scale=scale, causal=causal, window=window),
-        out_shape=(
-            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        functools.partial(
+            _dkdv_kernel, scale=scale, causal=causal, window=window,
+            q_blocks=nq,
         ),
-        grid=(bh, t // block_k, t // block_q),
+        out_shape=(
+            jax.ShapeDtypeStruct((bkv, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bkv, t, d), v.dtype),
+        ),
+        grid=(bkv, t // block_k, g * nq),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t],
         out_specs=(kv_spec_t, kv_spec_t),
         scratch_shapes=[
@@ -288,26 +329,57 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, causal, window, block_q, block_k, interpret):
-    return _flash_fwd_impl(q, k, v, causal, window, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(
+    q, k, v, causal, window, block_q, block_k, interpret, q_heads, kv_heads
+):
+    return _flash_fwd_impl(
+        q, k, v, causal, window, block_q, block_k, interpret, q_heads,
+        kv_heads,
+    )
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, window, block_q, block_k, interpret):
-    out, lse = _flash_fwd_impl(q, k, v, causal, window, block_q, block_k, interpret)
+def _flash_lse_vjp_fwd(
+    q, k, v, causal, window, block_q, block_k, interpret, q_heads, kv_heads
+):
+    out, lse = _flash_fwd_impl(
+        q, k, v, causal, window, block_q, block_k, interpret, q_heads,
+        kv_heads,
+    )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_vjp_bwd(causal, window, block_q, block_k, interpret, residuals, cts):
+def _flash_lse_vjp_bwd(
+    causal, window, block_q, block_k, interpret, q_heads, kv_heads,
+    residuals, cts,
+):
     do, dlse = cts
     q, k, v, out, lse = residuals
     return _flash_bwd_kernels(
         q, k, v, out, lse, do, dlse, causal, window, block_q, block_k,
-        interpret
+        interpret, q_heads, kv_heads,
     )
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+def _fold_heads(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _validate_flash_args(q, k, v, causal, window):
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True (sliding causal window)")
+    h, hkv = q.shape[2], k.shape[2]
+    if v.shape[2] != hkv:
+        raise ValueError(f"k has {hkv} heads but v has {v.shape[2]}")
+    if h % hkv:
+        raise ValueError(f"q heads {h} must divide by kv heads {hkv}")
+    return h, hkv
 
 
 def flash_attention(
@@ -320,7 +392,11 @@ def flash_attention(
     block_k: int = 512,
     interpret: bool | None = None,
 ):
-    """Flash attention. q, k, v: (B, T, H, D) -> (B, T, H, D).
+    """Flash attention. q: (B, T, H, D), k/v: (B, T, Hkv, D) -> (B, T, H, D).
+
+    Grouped-query attention is native: ``Hkv < H`` (``H % Hkv == 0``) makes
+    each K/V head serve ``H/Hkv`` query heads via BlockSpec indexing — the
+    K/V tensors and their gradients stay at Hkv heads end to end.
 
     ``window > 0`` (requires ``causal``) restricts each row to the last
     ``window`` positions — sliding-window attention, with blocks fully
@@ -336,23 +412,17 @@ def flash_attention(
     interpreter mode off-TPU so the kernel runs on the CPU-simulated mesh
     (tests) and compiled on real chips.
     """
-    if window < 0:
-        raise ValueError(f"window must be >= 0, got {window}")
-    if window and not causal:
-        raise ValueError("window > 0 requires causal=True (sliding causal window)")
+    h, hkv = _validate_flash_args(q, k, v, causal, window)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
-    b, t, h, d = q.shape
+    b, t, _, d = q.shape
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
-
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
     # one custom_vjp for both public entry points: dropping lse here hands
     # its backward a zero cotangent, which the shared kernels fold away
     out, _ = _flash_lse(
-        fold(q), fold(k), fold(v), causal, window, bq, bk, interpret
+        _fold_heads(q), _fold_heads(k), _fold_heads(v), causal, window,
+        bq, bk, interpret, h, hkv,
     )
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
@@ -369,29 +439,25 @@ def flash_attention_with_lse(
 ):
     """Flash attention that also returns the per-row logsumexp.
 
-    q, k, v: (B, T, H, D) -> (out (B, T, H, D), lse (B, H, T) float32) with
+    q: (B, T, H, D), k/v: (B, T, Hkv, D) -> (out (B, T, H, D),
+    lse (B, H, T) float32) with
     ``lse = log sum_j exp(q_i . k_j / sqrt(D))`` over the visible keys.
     Two partial attentions over disjoint key sets combine exactly as
     ``lse = logaddexp(lse1, lse2); out = out1*exp(lse1-lse) +
     out2*exp(lse2-lse)`` — the blockwise composition the ring schedule
     uses to run this kernel per K/V ring hop
     (``parallel/ring_attention.py``).  Differentiable in out AND lse
-    (shared backward kernels; the lse cotangent folds into delta)."""
-    if window < 0:
-        raise ValueError(f"window must be >= 0, got {window}")
-    if window and not causal:
-        raise ValueError("window > 0 requires causal=True (sliding causal window)")
+    (shared backward kernels; the lse cotangent folds into delta).
+    Grouped-query K/V (Hkv < H) supported as in ``flash_attention``."""
+    h, hkv = _validate_flash_args(q, k, v, causal, window)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
-    b, t, h, d = q.shape
+    b, t, _, d = q.shape
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
-
-    def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
     out, lse = _flash_lse(
-        fold(q), fold(k), fold(v), causal, window, bq, bk, interpret
+        _fold_heads(q), _fold_heads(k), _fold_heads(v), causal, window,
+        bq, bk, interpret, h, hkv,
     )
     return (
         out.reshape(b, h, t, d).transpose(0, 2, 1, 3),

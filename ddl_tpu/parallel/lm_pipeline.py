@@ -84,6 +84,7 @@ from ddl_tpu.parallel.sharding import (
     lm_logical_rules,
     normalize_flash,
     validate_kv_head_sharding,
+    validate_ulysses_kv_heads,
 )
 from ddl_tpu.train.lm_steps import (
     LMStepFns,
@@ -1030,6 +1031,7 @@ def make_lm_pipeline_step_fns(
                         "flash-under-Ulysses (heads are model-local in the "
                         "fully-manual region)"
                     )
+                validate_ulysses_kv_heads(cfg, spec)
                 from ddl_tpu.parallel.ulysses import ulysses_attention
 
                 inner = partial(

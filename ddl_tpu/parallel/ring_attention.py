@@ -35,17 +35,31 @@ _NEG_INF = -1e30
 def _block_attention(q, k, v, mask, scale):
     """One Q-shard x KV-block attention with unnormalised accumulation.
 
-    q: (B, Tq, H, D); k, v: (B, Tk, H, D); mask: (Tq, Tk) bool (True = keep).
-    Returns (block_acc (B,Tq,H,D), block_max (B,H,Tq), block_sum (B,H,Tq)).
+    q: (B, Tq, H, D); k, v: (B, Tk, Hkv, D); mask: (Tq, Tk) bool (True =
+    keep).  Returns (block_acc (B,Tq,H,D), block_max (B,H,Tq),
+    block_sum (B,H,Tq)).  Grouped-query K/V (``Hkv < H``, ``H % Hkv == 0``)
+    is handled by reshaping the query — K/V are never broadcast to H heads,
+    so the ring's ``ppermute`` hops carry only Hkv heads.
     """
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    scores = jnp.where(mask[None, None], scores, _NEG_INF)
-    blk_max = scores.max(axis=-1)
+    b, tq, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv  # == 1 for plain multi-head (the reshapes are free then)
+    qg = q.reshape(b, tq, hkv, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) * scale
+    scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    blk_max = scores.max(axis=-1)  # (b, hkv, g, tq)
     p = jnp.exp(scores - blk_max[..., None])
-    # rows with no visible keys: blk_max = -inf -> p would be exp(0)=1; zero them
-    p = jnp.where(mask[None, None], p, 0.0)
-    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    return acc, blk_max, p.sum(axis=-1)
+    # rows with no visible keys: blk_max = -inf -> p would be exp(0)=1;
+    # zero them
+    p = jnp.where(mask[None, None, None], p, 0.0)
+    acc = jnp.einsum("bhgqk,bkhd->bqhgd", p, v).reshape(b, tq, h, d)
+    # (b, hkv, g, tq) row stats flatten to the (b, h, tq) carry layout —
+    # head index h == hkv_idx * g + g_idx, matching the q reshape above
+    return (
+        acc,
+        blk_max.reshape(b, h, tq),
+        p.sum(axis=-1).reshape(b, h, tq),
+    )
 
 
 def ring_attention(

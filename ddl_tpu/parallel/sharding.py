@@ -38,6 +38,7 @@ __all__ = [
     "resolve_auto_flash",
     "normalize_flash",
     "validate_kv_head_sharding",
+    "validate_ulysses_kv_heads",
     "FLASH_AUTO_MIN_T",
     "SEQ_AXIS",
     "MODEL_AXIS",
@@ -79,6 +80,22 @@ def resolve_auto_flash(cfg, spec: "LMMeshSpec", seq_len: int) -> bool:
         # under Ulysses needs that split exact, so auto falls back to dense.
         return False
     return seq_len >= FLASH_AUTO_MIN_T
+
+
+def validate_ulysses_kv_heads(cfg, spec: "LMMeshSpec") -> None:
+    """Grouped-query Ulysses: the head/sequence all-to-all exchanges K/V at
+    Hkv heads, so the model-local K/V head count must split over ``seq``.
+    One check shared by the flat and pipeline step factories."""
+    if (
+        cfg.kv_heads != cfg.n_heads
+        and (cfg.kv_heads // spec.model) % spec.seq
+    ):
+        raise ValueError(
+            f"local K/V head count {cfg.kv_heads // spec.model} "
+            f"(n_kv_heads/model) must divide by mesh seq={spec.seq} for "
+            "grouped-query Ulysses (the all-to-all exchanges K/V at Hkv "
+            "heads; use attn_impl='ring' otherwise)"
+        )
 
 
 def validate_kv_head_sharding(cfg, spec: "LMMeshSpec") -> None:

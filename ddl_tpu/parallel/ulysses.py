@@ -34,17 +34,29 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                       attn_fn=None, window: int = 0):
     """Attention over a sequence-sharded batch (call inside ``shard_map``).
 
-    Per-device shapes: q, k, v: (B, T_local, H, D) with the *local* head
-    count divisible by the ``axis_name`` mesh axis size.  Returns the local
-    output shard (B, T_local, H, D), numerically equal to full attention
-    over the global sequence.
+    Per-device shapes: q: (B, T_local, H, D), k/v: (B, T_local, Hkv, D)
+    with the *local* head counts divisible by the ``axis_name`` mesh axis
+    size.  Returns the local output shard (B, T_local, H, D), numerically
+    equal to full attention over the global sequence.
+
+    Grouped-query K/V (``Hkv < H``): the all-to-alls move K/V at Hkv heads
+    — ``H/Hkv`` times less exchange volume than repeat-then-attend — and
+    the inner attention grouping stays aligned because ``n | Hkv`` makes
+    each query-head chunk's K/V group land in the matching K/V chunk.
     """
     n = lax.axis_size(axis_name)
-    h = q.shape[2]
+    h, hkv = q.shape[2], k.shape[2]
     if h % n:
         raise ValueError(
             f"local head count {h} must divide by sequence axis size {n} "
             "for Ulysses all-to-all attention (use ring attention otherwise)"
+        )
+    if hkv != h and (h % hkv or hkv % n):
+        raise ValueError(
+            f"local K/V head count {hkv} must divide local q heads {h} and "
+            f"divide by sequence axis size {n} for grouped-query Ulysses "
+            "(the head/sequence all-to-all must keep whole K/V groups "
+            "aligned with their query chunks; use ring attention otherwise)"
         )
     # (B, T/n, H, D) -> (B, T, H/n, D): split heads, gather sequence
     def fwd(x):

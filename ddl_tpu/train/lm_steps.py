@@ -35,6 +35,7 @@ from ddl_tpu.parallel.sharding import (
     normalize_flash,
     resolve_auto_flash,  # noqa: F401  (re-exported for tests/tools)
     validate_kv_head_sharding,
+    validate_ulysses_kv_heads,
 )
 from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
 
@@ -355,6 +356,8 @@ def make_lm_step_fns(
             f"must divide by mesh seq={spec.seq} for Ulysses all-to-all "
             "attention (use attn_impl='ring' otherwise)"
         )
+    if cfg.attn_impl == "ulysses":
+        validate_ulysses_kv_heads(cfg, spec)
     if cfg.num_experts and cfg.num_experts % spec.expert:
         raise ValueError(
             f"num_experts {cfg.num_experts} must divide by mesh "

@@ -334,6 +334,12 @@ class LMTrainer(BaseTrainer):
     def log_index(self, period: int) -> int:
         return self._period_bounds(period)[1]
 
+    def log_due(self, period: int) -> bool:
+        # log only at log_every multiples (and the final step), so eval and
+        # snapshot boundaries don't densify the CSV/console cadence
+        p1 = self._period_bounds(period)[1]
+        return p1 % self.run.log_every == 0 or p1 == self.run.steps
+
     def format_train_line(self, period, elapsed, steps, m) -> str:
         p0, p1 = self._period_bounds(period)
         body = " ".join(f"{k} {v:.4f}" for k, v in m.items())

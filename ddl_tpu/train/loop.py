@@ -86,6 +86,12 @@ class BaseTrainer:
         """Fixed-cadence snapshots, independent of the best-metric gate."""
         return False
 
+    def log_due(self, period: int) -> bool:
+        """Whether this period boundary is a logging/printing point.  Epoch
+        families log every epoch; the LM gates on its ``log_every`` cadence
+        so eval/save boundaries don't add extra log rows."""
+        return True
+
     def wait_for_saves(self) -> None:
         return None
 
@@ -167,21 +173,24 @@ class BaseTrainer:
                     f"{self.period_label.lower()} {period}; halting. "
                     f"Last snapshot: {self.last_snapshot_hint()}"
                 )
-            print(self.format_train_line(period, elapsed, steps, train_metrics))
             idx = self.log_index(period)
-            if self.logger is not None and self.is_logging_process:
-                self.logger.log_many(train_metrics, idx)
-                self.logger.log(self.time_metric, elapsed, idx)
-                # steps/sec/chip is BASELINE.json's target metric; the
-                # reference only logs epoch_time (steps derived offline).
-                self.logger.log("steps_per_sec", steps / elapsed, idx)
-                self.logger.log_many(self.rate_metrics(steps, elapsed), idx)
-                # HBM watermark (no analog in the reference; utils/memory.py)
-                mem = hbm_stats()
-                if mem is not None:
-                    self.logger.log(
-                        "hbm_peak_bytes", mem["peak_bytes_in_use"], idx
-                    )
+            if self.log_due(period):
+                print(
+                    self.format_train_line(period, elapsed, steps, train_metrics)
+                )
+                if self.logger is not None and self.is_logging_process:
+                    self.logger.log_many(train_metrics, idx)
+                    self.logger.log(self.time_metric, elapsed, idx)
+                    # steps/sec/chip is BASELINE.json's target metric; the
+                    # reference only logs epoch_time (steps derived offline).
+                    self.logger.log("steps_per_sec", steps / elapsed, idx)
+                    self.logger.log_many(self.rate_metrics(steps, elapsed), idx)
+                    # HBM watermark (no reference analog; utils/memory.py)
+                    mem = hbm_stats()
+                    if mem is not None:
+                        self.logger.log(
+                            "hbm_peak_bytes", mem["peak_bytes_in_use"], idx
+                        )
 
             eval_metrics = self.evaluate_period(period)
             if eval_metrics:

@@ -128,3 +128,12 @@ def test_lm_period_arithmetic():
     import bisect
 
     assert bisect.bisect_right(t._boundaries, 42) == 10  # resume cursor
+    # logging fires only at log_every multiples (and the final step):
+    # eval/save boundaries don't densify the console/CSV cadence
+    t._start_step = 0
+    logged = {
+        t._period_bounds(p)[1]
+        for p in range(len(t._boundaries))
+        if t.log_due(p)
+    }
+    assert logged == {10, 20, 30, 40, 47}

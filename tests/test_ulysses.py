@@ -95,3 +95,49 @@ def test_lm_ulysses_matches_dense_model():
     ref = run("dense", LMMeshSpec())
     uly = run("ulysses", LMMeshSpec(data=2, seq=2, model=2))
     np.testing.assert_allclose(ref, uly, atol=1e-4)
+
+
+def test_ulysses_gqa_matches_repeated_kv():
+    """Grouped K/V through the Ulysses all-to-all equals repeat-then-attend;
+    the exchange moves only Hkv K/V heads."""
+    rng = np.random.default_rng(9)
+    hq, hkv = 8, 4
+    q = jnp.asarray(rng.normal(size=(2, 32, hq, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 32, hkv, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 32, hkv, 8)), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    fn = make_ulysses_self_attention(mesh, causal=True)
+    grouped = np.asarray(fn(q, k, v))
+    repeated = np.asarray(fn(q, jnp.repeat(k, 2, 2), jnp.repeat(v, 2, 2)))
+    np.testing.assert_allclose(grouped, repeated, atol=2e-5, rtol=1e-4)
+    gk = jax.grad(lambda b: fn(q, b, v).sum())(k)
+    rk = jax.grad(lambda b: fn(q, jnp.repeat(b, 2, 2), v).sum())(k)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=2e-5)
+
+
+def test_ulysses_gqa_flash_matches_dense():
+    """Flash inner core under Ulysses with grouped K/V."""
+    from ddl_tpu.ops.attention import dense_attention
+    from ddl_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(10)
+    q = jnp.asarray(rng.normal(size=(1, 64, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 64, 4, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 64, 4, 8)), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+    fn = make_ulysses_self_attention(mesh, causal=True, attn_fn=flash_attention)
+    out = np.asarray(fn(q, k, v))
+    want = np.asarray(dense_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(out, want, atol=2e-5, rtol=1e-4)
+
+
+def test_ulysses_gqa_rejects_unsplittable_kv():
+    """Hkv must divide by the seq axis (the K/V all-to-all keeps whole
+    groups aligned); the clear error fires at trace time."""
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(1, 32, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    fn = make_ulysses_self_attention(mesh, causal=True, jit=False)
+    with pytest.raises(ValueError, match="K/V head count"):
+        fn(q, k, k)

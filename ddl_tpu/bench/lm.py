@@ -42,6 +42,10 @@ def main() -> None:
                     help="what the per-block checkpoint may save instead of "
                     "recomputing (LMConfig.remat_policy)")
     ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--ce-chunk", type=int, default=0,
+                    help="chunked head+CE fusion: sequence-chunk size for "
+                    "the loss edge (0 = dense CE; the (B,T,V) logits are "
+                    "never materialised when set)")
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
 
@@ -62,6 +66,7 @@ def main() -> None:
         flash={"on": True, "off": False, "auto": "auto"}[args.flash],
         remat=not args.no_remat,
         remat_policy=args.remat_policy,
+        ce_chunk=args.ce_chunk,
     )
     # resolve flash="auto" HERE and pass the concrete cfg down, so the
     # reported "flash" field is by construction the path benchmarked
@@ -93,8 +98,14 @@ def main() -> None:
         "flash": bool(cfg.flash),  # the path auto actually picked
         "flash_mode": args.flash,
         "remat": "off" if args.no_remat else args.remat_policy,
+        "ce_chunk": args.ce_chunk,
         "loss": round(float(m["loss"]), 3),
     }
+    from ddl_tpu.utils.memory import hbm_stats
+
+    mem = hbm_stats()
+    if mem is not None:
+        out["hbm_peak_bytes"] = int(mem["peak_bytes_in_use"])
     from ddl_tpu.bench.mfu import append_mfu
 
     # executed FLOPs: equals MFU with remat off, HFU otherwise

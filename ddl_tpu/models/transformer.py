@@ -109,6 +109,14 @@ class LMConfig:
     # Training passes deterministic=False + a 'dropout' rng; eval/decode
     # leave the default deterministic=True.
     dropout_rate: float = 0.0
+    # Chunked head+CE fusion (0 = off): the train/eval loss scans over
+    # chunks of this many sequence positions, so the (B, T, V) logits are
+    # never materialised — peak loss-edge memory drops T/ce_chunk times
+    # for ~one extra head matmul of backward FLOPs (jax.checkpoint).  The
+    # big-vocab lever: at V=50304, T=1024 the logits are the largest
+    # tensor in the step.  Requires mesh seq=1 (chunking splits T; under
+    # sequence parallelism per-device logits are already T/seq smaller).
+    ce_chunk: int = 0
 
     def __post_init__(self):
         if self.n_kv_heads and self.n_heads % self.n_kv_heads:
@@ -126,6 +134,10 @@ class LMConfig:
                 "attn_window > 0 requires causal=True (sliding causal "
                 "window); bidirectional encoders have no decode order to "
                 "window over"
+            )
+        if self.ce_chunk < 0:
+            raise ValueError(
+                f"ce_chunk must be >= 0, got {self.ce_chunk} (0 = dense CE)"
             )
 
     @property
@@ -531,13 +543,22 @@ def apply_final_norm_and_head(cfg: LMConfig, x):
 
 
 class TransformerLM(nn.Module):
-    """tokens (B, T) int32 -> (logits (B, T, V) f32, moe_aux_loss scalar)."""
+    """tokens (B, T) int32 -> (logits (B, T, V) f32, moe_aux_loss scalar).
+
+    ``return_hidden=True`` stops after the final RMSNorm and returns the
+    (B, T, D) pre-head activations instead of logits — the entry point for
+    the chunked head+CE fusion (``ops/losses.fused_chunked_ce``), which
+    applies the ``lm_head`` kernel chunk by chunk so the full logits
+    tensor never exists.  Initialisation always takes the logits path, so
+    the parameter tree (incl. ``lm_head``) is identical either way.
+    """
 
     cfg: LMConfig
     attn_core: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, deterministic: bool = True):
+    def __call__(self, tokens, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         x = make_embed(cfg)(tokens)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
@@ -548,6 +569,8 @@ class TransformerLM(nn.Module):
                 x, None, None, deterministic
             )
             aux_total = aux_total + aux
+        if return_hidden:
+            return RMSNorm(cfg.dtype, name="norm_f")(x), aux_total
         return apply_final_norm_and_head(cfg, x), aux_total
 
 

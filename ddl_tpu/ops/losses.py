@@ -2,13 +2,17 @@
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 __all__ = [
     "softmax_cross_entropy",
     "cross_entropy_loss",
     "onehot_cross_entropy_mean",
+    "fused_chunked_ce",
 ]
 
 
@@ -23,6 +27,99 @@ def softmax_cross_entropy(logits, labels):
 def cross_entropy_loss(logits, labels):
     """Mean cross-entropy — the training objective."""
     return softmax_cross_entropy(logits, labels).mean()
+
+
+def fused_chunked_ce(
+    hidden,
+    w,
+    targets,
+    token_chunk: int,
+    with_accuracy: bool = False,
+    constrain=None,
+    use_onehot: bool = False,
+):
+    """Head projection + mean cross-entropy without materialising the full
+    (B, T, V) logits tensor.
+
+    The LM's memory wall at large vocabularies is the loss edge: at
+    b=16, T=1024, V=50304 the logits alone are ~3.3 GB f32 (plus CE
+    intermediates), all live across the backward.  This computes the same
+    mean CE by scanning over chunks of ``token_chunk`` sequence positions:
+    each scan step projects one (B, C, D) hidden chunk through the vocab
+    kernel, reduces it to per-chunk CE sums, and drops the (B, C, V)
+    logits; ``jax.checkpoint`` makes the backward recompute each chunk's
+    logits instead of storing them, so peak logits residency falls from
+    O(T·V) to O(C·V) for ~one extra head matmul of FLOPs (the usual
+    remat trade, applied to the single biggest tensor in the step).
+
+    Sharding: the chunk matmul is a plain einsum, so GSPMD's vocab tensor
+    parallelism (``w`` sharded over 'model') works per chunk — the
+    logsumexp's cross-shard reduction happens per chunk instead of once.
+    Chunking splits T, so callers must keep T unsharded (``spec.seq == 1``
+    — under sequence parallelism the per-device logits are already T/seq
+    smaller and the dense CE is the right choice).
+
+    hidden: (B, T, D) post-final-norm activations; w: (D, V) f32 head
+    kernel; targets: (B, T) int.  Returns ``(mean_ce, accuracy | None)``
+    — exact parity with dense CE + argmax (``tests/test_ops.py``).
+    ``constrain`` (optional) applies a sharding annotation to each chunk's
+    logits (the caller passes flax's logical-axis constraint).
+    ``use_onehot`` selects the one-hot elementwise gather form (same math;
+    required inside manual-over-pipe shard_map subgroups, where
+    ``take_along_axis`` does not partition — see
+    ``onehot_cross_entropy_mean``); the 1F1B pipeline head uses it.
+    """
+    b, t, d = hidden.shape
+    if token_chunk < 1:
+        raise ValueError(f"token_chunk must be >= 1, got {token_chunk}")
+    # largest divisor of T at or under the request (halving would skip
+    # valid divisors and can collapse to per-position scans)
+    c = min(token_chunk, t)
+    while t % c:
+        c -= 1
+    if c != min(token_chunk, t):
+        import warnings
+
+        warnings.warn(
+            f"token_chunk {token_chunk} does not divide T={t}; using the "
+            f"largest divisor {c}",
+            stacklevel=2,
+        )
+    n_chunks = t // c
+    hs = jnp.moveaxis(hidden.reshape(b, n_chunks, c, d), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(b, n_chunks, c), 1, 0)
+
+    @jax.checkpoint
+    def chunk_ce(h_c, t_c):
+        logits = h_c.astype(jnp.float32) @ w  # (B, C, V)
+        if constrain is not None:
+            logits = constrain(logits)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        if use_onehot:
+            onehot = jax.nn.one_hot(t_c, logits.shape[-1], dtype=logits.dtype)
+            picked = (logits * onehot).sum(-1)
+        else:
+            picked = jnp.take_along_axis(
+                logits, t_c[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+        ce_sum = (lse - picked).sum()
+        if with_accuracy:
+            hits = (jnp.argmax(logits, -1) == t_c).sum()
+            return ce_sum, hits
+        return ce_sum, jnp.zeros((), jnp.int32)
+
+    def body(carry, xs):
+        ce_acc, hit_acc = carry
+        ce_sum, hits = chunk_ce(*xs)
+        return (ce_acc + ce_sum, hit_acc + hits), None
+
+    (ce, hits), _ = lax.scan(
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (hs, ts),
+    )
+    n = b * t
+    return ce / n, (hits / n if with_accuracy else None)
 
 
 def onehot_cross_entropy_mean(logits, labels):

@@ -90,6 +90,7 @@ from ddl_tpu.train.lm_steps import (
     LMStepFns,
     LMTrainState,
     _token_ce,
+    chunked_ce_loss,
     dropout_step_key,
     finalize_step_fns,
 )
@@ -673,6 +674,21 @@ class _Head(nn.Module):
         return apply_final_norm_and_head(self.cfg, x)
 
 
+class _HeadNorm(nn.Module):
+    """Norm-only view of the head params: applies ``norm_f`` and leaves the
+    vocab projection to the chunked head+CE fusion
+    (``ops/losses.fused_chunked_ce``) — apply with the same ``head`` param
+    subtree as ``_Head`` (``lm_head`` simply goes unused)."""
+
+    cfg: LMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from ddl_tpu.models.transformer import RMSNorm
+
+        return RMSNorm(self.cfg.dtype, name="norm_f")(x)
+
+
 def stack_block_params(full_params: Any, n_stages: int, virtual: int = 1):
     """Stack a param tree's ``block{i}`` subtrees into the pipeline layout —
     the unit every blocks pipeline shards ``P('pipe', ...)``.  Shared by the
@@ -1115,7 +1131,7 @@ def make_lm_pipeline_step_fns(
     def blocks_of(params):
         return unwrap_blocks(params["blocks"])
 
-    def forward(params, tokens, step=None):
+    def forward(params, tokens, step=None, return_hidden=False):
         with nn.logical_axis_rules(rules):
             x = embed_mod.apply({"params": params["embed"]}, tokens)  # (B,T,D)
             x = x.reshape(M, mb, seq_len, d)
@@ -1127,11 +1143,14 @@ def make_lm_pipeline_step_fns(
             else:
                 acc, aux_vec = pipeline(blocks_of(params), x)
             x_out = acc[-1].reshape(batch, seq_len, d)
-            logits = head_mod.apply({"params": params["head"]}, x_out)
+            if return_hidden:  # norm only; the chunked CE applies the head
+                out = _HeadNorm(cfg).apply({"params": params["head"]}, x_out)
+            else:
+                out = head_mod.apply({"params": params["head"]}, x_out)
         # Each (stage, microbatch) aux term is a mean over that microbatch's
         # rows; dividing the sum by M recovers the full-batch per-layer mean
         # the non-pipelined model computes.
-        return logits, aux_vec.sum() / M
+        return out, aux_vec.sum() / M
 
     # ---- init: build the full (non-pipelined) model's params and
     # restructure, so pipeline and single-program checkpoints interconvert
@@ -1173,6 +1192,17 @@ def make_lm_pipeline_step_fns(
         )
 
     def loss_fn(params, inputs, targets, step=None):
+        if cfg.ce_chunk:
+            # The GPipe head runs OUTSIDE the manual region on the full
+            # (B, T, V) logits — the same loss-edge memory wall as the
+            # flat path, fixed the same way: norm-only head, then the
+            # chunked head+CE fusion (shared tail: lm_steps.chunked_ce_loss).
+            hidden, aux = forward(params, inputs, step, return_hidden=True)
+            with nn.logical_axis_rules(rules):
+                return chunked_ce_loss(
+                    cfg, hidden, params["head"]["lm_head"]["kernel"],
+                    targets, aux, with_accuracy=step is None,
+                )
         logits, aux = forward(params, inputs, step)
         ce = _token_ce(logits, targets)
         loss = ce + cfg.moe_aux_weight * aux
@@ -1185,6 +1215,24 @@ def make_lm_pipeline_step_fns(
         # out as a metric.
         def head_loss(head_p, y, tgt):
             with nn.logical_axis_rules(rules):
+                if cfg.ce_chunk:
+                    # chunked head+CE per microbatch, one-hot gather form
+                    # (take_along_axis does not partition in manual
+                    # subgroups — see onehot_cross_entropy_mean)
+                    from ddl_tpu.ops.losses import fused_chunked_ce
+
+                    hidden = _HeadNorm(cfg).apply({"params": head_p}, y)
+                    ce, _ = fused_chunked_ce(
+                        hidden,
+                        head_p["lm_head"]["kernel"],
+                        tgt,
+                        cfg.ce_chunk,
+                        use_onehot=True,
+                        constrain=lambda z: nn.with_logical_constraint(
+                            z, ("batch", "act_seq", "act_vocab")
+                        ),
+                    )
+                    return ce / M, ce
                 logits = head_mod.apply({"params": head_p}, y)
             ce, _ = onehot_cross_entropy_mean(logits, tgt)
             return ce / M, ce

@@ -89,6 +89,31 @@ def make_ring_core(
     )
 
 
+def chunked_ce_loss(cfg, hidden, kernel, targets, aux, with_accuracy):
+    """Shared tail of the ce_chunk paths (flat loss and GPipe pipeline
+    loss): fused chunked head+CE over post-norm hidden states, assembled
+    into the ``(loss, (None, metrics))`` contract ``finalize_step_fns``
+    expects (``None`` logits signal the eval step that accuracy is already
+    in the metrics).  Call inside an ``nn.logical_axis_rules`` scope."""
+    from ddl_tpu.ops.losses import fused_chunked_ce
+
+    ce, acc = fused_chunked_ce(
+        hidden,
+        kernel,
+        targets,
+        cfg.ce_chunk,
+        with_accuracy=with_accuracy,
+        constrain=lambda z: nn.with_logical_constraint(
+            z, ("batch", "act_seq", "act_vocab")
+        ),
+    )
+    loss = ce + cfg.moe_aux_weight * aux
+    metrics = {"loss": loss, "ce": ce, "moe_aux": aux}
+    if acc is not None:
+        metrics["accuracy"] = acc
+    return loss, (None, metrics)
+
+
 def _token_ce(logits, targets):
     """Mean next-token cross-entropy (f32, stable)."""
     logits = logits.astype(jnp.float32)
@@ -218,6 +243,8 @@ def finalize_step_fns(
 
     def eval_step(state, inputs, targets):
         _, (logits, metrics) = loss_fn(state.params, inputs, targets)
+        if logits is None:  # fused CE path computed accuracy in-pass
+            return dict(metrics)
         acc = (jnp.argmax(logits, -1) == targets).mean()
         return dict(metrics, accuracy=acc)
 
@@ -284,6 +311,13 @@ def make_lm_step_fns(
         raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
     cfg = normalize_flash(cfg, spec, seq_len)
     validate_kv_head_sharding(cfg, spec)
+    if cfg.ce_chunk and spec.seq > 1:
+        raise ValueError(
+            f"ce_chunk={cfg.ce_chunk} requires mesh seq=1 (the chunked CE "
+            "scans over sequence positions, which conflicts with sequence "
+            "sharding — and under SP the per-device logits are already "
+            "T/seq smaller, so use the dense CE there)"
+        )
     if spec.pipe > 1:
         if accum_steps > 1:
             raise ValueError(
@@ -427,6 +461,23 @@ def make_lm_step_fns(
     def loss_fn(params, inputs, targets, step=None):
         kw = dropout_kwargs(rng, step, cfg.dropout_rate)
         with nn.logical_axis_rules(rules):
+            if cfg.ce_chunk:
+                # chunked head+CE fusion: the model stops at the final
+                # norm and the vocab projection runs chunk by chunk inside
+                # the loss — the (B, T, V) logits never materialise
+                # (ops/losses.fused_chunked_ce).  Eval (step=None) folds
+                # next-token accuracy into the same pass.
+                hidden, aux = model.apply(
+                    {"params": params},
+                    inputs,
+                    deterministic=kw["deterministic"],
+                    rngs=kw["rngs"],
+                    return_hidden=True,
+                )
+                return chunked_ce_loss(
+                    cfg, hidden, params["lm_head"]["kernel"], targets, aux,
+                    with_accuracy=step is None,
+                )
             logits, aux = model.apply(
                 {"params": params},
                 inputs,

@@ -95,6 +95,11 @@ def main() -> None:
     ap.add_argument("--attn-window", type=int, default=0,
                     help="sliding-window attention: each position attends "
                     "only the last N positions (0 = full causal history)")
+    ap.add_argument("--ce-chunk", type=int, default=0,
+                    help="chunked head+CE fusion: sequence-chunk size for "
+                    "the loss edge (0 = dense CE).  With a set chunk the "
+                    "(B,T,V) logits never materialise — the big-vocab "
+                    "memory lever; requires --seq 1")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="simulate N CPU devices (dev/test)")
     ap.add_argument("--checkpoint-dir", default=None,
@@ -155,6 +160,7 @@ def main() -> None:
         remat_policy=args.remat_policy,
         fsdp=args.fsdp,
         dropout_rate=args.dropout,
+        ce_chunk=args.ce_chunk,
     )
     spec = LMMeshSpec(
         args.data, args.seq, args.model, args.expert_axis, pipe=args.pipe

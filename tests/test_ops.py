@@ -86,3 +86,73 @@ class TestGroupedDenseAttention:
         kv = jnp.zeros((1, 4, 4, 4))
         with pytest.raises(ValueError, match="divide"):
             dense_attention(q, kv, kv, causal=True)
+
+
+class TestFusedChunkedCE:
+    """Chunked head+CE fusion (ops/losses.fused_chunked_ce): exact parity
+    with head-matmul + dense CE, in values AND gradients, without ever
+    materialising (B, T, V) logits (VERDICT round 2, task 3)."""
+
+    def _setup(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        b, t, d, v = 2, 32, 16, 97  # odd vocab: no tiling luck
+        h = jnp.asarray(rng.normal(size=(b, t, d)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(d, v)) * 0.1, jnp.float32)
+        tg = jnp.asarray(rng.integers(0, v, (b, t)))
+        return h, w, tg
+
+    def _dense(self, h, w, tg):
+        from ddl_tpu.ops.losses import cross_entropy_loss
+
+        return cross_entropy_loss(h.astype(np.float32) @ w, tg)
+
+    @pytest.mark.parametrize("chunk", [4, 8, 32, 100])
+    @pytest.mark.parametrize("use_onehot", [False, True])
+    def test_value_and_grad_parity(self, chunk, use_onehot):
+        import jax
+        import jax.numpy as jnp
+
+        from ddl_tpu.ops.losses import fused_chunked_ce
+
+        h, w, tg = self._setup()
+        ce, acc = fused_chunked_ce(
+            h, w, tg, chunk, with_accuracy=True, use_onehot=use_onehot
+        )
+        want = self._dense(h, w, tg)
+        np.testing.assert_allclose(float(ce), float(want), atol=1e-5)
+        logits = np.asarray(h) @ np.asarray(w)
+        np.testing.assert_allclose(
+            float(acc), float(np.mean(logits.argmax(-1) == np.asarray(tg))),
+            atol=1e-7,
+        )
+        gh, gw = jax.grad(
+            lambda a, b: fused_chunked_ce(a, b, tg, chunk,
+                                          use_onehot=use_onehot)[0],
+            (0, 1),
+        )(h, w)
+        rh, rw = jax.grad(lambda a, b: self._dense(a, b, tg), (0, 1))(h, w)
+        np.testing.assert_allclose(np.asarray(gh), np.asarray(rh), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), atol=1e-5)
+
+    def test_rejects_bad_chunk(self):
+        from ddl_tpu.ops.losses import fused_chunked_ce
+
+        h, w, tg = self._setup()
+        with pytest.raises(ValueError, match="token_chunk"):
+            fused_chunked_ce(h, w, tg, 0)
+
+    def test_non_divisor_chunk_warns_and_picks_largest_divisor(self):
+        import warnings
+
+        from ddl_tpu.ops.losses import fused_chunked_ce
+
+        h, w, tg = self._setup()  # T=32
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            ce, _ = fused_chunked_ce(h, w, tg, 24)  # largest divisor: 16
+        assert any("largest divisor 16" in str(r.message) for r in rec)
+        np.testing.assert_allclose(
+            float(ce), float(self._dense(h, w, tg)), atol=1e-5
+        )

@@ -39,9 +39,9 @@ def make_batch(rng, batch=4, seq=16, vocab=32):
     return jnp.asarray(x[:, :-1]), jnp.asarray(x[:, 1:])
 
 
-def run_steps(cfg, spec, n_steps=2, batch=4, seq=16):
+def run_steps(cfg, spec, n_steps=2, batch=4, seq=16, **fns_kw):
     fns = make_lm_step_fns(
-        cfg, spec, optax.adam(1e-3), jax.random.key(0), batch, seq
+        cfg, spec, optax.adam(1e-3), jax.random.key(0), batch, seq, **fns_kw
     )
     rng = np.random.default_rng(0)
     state = fns.init_state()
@@ -224,3 +224,35 @@ def test_gqa_ulysses_matches_single():
     par, par_losses = run_steps(cfg, LMMeshSpec(data=2, seq=2))
     np.testing.assert_allclose(ref_losses, par_losses, atol=1e-4)
     assert_state_close(ref, par, atol=1e-4)
+
+
+def test_ce_chunk_matches_dense_loss():
+    """ce_chunk reproduces the dense-CE training trajectory exactly —
+    flat path, TP (vocab-sharded chunks), and both pipeline schedules."""
+    import dataclasses
+
+    ref, ref_losses = run_steps(tiny_cfg(), LMMeshSpec())
+    for spec, kw in (
+        (LMMeshSpec(), {}),
+        (LMMeshSpec(data=2, model=2), {}),
+        (LMMeshSpec(data=2, pipe=2), {"n_steps": 2}),
+        (LMMeshSpec(data=2, pipe=2),
+         {"n_steps": 2, "pipeline_schedule": "1f1b"}),
+    ):
+        chunked, losses = run_steps(
+            tiny_cfg(ce_chunk=4), spec, **kw
+        )
+        np.testing.assert_allclose(
+            ref_losses[: len(losses)], losses, atol=2e-4,
+            err_msg=f"{spec} {kw}",
+        )
+
+
+def test_ce_chunk_rejects_seq_sharding():
+    import pytest
+
+    with pytest.raises(ValueError, match="ce_chunk"):
+        make_lm_step_fns(
+            tiny_cfg(ce_chunk=4, attn_impl="ring"), LMMeshSpec(seq=2),
+            optax.adam(1e-3), jax.random.key(0), 4, 16,
+        )

@@ -1,0 +1,147 @@
+"""Autoregressive decode benchmark: prefill latency + steady-state tokens/sec.
+
+The reference has no generation path at all (its only inference surface is
+a loss-less eval pipeline, ``pp.py:146-150``); this framework ships one
+(``infer/decode.py``) and makes two perf claims about it — the
+``Hq/Hkv``-times smaller KV-cache reads of grouped-query attention and the
+O(window) cache slice of sliding-window decode.  This bench measures both
+on one chip instead of asserting them.
+
+Method: the generator is ONE jitted program (prefill + ``lax.scan`` of
+single-token steps), so prefill and decode cannot be fenced separately.
+Prefill is measured with a ``max_new=1`` run (one decode token ~0.5-2 ms
+against a 100+ ms prefill); the decode rate is the wall-clock slope
+between ``max_new=n`` and ``2n`` runs, which cancels the tunnel's fixed
+dispatch/fence cost.  All three runs pin the SAME KV-cache capacity
+(``max_len = prompt + 2n``): without a window every step reads the whole
+allocated buffer (masked) regardless of position, so per-step cost is a
+function of capacity — equal allocations make the slope the true
+steady-state per-token cost at that capacity.
+
+    python -m ddl_tpu.bench.decode                 # 124M, prompt 4k, cache 8k
+    python -m ddl_tpu.bench.decode --sweep         # MHA/GQA x full/window
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddl_tpu.infer.decode import make_lm_generator
+from ddl_tpu.models.transformer import LMConfig, TransformerLM
+from ddl_tpu.utils.timing import fence
+
+
+def _bench_one(args, kv_heads: int, window: int) -> dict:
+    cfg = LMConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_layers=args.layers,
+        n_heads=args.d_model // 64,
+        n_kv_heads=kv_heads,
+        attn_window=window,
+        head_dim=64,
+        d_ff=4 * args.d_model,
+        compute_dtype="bfloat16",
+        remat=False,
+    )
+    params = TransformerLM(cfg, None).init(
+        jax.random.key(0), jnp.zeros((args.batch, 8), jnp.int32)
+    )["params"]
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, args.vocab, (args.batch, args.prompt)), jnp.int32
+    )
+
+    n1, n2 = args.new, 2 * args.new
+    capacity = args.prompt + n2
+
+    def timed(max_new: int) -> float:
+        gen = make_lm_generator(
+            cfg, prompt_len=args.prompt, max_new=max_new, batch=args.batch,
+            max_len=capacity,  # equal allocations across the three runs
+        )
+        fence(gen(params, prompt))  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = gen(params, prompt)
+        fence(out)
+        return (time.perf_counter() - t0) / args.iters
+
+    t_pre, t1, t2 = timed(1), timed(n1), timed(n2)
+    ms_per_tok = (t2 - t1) / (n2 - n1) * 1e3
+    kv = cfg.kv_heads
+    elt = cfg.dtype.itemsize
+    # one decode step (t=1) reads a window-long slice (transformer.py:
+    # span = attn_window + t - 1), or the whole allocated cache without one
+    span = min(window, capacity) if window else capacity
+    return {
+        "heads": f"{cfg.n_heads}q/{kv}kv",
+        "window": window,
+        "prompt": args.prompt,
+        "max_len": capacity,
+        "batch": args.batch,
+        "prefill_ms": round(t_pre * 1e3, 1),
+        "decode_ms_per_tok": round(ms_per_tok, 3),
+        "decode_tok_per_sec": round(args.batch / (ms_per_tok / 1e3), 1),
+        # allocation vs what one decode step actually reads per layer
+        "cache_bytes_per_layer": int(
+            2 * args.batch * capacity * kv * cfg.head_dim * elt
+        ),
+        "read_bytes_per_step_layer": int(
+            2 * args.batch * span * kv * cfg.head_dim * elt
+        ),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--prompt", type=int, default=4096)
+    ap.add_argument("--new", type=int, default=2048,
+                    help="decode lengths benched: --new and 2x --new "
+                    "(slope method); max cache = prompt + 2x new")
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=50304)
+    ap.add_argument("--kv-heads", type=int, default=0)
+    ap.add_argument("--attn-window", type=int, default=0)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the PERF.md grid: MHA vs GQA (12q/4kv) x "
+                    "full cache vs window 1024")
+    args = ap.parse_args()
+
+    from ddl_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    if args.iters < 1:
+        ap.error("--iters must be >= 1")
+    if args.sweep:
+        if args.kv_heads or args.attn_window:
+            ap.error("--sweep supplies its own grid; drop "
+                     "--kv-heads/--attn-window")
+        n_heads = args.d_model // 64
+        # grouped rows use the largest >=3x grouping the head count allows
+        kv = next(
+            (n_heads // g for g in (3, 4, 2) if n_heads % g == 0), 0
+        )
+        if not kv:
+            ap.error(f"--sweep needs a groupable head count, got {n_heads}")
+        grid = [(0, 0), (kv, 0), (0, 1024), (kv, 1024)]
+    else:
+        grid = [(args.kv_heads, args.attn_window)]
+    for kv, win in grid:
+        print(json.dumps(_bench_one(args, kv, win)))
+
+
+if __name__ == "__main__":
+    main()

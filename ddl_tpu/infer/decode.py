@@ -100,6 +100,7 @@ def make_lm_generator(
     top_k: int | None = None,
     devices=None,
     mesh=None,
+    max_len: int | None = None,
 ):
     """Build a jitted ``generate(params, prompt, rng) -> tokens`` function.
 
@@ -115,7 +116,20 @@ def make_lm_generator(
     always cached dense attention; ring/Ulysses are training-time
     strategies for long-context *processing*, and the prompt fits the
     cache by construction.
+
+    ``max_len`` overrides the KV-cache capacity (default
+    ``prompt_len + max_new``).  Without a window every decode step reads
+    the whole allocated buffer (masked), so per-step cost is set by the
+    *capacity*, not the position — benchmarks comparing different
+    ``max_new`` values must pin ``max_len`` to compare like with like.
     """
+    if max_len is None:
+        max_len = prompt_len + max_new
+    elif max_len < prompt_len + max_new:
+        raise ValueError(
+            f"max_len {max_len} < prompt_len + max_new "
+            f"({prompt_len} + {max_new})"
+        )
     if not cfg.causal:
         raise ValueError(
             "autoregressive decode requires a causal LM (cfg.causal=True); "
@@ -136,7 +150,6 @@ def make_lm_generator(
         mesh = build_lm_mesh(spec or LMMeshSpec(), devices)
     rules = lm_logical_rules(cfg.fsdp)
     model = LMDecode(cfg)
-    max_len = prompt_len + max_new
 
     def generate(params, prompt, rng):
         caches = init_kv_cache(cfg, batch, max_len)

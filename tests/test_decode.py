@@ -193,3 +193,27 @@ def test_gqa_incremental_matches_full_forward():
         np.testing.assert_allclose(
             np.asarray(logits[:, 0]), np.asarray(ref_logits[:, i]), atol=1e-5
         )
+
+
+def test_decode_bench_smoke(capsys):
+    """bench/decode.py runs end to end and reports the sweep fields (the
+    real-chip numbers live in PERF.md; this guards the harness)."""
+    import json
+    import sys
+
+    from ddl_tpu.bench import decode as bench_decode
+
+    argv = sys.argv
+    sys.argv = [
+        "decode", "--batch", "1", "--prompt", "16", "--new", "4",
+        "--d-model", "64", "--layers", "2", "--vocab", "64",
+        "--kv-heads", "0", "--attn-window", "8", "--iters", "1",
+    ]
+    try:
+        bench_decode.main()
+    finally:
+        sys.argv = argv
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["decode_tok_per_sec"] > 0 and row["prefill_ms"] > 0
+    # one windowed step reads an O(window) slice, not the whole cache
+    assert row["read_bytes_per_step_layer"] < row["cache_bytes_per_layer"]

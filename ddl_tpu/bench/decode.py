@@ -125,6 +125,9 @@ def main() -> None:
     enable_compile_cache()
     if args.iters < 1:
         ap.error("--iters must be >= 1")
+    if args.new < 1:
+        ap.error("--new must be >= 1 (decode lengths benched are --new "
+                 "and 2x --new)")
     if args.sweep:
         if args.kv_heads or args.attn_window:
             ap.error("--sweep supplies its own grid; drop "

@@ -46,6 +46,13 @@ def main() -> None:
                     help="chunked head+CE fusion: sequence-chunk size for "
                     "the loss edge (0 = dense CE; the (B,T,V) logits are "
                     "never materialised when set)")
+    ap.add_argument("--experts", type=int, default=0,
+                    help="top-k MoE blocks with this many experts (0 = "
+                    "dense MLP); combine with --d-ff to match active "
+                    "FLOPs, e.g. 8 experts top-2 at half d_ff")
+    ap.add_argument("--expert-top-k", type=int, default=2)
+    ap.add_argument("--d-ff", type=int, default=0,
+                    help="MLP/expert hidden size (0 = 4*d_model)")
     ap.add_argument("--iters", type=int, default=10)
     args = ap.parse_args()
 
@@ -61,7 +68,9 @@ def main() -> None:
         n_kv_heads=args.kv_heads,
         attn_window=args.attn_window,
         head_dim=64,
-        d_ff=4 * args.d_model,
+        d_ff=args.d_ff or 4 * args.d_model,
+        num_experts=args.experts,
+        expert_top_k=args.expert_top_k,
         compute_dtype="bfloat16",
         flash={"on": True, "off": False, "auto": "auto"}[args.flash],
         remat=not args.no_remat,
@@ -101,6 +110,11 @@ def main() -> None:
         "ce_chunk": args.ce_chunk,
         "loss": round(float(m["loss"]), 3),
     }
+    if args.experts:
+        out["experts"] = f"{args.experts}top{args.expert_top_k}"
+        out["d_ff"] = cfg.d_ff
+        for key in ("moe_drop_frac", "moe_load_max", "moe_load_min"):
+            out[key] = round(float(m[key]), 4)
     from ddl_tpu.utils.memory import hbm_stats
 
     mem = hbm_stats()

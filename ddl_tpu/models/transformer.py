@@ -420,6 +420,16 @@ class MoeMlp(nn.Module):
         mean_gate = gates.mean(axis=(0, 1))
         aux_loss = e * jnp.sum(frac / cfg.expert_top_k * mean_gate)
 
+        # Router observability (sown per block; the step aggregates into
+        # metrics): capacity overflow silently drops tokens in
+        # _top_k_dispatch, so a run must be able to SEE the drop fraction
+        # and the expert load spread, not just the aux loss.
+        kept = dispatch.sum() / (b * s * cfg.expert_top_k)
+        self.sow("intermediates", "moe_drop_frac", 1.0 - kept)
+        # per-expert share of the kept token-choices (uniform = 1/E)
+        load = frac / jnp.maximum(frac.sum(), 1e-9)
+        self.sow("intermediates", "moe_expert_load", load)
+
         wi = self.param(
             "wi",
             nn.with_logical_partitioning(

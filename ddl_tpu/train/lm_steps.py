@@ -114,6 +114,33 @@ def chunked_ce_loss(cfg, hidden, kernel, targets, aux, with_accuracy):
     return loss, (None, metrics)
 
 
+def moe_router_metrics(intermediates) -> dict:
+    """Aggregate the per-block router stats ``MoeMlp`` sows into scalar
+    step metrics: mean token-drop fraction (capacity overflow silently
+    drops tokens — a run must see it) and the expert-load spread
+    (min/max share of kept token-choices; uniform = 1/E).
+
+    Under gradient accumulation (``accum_steps > 1``) the step metrics are
+    chunk means, so ``moe_load_max`` is a mean-of-maxes — it understates a
+    single hot microbatch; watch per-chunk logs (accum=1) when hunting
+    routing collapse."""
+    drops, loads = [], []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
+        name = jax.tree_util.keystr(path)
+        if "moe_drop_frac" in name:
+            drops.append(leaf)
+        elif "moe_expert_load" in name:
+            loads.append(leaf)
+    if not drops:
+        return {}
+    load = jnp.stack(loads).mean(0)
+    return {
+        "moe_drop_frac": jnp.stack(drops).mean(),
+        "moe_load_max": load.max(),
+        "moe_load_min": load.min(),
+    }
+
+
 def _token_ce(logits, targets):
     """Mean next-token cross-entropy (f32, stable)."""
     logits = logits.astype(jnp.float32)
@@ -460,6 +487,10 @@ def make_lm_step_fns(
 
     def loss_fn(params, inputs, targets, step=None):
         kw = dropout_kwargs(rng, step, cfg.dropout_rate)
+        # MoE runs also collect the router stats MoeMlp sows (drop
+        # fraction, expert load) into the step metrics
+        mutable = ["intermediates"] if cfg.num_experts else False
+        router = {}
         with nn.logical_axis_rules(rules):
             if cfg.ce_chunk:
                 # chunked head+CE fusion: the model stops at the final
@@ -467,26 +498,40 @@ def make_lm_step_fns(
                 # the loss — the (B, T, V) logits never materialise
                 # (ops/losses.fused_chunked_ce).  Eval (step=None) folds
                 # next-token accuracy into the same pass.
-                hidden, aux = model.apply(
+                out = model.apply(
                     {"params": params},
                     inputs,
                     deterministic=kw["deterministic"],
                     rngs=kw["rngs"],
                     return_hidden=True,
+                    mutable=mutable,
                 )
-                return chunked_ce_loss(
+                if cfg.num_experts:
+                    (hidden, aux), col = out
+                    router = moe_router_metrics(col["intermediates"])
+                else:
+                    hidden, aux = out
+                loss, (none, metrics) = chunked_ce_loss(
                     cfg, hidden, params["lm_head"]["kernel"], targets, aux,
                     with_accuracy=step is None,
                 )
-            logits, aux = model.apply(
+                return loss, (none, dict(metrics, **router))
+            out = model.apply(
                 {"params": params},
                 inputs,
                 deterministic=kw["deterministic"],
                 rngs=kw["rngs"],
+                mutable=mutable,
             )
+            if cfg.num_experts:
+                (logits, aux), col = out
+                router = moe_router_metrics(col["intermediates"])
+            else:
+                logits, aux = out
         ce = _token_ce(logits, targets)
         loss = ce + cfg.moe_aux_weight * aux
-        return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})
+        metrics = {"loss": loss, "ce": ce, "moe_aux": aux, **router}
+        return loss, (logits, metrics)
 
     return finalize_step_fns(
         mesh, tx, loss_fn, create_state, rng, accum_steps=accum_steps

@@ -389,7 +389,7 @@ def flash_attention(
     causal: bool = False,
     window: int = 0,
     block_q: int = 512,
-    block_k: int = 512,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ):
     """Flash attention. q: (B, T, H, D), k/v: (B, T, Hkv, D) -> (B, T, H, D).
@@ -405,12 +405,14 @@ def flash_attention(
 
     Differentiable (custom VJP, flash backward).  Block sizes are clamped to
     the sequence length and halved until they divide it; pick powers of two.
-    Defaults come from a v5e sweep (B=2, H=8, D=64, causal, bf16, true-fenced
-    timing): 512x512 beats 128x128 by ~2x and beats XLA's dense lowering
-    fwd (16.0 vs 18.6 ms at T=8192) and bwd (32.2 vs 48.6 ms) while keeping
-    the T^2 score tile out of HBM.  ``interpret=None`` auto-selects
-    interpreter mode off-TPU so the kernel runs on the CPU-simulated mesh
-    (tests) and compiled on real chips.
+    Defaults (512x1024) come from a v5e device-only sweep
+    (``bench/kernels.py`` slope method; B=2, H=8, D=64, causal, bf16):
+    ``block_k=1024`` beats 512 in both directions at every measured T —
+    fwd 2.59 vs 4.14 ms and bwd 10.9 vs 13.3 at T=8192 (dense lowering:
+    8.77 / 28.7) — and also with a sliding window (W=1024: fwd 1.32 vs
+    1.46, bwd 7.01 vs 7.97), while keeping the T^2 score tile out of HBM.
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the kernel
+    runs on the CPU-simulated mesh (tests) and compiled on real chips.
     """
     h, hkv = _validate_flash_args(q, k, v, causal, window)
     if interpret is None:
@@ -434,7 +436,7 @@ def flash_attention_with_lse(
     causal: bool = False,
     window: int = 0,
     block_q: int = 512,
-    block_k: int = 512,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ):
     """Flash attention that also returns the per-row logsumexp.

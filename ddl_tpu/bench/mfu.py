@@ -23,6 +23,7 @@ import jax
 __all__ = [
     "device_peak_flops",
     "compiled_step_flops",
+    "flash_attention_train_flops",
     "mfu",
     "append_mfu",
     "PEAK_BF16_FLOPS",
@@ -71,6 +72,57 @@ def compiled_step_flops(fn, *args) -> float:
         return float("nan")
 
 
+def flash_attention_train_flops(
+    batch: int,
+    n_heads: int,
+    seq_len: int,
+    head_dim: int,
+    n_layers: int,
+    window: int = 0,
+    remat: bool = False,
+    accounting: str = "model",
+) -> float:
+    """Analytic attention-core FLOPs per train step for the Pallas kernel.
+
+    XLA's cost analysis assigns ZERO FLOPs to a Pallas custom call (probed
+    on v5e: an isolated `flash_attention` program reports none, and a
+    flash train step's total equals the model's non-attention FLOPs
+    exactly), so flash bench rows undercount MFU — increasingly with T.
+    This closed form restores the kernel's executed FLOPs, counting only
+    the visible (q, k) score pairs — the kernel really skips blocks
+    outside the causal/window band via predicated execution, so banded
+    rows are credited with banded FLOPs, not full causal ones (round-2's
+    windowed-MFU caveat, resolved analytically):
+
+    * visible pairs: causal ``T(T+1)/2``; with a window W, the first W
+      rows keep their triangle and the rest see W keys each —
+      ``W(W+1)/2 + (T-W)W``.
+    * matmuls over those pairs, 2 FLOPs/MAC each.  ``accounting`` picks
+      the convention:
+      - ``"model"`` (the MFU convention): the theoretical attention
+        matmuls only — forward 2 (QK^T, PV) + backward 4 (dV, dP, dQ,
+        dK) = 6; implementation recomputes don't count.
+      - ``"executed"`` (the HFU convention): what the flash kernels
+        actually run — forward 2; dQ kernel 3 (score recompute, dP, dQ);
+        dK/dV kernel 4 (score recompute, dV, dP, dK) = 9, +2 when remat
+        replays the forward.
+      Grouped-query K/V changes none of these (the kernel computes per
+      *query* head).
+    """
+    if accounting not in ("model", "executed"):
+        raise ValueError(f"accounting must be 'model' or 'executed', got {accounting!r}")
+    if window and window < seq_len:
+        pairs = window * (window + 1) / 2 + (seq_len - window) * window
+    else:
+        pairs = seq_len * (seq_len + 1) / 2
+    matmul = 2.0 * batch * n_heads * head_dim * pairs
+    if accounting == "model":
+        n_matmuls = 6
+    else:
+        n_matmuls = 11 if remat else 9
+    return n_matmuls * matmul * n_layers
+
+
 def mfu(flops_per_step: float, step_time_s: float, device=None) -> float | None:
     """Fraction of peak dense bf16 FLOP/s achieved; None when peak unknown."""
     peak = device_peak_flops(device)
@@ -79,14 +131,20 @@ def mfu(flops_per_step: float, step_time_s: float, device=None) -> float | None:
     return flops_per_step / step_time_s / peak
 
 
-def append_mfu(out: dict, fn, step_time_s: float, *args, key: str = "mfu") -> dict:
+def append_mfu(
+    out: dict, fn, step_time_s: float, *args,
+    key: str = "mfu", extra_flops: float = 0.0,
+) -> dict:
     """Add ``tflops_per_step`` (whenever cost analysis works) and ``key``
     (only when the chip's peak is known) to a bench result dict — the one
     reporting path shared by bench.py / bench.lm / bench.vit.  ``key`` is
     ``"mfu"`` when executed == model FLOPs (no remat) and ``"hfu"``
-    otherwise (see module docstring)."""
+    otherwise (see module docstring).  ``extra_flops`` adds work cost
+    analysis cannot see — Pallas custom calls report zero, so flash rows
+    pass ``flash_attention_train_flops``."""
     flops = compiled_step_flops(fn, *args)
     if flops > 0:  # NaN-safe: NaN > 0 is False
+        flops += extra_flops
         out["tflops_per_step"] = round(flops / 1e12, 2)
         u = mfu(flops, step_time_s)
         if u is not None:

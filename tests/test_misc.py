@@ -285,3 +285,37 @@ class TestMemoryStats:
         out = hbm_stats(FakeDev())
         assert out == {"bytes_in_use": 10, "peak_bytes_in_use": 99,
                        "bytes_limit": 1000}
+
+
+def test_flash_attention_train_flops_band_closed_form():
+    """The analytic visible-pair count matches brute force, windowed and
+    causal, and the remat/no-remat matmul multipliers hold their ratio."""
+    import numpy as np
+
+    from ddl_tpu.bench.mfu import flash_attention_train_flops
+
+    def brute_pairs(t, w):
+        n = 0
+        for q in range(t):
+            lo = max(0, q - w + 1) if w else 0
+            n += q - lo + 1
+        return n
+
+    for t, w in ((64, 0), (64, 16), (64, 64), (64, 100), (128, 31)):
+        got = flash_attention_train_flops(
+            1, 1, t, 1, 1, window=w, accounting="executed"
+        )
+        want = 9 * 2.0 * brute_pairs(t, w)
+        np.testing.assert_allclose(got, want, rtol=1e-12, err_msg=f"{t},{w}")
+    # model accounting (MFU): 6 theoretical matmuls, remat-invariant;
+    # executed accounting (HFU): 9, +2 under remat replay
+    model = flash_attention_train_flops(2, 8, 256, 64, 12)
+    assert model == flash_attention_train_flops(2, 8, 256, 64, 12, remat=True)
+    ex = flash_attention_train_flops(2, 8, 256, 64, 12, accounting="executed")
+    ex_r = flash_attention_train_flops(
+        2, 8, 256, 64, 12, remat=True, accounting="executed"
+    )
+    assert ex / model == 9 / 6 and ex_r / model == 11 / 6
+    # banded < causal
+    banded = flash_attention_train_flops(2, 8, 256, 64, 12, window=32)
+    assert banded < model

@@ -88,8 +88,9 @@ class LMConfig:
     # only the last attn_window positions (0 = unbounded causal history).
     # Requires causal=True.  Supported by the dense core, the flash kernel
     # (band-masked block skip), Ulysses (full sequence per head group),
-    # the dense-block ring (global-position band across ring hops), and
-    # the decode cache; flash-in-ring with a window is rejected.
+    # the dense-block ring (global-position band across ring hops),
+    # flash-in-ring (per-hop banded kernel via its kv_offset, ring
+    # truncated to O(window) hops), and the decode cache.
     attn_window: int = 0
     remat: bool = True
     # What the per-block jax.checkpoint may keep instead of recomputing

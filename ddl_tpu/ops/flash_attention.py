@@ -54,33 +54,36 @@ def _pick_block(t: int, requested: int) -> int:
     return max(block, 1)
 
 
-def _causal_mask(i, j, bq, bk, s, window=0):
+def _causal_mask(i, j, bq, bk, s, window=0, kv_offset=0):
     """Causal (and, with ``window > 0``, sliding-window) score mask: row
     q attends keys in ``(q - window, q]`` — ``window = 0`` means
-    unbounded history (plain causal)."""
+    unbounded history (plain causal).  ``kv_offset`` shifts the K/V
+    coordinates ``kv_offset`` positions EARLIER than the queries (the
+    ring schedule's off-diagonal hops, where the K/V block originated
+    ``hop * T_local`` positions back)."""
     q_pos = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    k_pos = j * bk - kv_offset + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     keep = k_pos <= q_pos
     if window:
         keep &= k_pos > q_pos - window
     return jnp.where(keep, s, _NEG_INF)
 
 
-def _qk_live(i, j, bq, bk, causal, window):
+def _qk_live(i, j, bq, bk, causal, window, kv_offset=0):
     """Whether the (q block i, k block j) tile intersects the visible band
     (the block-skip predicate; window extends causal's future-skip with a
-    past-skip)."""
+    past-skip; ``kv_offset`` as in ``_causal_mask``)."""
     if not causal:
         return True
-    live = j * bk <= i * bq + bq - 1
+    live = j * bk - kv_offset <= i * bq + bq - 1
     if window:
-        live &= j * bk + bk - 1 > i * bq - window
+        live &= j * bk + bk - 1 - kv_offset > i * bq - window
     return live
 
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *, scale,
-    causal, window=0,
+    causal, window=0, kv_offset=0,
 ):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -94,7 +97,7 @@ def _fwd_kernel(
         l_sc[:] = jnp.zeros_like(l_sc)
 
     # K/V blocks outside the visible band contribute nothing — skip
-    live = _qk_live(i, j, bq, bk, causal, window)
+    live = _qk_live(i, j, bq, bk, causal, window, kv_offset)
 
     @pl.when(live)
     def _():
@@ -103,11 +106,16 @@ def _fwd_kernel(
         v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(i, j, bq, bk, s, window)
+            s = _causal_mask(i, j, bq, bk, s, window, kv_offset)
         m = m_sc[:]
         blk_max = s.max(axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
         p = jnp.exp(s - new_m)
+        # rows whose whole visible set is masked (possible in a live tile
+        # when kv_offset pushes the band off the row): new_m == mask value
+        # makes p = exp(0) = 1 — zero those entries so the row's output is
+        # 0 and its lse stays at the -inf floor, not mean-of-V garbage
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
         corr = jnp.exp(m - new_m)
         l_sc[:] = l_sc[:] * corr + p.sum(axis=-1, keepdims=True)
         acc_sc[:] = acc_sc[:] * corr + jnp.dot(
@@ -124,7 +132,7 @@ def _fwd_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale,
-    causal, window=0,
+    causal, window=0, kv_offset=0,
 ):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -135,7 +143,7 @@ def _dq_kernel(
     def _():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    live = _qk_live(i, j, bq, bk, causal, window)
+    live = _qk_live(i, j, bq, bk, causal, window, kv_offset)
 
     @pl.when(live)
     def _():
@@ -147,8 +155,9 @@ def _dq_kernel(
         delta = delta_ref[0, 0][:, None]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(i, j, bq, bk, s, window)
+            s = _causal_mask(i, j, bq, bk, s, window, kv_offset)
         p = jnp.exp(s - lse)
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)  # empty-band rows (fwd note)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_sc[:] = dq_sc[:] + jnp.dot(
@@ -162,7 +171,7 @@ def _dq_kernel(
 
 def _dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_sc, dv_sc, *, scale, causal, window=0, q_blocks=1,
+    dk_sc, dv_sc, *, scale, causal, window=0, kv_offset=0, q_blocks=1,
 ):
     # grid: (b*kv_heads, k_blocks, group*q_blocks) — the innermost
     # dimension walks every (query head in the group, Q block) pair, so
@@ -179,7 +188,7 @@ def _dkdv_kernel(
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
     # Q blocks outside this K/V block's visible band contribute nothing
-    live = _qk_live(i, j, bq, bk, causal, window)
+    live = _qk_live(i, j, bq, bk, causal, window, kv_offset)
 
     @pl.when(live)
     def _():
@@ -191,8 +200,9 @@ def _dkdv_kernel(
         delta_blk = delta_ref[0, 0][:, None]
         s = jnp.dot(q_blk, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            s = _causal_mask(i, j, bq, bk, s, window)
+            s = _causal_mask(i, j, bq, bk, s, window, kv_offset)
         p = jnp.exp(s - lse_blk)
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)  # empty-band rows (fwd note)
         dv_sc[:] = dv_sc[:] + jnp.dot(
             p.T, do_blk, preferred_element_type=jnp.float32
         )
@@ -218,13 +228,15 @@ def _kv_row(b, q_heads, kv_heads):
 
 
 def _flash_fwd_impl(
-    q, k, v, causal, window, block_q, block_k, interpret, q_heads, kv_heads
+    q, k, v, causal, window, kv_offset, block_q, block_k, interpret,
+    q_heads, kv_heads,
 ):
     bh, t, d = q.shape
     scale = 1.0 / (d ** 0.5)
     kv_idx = lambda b, i, j: (_kv_row(b, q_heads, kv_heads), j, 0)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, window=window),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          window=window, kv_offset=kv_offset),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             # row stats ride in a (bh, 1, t) layout: the (1, 1, block_q)
@@ -253,7 +265,8 @@ def _flash_fwd_impl(
 
 
 def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
-                       block_q, block_k, interpret, q_heads, kv_heads):
+                       kv_offset, block_q, block_k, interpret, q_heads,
+                       kv_heads):
     """Shared backward: the two flash kernels with
     ``ds = p * (dp - (delta - dlse))``.
 
@@ -285,7 +298,8 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
     row_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, window=window),
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          window=window, kv_offset=kv_offset),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         grid=(bh, t // block_q, t // block_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
@@ -311,7 +325,7 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkdv_kernel, scale=scale, causal=causal, window=window,
-            q_blocks=nq,
+            kv_offset=kv_offset, q_blocks=nq,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bkv, t, d), k.dtype),
@@ -329,35 +343,37 @@ def _flash_bwd_kernels(q, k, v, out, lse, do, dlse, causal, window,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_lse(
-    q, k, v, causal, window, block_q, block_k, interpret, q_heads, kv_heads
+    q, k, v, causal, window, kv_offset, block_q, block_k, interpret,
+    q_heads, kv_heads,
 ):
     return _flash_fwd_impl(
-        q, k, v, causal, window, block_q, block_k, interpret, q_heads,
-        kv_heads,
+        q, k, v, causal, window, kv_offset, block_q, block_k, interpret,
+        q_heads, kv_heads,
     )
 
 
 def _flash_lse_vjp_fwd(
-    q, k, v, causal, window, block_q, block_k, interpret, q_heads, kv_heads
+    q, k, v, causal, window, kv_offset, block_q, block_k, interpret,
+    q_heads, kv_heads,
 ):
     out, lse = _flash_fwd_impl(
-        q, k, v, causal, window, block_q, block_k, interpret, q_heads,
-        kv_heads,
+        q, k, v, causal, window, kv_offset, block_q, block_k, interpret,
+        q_heads, kv_heads,
     )
     return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_lse_vjp_bwd(
-    causal, window, block_q, block_k, interpret, q_heads, kv_heads,
-    residuals, cts,
+    causal, window, kv_offset, block_q, block_k, interpret, q_heads,
+    kv_heads, residuals, cts,
 ):
     do, dlse = cts
     q, k, v, out, lse = residuals
     return _flash_bwd_kernels(
-        q, k, v, out, lse, do, dlse, causal, window, block_q, block_k,
-        interpret, q_heads, kv_heads,
+        q, k, v, out, lse, do, dlse, causal, window, kv_offset, block_q,
+        block_k, interpret, q_heads, kv_heads,
     )
 
 
@@ -369,11 +385,17 @@ def _fold_heads(x):
     return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
 
-def _validate_flash_args(q, k, v, causal, window):
+def _validate_flash_args(q, k, v, causal, window, kv_offset=0):
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
     if window and not causal:
         raise ValueError("window > 0 requires causal=True (sliding causal window)")
+    if kv_offset < 0:
+        raise ValueError(f"kv_offset must be >= 0, got {kv_offset}")
+    if kv_offset and not causal:
+        raise ValueError(
+            "kv_offset shifts the causal/window band; it requires causal=True"
+        )
     h, hkv = q.shape[2], k.shape[2]
     if v.shape[2] != hkv:
         raise ValueError(f"k has {hkv} heads but v has {v.shape[2]}")
@@ -391,6 +413,7 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: bool | None = None,
+    kv_offset: int = 0,
 ):
     """Flash attention. q: (B, T, H, D), k/v: (B, T, Hkv, D) -> (B, T, H, D).
 
@@ -414,7 +437,7 @@ def flash_attention(
     ``interpret=None`` auto-selects interpreter mode off-TPU so the kernel
     runs on the CPU-simulated mesh (tests) and compiled on real chips.
     """
-    h, hkv = _validate_flash_args(q, k, v, causal, window)
+    h, hkv = _validate_flash_args(q, k, v, causal, window, kv_offset)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     b, t, _, d = q.shape
@@ -424,7 +447,7 @@ def flash_attention(
     # its backward a zero cotangent, which the shared kernels fold away
     out, _ = _flash_lse(
         _fold_heads(q), _fold_heads(k), _fold_heads(v), causal, window,
-        bq, bk, interpret, h, hkv,
+        kv_offset, bq, bk, interpret, h, hkv,
     )
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
@@ -438,6 +461,7 @@ def flash_attention_with_lse(
     block_q: int = 512,
     block_k: int = 1024,
     interpret: bool | None = None,
+    kv_offset: int = 0,
 ):
     """Flash attention that also returns the per-row logsumexp.
 
@@ -451,7 +475,7 @@ def flash_attention_with_lse(
     (``parallel/ring_attention.py``).  Differentiable in out AND lse
     (shared backward kernels; the lse cotangent folds into delta).
     Grouped-query K/V (Hkv < H) supported as in ``flash_attention``."""
-    h, hkv = _validate_flash_args(q, k, v, causal, window)
+    h, hkv = _validate_flash_args(q, k, v, causal, window, kv_offset)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")
     b, t, _, d = q.shape
@@ -459,7 +483,7 @@ def flash_attention_with_lse(
     bk = _pick_block(t, block_k)
     out, lse = _flash_lse(
         _fold_heads(q), _fold_heads(k), _fold_heads(v), causal, window,
-        bq, bk, interpret, h, hkv,
+        kv_offset, bq, bk, interpret, h, hkv,
     )
     return (
         out.reshape(b, h, t, d).transpose(0, 2, 1, 3),

@@ -957,12 +957,6 @@ def make_lm_pipeline_step_fns(
             "causal=False is only implemented for the XLA dense attention "
             "path (the nested ring/Ulysses/flash cores are built causal)"
         )
-    if cfg.flash and cfg.attn_impl == "ring" and cfg.attn_window:
-        raise ValueError(
-            "attn_window inside flash-in-ring is not implemented (the "
-            "kernel's band mask assumes one global coordinate space); use "
-            "the dense-block ring (flash=False) or Ulysses with a window"
-        )
     if cfg.flash and cfg.attn_impl == "dense" and spec.seq > 1:
         raise ValueError(
             "flash=True with attn_impl='dense' requires mesh seq=1 "

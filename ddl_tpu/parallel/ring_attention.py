@@ -97,15 +97,8 @@ def ring_attention(
     if window and not causal:
         raise ValueError("window > 0 requires causal=True")
     if use_flash:
-        if window:
-            raise ValueError(
-                "sliding window inside flash-in-ring is not implemented "
-                "(the kernel's band mask assumes one global coordinate "
-                "space); use the dense-block ring (flash=False) with "
-                "attn_window, or Ulysses"
-            )
         return _ring_attention_flash(
-            q, k, v, axis_name, causal, pos, flash_block
+            q, k, v, axis_name, causal, pos, flash_block, window
         )
     n = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name) if pos is None else pos
@@ -114,6 +107,13 @@ def ring_attention(
     # ring: receive the next block from the left neighbour each step
     perm = [(j, (j + 1) % n) for j in range(n)]
     local_pos = jnp.arange(t)
+    # Sliding window: a K/V block from hop i sits i*T_local positions
+    # back, so hops past ceil((window + T_local - 1)/T_local) are fully
+    # outside every row's band on every device — truncate the ring there
+    # (O(window) hops of compute AND ppermute traffic instead of O(T)).
+    n_hops = n
+    if causal and window:
+        n_hops = min(n, -(-(window + t - 1) // t))
 
     def step(carry, i):
         k_blk, v_blk, acc, row_max, row_sum = carry
@@ -147,26 +147,66 @@ def ring_attention(
         jnp.full((b, h, t), _NEG_INF, q.dtype),
         jnp.zeros((b, h, t), q.dtype),
     )
-    (k, v, acc, row_max, row_sum), _ = lax.scan(step, init, jnp.arange(n))
+    (k, v, acc, row_max, row_sum), _ = lax.scan(
+        step, init, jnp.arange(n_hops)
+    )
     denom = jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
     return acc / denom
 
 
-def _ring_attention_flash(q, k, v, axis_name, causal, pos, block):
+def _ring_attention_flash(q, k, v, axis_name, causal, pos, block, window=0):
     """Flash-per-block ring: the diagonal block (step 0, always the
     device's own K/V under the ring source rule ``src = (s - i) mod n``)
     runs with the kernel's causal mask; every later block is either fully
     visible (``src < s``) or fully future (gated to lse = -inf so it
-    contributes nothing while the compute stays uniform SPMD)."""
+    contributes nothing while the compute stays uniform SPMD).
+
+    Sliding window (``window > 0``): hop ``i``'s K/V block originated
+    ``i * T_local`` positions back, a STATIC offset — the kernel's
+    ``kv_offset`` shifts its band mask into the hop's coordinates, so the
+    per-hop call computes exactly the in-band tiles.  The hop loop is a
+    Python unroll (mesh axis sizes are static) truncated at the last hop
+    any row's band can reach — O(window) ring compute AND ppermute
+    traffic, matching the dense-block ring's truncation."""
     from ddl_tpu.ops.flash_attention import flash_attention_with_lse
 
     n = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name) if pos is None else pos
+    t = q.shape[1]
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     out0, lse0 = flash_attention_with_lse(
-        q, k, v, causal=causal, block_q=block, block_k=block
+        q, k, v, causal=causal, window=window, block_q=block, block_k=block
     )
+
+    def combine(carry, o_blk, lse_blk, i):
+        o_run, lse_run = carry
+        if causal:
+            src = (s - i) % n
+            lse_blk = jnp.where(src < s, lse_blk, _NEG_INF)
+        lse_new = jnp.logaddexp(lse_run, lse_blk)
+        w_run = jnp.exp(lse_run - lse_new).transpose(0, 2, 1)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
+        return o_run * w_run + o_blk.astype(jnp.float32) * w_blk, lse_new
+
+    if causal and window:
+        # Windowed: hop i's K/V block sits a STATIC i*T_local positions
+        # back, so each hop runs the kernel banded in its own coordinates
+        # (kv_offset is a static kernel parameter — hence the Python
+        # unroll), and the loop truncates at the last hop any row's band
+        # reaches: O(window) ring compute AND ppermute traffic.
+        n_hops = min(n, -(-(window + t - 1) // t))
+        acc = (out0.astype(jnp.float32), lse0)
+        k_blk, v_blk = k, v
+        for i in range(1, n_hops):
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            o_blk, lse_blk = flash_attention_with_lse(
+                q, k_blk, v_blk, causal=True, window=window,
+                kv_offset=i * t, block_q=block, block_k=block,
+            )
+            acc = combine(acc, o_blk, lse_blk, i)
+        return acc[0].astype(q.dtype)
 
     def step(carry, i):
         k_blk, v_blk, o_run, lse_run = carry
@@ -175,14 +215,8 @@ def _ring_attention_flash(q, k, v, axis_name, causal, pos, block):
         o_blk, lse_blk = flash_attention_with_lse(
             q, k_blk, v_blk, causal=False, block_q=block, block_k=block
         )
-        if causal:
-            src = (s - i) % n
-            lse_blk = jnp.where(src < s, lse_blk, _NEG_INF)
-        lse_new = jnp.logaddexp(lse_run, lse_blk)
-        w_run = jnp.exp(lse_run - lse_new).transpose(0, 2, 1)[..., None]
-        w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
-        o_run = o_run * w_run + o_blk.astype(jnp.float32) * w_blk
-        return (k_blk, v_blk, o_run, lse_new), None
+        o_run, lse_run = combine((o_run, lse_run), o_blk, lse_blk, i)
+        return (k_blk, v_blk, o_run, lse_run), None
 
     init = (k, v, out0.astype(jnp.float32), lse0)
     (_, _, o, _), _ = lax.scan(step, init, jnp.arange(1, n))

@@ -424,12 +424,6 @@ def make_lm_step_fns(
             f"num_experts {cfg.num_experts} must divide by mesh "
             f"expert={spec.expert}"
         )
-    if cfg.flash and cfg.attn_impl == "ring" and cfg.attn_window:
-        raise ValueError(
-            "attn_window inside flash-in-ring is not implemented (the "
-            "kernel's band mask assumes one global coordinate space); use "
-            "the dense-block ring (flash=False) or Ulysses with a window"
-        )
     if cfg.flash and cfg.attn_impl == "dense" and spec.seq > 1:
         raise ValueError(
             "flash=True with attn_impl='dense' requires mesh seq=1 "

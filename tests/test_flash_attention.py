@@ -370,3 +370,42 @@ def test_flash_rejects_bad_kv_heads():
     k = jnp.zeros((1, 32, 4, 8), jnp.float32)
     with pytest.raises(ValueError, match="divide"):
         flash_attention(q, k, k, causal=True)
+
+
+def test_flash_kv_offset_empty_band_rows_are_zero():
+    """With kv_offset a live tile can hold rows whose whole band is masked;
+    those rows must output exactly zero (and a floor lse), not mean-of-V
+    garbage (round-3 review finding)."""
+    from ddl_tpu.ops.flash_attention import flash_attention_with_lse
+
+    rng = np.random.default_rng(3)
+    t = 32
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, t, 2, 8)), jnp.float32)
+        for _ in range(3)
+    )
+    # offset t, window 8: row q sees k_loc > q + t - 8, so rows >= 7
+    # see nothing in this block (empty band inside a live tile)
+    out, lse = flash_attention_with_lse(
+        q, k, v, causal=True, window=8, kv_offset=t, block_q=8, block_k=8
+    )
+    np.testing.assert_array_equal(np.asarray(out[:, 7:]), 0.0)
+    assert np.all(np.asarray(lse[:, :, 7:]) < -1e29)
+    # visible rows equal the dense cross-block band (the dense core's
+    # fully-masked rows produce uniform-softmax output, so compare only
+    # the rows with a non-empty band)
+    pos_q = np.arange(t)[:, None]
+    pos_k = np.arange(t)[None, :] - t
+    mask = (pos_k <= pos_q) & (pos_k > pos_q - 8)
+    want = dense_attention(q, k, v, mask=jnp.asarray(mask))
+    got = np.asarray(out[:, :7])
+    np.testing.assert_allclose(got, np.asarray(want)[:, :7], atol=2e-5)
+    # backward stays finite and zero for the empty rows
+    g = jax.grad(
+        lambda x: flash_attention_with_lse(
+            x, k, v, causal=True, window=8, kv_offset=t,
+            block_q=8, block_k=8,
+        )[0].sum()
+    )(q)
+    assert bool(jnp.isfinite(g).all())
+    np.testing.assert_array_equal(np.asarray(g[:, 7:]), 0.0)

@@ -403,6 +403,24 @@ def test_lm_pipeline_flash_attention(sched):
     assert _maxerr(split_lm_params(jax.device_get(s_ref.params), 2),
                    jax.device_get(s_r.params)) < 1e-3
 
+    # windowed flash-in-ring inside the pipeline (round 3): the per-hop
+    # banded kernel + O(window) hop truncation under the nested manual
+    # region, against the single-device dense-windowed run
+    win_ref_cfg = dataclasses.replace(cfg, attn_window=6)
+    fns_wref = make_lm_step_fns(win_ref_cfg, LMMeshSpec(data=1), tx, rng,
+                                B, 16, devices=jax.devices()[:1])
+    _, m_wref = fns_wref.train(fns_wref.init_state(), inp, tgt)
+    win_cfg = dataclasses.replace(
+        cfg, flash=True, attn_impl="ring", attn_window=6
+    )
+    fns_w = make_lm_step_fns(
+        win_cfg, LMMeshSpec(pipe=2, seq=2, model=2), tx, rng, B, 16,
+        devices=jax.devices()[:8], num_microbatches=2,
+        pipeline_schedule=sched,
+    )
+    _, m_w = fns_w.train(fns_w.init_state(), inp, tgt)
+    assert abs(float(m_w["loss"]) - float(m_wref["loss"])) < 1e-4
+
 
 def test_lm_pipeline_checkpoint_interop(tmp_path):
     """The parallelism topology is a resume-time choice: a snapshot from a

@@ -105,18 +105,39 @@ def test_ulysses_window_matches_dense(qkv):
     )
 
 
-def test_ring_flash_window_rejected():
+@pytest.mark.parametrize("n_dev,window", [(2, 8), (4, 8), (4, 24), (4, 100)])
+def test_ring_flash_window_matches_dense(n_dev, window):
+    """Flash-in-ring with a sliding window (the round-2 ValueError, now a
+    feature): each hop runs the kernel banded in its own coordinates via
+    kv_offset, the ring truncates to O(window) hops, and the result equals
+    single-device banded attention — including windows smaller than,
+    spanning, and exceeding the T_local block (and the full sequence)."""
     from jax.sharding import Mesh
 
     from ddl_tpu.parallel.ring_attention import make_ring_self_attention
 
-    mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
-    fn = make_ring_self_attention(
-        mesh, causal=True, use_flash=True, window=W
+    rng = np.random.default_rng(5)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+        for _ in range(3)
     )
-    x = jnp.zeros((1, 16, 2, 8), jnp.float32)
-    with pytest.raises(ValueError, match="flash-in-ring"):
-        fn(x, x, x)
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("seq",))
+    fn = make_ring_self_attention(
+        mesh, causal=True, use_flash=True, window=window, flash_block=8
+    )
+    want = _dense_banded(q, k, v, window)
+    np.testing.assert_allclose(
+        np.asarray(fn(q, k, v)), np.asarray(want), atol=2e-5, rtol=1e-4
+    )
+    # differentiable (the training path)
+    g = jax.grad(lambda a, b, c: fn(a, b, c).sum(), (0, 1, 2))(q, k, v)
+    gd = jax.grad(
+        lambda a, b, c: _dense_banded(a, b, c, window).sum(), (0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, rtol=1e-4
+        )
 
 
 def test_config_window_requires_causal():
@@ -128,25 +149,36 @@ def test_config_window_requires_causal():
         LMConfig(attn_window=-1)
 
 
-def test_ring_flash_window_rejected_at_factory():
-    """The unsupported combination fails at step-fn construction, not
-    buried in a first-trace shard_map traceback."""
+def test_lm_ring_flash_window_matches_dense_model():
+    """Full model: flash-in-ring + attn_window on a seq=2 mesh reproduces
+    the single-device dense-windowed run (the round-2 factory ValueError
+    is now a supported composition)."""
     import optax
 
     from ddl_tpu.models.transformer import LMConfig
     from ddl_tpu.parallel.sharding import LMMeshSpec
     from ddl_tpu.train.lm_steps import make_lm_step_fns
 
-    cfg = LMConfig(
-        vocab_size=32, d_model=32, n_layers=2, n_heads=4, head_dim=8,
-        d_ff=64, compute_dtype="float32", remat=False,
-        attn_impl="ring", flash=True, attn_window=W,
-    )
-    with pytest.raises(ValueError, match="flash-in-ring"):
-        make_lm_step_fns(
-            cfg, LMMeshSpec(seq=2), optax.adam(1e-3), jax.random.key(0),
-            4, 32, devices=jax.devices()[:2],
+    def run(spec, **kw):
+        cfg = LMConfig(
+            vocab_size=32, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+            d_ff=64, compute_dtype="float32", remat=False, attn_window=W,
+            **kw,
         )
+        fns = make_lm_step_fns(
+            cfg, spec, optax.adam(1e-3), jax.random.key(0), 4, 32,
+            devices=jax.devices()[: spec.num_devices],
+        )
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 32, (4, 33))
+        _, m = fns.train(
+            fns.init_state(), jnp.asarray(x[:, :-1]), jnp.asarray(x[:, 1:])
+        )
+        return float(m["loss"])
+
+    ref = run(LMMeshSpec())
+    got = run(LMMeshSpec(seq=2), attn_impl="ring", flash=True)
+    np.testing.assert_allclose(got, ref, atol=1e-4)
 
 
 def test_lm_windowed_decode_matches_training_forward():

@@ -80,8 +80,15 @@ def _bench_one(args, kv_heads: int, window: int) -> dict:
     ms_per_tok = (t2 - t1) / (n2 - n1) * 1e3
     kv = cfg.kv_heads
     elt = cfg.dtype.itemsize
-    # one decode step (t=1) reads a window-long slice (transformer.py:
-    # span = attn_window + t - 1), or the whole allocated cache without one
+    # windowed rows use the O(window)-memory ring cache (the generator's
+    # rolling auto-mode); read the real allocation from init_kv_cache so
+    # the reported bytes cannot drift from what the generator builds
+    from ddl_tpu.infer.decode import init_kv_cache
+
+    rolling = bool(window) and window < capacity
+    alloc = jax.eval_shape(
+        lambda: init_kv_cache(cfg, args.batch, capacity, rolling=rolling)
+    )[0][0].shape[1]
     span = min(window, capacity) if window else capacity
     return {
         "heads": f"{cfg.n_heads}q/{kv}kv",
@@ -94,7 +101,7 @@ def _bench_one(args, kv_heads: int, window: int) -> dict:
         "decode_tok_per_sec": round(args.batch / (ms_per_tok / 1e3), 1),
         # allocation vs what one decode step actually reads per layer
         "cache_bytes_per_layer": int(
-            2 * args.batch * capacity * kv * cfg.head_dim * elt
+            2 * args.batch * alloc * kv * cfg.head_dim * elt
         ),
         "read_bytes_per_step_layer": int(
             2 * args.batch * span * kv * cfg.head_dim * elt

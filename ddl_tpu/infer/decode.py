@@ -59,6 +59,7 @@ class LMDecode(nn.Module):
     """
 
     cfg: LMConfig
+    rolling: bool = False  # ring cache of capacity attn_window
 
     @nn.compact
     def __call__(self, tokens, caches, offset, last_only: bool = False):
@@ -67,7 +68,9 @@ class LMDecode(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         new_caches = []
         for i in range(cfg.n_layers):
-            x, _aux, c = Block(cfg, None, name=f"block{i}")(x, caches[i], offset)
+            x, _aux, c = Block(cfg, None, name=f"block{i}")(
+                x, caches[i], offset, rolling=self.rolling
+            )
             new_caches.append(c)
         if last_only:  # prefill only needs the next-token logits
             x = x[:, -1:]
@@ -75,16 +78,25 @@ class LMDecode(nn.Module):
 
 
 def init_kv_cache(
-    cfg: LMConfig, batch: int, max_len: int, dtype=None
+    cfg: LMConfig, batch: int, max_len: int, dtype=None,
+    rolling: bool = False,
 ) -> tuple:
-    """Per-layer zeroed ``(k, v)`` buffers of shape (B, max_len, Hkv, Dh).
+    """Per-layer zeroed ``(k, v)`` buffers of shape (B, L, Hkv, Dh).
+
+    ``L`` is ``max_len``, or ``min(max_len, attn_window)`` with
+    ``rolling=True`` — the ring cache holds only the window, so a
+    windowed generation's cache memory is O(window) regardless of
+    ``max_len`` (pair with ``LMDecode(rolling=True)``).
 
     With grouped-query attention (``cfg.n_kv_heads``) the cache holds only
     the K/V heads — an ``n_heads/n_kv_heads``-times smaller buffer, which
     is GQA's decode-bandwidth win (the grouped ``dense_attention`` reads it
     without re-materialising full heads)."""
+    if rolling and not cfg.attn_window:
+        raise ValueError("rolling cache requires cfg.attn_window > 0")
     dtype = dtype or cfg.dtype
-    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    length = min(max_len, cfg.attn_window) if rolling else max_len
+    shape = (batch, length, cfg.kv_heads, cfg.head_dim)
     zero = jnp.zeros(shape, dtype)
     return tuple((zero, zero) for _ in range(cfg.n_layers))
 
@@ -101,6 +113,7 @@ def make_lm_generator(
     devices=None,
     mesh=None,
     max_len: int | None = None,
+    rolling: bool | None = None,
 ):
     """Build a jitted ``generate(params, prompt, rng) -> tokens`` function.
 
@@ -122,6 +135,11 @@ def make_lm_generator(
     the whole allocated buffer (masked), so per-step cost is set by the
     *capacity*, not the position — benchmarks comparing different
     ``max_new`` values must pin ``max_len`` to compare like with like.
+
+    ``rolling`` selects the O(window)-memory ring cache (None = auto: on
+    whenever ``cfg.attn_window`` is set and smaller than the cache
+    length).  Windowed decode then allocates ``attn_window`` cache rows
+    instead of ``max_len`` — identical outputs, ring-slot writes.
     """
     if max_len is None:
         max_len = prompt_len + max_new
@@ -130,6 +148,10 @@ def make_lm_generator(
             f"max_len {max_len} < prompt_len + max_new "
             f"({prompt_len} + {max_new})"
         )
+    if rolling is None:
+        rolling = bool(cfg.attn_window) and cfg.attn_window < max_len
+    if rolling and not cfg.attn_window:
+        raise ValueError("rolling=True requires cfg.attn_window > 0")
     if not cfg.causal:
         raise ValueError(
             "autoregressive decode requires a causal LM (cfg.causal=True); "
@@ -149,10 +171,10 @@ def make_lm_generator(
     if mesh is None:
         mesh = build_lm_mesh(spec or LMMeshSpec(), devices)
     rules = lm_logical_rules(cfg.fsdp)
-    model = LMDecode(cfg)
+    model = LMDecode(cfg, rolling=rolling)
 
     def generate(params, prompt, rng):
-        caches = init_kv_cache(cfg, batch, max_len)
+        caches = init_kv_cache(cfg, batch, max_len, rolling=rolling)
 
         with nn.logical_axis_rules(rules):
             logits, caches = model.apply(

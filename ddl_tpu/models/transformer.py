@@ -223,13 +223,23 @@ class Attention(nn.Module):
       K/V are written into the cache at ``offset`` and the queries attend
       over the whole cache under the causal mask; returns
       ``(out, (new_k, new_v))``.  Used by ``infer/decode.py``.
+
+    ``rolling=True`` (requires ``cfg.attn_window``) treats the cache as a
+    RING of capacity ``attn_window`` instead of a linear buffer: slot
+    ``p % L`` holds position ``p``, so allocation is O(window) no matter
+    how long the generation runs — the memory-side twin of the linear
+    cache's O(window) read slice.  Prefill (``t > 1``) attends its own
+    fresh K/V directly (banded causal — the cache holds nothing older)
+    and writes only the last ``min(L, t)`` keys; single-token decode
+    writes one slot and reads the whole ring under a derived absolute-
+    position mask.
     """
 
     cfg: LMConfig
     attn_core: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, kv_cache=None, offset=None):
+    def __call__(self, x, kv_cache=None, offset=None, rolling=False):
         cfg = self.cfg
         b, t, _ = x.shape
         # kernels are flat (embed, heads*head_dim) with the fused dim sharded
@@ -272,6 +282,43 @@ class Attention(nn.Module):
             )
             o = nn.with_logical_constraint(core(q, k, v), spec)
             new_cache = None
+        elif rolling:
+            if not cfg.attn_window:
+                raise ValueError("rolling decode cache requires attn_window")
+            ck, cv = kv_cache
+            cap = ck.shape[1]
+            if t > 1:
+                # prefill: the ring holds nothing older than these tokens,
+                # so attend the fresh K/V directly (banded causal) and
+                # persist only the last min(cap, t) of them
+                o = dense_attention(
+                    q, k, v, causal=True, window=cfg.attn_window
+                )
+                keep = min(cap, t)
+                slots = (offset + t - keep + jnp.arange(keep)) % cap
+                ck = ck.at[:, slots].set(k[:, -keep:].astype(ck.dtype))
+                cv = cv.at[:, slots].set(v[:, -keep:].astype(cv.dtype))
+            else:
+                slot = offset % cap
+                ck = jax.lax.dynamic_update_slice(
+                    ck, k.astype(ck.dtype), (0, slot, 0, 0)
+                )
+                cv = jax.lax.dynamic_update_slice(
+                    cv, v.astype(cv.dtype), (0, slot, 0, 0)
+                )
+                # slot s holds the newest position congruent to s (mod
+                # cap); never-written slots derive negative positions
+                key_pos = offset - ((offset - jnp.arange(cap)) % cap)
+                mask = (
+                    (key_pos[None, :] <= offset)
+                    & (key_pos[None, :] > offset - cfg.attn_window)
+                    & (key_pos[None, :] >= 0)
+                )
+                o = dense_attention(q, ck, cv, mask=mask)
+            ck = nn.with_logical_constraint(ck, spec)
+            cv = nn.with_logical_constraint(cv, spec)
+            o = nn.with_logical_constraint(o, spec)
+            new_cache = (ck, cv)
         else:
             ck, cv = kv_cache
             ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, offset, 0, 0))
@@ -473,7 +520,8 @@ class Block(nn.Module):
     attn_core: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, kv_cache=None, offset=None, deterministic=True):
+    def __call__(self, x, kv_cache=None, offset=None, deterministic=True,
+                 rolling=False):
         cfg = self.cfg
         drop = nn.Dropout(cfg.dropout_rate, deterministic=deterministic)
         attn = Attention(cfg, self.attn_core, name="attn")
@@ -482,7 +530,7 @@ class Block(nn.Module):
             x = x + drop(attn(h))
             new_cache = None
         else:
-            a, new_cache = attn(h, kv_cache, offset)
+            a, new_cache = attn(h, kv_cache, offset, rolling=rolling)
             x = x + drop(a)
         h = RMSNorm(cfg.dtype, name="norm_mlp")(x)
         if cfg.num_experts > 0:

@@ -214,6 +214,63 @@ def test_decode_bench_smoke(capsys):
     finally:
         sys.argv = argv
     row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert row["decode_tok_per_sec"] > 0 and row["prefill_ms"] > 0
-    # one windowed step reads an O(window) slice, not the whole cache
-    assert row["read_bytes_per_step_layer"] < row["cache_bytes_per_layer"]
+    # CPU walls are microseconds, so the two-length slope can come out
+    # negative from noise — assert structure here, timing signs belong to
+    # the real-chip runs (PERF.md)
+    assert np.isfinite(row["decode_tok_per_sec"]) and row["prefill_ms"] > 0
+    # the windowed ring allocates O(window); its per-step read spans the
+    # same window rows
+    assert row["cache_bytes_per_layer"] < row["max_len"] * 2 * 64 * 4
+    assert row["read_bytes_per_step_layer"] <= row["cache_bytes_per_layer"]
+
+
+def test_rolling_cache_matches_linear_and_is_o_window():
+    """The ring cache (rolling=True, O(window) allocation) decodes the
+    exact same tokens as the linear cache, for prompts longer and shorter
+    than the window, and really allocates only window rows."""
+    import flax.linen as nn
+
+    from ddl_tpu.infer.decode import init_kv_cache, make_lm_generator
+    from ddl_tpu.models.transformer import LMConfig, TransformerLM
+
+    cfg = LMConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+        d_ff=64, compute_dtype="float32", remat=False, attn_window=6,
+    )
+    params = nn.meta.unbox(
+        TransformerLM(cfg, None).init(
+            jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+    )
+    caches = init_kv_cache(cfg, 2, 64, rolling=True)
+    assert caches[0][0].shape == (2, 6, 4, 8)  # (B, window, Hkv, Dh)
+    rng = np.random.default_rng(0)
+    for prompt_len, max_new in ((12, 10), (3, 15)):
+        prompt = jnp.asarray(
+            rng.integers(0, 64, (1, prompt_len)), jnp.int32
+        )
+        lin = make_lm_generator(
+            cfg, prompt_len=prompt_len, max_new=max_new, rolling=False
+        )
+        rol = make_lm_generator(
+            cfg, prompt_len=prompt_len, max_new=max_new, rolling=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(lin(params, prompt)), np.asarray(rol(params, prompt))
+        )
+
+    # auto mode turns the ring on exactly when a window is set and smaller
+    # than the cache; without a window it must reject rolling=True
+    import pytest
+
+    with pytest.raises(ValueError, match="attn_window"):
+        make_lm_generator(
+            dataclasses_replace_no_window(cfg), prompt_len=4, max_new=4,
+            rolling=True,
+        )
+
+
+def dataclasses_replace_no_window(cfg):
+    import dataclasses
+
+    return dataclasses.replace(cfg, attn_window=0)

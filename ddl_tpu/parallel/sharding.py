@@ -40,6 +40,7 @@ __all__ = [
     "validate_kv_head_sharding",
     "validate_ulysses_kv_heads",
     "FLASH_AUTO_MIN_T",
+    "FLASH_AUTO_MIN_T_LOCAL_RING",
     "SEQ_AXIS",
     "MODEL_AXIS",
     "EXPERT_AXIS",
@@ -52,6 +53,12 @@ __all__ = [
 # batch 8) and the gap grows with T (backward dominates training, and
 # flash backward wins at every measured length).
 FLASH_AUTO_MIN_T = 1024
+# Ring crossover operates on the per-device shard: each hop is a
+# T_local x T_local block, and the device-only kernel table (PERF.md,
+# round-3 slope method) shows flash beating dense in BOTH directions
+# from 2048 — below that the per-hop kernels sit at the grid-overhead
+# floor and the dense blocks win.
+FLASH_AUTO_MIN_T_LOCAL_RING = 2048
 
 
 def resolve_auto_flash(cfg, spec: "LMMeshSpec", seq_len: int) -> bool:
@@ -62,14 +69,13 @@ def resolve_auto_flash(cfg, spec: "LMMeshSpec", seq_len: int) -> bool:
     Pallas kernel only where it is both *supported* — causal; not
     dense-with-sharded-seq, where the kernel cannot see the full sequence;
     heads divisible over ``model``, which the head-parallel manual core
-    requires — and *measured faster* (training ``seq_len`` at or past
-    ``FLASH_AUTO_MIN_T``).  Ulysses attends the full sequence per head
-    group after its all-to-all, so the global ``seq_len`` is the right
-    scale.  'ring' is deliberately excluded from auto even though
-    flash-inside-ring is supported (``flash=True`` + ``attn_impl='ring'``):
-    its crossover depends on T_local and has no multi-chip measurement yet
-    — opt in explicitly for long per-device sequences (PERF.md)."""
-    if not cfg.causal or cfg.attn_impl == "ring":
+    requires — and *measured faster*.  Ulysses attends the full sequence
+    per head group after its all-to-all, so the global ``seq_len`` is the
+    right scale; ring attends T_local-sized blocks per hop, so its
+    threshold applies to ``seq_len / spec.seq``
+    (``FLASH_AUTO_MIN_T_LOCAL_RING`` — flash-inside-ring is the
+    long-per-device-sequence composition)."""
+    if not cfg.causal:
         return False
     if cfg.attn_impl == "dense" and spec.seq > 1:
         return False
@@ -79,6 +85,12 @@ def resolve_auto_flash(cfg, spec: "LMMeshSpec", seq_len: int) -> bool:
         # Ulysses re-splits local heads over 'seq' in its all-to-all; flash
         # under Ulysses needs that split exact, so auto falls back to dense.
         return False
+    if cfg.attn_impl == "ring":
+        if spec.seq == 1:
+            # degenerate ring: one diagonal hop = full-sequence kernel,
+            # same regime as the dense+flash path
+            return seq_len >= FLASH_AUTO_MIN_T
+        return seq_len // spec.seq >= FLASH_AUTO_MIN_T_LOCAL_RING
     return seq_len >= FLASH_AUTO_MIN_T
 
 

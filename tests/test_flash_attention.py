@@ -136,9 +136,18 @@ def test_flash_auto_resolution():
     spec = LMMeshSpec()
     assert resolve_auto_flash(base, spec, FLASH_AUTO_MIN_T - 1) is False
     assert resolve_auto_flash(base, spec, FLASH_AUTO_MIN_T) is True
-    # unsupported compositions stay dense regardless of length
+    # ring auto is thresholded on the PER-DEVICE block: flash-in-ring from
+    # T_local >= 2048 (device-only kernel crossover), dense blocks below
     ring = dataclasses.replace(base, attn_impl="ring")
-    assert resolve_auto_flash(ring, LMMeshSpec(seq=2), 8192) is False
+    assert resolve_auto_flash(ring, LMMeshSpec(seq=2), 8192) is True
+    assert resolve_auto_flash(ring, LMMeshSpec(seq=2), 2048) is False
+    assert resolve_auto_flash(ring, LMMeshSpec(seq=4), 8192) is True
+    assert resolve_auto_flash(ring, LMMeshSpec(seq=8), 8192) is False
+    # degenerate seq=1 ring == full-sequence kernel: the step-level 1024
+    # crossover applies, not the per-hop one
+    assert resolve_auto_flash(ring, LMMeshSpec(), 1024) is True
+    assert resolve_auto_flash(ring, LMMeshSpec(), 512) is False
+    # dense attention cannot see a sharded sequence: stays dense
     assert resolve_auto_flash(base, LMMeshSpec(seq=2), 8192) is False
     bidir = dataclasses.replace(base, causal=False)
     assert resolve_auto_flash(bidir, spec, 8192) is False

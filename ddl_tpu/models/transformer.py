@@ -65,6 +65,13 @@ class LMConfig:
     num_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.5
+    # How tokens reach their experts.  'sort' (default) routes with an
+    # argsort/scatter/gather pipeline whose cost is O(B*S*(K+E)) index work
+    # plus the expert matmuls themselves; 'einsum' is the naive GShard
+    # form that materialises (B, S, E, C) one-hot dispatch/combine tensors
+    # and pays two extra (B,S,E,C,D) matmuls per layer (kept as the
+    # reference implementation for parity tests).
+    moe_dispatch: str = "sort"
     moe_aux_weight: float = 0.01
     rope_theta: float = 10000.0
     compute_dtype: str = "bfloat16"
@@ -428,6 +435,68 @@ def _top_k_dispatch(gates, k: int, capacity: int):
     return dispatch, combine
 
 
+def _sort_dispatch(gates, k: int, capacity: int):
+    """Sort-based top-k routing — same slot assignment as
+    ``_top_k_dispatch`` without the (B, S, E, C) one-hot tensors.
+
+    Token-choices are flattened choice-rank-major (all first choices, then
+    all second choices) and stably argsorted by expert id, which reproduces
+    the einsum path's priority order exactly: slots fill by choice rank,
+    then sequence position.  Returns index/mask arrays for a gather-based
+    dispatch and combine:
+
+    - ``slot_token`` (B, E*C) int32: source token for each expert slot
+    - ``slot_valid`` (B, E*C): 1.0 where the slot is filled
+    - ``choice_slot`` (B, K, S) int32: destination slot per token-choice
+      (clamped; dropped choices carry weight 0)
+    - ``choice_weight`` (B, K, S): renormalised gate weight, 0 if dropped
+    - ``frac`` (E,): kept token-choices per token, per expert (the einsum
+      path's ``dispatch.sum(-1).mean((0, 1))``)
+    - ``kept`` (): fraction of all token-choices that found a slot
+    """
+    b, s, e = gates.shape
+    n = k * s
+    gate_vals, expert_idx = jax.lax.top_k(gates, k)  # (B, S, K)
+    expert_flat = expert_idx.transpose(0, 2, 1).reshape(b, n)  # k-major
+    sort_ord = jnp.argsort(expert_flat, axis=-1, stable=True)  # (B, N)
+    sorted_expert = jnp.take_along_axis(expert_flat, sort_ord, axis=-1)
+    # position inside each expert's run = sorted index - group start
+    counts = (expert_flat[..., None] == jnp.arange(e)).sum(1)  # (B, E)
+    starts = jnp.cumsum(counts, axis=-1) - counts  # exclusive
+    pos_in_e = jnp.arange(n)[None, :] - jnp.take_along_axis(
+        starts, sorted_expert, axis=-1
+    )
+    keep_sorted = pos_in_e < capacity
+    # overflow choices target slot E*C: out of bounds, so the scatter's
+    # mode='drop' discards them — static shapes, no branching
+    slot_sorted = jnp.where(
+        keep_sorted, sorted_expert * capacity + pos_in_e, e * capacity
+    )
+    token_sorted = sort_ord % s  # k-major flatten: flat = k_idx * s + pos
+    batch_ix = jnp.arange(b)[:, None]
+    slot_token = jnp.zeros((b, e * capacity), jnp.int32).at[
+        batch_ix, slot_sorted
+    ].set(token_sorted.astype(jnp.int32), mode="drop")
+    slot_valid = jnp.zeros((b, e * capacity), gates.dtype).at[
+        batch_ix, slot_sorted
+    ].set(1.0, mode="drop")
+    # back to original choice order for the combine side
+    inv = jnp.argsort(sort_ord, axis=-1)  # inverse permutation
+    choice_slot = jnp.take_along_axis(slot_sorted, inv, axis=-1)
+    choice_keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    gate_r = gate_vals.transpose(0, 2, 1)  # (B, K, S)
+    keep_r = choice_keep.reshape(b, k, s).astype(gates.dtype)
+    mass = (gate_r * keep_r).sum(1)  # (B, S)
+    choice_weight = gate_r * keep_r / jnp.maximum(mass, 1e-9)[:, None, :]
+    frac = (
+        (expert_flat[..., None] == jnp.arange(e))
+        * choice_keep[..., None]
+    ).sum((0, 1)).astype(gates.dtype) / (b * s)
+    kept = choice_keep.mean(dtype=gates.dtype)
+    choice_slot = jnp.minimum(choice_slot, e * capacity - 1).reshape(b, k, s)
+    return slot_token, slot_valid, choice_slot, choice_weight, frac, kept
+
+
 class MoeMlp(nn.Module):
     """Top-k mixture-of-experts MLP with expert parallelism.
 
@@ -460,19 +529,30 @@ class MoeMlp(nn.Module):
             name="router",
         )(x.astype(jnp.float32))
         gates = jax.nn.softmax(router_logits, axis=-1)  # (B, S, E)
-        dispatch, combine = _top_k_dispatch(gates, cfg.expert_top_k, capacity)
+        if cfg.moe_dispatch == "sort":
+            (slot_token, slot_valid, choice_slot, choice_weight,
+             frac, kept) = _sort_dispatch(gates, cfg.expert_top_k, capacity)
+        elif cfg.moe_dispatch == "einsum":
+            dispatch, combine = _top_k_dispatch(
+                gates, cfg.expert_top_k, capacity
+            )
+            frac = dispatch.sum(-1).mean(axis=(0, 1))  # (E,) kept fraction
+            kept = dispatch.sum() / (b * s * cfg.expert_top_k)
+        else:
+            raise ValueError(
+                f"moe_dispatch must be 'sort' or 'einsum', got "
+                f"{cfg.moe_dispatch!r}"
+            )
 
         # Switch-transformer load-balance loss: E * sum_e f_e * p_e where
         # f_e = fraction of tokens whose slot-0 choice is e, p_e = mean gate.
-        frac = dispatch.sum(-1).mean(axis=(0, 1))  # (E,) dispatched fraction
         mean_gate = gates.mean(axis=(0, 1))
         aux_loss = e * jnp.sum(frac / cfg.expert_top_k * mean_gate)
 
         # Router observability (sown per block; the step aggregates into
-        # metrics): capacity overflow silently drops tokens in
-        # _top_k_dispatch, so a run must be able to SEE the drop fraction
-        # and the expert load spread, not just the aux loss.
-        kept = dispatch.sum() / (b * s * cfg.expert_top_k)
+        # metrics): capacity overflow silently drops tokens, so a run must
+        # be able to SEE the drop fraction and the expert load spread, not
+        # just the aux loss.
         self.sow("intermediates", "moe_drop_frac", 1.0 - kept)
         # per-expert share of the kept token-choices (uniform = 1/E)
         load = frac / jnp.maximum(frac.sum(), 1e-9)
@@ -497,7 +577,19 @@ class MoeMlp(nn.Module):
             jnp.float32,
         )
         dt = cfg.dtype
-        xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(dt), x.astype(dt))
+        if cfg.moe_dispatch == "sort":
+            # dispatch = batch-local gather of each slot's source token
+            # (index work, not matmuls), then the same expert-sharded
+            # layout as the einsum path so the act_expert constraint
+            # induces the identical all-to-all under EP
+            xe = jnp.take_along_axis(
+                x.astype(dt), slot_token[..., None], axis=1
+            ) * slot_valid[..., None].astype(dt)  # (B, E*C, D)
+            xe = xe.reshape(b, e, capacity, d).transpose(1, 0, 2, 3)
+        else:
+            xe = jnp.einsum(
+                "bsec,bsd->ebcd", dispatch.astype(dt), x.astype(dt)
+            )
         xe = nn.with_logical_constraint(
             xe, ("act_expert", "batch", None, "act_embed")
         )
@@ -507,7 +599,17 @@ class MoeMlp(nn.Module):
         ye = nn.with_logical_constraint(
             ye, ("act_expert", "batch", None, "act_embed")
         )
-        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(dt), ye)
+        if cfg.moe_dispatch == "sort":
+            # combine = gather each token-choice's slot output, weight by
+            # the renormalised gate, sum over the K choices
+            ye_flat = ye.transpose(1, 0, 2, 3).reshape(b, e * capacity, d)
+            k = cfg.expert_top_k
+            yc = jnp.take_along_axis(
+                ye_flat, choice_slot.reshape(b, k * s)[..., None], axis=1
+            ).reshape(b, k, s, d)
+            y = (yc * choice_weight[..., None].astype(dt)).sum(axis=1)
+        else:
+            y = jnp.einsum("bsec,ebcd->bsd", combine.astype(dt), ye)
         y = nn.with_logical_constraint(y, ("batch", "act_seq", "act_embed"))
         return y, aux_loss
 

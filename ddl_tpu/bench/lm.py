@@ -120,25 +120,36 @@ def main() -> None:
     mem = hbm_stats()
     if mem is not None:
         out["hbm_peak_bytes"] = int(mem["peak_bytes_in_use"])
-    from ddl_tpu.bench.mfu import append_mfu, flash_attention_train_flops
+    from ddl_tpu.bench.mfu import (
+        append_mfu,
+        chunked_ce_extra_flops,
+        flash_attention_train_flops,
+    )
 
     # executed FLOPs: equals MFU with remat off, HFU otherwise.  Cost
     # analysis assigns zero FLOPs to the Pallas kernel, so flash rows add
-    # the kernel's banded FLOPs analytically (bench/mfu.py).
-    attn_flops = (
+    # the kernel's banded FLOPs analytically; it also counts scan bodies
+    # once, so ce_chunk rows add the missing loss-edge trips (bench/mfu.py).
+    # MFU rows count theoretical model matmuls; HFU rows count what the
+    # program executes (incl. score recomputes / checkpoint replays).
+    accounting = "model" if args.no_remat else "executed"
+    extra_flops = (
         flash_attention_train_flops(
             args.batch, cfg.n_heads, args.seq_len, cfg.head_dim,
             cfg.n_layers, window=cfg.attn_window, remat=cfg.remat,
-            # MFU rows count theoretical model matmuls; HFU rows count
-            # what the kernels execute (incl. score recomputes)
-            accounting="model" if args.no_remat else "executed",
+            accounting=accounting,
         )
         if cfg.flash
         else 0.0
     )
+    if cfg.ce_chunk:
+        extra_flops += chunked_ce_extra_flops(
+            args.batch, args.seq_len, args.d_model, args.vocab,
+            cfg.ce_chunk, accounting=accounting,
+        )
     append_mfu(out, fns.train, dt, state, inp, tgt,
                key="mfu" if args.no_remat else "hfu",
-               extra_flops=attn_flops)
+               extra_flops=extra_flops)
     print(json.dumps(out))
 
 

@@ -24,6 +24,7 @@ __all__ = [
     "device_peak_flops",
     "compiled_step_flops",
     "flash_attention_train_flops",
+    "chunked_ce_extra_flops",
     "mfu",
     "append_mfu",
     "PEAK_BF16_FLOPS",
@@ -121,6 +122,45 @@ def flash_attention_train_flops(
     else:
         n_matmuls = 11 if remat else 9
     return n_matmuls * matmul * n_layers
+
+
+def chunked_ce_extra_flops(
+    batch: int,
+    seq_len: int,
+    d_model: int,
+    vocab: int,
+    token_chunk: int,
+    accounting: str = "model",
+) -> float:
+    """FLOPs correction for ``ce_chunk`` rows: XLA cost analysis counts a
+    ``lax.scan`` body ONCE regardless of trip count, so a chunked head+CE
+    loss (``ops/losses.fused_chunked_ce``) is undercounted by a factor of
+    ``T/chunk`` on its scan bodies.  Returns the signed delta to add to
+    the cost-analysis total so the loss edge is accounted at full T.
+
+    The loss edge is three model matmuls of ``2*B*T*D*V`` each (forward
+    head projection, backward dx, backward dW); the ``jax.checkpoint``
+    inside the scan body replays the forward, so the *executed* count is
+    four.  Cost analysis sees one fwd-scan body plus one bwd-scan body —
+    four chunk-sized matmuls — hence ``counted = 4 * matmul / trips``.
+    ``accounting`` follows ``flash_attention_train_flops``: "model" (MFU
+    rows) targets the three theoretical matmuls — the checkpoint replay is
+    implementation overhead — and "executed" (HFU rows) targets all four.
+    The delta can be negative at small trip counts under "model" (counted
+    replay work that the MFU convention excludes); that is the correct
+    correction, not an error.
+    """
+    if accounting not in ("model", "executed"):
+        raise ValueError(
+            f"accounting must be 'model' or 'executed', got {accounting!r}"
+        )
+    from ddl_tpu.ops.losses import effective_chunk
+
+    trips = seq_len // effective_chunk(token_chunk, seq_len)
+    matmul = 2.0 * batch * seq_len * d_model * vocab
+    target = (3.0 if accounting == "model" else 4.0) * matmul
+    counted = 4.0 * matmul / trips
+    return target - counted
 
 
 def mfu(flops_per_step: float, step_time_s: float, device=None) -> float | None:

@@ -12,8 +12,21 @@ __all__ = [
     "softmax_cross_entropy",
     "cross_entropy_loss",
     "onehot_cross_entropy_mean",
+    "effective_chunk",
     "fused_chunked_ce",
 ]
+
+
+def effective_chunk(token_chunk: int, t: int) -> int:
+    """The sequence-chunk size ``fused_chunked_ce`` actually scans with:
+    the largest divisor of ``t`` at or under the request (halving would
+    skip valid divisors and can collapse to per-position scans).  Shared
+    with ``bench.mfu.chunked_ce_extra_flops`` so the FLOPs correction and
+    the executed loss agree on the trip count by construction."""
+    c = min(token_chunk, t)
+    while t % c:
+        c -= 1
+    return c
 
 
 def softmax_cross_entropy(logits, labels):
@@ -72,11 +85,7 @@ def fused_chunked_ce(
     b, t, d = hidden.shape
     if token_chunk < 1:
         raise ValueError(f"token_chunk must be >= 1, got {token_chunk}")
-    # largest divisor of T at or under the request (halving would skip
-    # valid divisors and can collapse to per-position scans)
-    c = min(token_chunk, t)
-    while t % c:
-        c -= 1
+    c = effective_chunk(token_chunk, t)
     if c != min(token_chunk, t):
         import warnings
 

@@ -319,3 +319,42 @@ def test_flash_attention_train_flops_band_closed_form():
     # banded < causal
     banded = flash_attention_train_flops(2, 8, 256, 64, 12, window=32)
     assert banded < model
+
+
+def test_chunked_ce_extra_flops_restores_scan_trips():
+    """Cost analysis counts a lax.scan body once; the ce_chunk correction
+    must bring the loss edge back to full-T FLOPs (VERDICT round 3 #7:
+    emitted JSON undercounted chunked rows by the trip count)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl_tpu.bench.mfu import chunked_ce_extra_flops, compiled_step_flops
+    from ddl_tpu.ops.losses import fused_chunked_ce
+
+    b, t, d, v, chunk = 2, 64, 64, 256, 16  # 4 scan trips
+
+    def loss(h, w, tgt):
+        ce, _ = fused_chunked_ce(h, w, tgt, chunk)
+        return ce
+
+    g = jax.grad(loss, argnums=(0, 1))
+    h = jnp.zeros((b, t, d), jnp.float32)
+    w = jnp.zeros((d, v), jnp.float32)
+    tgt = jnp.zeros((b, t), jnp.int32)
+    counted = compiled_step_flops(g, h, w, tgt)
+    if not counted > 0:
+        import pytest
+
+        pytest.skip("backend has no cost analysis")
+    matmul = 2.0 * b * t * d * v
+    # the undercount is real: the compiled program reports well under the
+    # three model matmuls
+    assert counted < 2.5 * matmul
+    extra = chunked_ce_extra_flops(b, t, d, v, chunk, accounting="executed")
+    # counted-once scan bodies + correction ≈ the four executed matmuls
+    # (fwd, checkpoint replay, dx, dW); tolerance covers elementwise work
+    np.testing.assert_allclose(counted + extra, 4 * matmul, rtol=0.1)
+    # model accounting excludes exactly the checkpoint replay
+    delta = extra - chunked_ce_extra_flops(b, t, d, v, chunk)
+    np.testing.assert_allclose(delta, matmul, rtol=1e-12)

@@ -113,6 +113,12 @@ class TrainConfig:
     # (reference single.py:116, ddp.py:129-133).
     snapshot_job_id: str | None = None
     snapshot_epoch: int | None = None
+    # When no explicit snapshot_job_id is given, resume automatically from
+    # the latest snapshot of THIS job id if one exists — the reference's
+    # manual snapshot args (ddp.py:109-110) made automatic, so a
+    # JobSet/SIGTERM relaunch with the same job id continues training with
+    # no extra flags.
+    auto_resume: bool = True
     # Save a snapshot when validation QWK improves (reference ddp.py:292-295;
     # the saves themselves are commented out in the reference — here they work).
     save_best_qwk: bool = True

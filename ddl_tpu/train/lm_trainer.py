@@ -64,6 +64,9 @@ class LMRunConfig:
     checkpoint_dir: str | None = None
     save_every: int = 50  # snapshot cadence in steps
     resume_step: int | None = None
+    # With no explicit resume_step, continue from this job id's latest
+    # snapshot automatically when one exists (relaunch == resume).
+    auto_resume: bool = True
     job_id: str = "lm"
     log_dir: str | None = "training_logs"  # default-on CSV observability
     log_every: int = 10  # console/CSV cadence in steps
@@ -134,8 +137,19 @@ class LMTrainer(BaseTrainer):
 
         self.state = self.fns.init_state()
         self._start_step = 0
-        if run.checkpoint_dir and run.resume_step is not None:
-            self._resume()
+        resume_step = ckpt.resolve_resume(
+            run.checkpoint_dir, run.job_id, run.resume_step,
+            run.auto_resume, unit="step",
+        )
+        if run.checkpoint_dir and resume_step is not None:
+            # cross-LAYOUT resume is handled inside _resume; what fails
+            # here is a genuinely different model config
+            ckpt.run_resume_load(
+                lambda: self._resume(resume_step),
+                auto=run.resume_step is None,
+                desc=f"job {run.job_id!r} step {resume_step}",
+                hint="pass --fresh (auto_resume=False)",
+            )
         # first period whose boundary lies beyond the resume step
         self.periods_run = bisect.bisect_right(
             self._boundaries, self._start_step
@@ -257,7 +271,7 @@ class LMTrainer(BaseTrainer):
 
     # ----------------------------------------------------------- resume
 
-    def _resume(self) -> None:
+    def _resume(self, resume_step: int) -> None:
         run = self.run
         from ddl_tpu.parallel.lm_pipeline import (
             saved_pipe_stages,
@@ -267,13 +281,13 @@ class LMTrainer(BaseTrainer):
         # The snapshot itself records its layout (pipe stages AND
         # interleaved virtual count) — no flag to get wrong.
         saved_md = ckpt.snapshot_metadata(
-            run.checkpoint_dir, run.job_id, run.resume_step
+            run.checkpoint_dir, run.job_id, resume_step
         )
         saved_pipe = saved_pipe_stages(saved_md["state"]["params"])
         saved_virtual = saved_virtual_stages(saved_md["state"]["params"])
         if saved_pipe == self.spec.pipe and saved_virtual == run.virtual_stages:
             self.state, _ = ckpt.load_snapshot(
-                run.checkpoint_dir, run.job_id, run.resume_step, self.state
+                run.checkpoint_dir, run.job_id, resume_step, self.state
             )
             print("resumed (snapshots are mesh-independent)")
         else:
@@ -289,7 +303,7 @@ class LMTrainer(BaseTrainer):
             )
 
             restored, _ = ckpt.load_snapshot(
-                run.checkpoint_dir, run.job_id, run.resume_step,
+                run.checkpoint_dir, run.job_id, resume_step,
                 abstract_lm_state(
                     self.cfg, self.tx, saved_pipe, mesh=self.fns.mesh,
                     virtual=saved_virtual,

@@ -159,12 +159,27 @@ class Trainer(BaseTrainer):
         if len(test_ds) == 0:
             raise ValueError("empty eval set")
 
+        # resume decision happens BEFORE the logger so the CSV lineage
+        # column records auto-resumed runs too, not just flag-resumed ones
+        self._resume_job = cfg.train.snapshot_job_id
+        self._resume_epoch = cfg.train.snapshot_epoch
+        self._resume_auto = False
+        if self._resume_job is None:
+            # snapshot_epoch without a job id means THIS job at that epoch
+            found = ckpt.resolve_resume(
+                cfg.train.checkpoint_dir, self.job_id,
+                explicit=cfg.train.snapshot_epoch,
+                auto=cfg.train.auto_resume,
+            )
+            if found is not None:
+                self._resume_job, self._resume_epoch = self.job_id, found
+                self._resume_auto = cfg.train.snapshot_epoch is None
         self.logger = MetricLogger(
             cfg.train.log_dir,
             self.job_id,
             global_rank=proc,
             local_rank=proc,
-            model_start_job_id=cfg.train.snapshot_job_id,
+            model_start_job_id=self._resume_job,
         )
         self.is_logging_process = proc == 0
         self.epochs_run = 0
@@ -176,7 +191,7 @@ class Trainer(BaseTrainer):
         self.save_best = cfg.train.save_best_qwk
         self.best_value = -1.0
         self._snapshot_mgr = None
-        if cfg.train.snapshot_job_id is not None:
+        if self._resume_job is not None:
             self._load_snapshot()
 
     # ------------------------------------------------------------------
@@ -193,13 +208,21 @@ class Trainer(BaseTrainer):
 
     def _load_snapshot(self) -> None:
         t = self.cfg.train
-        path = ckpt.snapshot_path(t.checkpoint_dir, t.snapshot_job_id, t.snapshot_epoch)
+        path = ckpt.snapshot_path(
+            t.checkpoint_dir, self._resume_job, self._resume_epoch
+        )
         if not path.exists():
             print(f"No snapshot at {path}; starting fresh")
             return
         print(f"Loading snapshot from {path}")
-        self.state, self.epochs_run = ckpt.load_snapshot(
-            t.checkpoint_dir, t.snapshot_job_id, t.snapshot_epoch, self.state
+        self.state, self.epochs_run = ckpt.run_resume_load(
+            lambda: ckpt.load_snapshot(
+                t.checkpoint_dir, self._resume_job, self._resume_epoch,
+                self.state,
+            ),
+            auto=self._resume_auto,
+            desc=str(path),
+            hint="pass train.auto_resume=false",
         )
         print(f"Resuming training from epoch {self.epochs_run}")
 

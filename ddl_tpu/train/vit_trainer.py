@@ -42,6 +42,9 @@ class ViTRunConfig:
     virtual_stages: int = 1
     checkpoint_dir: str | None = "checkpoints"
     resume_epoch: int | None = None
+    # With no explicit resume_epoch, continue from this job id's latest
+    # snapshot automatically when one exists (relaunch == resume).
+    auto_resume: bool = True
     save_best_qwk: bool = True
     job_id: str = "vit"
     log_dir: str | None = "training_logs"  # default-on CSV observability
@@ -117,9 +120,17 @@ class ViTTrainer(BaseTrainer):
 
         self.state = self.fns.init_state()
         self.periods_run = 0
-        if run.checkpoint_dir and run.resume_epoch is not None:
-            self.state, self.periods_run = ckpt.load_snapshot(
-                run.checkpoint_dir, run.job_id, run.resume_epoch, self.state
+        resume_epoch = ckpt.resolve_resume(
+            run.checkpoint_dir, run.job_id, run.resume_epoch, run.auto_resume
+        )
+        if run.checkpoint_dir and resume_epoch is not None:
+            self.state, self.periods_run = ckpt.run_resume_load(
+                lambda: ckpt.load_snapshot(
+                    run.checkpoint_dir, run.job_id, resume_epoch, self.state
+                ),
+                auto=run.resume_epoch is None,
+                desc=f"job {run.job_id!r} epoch {resume_epoch}",
+                hint="pass --fresh (auto_resume=False)",
             )
             print(f"resumed; continuing at epoch {self.periods_run}")
 

@@ -110,6 +110,10 @@ def main() -> None:
                     help="restore the snapshot saved at this step (any mesh, "
                     "any pipeline layout — the saved layout is read from the "
                     "snapshot's metadata)")
+    ap.add_argument("--fresh", action="store_true",
+                    help="start from scratch even if this job id already "
+                    "has snapshots (auto-resume is the default: a relaunch "
+                    "with the same --job-id continues from the latest one)")
     ap.add_argument("--job-id", default="lm")
     ap.add_argument("--log-dir", default="training_logs",
                     help="MetricLogger CSV suite directory (loss, "
@@ -187,6 +191,7 @@ def main() -> None:
         checkpoint_dir=args.checkpoint_dir,
         save_every=args.save_every,
         resume_step=args.resume_step,
+        auto_resume=not args.fresh,
         job_id=args.job_id,
         log_dir=args.log_dir or None,
         halt_on_nan=not args.no_halt_on_nan,

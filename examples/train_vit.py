@@ -62,6 +62,10 @@ def main() -> None:
                     help="QWK-gated / preemption snapshot dir ('' disables)")
     ap.add_argument("--resume-epoch", type=int, default=None,
                     help="restore the snapshot saved at this epoch")
+    ap.add_argument("--fresh", action="store_true",
+                    help="start from scratch even if this job id already "
+                    "has snapshots (auto-resume is the default: a relaunch "
+                    "with the same --job-id continues from the latest one)")
     ap.add_argument("--job-id", default="vit")
     ap.add_argument("--log-dir", default="training_logs",
                     help="MetricLogger CSV suite directory (loss, "
@@ -111,6 +115,7 @@ def main() -> None:
         virtual_stages=args.virtual_stages,
         checkpoint_dir=args.checkpoint_dir or None,
         resume_epoch=args.resume_epoch,
+        auto_resume=not args.fresh,
         job_id=args.job_id,
         log_dir=args.log_dir or None,
         halt_on_nan=not args.no_halt_on_nan,

@@ -108,12 +108,24 @@ def test_lm_sigterm_checkpoints_and_resumes(tmp_path):
     assert saved is not None and 0 < saved < 10**6
     assert saved == int(trainer.state.step)
 
+    # relaunch with the SAME job id and no resume flag: auto-resume finds
+    # the snapshot (VERDICT round 3, task 8 — relaunch == resume)
     resumed = LMTrainer(
-        cfg, LMMeshSpec(), optax.adam(1e-3), _run(saved + 5, resume=saved)
+        cfg, LMMeshSpec(), optax.adam(1e-3), _run(saved + 5)
     )
     assert resumed._start_step == saved
     resumed.train()
     assert int(resumed.state.step) == saved + 5
+
+    # the explicit flag still works, and auto_resume=False starts fresh
+    explicit = LMTrainer(
+        cfg, LMMeshSpec(), optax.adam(1e-3), _run(saved + 5, resume=saved)
+    )
+    assert explicit._start_step == saved
+    run_fresh = _run(10)
+    run_fresh.auto_resume = False
+    fresh = LMTrainer(cfg, LMMeshSpec(), optax.adam(1e-3), run_fresh)
+    assert fresh._start_step == 0
 
 
 def test_sigterm_mid_training_checkpoints_and_resumes(tmp_path, monkeypatch):
@@ -134,11 +146,17 @@ def test_sigterm_mid_training_checkpoints_and_resumes(tmp_path, monkeypatch):
     saved = latest_epoch(cfg.train.checkpoint_dir, "preempt-test")
     assert saved == trainer.epochs_run - 1
 
-    # relaunch resumes from the preemption snapshot and completes
+    # relaunch with the same job id and NO resume flags: auto-resume picks
+    # up the preemption snapshot (VERDICT round 3, task 8 — the
+    # JobSet-restart story end to end)
     cfg2 = _tiny_cfg(tmp_path, epochs=saved + 2)
-    cfg2.train.snapshot_job_id = "preempt-test"
-    cfg2.train.snapshot_epoch = saved
     resumed = Trainer(cfg2, datasets=_datasets(cfg2))
     assert resumed.epochs_run == saved + 1
     resumed.train()
     assert resumed.epochs_run == saved + 2
+
+    # auto_resume=False opts back into a fresh start
+    cfg3 = _tiny_cfg(tmp_path, epochs=1)
+    cfg3.train.auto_resume = False
+    fresh = Trainer(cfg3, datasets=_datasets(cfg3))
+    assert fresh.epochs_run == 0

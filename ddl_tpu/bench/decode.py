@@ -37,7 +37,7 @@ from ddl_tpu.models.transformer import LMConfig, TransformerLM
 from ddl_tpu.utils.timing import fence
 
 
-def _bench_one(args, kv_heads: int, window: int) -> dict:
+def _bench_one(args, batch: int, kv_heads: int, window: int) -> dict:
     cfg = LMConfig(
         vocab_size=args.vocab,
         d_model=args.d_model,
@@ -51,14 +51,14 @@ def _bench_one(args, kv_heads: int, window: int) -> dict:
         remat=False,
     )
     params = TransformerLM(cfg, None).init(
-        jax.random.key(0), jnp.zeros((args.batch, 8), jnp.int32)
+        jax.random.key(0), jnp.zeros((batch, 8), jnp.int32)
     )["params"]
     import flax.linen as nn
 
     params = nn.meta.unbox(params)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
-        rng.integers(0, args.vocab, (args.batch, args.prompt)), jnp.int32
+        rng.integers(0, args.vocab, (batch, args.prompt)), jnp.int32
     )
 
     n1, n2 = args.new, 2 * args.new
@@ -66,7 +66,7 @@ def _bench_one(args, kv_heads: int, window: int) -> dict:
 
     def timed(max_new: int) -> float:
         gen = make_lm_generator(
-            cfg, prompt_len=args.prompt, max_new=max_new, batch=args.batch,
+            cfg, prompt_len=args.prompt, max_new=max_new, batch=batch,
             max_len=capacity,  # equal allocations across the three runs
         )
         fence(gen(params, prompt))  # compile + warm
@@ -87,7 +87,7 @@ def _bench_one(args, kv_heads: int, window: int) -> dict:
 
     rolling = bool(window) and window < capacity
     alloc = jax.eval_shape(
-        lambda: init_kv_cache(cfg, args.batch, capacity, rolling=rolling)
+        lambda: init_kv_cache(cfg, batch, capacity, rolling=rolling)
     )[0][0].shape[1]
     span = min(window, capacity) if window else capacity
     return {
@@ -95,16 +95,16 @@ def _bench_one(args, kv_heads: int, window: int) -> dict:
         "window": window,
         "prompt": args.prompt,
         "max_len": capacity,
-        "batch": args.batch,
+        "batch": batch,
         "prefill_ms": round(t_pre * 1e3, 1),
         "decode_ms_per_tok": round(ms_per_tok, 3),
-        "decode_tok_per_sec": round(args.batch / (ms_per_tok / 1e3), 1),
+        "decode_tok_per_sec": round(batch / (ms_per_tok / 1e3), 1),
         # allocation vs what one decode step actually reads per layer
         "cache_bytes_per_layer": int(
-            2 * args.batch * alloc * kv * cfg.head_dim * elt
+            2 * batch * alloc * kv * cfg.head_dim * elt
         ),
         "read_bytes_per_step_layer": int(
-            2 * args.batch * span * kv * cfg.head_dim * elt
+            2 * batch * span * kv * cfg.head_dim * elt
         ),
     }
 
@@ -125,6 +125,11 @@ def main() -> None:
     ap.add_argument("--sweep", action="store_true",
                     help="run the PERF.md grid: MHA vs GQA (12q/4kv) x "
                     "full cache vs window 1024")
+    ap.add_argument("--batches", default=None,
+                    help="comma-separated batch sizes (e.g. 1,8,32), each "
+                    "crossed with the config grid — the serving question: "
+                    "how do weights/cache amortise across concurrent "
+                    "streams (overrides --batch)")
     args = ap.parse_args()
 
     from ddl_tpu.utils.compile_cache import enable_compile_cache
@@ -149,8 +154,14 @@ def main() -> None:
         grid = [(0, 0), (kv, 0), (0, 1024), (kv, 1024)]
     else:
         grid = [(args.kv_heads, args.attn_window)]
-    for kv, win in grid:
-        print(json.dumps(_bench_one(args, kv, win)))
+    batches = (
+        [int(x) for x in args.batches.split(",")]
+        if args.batches
+        else [args.batch]
+    )
+    for b in batches:
+        for kv, win in grid:
+            print(json.dumps(_bench_one(args, b, kv, win)))
 
 
 if __name__ == "__main__":

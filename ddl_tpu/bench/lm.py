@@ -51,6 +51,19 @@ def main() -> None:
                     "dense MLP); combine with --d-ff to match active "
                     "FLOPs, e.g. 8 experts top-2 at half d_ff")
     ap.add_argument("--expert-top-k", type=int, default=2)
+    ap.add_argument("--capacity-factor", type=float, default=1.5,
+                    help="per-expert token capacity = k*S*cf/E; the router "
+                    "drops overflow, so cf trades step time against "
+                    "moe_drop_frac (watch both in the output)")
+    ap.add_argument("--moe-dispatch", default="auto",
+                    choices=["auto", "sort", "einsum"],
+                    help="token routing path: one-hot einsum matmuls, "
+                    "argsort + permutation gathers, or auto (einsum for "
+                    "groups <= 2048 tokens)")
+    ap.add_argument("--moe-group", type=int, default=256,
+                    help="routing-group size in tokens (capacity is per "
+                    "group; smaller groups cut dispatch cost ~linearly, "
+                    "0 = whole sequence)")
     ap.add_argument("--d-ff", type=int, default=0,
                     help="MLP/expert hidden size (0 = 4*d_model)")
     ap.add_argument("--iters", type=int, default=10)
@@ -71,6 +84,9 @@ def main() -> None:
         d_ff=args.d_ff or 4 * args.d_model,
         num_experts=args.experts,
         expert_top_k=args.expert_top_k,
+        capacity_factor=args.capacity_factor,
+        moe_dispatch=args.moe_dispatch,
+        moe_group=args.moe_group,
         compute_dtype="bfloat16",
         flash={"on": True, "off": False, "auto": "auto"}[args.flash],
         remat=not args.no_remat,
@@ -113,6 +129,14 @@ def main() -> None:
     if args.experts:
         out["experts"] = f"{args.experts}top{args.expert_top_k}"
         out["d_ff"] = cfg.d_ff
+        out["capacity_factor"] = args.capacity_factor
+        # record what the model RESOLVED, not what the CLI requested —
+        # auto picks an impl and the group snaps to a divisor of S
+        from ddl_tpu.models.transformer import moe_routing_plan
+
+        out["moe_dispatch"], out["moe_group"] = moe_routing_plan(
+            cfg, args.seq_len
+        )
         for key in ("moe_drop_frac", "moe_load_max", "moe_load_min"):
             out[key] = round(float(m[key]), 4)
     from ddl_tpu.utils.memory import hbm_stats

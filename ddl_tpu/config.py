@@ -59,6 +59,11 @@ class ModelConfig:
     # jnp path (which XLA fuses into the stem conv). Off by default; useful
     # for A/B timing on real hardware.
     pallas_normalize: bool = False
+    # How dense blocks materialise their concatenative skips: "concat"
+    # (textbook jnp.concatenate per layer) or "buffer" (memory-efficient:
+    # one preallocated per-block feature buffer, layers write their
+    # growth-rate strip in place — models/densenet.py DenseBlock).
+    dense_block_impl: str = "concat"
     # Optional torchvision state_dict (.pth) to initialise from — the
     # ImageNet-pretrained start the reference uses (single.py:297); a
     # mismatched classifier head is skipped (the head swap, single.py:298-299).

@@ -60,11 +60,16 @@ def _bn(dtype, name: str):
 
 
 class DenseLayer(nn.Module):
-    """Bottleneck layer: BN-ReLU-Conv1x1(bn_size*k) -> BN-ReLU-Conv3x3(k)."""
+    """Bottleneck layer: BN-ReLU-Conv1x1(bn_size*k) -> BN-ReLU-Conv3x3(k).
+
+    ``concat_output=False`` returns only the new ``growth_rate`` feature
+    maps (the buffer-based block writes them into its preallocated
+    feature buffer); the parameter tree is identical either way."""
 
     growth_rate: int
     bn_size: int
     dtype: Any = jnp.float32
+    concat_output: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -91,22 +96,69 @@ class DenseLayer(nn.Module):
             kernel_init=_conv_init,
             name="conv2",
         )(h)
+        if not self.concat_output:
+            return h
         return jnp.concatenate([x, h], axis=-1)
 
 
 class DenseBlock(nn.Module):
+    """A run of dense layers.  ``impl`` picks how the concatenative skip
+    connections materialise (same math, same parameter tree, different
+    memory traffic — PERF.md 'DenseNet dense-block memory'):
+
+    * ``"concat"`` — the textbook form: every layer concatenates its 32
+      new channels onto the running features, copying all C prior
+      channels per layer (O(L^2) channel-writes per block).
+    * ``"buffer"`` — the memory-efficient-DenseNet form (Pleiss et al.
+      2017): the block's full (B, H, W, C_in + L*k) feature buffer is
+      allocated once; each layer reads the first-C slice and writes only
+      its own k-channel strip (``lax.dynamic_update_slice``).
+
+    Measured on one v5e chip (PERF.md): "buffer" is ~2x SLOWER than
+    "concat" for the full bs-30 train step — XLA's copy-insertion does
+    NOT keep the update in place while the prefix slice is still live in
+    the same program (plus its transpose in the backward), so every
+    layer copies the whole buffer where concat copies only the prefix.
+    The flag stays as the committed evidence for that result; "concat"
+    is the right default under XLA.
+    """
+
     num_layers: int
     growth_rate: int
     bn_size: int
     dtype: Any = jnp.float32
+    impl: str = "concat"
 
     @nn.compact
     def __call__(self, x, train: bool):
+        if self.impl == "concat":
+            for i in range(self.num_layers):
+                x = DenseLayer(
+                    self.growth_rate, self.bn_size, self.dtype,
+                    name=f"denselayer{i + 1}",
+                )(x, train)
+            return x
+        if self.impl != "buffer":
+            raise ValueError(
+                f"dense_block_impl must be 'concat' or 'buffer', got "
+                f"{self.impl!r}"
+            )
+        b, hgt, wid, c_in = x.shape
+        total = c_in + self.num_layers * self.growth_rate
+        buf = jnp.zeros((b, hgt, wid, total), x.dtype)
+        buf = jax.lax.dynamic_update_slice(buf, x, (0, 0, 0, 0))
+        c = c_in
         for i in range(self.num_layers):
-            x = DenseLayer(
-                self.growth_rate, self.bn_size, self.dtype, name=f"denselayer{i + 1}"
-            )(x, train)
-        return x
+            xi = jax.lax.slice_in_dim(buf, 0, c, axis=3)
+            h = DenseLayer(
+                self.growth_rate, self.bn_size, self.dtype,
+                concat_output=False, name=f"denselayer{i + 1}",
+            )(xi, train)
+            buf = jax.lax.dynamic_update_slice(
+                buf, h.astype(buf.dtype), (0, 0, 0, c)
+            )
+            c += self.growth_rate
+        return buf
 
 
 class Transition(nn.Module):
@@ -179,6 +231,7 @@ class DenseNetStage(nn.Module):
                 growth_rate=cfg.growth_rate,
                 bn_size=cfg.bn_size,
                 dtype=dtype,
+                impl=cfg.dense_block_impl,
                 name=f"denseblock{b + 1}",
             )(x, train)
             num_features += cfg.block_config[b] * cfg.growth_rate

@@ -41,6 +41,7 @@ __all__ = [
     "make_embed",
     "make_lm_head",
     "apply_final_norm_and_head",
+    "moe_routing_plan",
     "remat_block",
 ]
 
@@ -65,13 +66,27 @@ class LMConfig:
     num_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.5
-    # How tokens reach their experts.  'sort' (default) routes with an
-    # argsort/scatter/gather pipeline whose cost is O(B*S*(K+E)) index work
-    # plus the expert matmuls themselves; 'einsum' is the naive GShard
-    # form that materialises (B, S, E, C) one-hot dispatch/combine tensors
-    # and pays two extra (B,S,E,C,D) matmuls per layer (kept as the
-    # reference implementation for parity tests).
-    moe_dispatch: str = "sort"
+    # How tokens reach their experts.  'einsum' materialises (B, S, E, C)
+    # one-hot dispatch/combine tensors and moves data with matmuls; 'sort'
+    # routes with argsort index math + permutation gathers (custom-VJP:
+    # the backward is also gathers, never a TPU scatter-add).  Measured on
+    # one v5e chip at B=16 T=1024 E=8 top-2 (PERF.md MoE table): einsum
+    # 2.9 ms vs sort 4.9 ms per dispatch+combine pair — the MXU crunches
+    # one-hot matmuls faster than the gather unit moves rows, so einsum
+    # wins at training scale; but its one-hot tensors grow as
+    # O(B*S^2*k*cf), so at long sequence the memory (and matmul FLOPs)
+    # blow up while sort's index arrays stay O(B*S*k).  'auto' (default)
+    # picks einsum when the routing group is <= 2048 tokens and sort
+    # beyond.
+    moe_dispatch: str = "auto"
+    # Routing-group size in tokens (the GShard group): capacity is
+    # enforced per group, and the einsum dispatch/combine cost is
+    # O(group) per token — splitting a sequence into G groups divides the
+    # one-hot tensors AND their matmul FLOPs by G (measured 7x cheaper at
+    # 256 vs 1024, PERF.md MoE table).  Smaller groups drop more tokens
+    # at equal capacity_factor (fewer tokens to average over); 0 routes
+    # the whole sequence as one group.
+    moe_group: int = 256
     moe_aux_weight: float = 0.01
     rope_theta: float = 10000.0
     compute_dtype: str = "bfloat16"
@@ -435,6 +450,34 @@ def _top_k_dispatch(gates, k: int, capacity: int):
     return dispatch, combine
 
 
+def moe_routing_plan(cfg, seq_len: int) -> tuple[str, int]:
+    """The (dispatch_impl, group_size) a MoE layer actually uses at this
+    sequence length — shared by ``MoeMlp`` and the bench so reported
+    configs can't drift from executed ones.
+
+    The group is the largest divisor of ``seq_len`` at or under
+    ``cfg.moe_group``; when no usable divisor exists (e.g. prime or
+    near-prime lengths would collapse to 1-2 token groups, destroying
+    routing/load-balance quality), the whole sequence routes as one group
+    instead.  ``moe_dispatch="auto"`` resolves by the measured crossover
+    (PERF.md MoE table): one-hot einsum matmuls up to 2048-token groups,
+    argsort + permutation gathers beyond."""
+    g = min(cfg.moe_group, seq_len) if cfg.moe_group else seq_len
+    while seq_len % g:
+        g -= 1
+    if cfg.moe_group and g < min(cfg.moe_group, seq_len) / 2:
+        g = seq_len
+    impl = cfg.moe_dispatch
+    if impl == "auto":
+        impl = "einsum" if g <= 2048 else "sort"
+    if impl not in ("sort", "einsum"):
+        raise ValueError(
+            f"moe_dispatch must be 'auto', 'sort' or 'einsum', got "
+            f"{cfg.moe_dispatch!r}"
+        )
+    return impl, g
+
+
 def _sort_dispatch(gates, k: int, capacity: int):
     """Sort-based top-k routing — same slot assignment as
     ``_top_k_dispatch`` without the (B, S, E, C) one-hot tensors.
@@ -447,8 +490,11 @@ def _sort_dispatch(gates, k: int, capacity: int):
 
     - ``slot_token`` (B, E*C) int32: source token for each expert slot
     - ``slot_valid`` (B, E*C): 1.0 where the slot is filled
+    - ``slot_choice`` (B, E*C) int32: flat (k-major) choice index that
+      fills each slot (the combine gather's inverse, used by its VJP)
     - ``choice_slot`` (B, K, S) int32: destination slot per token-choice
       (clamped; dropped choices carry weight 0)
+    - ``choice_keep`` (B, K, S) bool: which choices found a slot
     - ``choice_weight`` (B, K, S): renormalised gate weight, 0 if dropped
     - ``frac`` (E,): kept token-choices per token, per expert (the einsum
       path's ``dispatch.sum(-1).mean((0, 1))``)
@@ -480,6 +526,9 @@ def _sort_dispatch(gates, k: int, capacity: int):
     slot_valid = jnp.zeros((b, e * capacity), gates.dtype).at[
         batch_ix, slot_sorted
     ].set(1.0, mode="drop")
+    slot_choice = jnp.zeros((b, e * capacity), jnp.int32).at[
+        batch_ix, slot_sorted
+    ].set(sort_ord.astype(jnp.int32), mode="drop")
     # back to original choice order for the combine side
     inv = jnp.argsort(sort_ord, axis=-1)  # inverse permutation
     choice_slot = jnp.take_along_axis(slot_sorted, inv, axis=-1)
@@ -494,7 +543,66 @@ def _sort_dispatch(gates, k: int, capacity: int):
     ).sum((0, 1)).astype(gates.dtype) / (b * s)
     kept = choice_keep.mean(dtype=gates.dtype)
     choice_slot = jnp.minimum(choice_slot, e * capacity - 1).reshape(b, k, s)
-    return slot_token, slot_valid, choice_slot, choice_weight, frac, kept
+    return (slot_token, slot_valid, slot_choice, choice_slot,
+            choice_keep.reshape(b, k, s), choice_weight, frac, kept)
+
+
+@jax.custom_vjp
+def _dispatch_gather(x, slot_token, slot_valid, choice_slot, choice_keep):
+    """xe[b, slot] = x[b, slot_token[b, slot]] * valid — the dispatch data
+    movement as a permutation gather.  The VJP is ALSO a gather: token t's
+    gradient is the (masked) sum over its k choice slots, read back
+    through ``choice_slot`` — a TPU scatter-add never appears in either
+    direction (the naive ``take_along_axis`` backward is a scatter-add,
+    measured ~2x the whole einsum path's cost on v5e; PERF.md MoE table)."""
+    xe = jnp.take_along_axis(x, slot_token[..., None], axis=1)
+    return xe * slot_valid[..., None].astype(x.dtype)
+
+
+def _dispatch_gather_fwd(x, st, sv, cs, ck):
+    return _dispatch_gather(x, st, sv, cs, ck), (sv, cs, ck)
+
+
+def _dispatch_gather_bwd(res, g):
+    sv, cs, ck = res
+    b, k, s = cs.shape
+    g = g * sv[..., None].astype(g.dtype)
+    contrib = jnp.take_along_axis(
+        g, cs.reshape(b, k * s)[..., None], axis=1
+    ).reshape(b, k, s, g.shape[-1])
+    dx = (contrib * ck[..., None].astype(g.dtype)).sum(axis=1)
+    return dx, None, None, None, None
+
+
+_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather(ye, choice_slot, slot_choice, slot_valid):
+    """yc[b, choice] = ye[b, choice_slot[b, choice]] — each token-choice
+    reads its expert-slot output.  Slot↔kept-choice is a bijection, so
+    the VJP gathers through the inverse map ``slot_choice`` (masked by
+    slot validity) instead of scatter-adding."""
+    b, k, s = choice_slot.shape
+    yc = jnp.take_along_axis(
+        ye, choice_slot.reshape(b, k * s)[..., None], axis=1
+    )
+    return yc.reshape(b, k, s, ye.shape[-1])
+
+
+def _combine_gather_fwd(ye, cs, sc, sv):
+    return _combine_gather(ye, cs, sc, sv), (sc, sv)
+
+
+def _combine_gather_bwd(res, g):
+    sc, sv = res
+    b = g.shape[0]
+    gf = g.reshape(b, -1, g.shape[-1])
+    d_ye = jnp.take_along_axis(gf, sc[..., None], axis=1)
+    return d_ye * sv[..., None].astype(g.dtype), None, None, None
+
+
+_combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
 
 
 class MoeMlp(nn.Module):
@@ -512,7 +620,16 @@ class MoeMlp(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        b, s, d = x.shape
+        b0, s0, d = x.shape
+        # split the sequence into routing groups (moe_routing_plan):
+        # capacity is per group and dispatch cost is O(group) per token,
+        # so groups make the einsum path cheap; the group dim folds into
+        # batch, which keeps data sharding intact
+        dispatch_impl, g = moe_routing_plan(cfg, s0)
+        n_groups = s0 // g
+        if n_groups > 1:
+            x = x.reshape(b0 * n_groups, g, d)
+        b, s = x.shape[:2]
         e = cfg.num_experts
         capacity = max(
             1, int(cfg.expert_top_k * s * cfg.capacity_factor / e)
@@ -529,20 +646,17 @@ class MoeMlp(nn.Module):
             name="router",
         )(x.astype(jnp.float32))
         gates = jax.nn.softmax(router_logits, axis=-1)  # (B, S, E)
-        if cfg.moe_dispatch == "sort":
-            (slot_token, slot_valid, choice_slot, choice_weight,
-             frac, kept) = _sort_dispatch(gates, cfg.expert_top_k, capacity)
-        elif cfg.moe_dispatch == "einsum":
+        if dispatch_impl == "sort":
+            (slot_token, slot_valid, slot_choice, choice_slot, choice_keep,
+             choice_weight, frac, kept) = _sort_dispatch(
+                gates, cfg.expert_top_k, capacity
+            )
+        else:
             dispatch, combine = _top_k_dispatch(
                 gates, cfg.expert_top_k, capacity
             )
             frac = dispatch.sum(-1).mean(axis=(0, 1))  # (E,) kept fraction
             kept = dispatch.sum() / (b * s * cfg.expert_top_k)
-        else:
-            raise ValueError(
-                f"moe_dispatch must be 'sort' or 'einsum', got "
-                f"{cfg.moe_dispatch!r}"
-            )
 
         # Switch-transformer load-balance loss: E * sum_e f_e * p_e where
         # f_e = fraction of tokens whose slot-0 choice is e, p_e = mean gate.
@@ -577,14 +691,16 @@ class MoeMlp(nn.Module):
             jnp.float32,
         )
         dt = cfg.dtype
-        if cfg.moe_dispatch == "sort":
-            # dispatch = batch-local gather of each slot's source token
-            # (index work, not matmuls), then the same expert-sharded
-            # layout as the einsum path so the act_expert constraint
-            # induces the identical all-to-all under EP
-            xe = jnp.take_along_axis(
-                x.astype(dt), slot_token[..., None], axis=1
-            ) * slot_valid[..., None].astype(dt)  # (B, E*C, D)
+        if dispatch_impl == "sort":
+            # dispatch = batch-local permutation gather of each slot's
+            # source token (custom-VJP: backward is gathers too), then the
+            # same expert-sharded layout as the einsum path so the
+            # act_expert constraint induces the identical all-to-all
+            # under EP
+            xe = _dispatch_gather(
+                x.astype(dt), slot_token, slot_valid, choice_slot,
+                choice_keep,
+            )  # (B, E*C, D)
             xe = xe.reshape(b, e, capacity, d).transpose(1, 0, 2, 3)
         else:
             xe = jnp.einsum(
@@ -599,17 +715,17 @@ class MoeMlp(nn.Module):
         ye = nn.with_logical_constraint(
             ye, ("act_expert", "batch", None, "act_embed")
         )
-        if cfg.moe_dispatch == "sort":
+        if dispatch_impl == "sort":
             # combine = gather each token-choice's slot output, weight by
             # the renormalised gate, sum over the K choices
             ye_flat = ye.transpose(1, 0, 2, 3).reshape(b, e * capacity, d)
-            k = cfg.expert_top_k
-            yc = jnp.take_along_axis(
-                ye_flat, choice_slot.reshape(b, k * s)[..., None], axis=1
-            ).reshape(b, k, s, d)
+            yc = _combine_gather(ye_flat, choice_slot, slot_choice,
+                                 slot_valid)
             y = (yc * choice_weight[..., None].astype(dt)).sum(axis=1)
         else:
             y = jnp.einsum("bsec,ebcd->bsd", combine.astype(dt), ye)
+        if n_groups > 1:
+            y = y.reshape(b0, s0, d)
         y = nn.with_logical_constraint(y, ("batch", "act_seq", "act_embed"))
         return y, aux_loss
 

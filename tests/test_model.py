@@ -97,3 +97,34 @@ def test_bad_split_rejected(tiny_model_cfg):
     cfg = dataclasses.replace(tiny_model_cfg, split_blocks=(0,))
     with pytest.raises(ValueError):
         build_stages(cfg)
+
+
+def test_buffer_block_matches_concat(tiny_model_cfg):
+    """dense_block_impl='buffer' (preallocated feature buffer, in-place
+    strips) is the same math as the textbook concat form: identical
+    params, forward, train-mode batch stats, and gradients."""
+    import dataclasses
+
+    x = jax.random.normal(jax.random.key(2), (2, 16, 16, 3))
+    outs = {}
+    for impl in ("concat", "buffer"):
+        cfg = dataclasses.replace(tiny_model_cfg, dense_block_impl=impl)
+        stages = build_stages(cfg, num_stages=1)
+        params, bstats = init_stages(stages, jax.random.key(0), image_size=16)
+
+        def loss(params, bstats, x):
+            logits, ns = forward_stages(stages, params, bstats, x, train=True)
+            return (logits ** 2).sum(), ns
+
+        (val, ns), grads = jax.value_and_grad(loss, has_aux=True)(
+            params, bstats, x
+        )
+        outs[impl] = (val, ns, grads, params)
+    # same init (param tree is impl-independent)
+    for a, b in zip(jax.tree.leaves(outs["concat"][3]), jax.tree.leaves(outs["buffer"][3])):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(outs["concat"][0], outs["buffer"][0], rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(outs["concat"][1]), jax.tree.leaves(outs["buffer"][1])):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(outs["concat"][2]), jax.tree.leaves(outs["buffer"][2])):
+        np.testing.assert_allclose(a, b, atol=1e-5)

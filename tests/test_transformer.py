@@ -227,7 +227,8 @@ class TestRouting:
 
         for cf in (1.5, 0.5):  # ample and starved capacity
             cfg_s = tiny_cfg(
-                num_experts=4, expert_top_k=2, capacity_factor=cf
+                num_experts=4, expert_top_k=2, capacity_factor=cf,
+                moe_dispatch="sort",
             )
             cfg_e = dataclasses.replace(cfg_s, moe_dispatch="einsum")
             x = jax.random.normal(jax.random.key(2), (2, 16, 32))
@@ -248,10 +249,67 @@ class TestRouting:
             np.testing.assert_allclose(
                 i_s["moe_expert_load"], i_e["moe_expert_load"], atol=1e-6
             )
+            # the permutation gathers use hand-written VJPs (backward is
+            # gathers, not scatter-adds); they must match the einsum
+            # path's autodiff gradients, not just its forward
+            def loss(params, x, cfg=None):
+                (y, aux), _ = MoeMlp(cfg).apply(
+                    params, x, mutable=["intermediates"]
+                )
+                return (y ** 2).sum() + aux
+
+            g_s = jax.grad(loss, argnums=(0, 1))(params, x, cfg=cfg_s)
+            g_e = jax.grad(loss, argnums=(0, 1))(params, x, cfg=cfg_e)
+            for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g_e)):
+                np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_routing_plan_rejects_degenerate_groups(self):
+        """Prime/near-prime sequence lengths must not collapse to 1-2
+        token routing groups — the plan falls back to whole-sequence."""
+        import dataclasses
+
+        from ddl_tpu.models.transformer import moe_routing_plan
+
+        cfg = tiny_cfg(num_experts=4, moe_group=256)
+        assert moe_routing_plan(cfg, 1024) == ("einsum", 256)
+        assert moe_routing_plan(cfg, 514) == ("einsum", 514)  # 2*257
+        assert moe_routing_plan(cfg, 509) == ("einsum", 509)  # prime
+        assert moe_routing_plan(cfg, 192) == ("einsum", 192)
+        big = dataclasses.replace(cfg, moe_group=0)
+        assert moe_routing_plan(big, 4096) == ("sort", 4096)
+        assert moe_routing_plan(
+            dataclasses.replace(cfg, moe_dispatch="sort"), 1024
+        ) == ("sort", 256)
+
+    def test_routing_groups_match_whole_sequence_when_capacity_ample(self):
+        """Splitting the sequence into routing groups only changes WHICH
+        tokens drop under pressure; with ample capacity nothing drops in
+        either layout, so grouped == ungrouped exactly."""
+        import dataclasses
+
+        from ddl_tpu.models.transformer import MoeMlp
+
+        cfg_g = tiny_cfg(
+            num_experts=4, expert_top_k=2, capacity_factor=8.0, moe_group=4
+        )
+        cfg_w = dataclasses.replace(cfg_g, moe_group=0)
+        x = jax.random.normal(jax.random.key(3), (2, 16, 32))
+        params = MoeMlp(cfg_g).init(jax.random.key(0), x)
+        outs = {}
+        for name, cfg in (("grouped", cfg_g), ("whole", cfg_w)):
+            (y, aux), inter = MoeMlp(cfg).apply(
+                params, x, mutable=["intermediates"]
+            )
+            outs[name] = (y, inter["intermediates"]["moe_drop_frac"])
+        assert float(outs["grouped"][1][0]) == 0.0  # genuinely drop-free
+        np.testing.assert_allclose(
+            outs["grouped"][0], outs["whole"][0], atol=1e-6
+        )
 
     def test_sort_dispatch_ep_matches_single(self):
         """Sort dispatch under real expert parallelism == single device."""
-        cfg = tiny_cfg(num_experts=4, expert_top_k=2, capacity_factor=0.75)
+        cfg = tiny_cfg(num_experts=4, expert_top_k=2, capacity_factor=0.75,
+                       moe_dispatch="sort")
         ref, ref_losses = run_steps(cfg, LMMeshSpec())
         par, par_losses = run_steps(cfg, LMMeshSpec(data=2, model=2, expert=2))
         np.testing.assert_allclose(ref_losses, par_losses, atol=1e-4)

@@ -99,15 +99,18 @@ def test_bad_split_rejected(tiny_model_cfg):
         build_stages(cfg)
 
 
-def test_buffer_block_matches_concat(tiny_model_cfg):
+@pytest.mark.parametrize("alt_impl", ["buffer", "packed"])
+def test_alt_block_impl_matches_concat(tiny_model_cfg, alt_impl):
     """dense_block_impl='buffer' (preallocated feature buffer, in-place
-    strips) is the same math as the textbook concat form: identical
-    params, forward, train-mode batch stats, and gradients."""
+    strips) and 'packed' (lane-aligned packs, implicit concat via
+    per-pack 1x1 contraction, stats-once) are the same math as the
+    textbook concat form: identical params, forward, train-mode batch
+    stats, and gradients."""
     import dataclasses
 
     x = jax.random.normal(jax.random.key(2), (2, 16, 16, 3))
     outs = {}
-    for impl in ("concat", "buffer"):
+    for impl in ("concat", alt_impl):
         cfg = dataclasses.replace(tiny_model_cfg, dense_block_impl=impl)
         stages = build_stages(cfg, num_stages=1)
         params, bstats = init_stages(stages, jax.random.key(0), image_size=16)
@@ -121,10 +124,68 @@ def test_buffer_block_matches_concat(tiny_model_cfg):
         )
         outs[impl] = (val, ns, grads, params)
     # same init (param tree is impl-independent)
-    for a, b in zip(jax.tree.leaves(outs["concat"][3]), jax.tree.leaves(outs["buffer"][3])):
+    ca, cb = jax.tree.structure(outs["concat"][3]), jax.tree.structure(outs[alt_impl][3])
+    assert ca == cb
+    for a, b in zip(jax.tree.leaves(outs["concat"][3]), jax.tree.leaves(outs[alt_impl][3])):
         np.testing.assert_array_equal(a, b)
-    np.testing.assert_allclose(outs["concat"][0], outs["buffer"][0], rtol=1e-6)
-    for a, b in zip(jax.tree.leaves(outs["concat"][1]), jax.tree.leaves(outs["buffer"][1])):
-        np.testing.assert_allclose(a, b, atol=1e-6)
-    for a, b in zip(jax.tree.leaves(outs["concat"][2]), jax.tree.leaves(outs["buffer"][2])):
+    np.testing.assert_allclose(outs["concat"][0], outs[alt_impl][0], rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(outs["concat"][1]), jax.tree.leaves(outs[alt_impl][1])):
         np.testing.assert_allclose(a, b, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(outs["concat"][2]), jax.tree.leaves(outs[alt_impl][2])):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_packed_multi_pack_and_eval(tiny_model_cfg, monkeypatch):
+    """The packed impl with features spanning MULTIPLE lane packs (pack
+    width patched to 8 so the tiny config splits/merges/slices across
+    packs), in both train and eval mode (eval reads each consumer's own
+    running stats, sliced per pack)."""
+    import dataclasses
+
+    from ddl_tpu.models import densenet
+
+    monkeypatch.setattr(densenet, "_PACK", 8)
+    x = jax.random.normal(jax.random.key(3), (2, 16, 16, 3))
+    outs = {}
+    for impl in ("concat", "packed"):
+        cfg = dataclasses.replace(tiny_model_cfg, dense_block_impl=impl)
+        stages = build_stages(cfg, num_stages=1)
+        params, bstats = init_stages(stages, jax.random.key(0), image_size=16)
+        # one train step to make running stats non-trivial before eval
+        logits_tr, ns = forward_stages(stages, params, bstats, x, train=True)
+        logits_ev, _ = forward_stages(stages, params, ns, x, train=False)
+        outs[impl] = (logits_tr, ns, logits_ev)
+    np.testing.assert_allclose(
+        np.asarray(outs["concat"][0]), np.asarray(outs["packed"][0]),
+        atol=1e-5,
+    )
+    for a, b in zip(
+        jax.tree.leaves(outs["concat"][1]), jax.tree.leaves(outs["packed"][1])
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(outs["concat"][2]), np.asarray(outs["packed"][2]),
+        atol=1e-5,
+    )
+
+
+def test_packed_staged_matches_single(tiny_model_cfg):
+    """The packed impl through the PIPELINE staging path (stage boundary
+    falls between blocks, where the packed transition hands a dense
+    tensor across) equals its single-stage forward."""
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_model_cfg, dense_block_impl="packed")
+    stages2 = build_stages(cfg)
+    stages1 = build_stages(cfg, num_stages=1)
+    p2, s2 = init_stages(stages2, jax.random.key(0), image_size=16)
+    x = jax.random.normal(jax.random.key(1), (3, 16, 16, 3))
+    merged_params = {**p2[0], **p2[1]}
+    merged_stats = {**s2[0], **s2[1]}
+    out2, _ = forward_stages(stages2, p2, s2, x, train=True)
+    out1, _ = forward_stages(
+        stages1, (merged_params,), (merged_stats,), x, train=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out1), np.asarray(out2), rtol=1e-6, atol=1e-6
+    )

@@ -24,7 +24,8 @@ separate inference model:
 
 from __future__ import annotations
 
-from typing import Optional
+from functools import partial
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -39,6 +40,7 @@ from ddl_tpu.models.transformer import (
     make_embed,
 )
 from ddl_tpu.parallel.sharding import (
+    FLASH_AUTO_MIN_T,
     LMMeshSpec,
     build_lm_mesh,
     lm_logical_rules,
@@ -60,6 +62,10 @@ class LMDecode(nn.Module):
 
     cfg: LMConfig
     rolling: bool = False  # ring cache of capacity attn_window
+    # attention core for the PREFILL pass only (e.g. the flash kernel —
+    # prefill is a training-style causal forward over the prompt); decode
+    # steps (T=1) always use cached dense attention.
+    attn_core: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, tokens, caches, offset, last_only: bool = False):
@@ -68,7 +74,7 @@ class LMDecode(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         new_caches = []
         for i in range(cfg.n_layers):
-            x, _aux, c = Block(cfg, None, name=f"block{i}")(
+            x, _aux, c = Block(cfg, self.attn_core, name=f"block{i}")(
                 x, caches[i], offset, rolling=self.rolling
             )
             new_caches.append(c)
@@ -171,7 +177,21 @@ def make_lm_generator(
     if mesh is None:
         mesh = build_lm_mesh(spec or LMMeshSpec(), devices)
     rules = lm_logical_rules(cfg.fsdp)
-    model = LMDecode(cfg, rolling=rolling)
+    # Prefill is a training-style causal forward over the prompt, so it
+    # can ride the flash kernel where training would (single-device mesh:
+    # GSPMD cannot partition a Pallas custom call, and multi-device decode
+    # keeps the dense prefill core inside its sharded program).
+    attn_core = None
+    if mesh.size == 1 and cfg.causal and (
+        cfg.flash is True
+        or (cfg.flash == "auto" and prompt_len >= FLASH_AUTO_MIN_T)
+    ):
+        from ddl_tpu.ops.flash_attention import flash_attention
+
+        attn_core = partial(
+            flash_attention, causal=True, window=cfg.attn_window
+        )
+    model = LMDecode(cfg, rolling=rolling, attn_core=attn_core)
 
     def generate(params, prompt, rng):
         caches = init_kv_cache(cfg, batch, max_len, rolling=rolling)

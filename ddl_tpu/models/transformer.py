@@ -313,9 +313,10 @@ class Attention(nn.Module):
                 # prefill: the ring holds nothing older than these tokens,
                 # so attend the fresh K/V directly (banded causal) and
                 # persist only the last min(cap, t) of them
-                o = dense_attention(
-                    q, k, v, causal=True, window=cfg.attn_window
+                core = self.attn_core or partial(
+                    dense_attention, causal=True, window=cfg.attn_window
                 )
+                o = core(q, k, v)
                 keep = min(cap, t)
                 slots = (offset + t - keep + jnp.arange(keep)) % cap
                 ck = ck.at[:, slots].set(k[:, -keep:].astype(ck.dtype))
@@ -340,6 +341,24 @@ class Attention(nn.Module):
             ck = nn.with_logical_constraint(ck, spec)
             cv = nn.with_logical_constraint(cv, spec)
             o = nn.with_logical_constraint(o, spec)
+            new_cache = (ck, cv)
+        elif t > 1 and isinstance(offset, int) and offset == 0:
+            # prefill: the cache holds nothing older than these tokens, so
+            # attend the fresh K/V directly — causal (+window) over the
+            # prompt, optionally through the flash kernel — instead of
+            # masked-attending the whole allocated buffer.  Scores are
+            # O(T^2) (O(T*W) windowed / O(T*block) flash) rather than
+            # O(T*capacity): a B=8, T=4096 prefill against an 8K cache
+            # would otherwise materialise a 13 GB score tensor and OOM.
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+            ck = nn.with_logical_constraint(ck, spec)
+            cv = nn.with_logical_constraint(cv, spec)
+            core = self.attn_core or partial(
+                dense_attention, causal=True, window=cfg.attn_window
+            )
+            o = nn.with_logical_constraint(core(q, k, v), spec)
             new_cache = (ck, cv)
         else:
             ck, cv = kv_cache

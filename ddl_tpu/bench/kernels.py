@@ -33,10 +33,18 @@ from ddl_tpu.utils.timing import fence
 __all__ = ["time_device_slope", "attention_sweep", "block_sweep"]
 
 
-def time_device_slope(fn, x0, n1: int = 10, n2: int = 50, reps: int = 4) -> float:
+def time_device_slope(
+    fn, x0, n1: int = 10, n2: int = 50, reps: int = 4,
+    target_s: float | None = None,
+) -> float:
     """Pure device ms/call: slope between n1- and n2-iteration on-device
     chains (``y = fn(y)`` under ``lax.fori_loop``), best-of-``reps`` walls
-    so tunnel-RPC variance drops out."""
+    so tunnel-RPC variance drops out.
+
+    ``target_s`` auto-scales the chain so the long wall is ~that many
+    seconds: sub-0.1 ms kernels under a 50-iteration chain (5 ms wall)
+    are invisible inside the tunnel's multi-ms jitter — round 3's small-T
+    kernel rows carried exactly that bias (see PERF.md round 4)."""
 
     def wall(n: int) -> float:
         j = jax.jit(
@@ -52,6 +60,14 @@ def time_device_slope(fn, x0, n1: int = 10, n2: int = 50, reps: int = 4) -> floa
             best = min(best, time.perf_counter() - t0)
         return best
 
+    if target_s is not None:
+        # calibrate per-call time from a short SLOPE (a single wall is
+        # dominated by the fixed ~0.15 s tunnel round-trip for fast fns)
+        per_call_s = max(
+            (wall(4 * n1) - wall(n1)) / (3 * n1), 1e-7
+        )
+        n2 = max(int(target_s / per_call_s), n1 * 4)
+        n2 = min(n2, 20000)
     return (wall(n2) - wall(n1)) / (n2 - n1) * 1e3
 
 
@@ -75,7 +91,9 @@ def attention_sweep(seq_lens=(1024, 2048, 4096, 8192), b=2, h=8, d=64):
         }
         row = {"T": t}
         for name, fn in fns.items():
-            row[name + "_ms"] = round(time_device_slope(fn, q0), 3)
+            row[name + "_ms"] = round(
+                time_device_slope(fn, q0, n1=20, target_s=0.8), 4
+            )
         rows.append(row)
         print(row, flush=True)
     return rows
@@ -101,7 +119,7 @@ def block_sweep(t=8192, b=2, h=8, d=64):
                     ).astype(jnp.float32).sum()
                 )
             )
-            ms = round(time_device_slope(fn, q0, n1=5, n2=25), 3)
+            ms = round(time_device_slope(fn, q0, n1=5, target_s=0.8), 3)
             rows.append(
                 {"block_q": bq, "block_k": bk, "dir": direction, "ms": ms}
             )
@@ -112,8 +130,10 @@ def block_sweep(t=8192, b=2, h=8, d=64):
 if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--blocks", action="store_true", help="block-size sweep")
+    ap.add_argument("--t", type=int, default=8192,
+                    help="sequence length for --blocks")
     args = ap.parse_args()
     if args.blocks:
-        block_sweep()
+        block_sweep(t=args.t)
     else:
         attention_sweep()

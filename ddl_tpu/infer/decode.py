@@ -131,10 +131,15 @@ def make_lm_generator(
 
     ``spec``/``devices`` (or an explicit ``mesh``) place the computation:
     batch over ``data``, attention heads over ``model`` (tensor-parallel
-    decode).  ``cfg.attn_impl`` is ignored here — incremental decode is
-    always cached dense attention; ring/Ulysses are training-time
-    strategies for long-context *processing*, and the prompt fits the
-    cache by construction.
+    decode), and the KV cache's sequence dimension over ``seq`` —
+    context-parallel serving for prompts/caches one device cannot hold;
+    the same logical-axis rules as training shard the cache, and GSPMD
+    inserts the gather/reduce for the softmax over the sharded sequence
+    (token-exact vs single device,
+    ``tests/test_decode.py::test_seq_sharded_decode_matches_single_device``).
+    ``cfg.attn_impl`` is ignored here — incremental decode is always
+    cached dense attention; ring/Ulysses are training-time strategies
+    for long-context *processing*.
 
     ``max_len`` overrides the KV-cache capacity (default
     ``prompt_len + max_new``).  Without a window every decode step reads

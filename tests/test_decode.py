@@ -128,6 +128,32 @@ def test_tp_decode_matches_single_device():
     )
 
 
+def test_seq_sharded_decode_matches_single_device():
+    """Context-parallel decode: the KV cache shards over the ``seq`` mesh
+    axis (the same logical-axis rules as training), and GSPMD inserts the
+    gather/reduce for the softmax over the sharded cache — long-prompt
+    serving where one device cannot hold the cache.  Token-exact vs one
+    device, composed with data and model parallelism."""
+    cfg = _cfg(n_heads=4)
+    b, p, n = 2, 16, 6
+    params = _params(cfg, b, p)
+    prompt = jnp.asarray(np.random.default_rng(5).integers(0, 32, (b, p)))
+
+    single = make_lm_generator(
+        cfg, prompt_len=p, max_new=n, batch=b, devices=jax.devices()[:1]
+    )
+    sp = make_lm_generator(
+        cfg,
+        LMMeshSpec(data=2, seq=2, model=2),
+        prompt_len=p,
+        max_new=n,
+        batch=b,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single(params, prompt)), np.asarray(sp(params, prompt))
+    )
+
+
 def test_sampled_generation_and_moe():
     """Temperature sampling is deterministic under a fixed key; MoE decode
     runs end-to-end (capacity-based routing makes incremental MoE logits

@@ -1,12 +1,12 @@
 """Per-op device-time breakdown of the headline DenseNet121 train step.
 
 Captures a ``jax.profiler`` trace of the bs-30 train step (the
-``bench.py`` headline workload) and aggregates XLA-op device time from
-the trace's device plane (``jax.profiler.ProfileData`` — no TensorBoard
-round-trip), attributing each fused op to a category (conv / batch-norm
-reduction / elementwise / copy-concat / optimizer / other).  This is the
-evidence channel for PERF.md's "where do 16 ms actually go" analysis
-(VERDICT r3 task 1: profile the headline instead of defending it).
+``bench.py`` headline workload) and aggregates XLA-op device time via
+the shared ``bench/xprof`` analysis.  This is the evidence channel for
+PERF.md's "where do the headline milliseconds go" analysis (VERDICT r3
+task 1: profile the headline instead of defending it).  The default
+measures the packed impl (the config default since round 4); pass
+``--impl concat`` to reproduce the textbook-form table in PERF.md.
 
 Usage::
 
@@ -19,15 +19,10 @@ names, and one JSON line with the category split.
 from __future__ import annotations
 
 import argparse
-import collections
-import glob
-import json
-import os
-import re
 import tempfile
 
 
-def capture(batch: int, steps: int, trace_dir: str, impl: str = "concat"):
+def capture(batch: int, steps: int, trace_dir: str, impl: str = "packed"):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -63,82 +58,12 @@ def capture(batch: int, steps: int, trace_dir: str, impl: str = "concat"):
     jax.profiler.stop_trace()
 
 
-# HLO text looks like "%fusion.123 = bf16[...] fusion(...), kind=kLoop ..."
-_OPCODE_RX = re.compile(r"=\s*(?:\([^)]*\)|[^ ]+)\s+([a-z][a-z0-9-]*)\(")
-
-
-def opcode_of(name: str) -> str:
-    """Pull the HLO opcode out of a profiler op-event name."""
-    m = _OPCODE_RX.search(name)
-    if m:
-        op = m.group(1)
-    else:
-        # bare names like "fusion.123" / "copy-start.4"
-        op = name.split(" ")[0].lstrip("%").split(".")[0]
-    if "fusion" in name and (kind := re.search(r"kind=k(\w+)", name)):
-        return f"fusion:{kind.group(1)}"
-    return op
-
-
-_CATEGORY = {
-    "convolution": "conv",
-    "fusion:Output": "conv-fusion (conv+fused elementwise)",
-    "fusion:Convolution": "conv-fusion (conv+fused elementwise)",
-    "copy": "copy (layout/concat materialise)",
-    "copy-start": "async copy (overlapped)",
-    "copy-done": "copy-done (DMA wait)",
-    "slice-start": "async slice (overlapped)",
-    "slice-done": "slice-done (DMA wait)",
-    "dynamic-update-slice": "copy (layout/concat materialise)",
-    "concatenate": "copy (layout/concat materialise)",
-    "fusion:Loop": "fusion (elementwise loops)",
-    "fusion:Input": "fusion (reduce/BN stats)",
-    "reduce": "fusion (reduce/BN stats)",
-    "reduce-window": "fusion (reduce/BN stats)",
-}
-
-
-def analyze(trace_dir: str):
-    from jax.profiler import ProfileData
-
-    paths = glob.glob(
-        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
-    )
-    if not paths:
-        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
-    data = ProfileData.from_file(max(paths, key=os.path.getmtime))
-
-    per_op: dict[str, float] = collections.defaultdict(float)
-    per_op_count: dict[str, int] = collections.defaultdict(int)
-    async_ms = 0.0
-    module_ms = 0.0
-    for plane in data.planes:
-        if not plane.name.startswith("/device:"):
-            continue
-        for line in plane.lines:
-            if line.name == "XLA Modules":
-                module_ms += sum(
-                    (e.end_ns - e.start_ns) / 1e6 for e in line.events
-                )
-            if line.name == "Async XLA Ops":
-                async_ms += sum(
-                    (e.end_ns - e.start_ns) / 1e6 for e in line.events
-                )
-            if line.name != "XLA Ops":
-                continue  # Steps/Modules duplicate; Async overlaps compute
-            for ev in line.events:
-                dur = (ev.end_ns - ev.start_ns) / 1e6  # ms
-                per_op[ev.name] += dur
-                per_op_count[ev.name] += 1
-    return per_op, per_op_count, async_ms, module_ms
-
-
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--batch", type=int, default=30)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--top", type=int, default=25)
-    ap.add_argument("--impl", default="concat",
+    ap.add_argument("--impl", default="packed",
                     choices=("concat", "buffer", "packed"))
     ap.add_argument("--trace-dir", default=None,
                     help="reuse an existing trace instead of capturing")
@@ -148,34 +73,13 @@ def main() -> None:
     if not args.trace_dir:
         capture(args.batch, args.steps, trace_dir, args.impl)
 
-    per_op, per_op_count, async_ms, module_ms = analyze(trace_dir)
-    total = sum(per_op.values())
-    cats: dict[str, float] = collections.defaultdict(float)
-    for name, ms in per_op.items():
-        op = opcode_of(name)
-        cats[_CATEGORY.get(op, f"other ({op})")] += ms
+    from ddl_tpu.bench.xprof import print_report
 
-    print(f"# trace: {trace_dir}  ({args.steps} steps, batch {args.batch})")
-    print(f"# XLA module time: {module_ms / args.steps:.2f} ms/step; "
-          f"sync-op exclusive total: {total / args.steps:.2f} ms/step; "
-          f"async-DMA busy (overlapped): {async_ms / args.steps:.2f} ms/step")
-    print("\n== by category (ms/step, % of sync op time) ==")
-    for cat, ms in sorted(cats.items(), key=lambda kv: -kv[1]):
-        print(f"  {cat:40s} {ms / args.steps:8.3f}  "
-              f"({100 * ms / total:5.1f}%)")
-    print(f"\n== top {args.top} ops (ms/step, count/step) ==")
-    rows = sorted(per_op.items(), key=lambda kv: -kv[1])[: args.top]
-    for name, ms in rows:
-        n = per_op_count[name] // args.steps
-        print(f"  {ms / args.steps:8.3f}  x{n:<4d} {name[:140]}")
-    print(json.dumps({
-        "module_ms_per_step": round(module_ms / args.steps, 3),
-        "sync_op_ms_per_step": round(total / args.steps, 3),
-        "async_dma_busy_ms_per_step": round(async_ms / args.steps, 3),
-        "category_ms_per_step": {
-            k: round(v / args.steps, 3) for k, v in cats.items()
-        },
-    }))
+    print_report(
+        trace_dir, args.steps, args.top,
+        header=f", batch {args.batch}, impl {args.impl}",
+    )
+
 
 
 if __name__ == "__main__":

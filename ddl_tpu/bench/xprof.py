@@ -1,0 +1,134 @@
+"""Shared per-op device-time trace analysis (`jax.profiler.ProfileData`).
+
+Captures live on any workload: run the step a few times warm, trace N
+steps, then aggregate the device plane's sync-op line — XLA-op exclusive
+times — into opcode categories.  The async-DMA line is reported
+separately (those copies overlap compute; summing them into the op time
+double-counts).  Used by ``profile_densenet`` (the headline CNN story,
+PERF.md round 4) and ``profile_lm``.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import re
+
+__all__ = ["analyze", "opcode_of", "print_report", "CATEGORY"]
+
+# HLO text looks like "%fusion.123 = bf16[...] fusion(...), kind=kLoop ..."
+_OPCODE_RX = re.compile(r"=\s*(?:\([^)]*\)|[^ ]+)\s+([a-z][a-z0-9-]*)\(")
+
+
+def opcode_of(name: str) -> str:
+    """Pull the HLO opcode out of a profiler op-event name."""
+    m = _OPCODE_RX.search(name)
+    if m:
+        op = m.group(1)
+    else:
+        # bare names like "fusion.123" / "copy-start.4"
+        op = name.split(" ")[0].lstrip("%").split(".")[0]
+    if "fusion" in name and (kind := re.search(r"kind=k(\w+)", name)):
+        return f"fusion:{kind.group(1)}"
+    return op
+
+
+CATEGORY = {
+    "convolution": "conv",
+    "fusion:Output": "conv/matmul fusion (+fused elementwise)",
+    "fusion:Convolution": "conv/matmul fusion (+fused elementwise)",
+    "dot": "conv/matmul fusion (+fused elementwise)",
+    "copy": "copy (layout/concat materialise)",
+    "copy-start": "async copy (overlapped)",
+    "copy-done": "copy-done (DMA wait)",
+    "slice-start": "async slice (overlapped)",
+    "slice-done": "slice-done (DMA wait)",
+    "dynamic-update-slice": "copy (layout/concat materialise)",
+    "concatenate": "copy (layout/concat materialise)",
+    "fusion:Loop": "fusion (elementwise loops)",
+    "fusion:Input": "fusion (reduce/stats)",
+    "reduce": "fusion (reduce/stats)",
+    "reduce-window": "fusion (reduce/stats)",
+    "fusion:Custom": "custom call (Pallas)",
+    "custom-call": "custom call (Pallas)",
+    "all-gather-start": "collective",
+    "all-reduce-start": "collective",
+    "collective-permute-start": "collective",
+    "sort": "sort",
+    "scatter": "scatter",
+    "gather": "gather",
+}
+
+
+def analyze(trace_dir: str):
+    """Aggregate a captured trace.  Returns (per_op ms, per_op counts,
+    async-DMA busy ms, XLA-module ms) — all totals over the traced steps."""
+    from jax.profiler import ProfileData
+
+    paths = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    )
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    data = ProfileData.from_file(max(paths, key=os.path.getmtime))
+
+    per_op: dict[str, float] = collections.defaultdict(float)
+    per_op_count: dict[str, int] = collections.defaultdict(int)
+    async_ms = 0.0
+    module_ms = 0.0
+    for plane in data.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        for line in plane.lines:
+            if line.name == "XLA Modules":
+                module_ms += sum(
+                    (e.end_ns - e.start_ns) / 1e6 for e in line.events
+                )
+            if line.name == "Async XLA Ops":
+                async_ms += sum(
+                    (e.end_ns - e.start_ns) / 1e6 for e in line.events
+                )
+            if line.name != "XLA Ops":
+                continue  # Steps/Modules duplicate; Async overlaps compute
+            for ev in line.events:
+                dur = (ev.end_ns - ev.start_ns) / 1e6  # ms
+                per_op[ev.name] += dur
+                per_op_count[ev.name] += 1
+    return per_op, per_op_count, async_ms, module_ms
+
+
+def print_report(trace_dir: str, steps: int, top: int = 25, header: str = ""):
+    """Analyze + print the category table, top ops, and one JSON line.
+    Returns the category dict (ms/step)."""
+    import json
+
+    per_op, per_op_count, async_ms, module_ms = analyze(trace_dir)
+    total = sum(per_op.values())
+    cats: dict[str, float] = collections.defaultdict(float)
+    for name, ms in per_op.items():
+        op = opcode_of(name)
+        cats[CATEGORY.get(op, f"other ({op})")] += ms
+
+    print(f"# trace: {trace_dir}  ({steps} steps{header})")
+    print(f"# XLA module time: {module_ms / steps:.2f} ms/step; "
+          f"sync-op exclusive total: {total / steps:.2f} ms/step; "
+          f"async-DMA busy (overlapped): {async_ms / steps:.2f} ms/step")
+    print("\n== by category (ms/step, % of sync op time) ==")
+    for cat, ms in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"  {cat:40s} {ms / steps:8.3f}  "
+              f"({100 * ms / total:5.1f}%)")
+    print(f"\n== top {top} ops (ms/step, count/step) ==")
+    rows = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
+    for name, ms in rows:
+        n = per_op_count[name] // steps
+        print(f"  {ms / steps:8.3f}  x{n:<4d} {name[:140]}")
+    print(json.dumps({
+        "module_ms_per_step": round(module_ms / steps, 3),
+        "sync_op_ms_per_step": round(total / steps, 3),
+        "async_dma_busy_ms_per_step": round(async_ms / steps, 3),
+        "category_ms_per_step": {
+            k: round(v / steps, 3) for k, v in cats.items()
+        },
+    }))
+    return cats

@@ -26,6 +26,7 @@ from pathlib import Path
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
 __all__ = [
@@ -54,6 +55,73 @@ def save_snapshot(
     return path
 
 
+def _kp_norm(key_path) -> tuple:
+    """Normalise a tree key path to comparable strings (DictKey /
+    GetAttrKey / SequenceKey all stringify differently)."""
+    return tuple(
+        str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k))))
+        for k in key_path
+    )
+
+
+def _is_head_kernel_path(key_path) -> bool:
+    keys = _kp_norm(key_path)
+    return any(k == "lm_head" for k in keys) and keys[-1] == "kernel"
+
+
+def _head_migration_abstract(ckptr, path, abstract):
+    """Detect pre-round-4 snapshots whose lm_head kernel (and its
+    param-shaped optimizer moments) were saved (d_model, vocab): round 4
+    transposed the stored kernel to vocab-major (``LMHead``, PERF.md).
+    Returns an abstract tree asking Orbax for the SAVED orientation (the
+    loaded arrays are transposed after restore), or None if the snapshot
+    already matches.  Square heads (vocab == d_model, realistically only
+    toy configs) are orientation-ambiguous by shape and restore as-is."""
+    try:
+        saved = ckptr.metadata(path).item_metadata.tree["state"]
+    except Exception:
+        return None
+    saved_shapes = {
+        _kp_norm(kp): tuple(leaf.shape)
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(saved)[0]
+        if hasattr(leaf, "shape")
+    }
+    hits = 0
+
+    def fix(kp, leaf):
+        nonlocal hits
+        key = _kp_norm(kp)
+        if (
+            _is_head_kernel_path(kp)
+            and len(leaf.shape) == 2
+            # a square head (vocab == d_model) is orientation-ambiguous by
+            # shape: skip migration and restore as-is (pre-shim behavior)
+            and leaf.shape[0] != leaf.shape[1]
+            and saved_shapes.get(key) == leaf.shape[::-1]
+        ):
+            hits += 1
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None and hasattr(sharding, "spec"):
+                # keep cross-topology restore working: ask Orbax for the
+                # transposed shape under the transposed partition spec
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                spec = tuple(sharding.spec) + (None,) * (
+                    2 - len(tuple(sharding.spec))
+                )
+                sharding = NamedSharding(
+                    sharding.mesh, PartitionSpec(spec[1], spec[0])
+                )
+                return jax.ShapeDtypeStruct(
+                    leaf.shape[::-1], leaf.dtype, sharding=sharding
+                )
+            return jax.ShapeDtypeStruct(leaf.shape[::-1], leaf.dtype)
+        return leaf
+
+    migrated = jax.tree_util.tree_map_with_path(fix, abstract)
+    return migrated if hits else None
+
+
 def load_snapshot(
     checkpoint_dir: str | os.PathLike,
     job_id: str,
@@ -61,11 +129,34 @@ def load_snapshot(
     abstract_state: Any,
 ) -> tuple[Any, int]:
     """Restore a snapshot; returns ``(state, epochs_run)`` where training
-    resumes at ``epochs_run = saved_epoch + 1`` (reference ``single.py:124``)."""
+    resumes at ``epochs_run = saved_epoch + 1`` (reference ``single.py:124``).
+
+    Snapshots from before the vocab-major lm_head (round 4) are migrated
+    on load: the kernel and its optimizer moments restore in their saved
+    (d_model, vocab) orientation and are transposed into the requested
+    tree (with the requested sharding, when the abstract leaf carries
+    one)."""
     path = snapshot_path(checkpoint_dir, job_id, epoch)
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
     with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, {"state": abstract, "epoch": 0})
+        migrated = _head_migration_abstract(ckptr, path, abstract)
+        if migrated is None:
+            restored = ckptr.restore(path, {"state": abstract, "epoch": 0})
+        else:
+            restored = ckptr.restore(path, {"state": migrated, "epoch": 0})
+
+            def untranspose(kp, leaf, want):
+                if not hasattr(leaf, "shape") or leaf.shape == getattr(
+                    want, "shape", None
+                ):
+                    return leaf
+                out = jnp.transpose(leaf)
+                sharding = getattr(want, "sharding", None)
+                return jax.device_put(out, sharding) if sharding else out
+
+            restored["state"] = jax.tree_util.tree_map_with_path(
+                untranspose, restored["state"], abstract
+            )
     return restored["state"], int(restored["epoch"]) + 1
 
 

@@ -72,8 +72,10 @@ def fused_chunked_ce(
     — under sequence parallelism the per-device logits are already T/seq
     smaller and the dense CE is the right choice).
 
-    hidden: (B, T, D) post-final-norm activations; w: (D, V) f32 head
-    kernel; targets: (B, T) int.  Returns ``(mean_ce, accuracy | None)``
+    hidden: (B, T, D) post-final-norm activations; w: (V, D) f32 head
+    kernel as stored (``models.transformer.LMHead`` — vocab-major, the
+    embedding orientation); targets: (B, T) int.  Returns
+    ``(mean_ce, accuracy | None)``
     — exact parity with dense CE + argmax (``tests/test_ops.py``).
     ``constrain`` (optional) applies a sharding annotation to each chunk's
     logits (the caller passes flax's logical-axis constraint).
@@ -100,7 +102,9 @@ def fused_chunked_ce(
 
     @jax.checkpoint
     def chunk_ce(h_c, t_c):
-        logits = h_c.astype(jnp.float32) @ w  # (B, C, V)
+        logits = jnp.einsum(  # (B, C, V); w is vocab-major (V, D)
+            "bcd,vd->bcv", h_c.astype(jnp.float32), w
+        )
         if constrain is not None:
             logits = constrain(logits)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)

@@ -81,3 +81,46 @@ def test_lm_restore_onto_different_mesh(tmp_path):
     # params really live on the new mesh
     kernel = resharded.params["block0"]["mlp"]["wi"]["kernel"]
     assert kernel.sharding.mesh.shape["data"] == 4
+
+
+def test_legacy_head_orientation_migrates_on_load(tmp_path):
+    """Round 4 transposed the stored lm_head kernel to vocab-major
+    (models/transformer.LMHead).  A snapshot saved with the old
+    (d_model, vocab) orientation — kernel AND its param-shaped Adam
+    moments — must restore via the transpose-on-load migration, so
+    auto-resume across the upgrade continues instead of crashing."""
+    import dataclasses
+
+    cfg = dataclasses.replace(_cfg(), vocab_size=48)  # non-square head
+    fns = make_lm_step_fns(
+        cfg, LMMeshSpec(), optax.adam(1e-3), jax.random.key(0), 4, 16
+    )
+    state = fns.init_state()
+    for inp, tgt in _batches(3):
+        state, _ = fns.train(state, inp, tgt)
+
+    def t_head(kp, leaf):
+        keys = [getattr(k, "key", getattr(k, "name", k)) for k in kp]
+        if "lm_head" in keys and keys[-1] == "kernel":
+            return jnp.transpose(leaf)
+        return leaf
+
+    legacy = jax.tree_util.tree_map_with_path(t_head, state)
+    # sanity: the legacy tree really is transposed where it matters
+    changed = sum(
+        int(a.shape != b.shape)
+        for a, b in zip(jax.tree.leaves(legacy), jax.tree.leaves(state))
+    )
+    assert changed >= 3  # param + two Adam moments
+    save_snapshot(tmp_path, "legacy", 0, legacy)
+
+    restored, epochs = load_snapshot(tmp_path, "legacy", 0, state)
+    assert epochs == 1
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # non-legacy snapshots take the fast path and still round-trip
+    save_snapshot(tmp_path, "new", 0, state)
+    restored2, _ = load_snapshot(tmp_path, "new", 0, state)
+    for a, b in zip(jax.tree.leaves(restored2), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

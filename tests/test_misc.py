@@ -340,7 +340,7 @@ def test_chunked_ce_extra_flops_restores_scan_trips():
 
     g = jax.grad(loss, argnums=(0, 1))
     h = jnp.zeros((b, t, d), jnp.float32)
-    w = jnp.zeros((d, v), jnp.float32)
+    w = jnp.zeros((v, d), jnp.float32)  # vocab-major, as LMHead stores it
     tgt = jnp.zeros((b, t), jnp.int32)
     counted = compiled_step_flops(g, h, w, tgt)
     if not counted > 0:

@@ -99,14 +99,15 @@ class TestFusedChunkedCE:
         rng = np.random.default_rng(0)
         b, t, d, v = 2, 32, 16, 97  # odd vocab: no tiling luck
         h = jnp.asarray(rng.normal(size=(b, t, d)), jnp.float32)
-        w = jnp.asarray(rng.normal(size=(d, v)) * 0.1, jnp.float32)
+        # vocab-major kernel, as LMHead stores it
+        w = jnp.asarray(rng.normal(size=(v, d)) * 0.1, jnp.float32)
         tg = jnp.asarray(rng.integers(0, v, (b, t)))
         return h, w, tg
 
     def _dense(self, h, w, tg):
         from ddl_tpu.ops.losses import cross_entropy_loss
 
-        return cross_entropy_loss(h.astype(np.float32) @ w, tg)
+        return cross_entropy_loss(h.astype(np.float32) @ w.T, tg)
 
     @pytest.mark.parametrize("chunk", [4, 8, 32, 100])
     @pytest.mark.parametrize("use_onehot", [False, True])
@@ -122,7 +123,7 @@ class TestFusedChunkedCE:
         )
         want = self._dense(h, w, tg)
         np.testing.assert_allclose(float(ce), float(want), atol=1e-5)
-        logits = np.asarray(h) @ np.asarray(w)
+        logits = np.asarray(h) @ np.asarray(w).T
         np.testing.assert_allclose(
             float(acc), float(np.mean(logits.argmax(-1) == np.asarray(tg))),
             atol=1e-7,

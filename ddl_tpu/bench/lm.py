@@ -42,6 +42,9 @@ def main() -> None:
                     help="what the per-block checkpoint may save instead of "
                     "recomputing (LMConfig.remat_policy)")
     ap.add_argument("--no-remat", action="store_true")
+    ap.add_argument("--ce-vocab-chunk", type=int, default=0,
+                    help="vocab-streamed head+CE (losses."
+                    "fused_vocab_chunked_ce): vocab-block size, 0 = off")
     ap.add_argument("--ce-chunk", type=int, default=0,
                     help="chunked head+CE fusion: sequence-chunk size for "
                     "the loss edge (0 = dense CE; the (B,T,V) logits are "
@@ -92,6 +95,7 @@ def main() -> None:
         remat=not args.no_remat,
         remat_policy=args.remat_policy,
         ce_chunk=args.ce_chunk,
+        ce_vocab_chunk=args.ce_vocab_chunk,
     )
     # resolve flash="auto" HERE and pass the concrete cfg down, so the
     # reported "flash" field is by construction the path benchmarked
@@ -124,6 +128,7 @@ def main() -> None:
         "flash_mode": args.flash,
         "remat": "off" if args.no_remat else args.remat_policy,
         "ce_chunk": args.ce_chunk,
+        "ce_vocab_chunk": args.ce_vocab_chunk,
         "loss": round(float(m["loss"]), 3),
     }
     if args.experts:
@@ -166,6 +171,13 @@ def main() -> None:
         if cfg.flash
         else 0.0
     )
+    if cfg.ce_vocab_chunk:
+        from ddl_tpu.bench.mfu import vocab_chunked_ce_extra_flops
+
+        extra_flops += vocab_chunked_ce_extra_flops(
+            args.batch, args.seq_len, args.d_model, args.vocab,
+            cfg.ce_vocab_chunk, accounting=accounting,
+        )
     if cfg.ce_chunk:
         extra_flops += chunked_ce_extra_flops(
             args.batch, args.seq_len, args.d_model, args.vocab,

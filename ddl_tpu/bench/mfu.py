@@ -163,6 +163,35 @@ def chunked_ce_extra_flops(
     return target - counted
 
 
+def vocab_chunked_ce_extra_flops(
+    batch: int,
+    seq_len: int,
+    d_model: int,
+    vocab: int,
+    vocab_chunk: int,
+    accounting: str = "model",
+) -> float:
+    """FLOPs correction for ``ce_vocab_chunk`` rows (same scan-counted-once
+    rule as ``chunked_ce_extra_flops``, over the VOCAB scan of
+    ``ops/losses.fused_vocab_chunked_ce``).  The forward scan body holds
+    one chunk-sized matmul and the hand-written backward scan body three
+    (logits recompute, dx, dW): counted = 4 chunk-sized matmuls; executed
+    = 4 full-V matmuls; the "model" target excludes the backward's
+    recompute (3 full-V matmuls), matching the MFU convention used for
+    the flash kernel and ce_chunk."""
+    if accounting not in ("model", "executed"):
+        raise ValueError(
+            f"accounting must be 'model' or 'executed', got {accounting!r}"
+        )
+    from ddl_tpu.ops.losses import _vocab_blocks
+
+    vb = _vocab_blocks(vocab, vocab_chunk)
+    per_v = 2.0 * batch * seq_len * d_model
+    target = (3.0 if accounting == "model" else 4.0) * per_v * vocab
+    counted = 4.0 * per_v * vb
+    return target - counted
+
+
 def mfu(flops_per_step: float, step_time_s: float, device=None) -> float | None:
     """Fraction of peak dense bf16 FLOP/s achieved; None when peak unknown."""
     peak = device_peak_flops(device)

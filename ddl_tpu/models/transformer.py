@@ -140,6 +140,15 @@ class LMConfig:
     # tensor in the step.  Requires mesh seq=1 (chunking splits T; under
     # sequence parallelism per-device logits are already T/seq smaller).
     ce_chunk: int = 0
+    # Vocab-streamed head+CE (0 = off): the loss edge scans VOCAB blocks
+    # of this size with an online logsumexp, so the (B, T, V) logits
+    # never exist in either direction (ops/losses.fused_vocab_chunked_ce
+    # — hand-written VJP).  The extreme-vocab lever: measured ~5% slower
+    # than dense CE at V=50k (PERF.md round 4) but the only loss edge
+    # whose transient memory is O(B*T*vb) with no O(T*V) tensor at all.
+    # Mutually exclusive with ce_chunk; requires mesh model=1 (the scan
+    # slices the head kernel over vocab).
+    ce_vocab_chunk: int = 0
 
     def __post_init__(self):
         if self.n_kv_heads and self.n_heads % self.n_kv_heads:
@@ -157,6 +166,15 @@ class LMConfig:
                 "attn_window > 0 requires causal=True (sliding causal "
                 "window); bidirectional encoders have no decode order to "
                 "window over"
+            )
+        if self.ce_vocab_chunk < 0:
+            raise ValueError(
+                f"ce_vocab_chunk must be >= 0, got {self.ce_vocab_chunk}"
+            )
+        if self.ce_chunk and self.ce_vocab_chunk:
+            raise ValueError(
+                "ce_chunk and ce_vocab_chunk are mutually exclusive "
+                "(token-chunked vs vocab-streamed loss edge)"
             )
         if self.ce_chunk < 0:
             raise ValueError(

@@ -14,6 +14,7 @@ __all__ = [
     "onehot_cross_entropy_mean",
     "effective_chunk",
     "fused_chunked_ce",
+    "fused_vocab_chunked_ce",
 ]
 
 
@@ -147,3 +148,145 @@ def onehot_cross_entropy_mean(logits, labels):
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
     return (lse - (logits * onehot).sum(-1)).mean(), logits
+
+
+def _vocab_blocks(v: int, vocab_chunk: int) -> int:
+    """Vocab-block size actually scanned: largest divisor of V at or
+    under the request (``effective_chunk`` on the vocab axis), warning
+    like the token-chunk path when the request does not divide."""
+    if vocab_chunk < 1:
+        raise ValueError(f"vocab_chunk must be >= 1, got {vocab_chunk}")
+    c = effective_chunk(vocab_chunk, v)
+    if c != min(vocab_chunk, v):
+        import warnings
+
+        warnings.warn(
+            f"vocab_chunk {vocab_chunk} does not divide V={v}; using the "
+            f"largest divisor {c}",
+            stacklevel=3,
+        )
+    return c
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4)
+)
+def fused_vocab_chunked_ce(hidden, w, targets, vocab_chunk: int,
+                           with_accuracy: bool = False):
+    """Head projection + mean CE, streamed over VOCAB blocks — the full
+    (B, T, V) logits tensor never exists in EITHER direction.
+
+    Why a second chunking axis (PERF.md round 4, "Profiling the LM
+    step"): the dense loss edge writes 3.3 GB of f32 logits once and
+    re-reads them in three consumers (~13 GB of HBM traffic at b=16,
+    T=1024, V=50304), and ``fused_chunked_ce`` (token-chunked) still
+    materialises (B, C, V) logits per scan trip, so it trades residency,
+    not traffic.  Streaming the *vocab* axis with an online logsumexp
+    (the flash-attention recurrence applied to the loss edge) keeps each
+    (B, T, Vb) block internal to one matmul+reduce fusion: the forward
+    carries running (max, sumexp, picked-logit, argmax), and the
+    hand-written backward re-runs the scan, forming each block's
+    softmax-minus-onehot gradient and accumulating dX += dP_b @ W_b and
+    dW_b = dP_b^T @ X directly — four MXU matmuls total (vs dense's
+    three) and O(B·T·Vb) transient memory.
+
+    hidden: (B, T, D); w: (V, D) vocab-major (``LMHead``'s stored
+    orientation); targets: (B, T) int.  Returns ``(mean_ce, accuracy)``
+    (accuracy None unless ``with_accuracy``; non-differentiable).
+    Requires an unsharded vocab axis (``spec.model == 1``) — the block
+    scan slices W; the dense and token-chunked paths remain the
+    tensor-parallel choices.
+    """
+    ce, acc, _ = _vocab_ce_fwd_scan(hidden, w, targets, vocab_chunk,
+                                    with_accuracy)
+    return ce, acc
+
+
+def _vocab_ce_fwd_scan(hidden, w, targets, vocab_chunk, with_accuracy):
+    b, t, d = hidden.shape
+    v = w.shape[0]
+    vb = _vocab_blocks(v, vocab_chunk)
+    n_blocks = v // vb
+    h32 = hidden.astype(jnp.float32)
+    wb = w.reshape(n_blocks, vb, d)
+    tgt = targets.astype(jnp.int32)
+
+    def body(carry, xs):
+        m, s, picked, best, best_idx = carry
+        w_b, off = xs
+        z = jnp.einsum("btd,vd->btv", h32, w_b.astype(jnp.float32))
+        zmax = z.max(-1)
+        new_m = jnp.maximum(m, zmax)
+        s = s * jnp.exp(m - new_m) + jnp.exp(
+            z - new_m[..., None]
+        ).sum(-1)
+        local = tgt - off
+        in_blk = (local >= 0) & (local < vb)
+        z_t = jnp.take_along_axis(
+            z, jnp.clip(local, 0, vb - 1)[..., None], axis=-1
+        )[..., 0]
+        picked = jnp.where(in_blk, z_t, picked)
+        if with_accuracy:
+            arg = jnp.argmax(z, -1) + off
+            best_idx = jnp.where(zmax > best, arg, best_idx)
+            best = jnp.maximum(best, zmax)
+        return (new_m, s, picked, best, best_idx), None
+
+    neg = jnp.full((b, t), -jnp.inf, jnp.float32)
+    zero = jnp.zeros((b, t), jnp.float32)
+    izero = jnp.zeros((b, t), jnp.int32)
+    offs = jnp.arange(n_blocks, dtype=jnp.int32) * vb
+    (m, s, picked, _, best_idx), _ = lax.scan(
+        body, (neg, zero, zero, neg, izero), (wb, offs)
+    )
+    lse = m + jnp.log(s)
+    ce = (lse - picked).mean()
+    acc = (
+        (best_idx == tgt).mean(dtype=jnp.float32) if with_accuracy else None
+    )
+    return ce, acc, lse
+
+
+def _vocab_ce_fwd(hidden, w, targets, vocab_chunk, with_accuracy):
+    ce, acc, lse = _vocab_ce_fwd_scan(hidden, w, targets, vocab_chunk,
+                                      with_accuracy)
+    return (ce, acc), (hidden, w, targets, lse)
+
+
+def _vocab_ce_bwd(vocab_chunk, with_accuracy, res, g):
+    hidden, w, targets, lse = res
+    g_ce = g[0]  # accuracy output is non-differentiable
+    b, t, d = hidden.shape
+    v = w.shape[0]
+    vb = _vocab_blocks(v, vocab_chunk)
+    n_blocks = v // vb
+    h32 = hidden.astype(jnp.float32)
+    wb = w.reshape(n_blocks, vb, d)
+    tgt = targets.astype(jnp.int32)
+    scale = g_ce / (b * t)
+
+    def body(dx, xs):
+        w_b, off = xs
+        z = jnp.einsum("btd,vd->btv", h32, w_b.astype(jnp.float32))
+        p = jnp.exp(z - lse[..., None])
+        local = tgt - off
+        in_blk = (local >= 0) & (local < vb)
+        onehot = (
+            jax.nn.one_hot(jnp.clip(local, 0, vb - 1), vb,
+                           dtype=jnp.float32)
+            * in_blk[..., None]
+        )
+        dp = (p - onehot) * scale
+        dx = dx + jnp.einsum("btv,vd->btd", dp, w_b.astype(jnp.float32))
+        dw_b = jnp.einsum("btv,btd->vd", dp, h32)
+        return dx, dw_b
+
+    dx, dwb = lax.scan(
+        body, jnp.zeros((b, t, d), jnp.float32),
+        (wb, jnp.arange(n_blocks, dtype=jnp.int32) * vb),
+    )
+    dw = dwb.reshape(v, d).astype(w.dtype)
+    return dx.astype(hidden.dtype), dw, None
+
+
+fused_vocab_chunked_ce.defvjp(_vocab_ce_fwd, _vocab_ce_bwd)

@@ -943,6 +943,13 @@ def make_lm_pipeline_step_fns(
         raise ValueError("make_lm_pipeline_step_fns needs spec.pipe >= 2")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if cfg.ce_vocab_chunk and schedule == "1f1b":
+        raise ValueError(
+            "ce_vocab_chunk is not supported with the 1F1B schedule (its "
+            "per-microbatch head loss runs inside the manual region, "
+            "where the vocab-scan custom VJP is unverified); use the "
+            "GPipe schedule or ce_chunk"
+        )
     if V < 1:
         raise ValueError(f"virtual_stages must be >= 1, got {V}")
     if V > 1 and M % n_stages:
@@ -1186,7 +1193,7 @@ def make_lm_pipeline_step_fns(
         )
 
     def loss_fn(params, inputs, targets, step=None):
-        if cfg.ce_chunk:
+        if cfg.ce_chunk or cfg.ce_vocab_chunk:
             # The GPipe head runs OUTSIDE the manual region on the full
             # (B, T, V) logits — the same loss-edge memory wall as the
             # flat path, fixed the same way: norm-only head, then the

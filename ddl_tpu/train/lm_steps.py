@@ -97,16 +97,23 @@ def chunked_ce_loss(cfg, hidden, kernel, targets, aux, with_accuracy):
     in the metrics).  Call inside an ``nn.logical_axis_rules`` scope."""
     from ddl_tpu.ops.losses import fused_chunked_ce
 
-    ce, acc = fused_chunked_ce(
-        hidden,
-        kernel,
-        targets,
-        cfg.ce_chunk,
-        with_accuracy=with_accuracy,
-        constrain=lambda z: nn.with_logical_constraint(
-            z, ("batch", "act_seq", "act_vocab")
-        ),
-    )
+    if cfg.ce_vocab_chunk:
+        from ddl_tpu.ops.losses import fused_vocab_chunked_ce
+
+        ce, acc = fused_vocab_chunked_ce(
+            hidden, kernel, targets, cfg.ce_vocab_chunk, with_accuracy
+        )
+    else:
+        ce, acc = fused_chunked_ce(
+            hidden,
+            kernel,
+            targets,
+            cfg.ce_chunk,
+            with_accuracy=with_accuracy,
+            constrain=lambda z: nn.with_logical_constraint(
+                z, ("batch", "act_seq", "act_vocab")
+            ),
+        )
     loss = ce + cfg.moe_aux_weight * aux
     metrics = {"loss": loss, "ce": ce, "moe_aux": aux}
     if acc is not None:
@@ -338,6 +345,12 @@ def make_lm_step_fns(
         raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
     cfg = normalize_flash(cfg, spec, seq_len)
     validate_kv_head_sharding(cfg, spec)
+    if cfg.ce_vocab_chunk and spec.model > 1:
+        raise ValueError(
+            f"ce_vocab_chunk={cfg.ce_vocab_chunk} requires mesh model=1 "
+            "(the vocab scan slices the head kernel; use ce_chunk, whose "
+            "per-chunk matmul shards over 'model')"
+        )
     if cfg.ce_chunk and spec.seq > 1:
         raise ValueError(
             f"ce_chunk={cfg.ce_chunk} requires mesh seq=1 (the chunked CE "
@@ -486,7 +499,7 @@ def make_lm_step_fns(
         mutable = ["intermediates"] if cfg.num_experts else False
         router = {}
         with nn.logical_axis_rules(rules):
-            if cfg.ce_chunk:
+            if cfg.ce_chunk or cfg.ce_vocab_chunk:
                 # chunked head+CE fusion: the model stops at the final
                 # norm and the vocab projection runs chunk by chunk inside
                 # the loss — the (B, T, V) logits never materialise

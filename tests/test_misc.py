@@ -358,3 +358,41 @@ def test_chunked_ce_extra_flops_restores_scan_trips():
     # model accounting excludes exactly the checkpoint replay
     delta = extra - chunked_ce_extra_flops(b, t, d, v, chunk)
     np.testing.assert_allclose(delta, matmul, rtol=1e-12)
+
+
+def test_vocab_chunked_ce_extra_flops_restores_scan_trips():
+    """Same counted-once rule for the VOCAB-streamed loss edge: the
+    correction must bring the compiled count back to the four executed
+    full-V matmuls (fwd, bwd recompute, dx, dW)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl_tpu.bench.mfu import (
+        compiled_step_flops,
+        vocab_chunked_ce_extra_flops,
+    )
+    from ddl_tpu.ops.losses import fused_vocab_chunked_ce
+
+    b, t, d, v, vb = 2, 64, 64, 256, 64  # 4 vocab blocks
+
+    def loss(h, w, tgt):
+        return fused_vocab_chunked_ce(h, w, tgt, vb)[0]
+
+    g = jax.grad(loss, argnums=(0, 1))
+    h = jnp.zeros((b, t, d), jnp.float32)
+    w = jnp.zeros((v, d), jnp.float32)
+    tgt = jnp.zeros((b, t), jnp.int32)
+    counted = compiled_step_flops(g, h, w, tgt)
+    if not counted > 0:
+        import pytest
+
+        pytest.skip("backend has no cost analysis")
+    matmul = 2.0 * b * t * d * v
+    assert counted < 2.0 * matmul  # the undercount is real
+    extra = vocab_chunked_ce_extra_flops(b, t, d, v, vb,
+                                         accounting="executed")
+    np.testing.assert_allclose(counted + extra, 4 * matmul, rtol=0.1)
+    # model accounting excludes exactly the backward's recompute matmul
+    delta = extra - vocab_chunked_ce_extra_flops(b, t, d, v, vb)
+    np.testing.assert_allclose(delta, matmul, rtol=1e-12)

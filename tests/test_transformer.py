@@ -395,3 +395,42 @@ def test_moe_router_metrics_surface_drops_and_load():
     inp, tgt = make_batch(rng)
     _, m_dense = fns.train(fns.init_state(), inp, tgt)
     assert "moe_drop_frac" not in m_dense
+
+
+def test_ce_vocab_chunk_matches_dense_loss():
+    """ce_vocab_chunk (vocab-streamed loss edge, custom VJP) reproduces
+    the dense-CE training trajectory — flat path and with data + seq
+    sharding (the scan slices W; hidden stays T-sharded)."""
+    ref, ref_losses = run_steps(tiny_cfg(), LMMeshSpec())
+    for spec, kw in (
+        (LMMeshSpec(), {}),
+        (LMMeshSpec(data=2, seq=2), {}),
+        (LMMeshSpec(data=2, pipe=2), {"n_steps": 2}),  # GPipe head path
+    ):
+        chunked, losses = run_steps(
+            tiny_cfg(ce_vocab_chunk=8), spec, **kw
+        )
+        np.testing.assert_allclose(
+            ref_losses[: len(losses)], losses, atol=2e-4,
+            err_msg=f"{spec} {kw}",
+        )
+
+
+def test_ce_vocab_chunk_validation():
+    import dataclasses
+
+    import pytest
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        tiny_cfg(ce_chunk=4, ce_vocab_chunk=8)
+    with pytest.raises(ValueError, match="ce_vocab_chunk"):
+        make_lm_step_fns(
+            tiny_cfg(ce_vocab_chunk=8), LMMeshSpec(model=2),
+            optax.adam(1e-3), jax.random.key(0), 4, 16,
+        )
+    with pytest.raises(ValueError, match="1F1B"):
+        make_lm_step_fns(
+            tiny_cfg(ce_vocab_chunk=8), LMMeshSpec(data=2, pipe=2),
+            optax.adam(1e-3), jax.random.key(0), 4, 16,
+            num_microbatches=2, pipeline_schedule="1f1b",
+        )

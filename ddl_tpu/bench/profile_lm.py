@@ -44,6 +44,7 @@ def capture(args, trace_dir: str) -> None:
         flash=bool(args.flash),
         remat=not args.no_remat,
         ce_chunk=args.ce_chunk,
+        ce_vocab_chunk=args.ce_vocab_chunk,
     )
     import optax
 
@@ -79,6 +80,7 @@ def main() -> None:
     ap.add_argument("--d-ff", type=int, default=3072)
     ap.add_argument("--kv-heads", type=int, default=0)
     ap.add_argument("--ce-chunk", type=int, default=0)
+    ap.add_argument("--ce-vocab-chunk", type=int, default=0)
     ap.add_argument("--flash", action="store_true")
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--trace-dir", default=None,

@@ -1197,7 +1197,8 @@ def make_lm_pipeline_step_fns(
             # The GPipe head runs OUTSIDE the manual region on the full
             # (B, T, V) logits — the same loss-edge memory wall as the
             # flat path, fixed the same way: norm-only head, then the
-            # chunked head+CE fusion (shared tail: lm_steps.chunked_ce_loss).
+            # chunked head+CE fusion, token-chunked or vocab-streamed
+            # (shared tail: lm_steps.chunked_ce_loss).
             hidden, aux = forward(params, inputs, step, return_hidden=True)
             with nn.logical_axis_rules(rules):
                 return chunked_ce_loss(

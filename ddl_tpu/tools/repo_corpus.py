@@ -31,7 +31,10 @@ def iter_files(root: Path):
     for p in sorted(root.rglob("*")):
         if not p.is_file() or p.suffix.lower() not in EXTS:
             continue
-        if any(part in SKIP_DIRS for part in p.parts):
+        # skip-list applies to directories INSIDE the tree, not to the
+        # root's own ancestors (harvesting a tree that happens to live
+        # under e.g. a venv must work)
+        if any(part in SKIP_DIRS for part in p.relative_to(root).parts):
             continue
         yield p
 

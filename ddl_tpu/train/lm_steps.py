@@ -90,8 +90,10 @@ def make_ring_core(
 
 
 def chunked_ce_loss(cfg, hidden, kernel, targets, aux, with_accuracy):
-    """Shared tail of the ce_chunk paths (flat loss and GPipe pipeline
-    loss): fused chunked head+CE over post-norm hidden states, assembled
+    """Shared tail of the ce_chunk / ce_vocab_chunk paths (flat loss and
+    GPipe pipeline loss): fused chunked head+CE over post-norm hidden
+    states — token-chunked (ops/losses.fused_chunked_ce) or
+    vocab-streamed (fused_vocab_chunked_ce) per the config — assembled
     into the ``(loss, (None, metrics))`` contract ``finalize_step_fns``
     expects (``None`` logits signal the eval step that accuracy is already
     in the metrics).  Call inside an ``nn.logical_axis_rules`` scope."""
@@ -503,8 +505,9 @@ def make_lm_step_fns(
                 # chunked head+CE fusion: the model stops at the final
                 # norm and the vocab projection runs chunk by chunk inside
                 # the loss — the (B, T, V) logits never materialise
-                # (ops/losses.fused_chunked_ce).  Eval (step=None) folds
-                # next-token accuracy into the same pass.
+                # (ops/losses.fused_chunked_ce token-chunked, or
+                # fused_vocab_chunked_ce vocab-streamed).  Eval
+                # (step=None) folds next-token accuracy into the pass.
                 out = model.apply(
                     {"params": params},
                     inputs,

@@ -151,6 +151,12 @@ def main() -> None:
             "train.save_best_qwk": "false",
             "train.preemption_save": "false",
             "train.log_dir": os.environ["DDL_TEST_LOG_DIR"],
+            # isolate from the developer's ./checkpoints: a stale snapshot
+            # under the default dir + default job id would auto-resume a
+            # mismatched config and fail the run
+            "train.checkpoint_dir": os.path.join(
+                os.environ["DDL_TEST_LOG_DIR"], "ckpt"
+            ),
         },
     )
     trainer = Trainer(cfg)

@@ -37,7 +37,18 @@ from ddl_tpu.models.transformer import LMConfig, TransformerLM
 from ddl_tpu.utils.timing import fence
 
 
-def _bench_one(args, batch: int, kv_heads: int, window: int) -> dict:
+def _is_oom(e: Exception) -> bool:
+    """XLA allocation failure — the runtime error whose status is
+    RESOURCE_EXHAUSTED (matching the typed status, not free text like
+    'memory', which unrelated errors could contain)."""
+    return isinstance(e, jax.errors.JaxRuntimeError) and (
+        "RESOURCE_EXHAUSTED" in str(e)
+    )
+
+
+def _bench_one(
+    args, batch: int, kv_heads: int, window: int, quant: str = "none"
+) -> dict:
     cfg = LMConfig(
         vocab_size=args.vocab,
         d_model=args.d_model,
@@ -60,6 +71,13 @@ def _bench_one(args, batch: int, kv_heads: int, window: int) -> dict:
     import flax.linen as nn
 
     params = nn.meta.unbox(params)
+    if quant not in ("none", "kv", "kv+w"):
+        raise ValueError(f"quant mode must be none|kv|kv+w, got {quant!r}")
+    kv_quant = quant != "none"
+    if quant == "kv+w":
+        from ddl_tpu.ops.quant import quantize_lm_params
+
+        params = quantize_lm_params(params)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(
         rng.integers(0, args.vocab, (batch, args.prompt)), jnp.int32
@@ -72,6 +90,7 @@ def _bench_one(args, batch: int, kv_heads: int, window: int) -> dict:
         gen = make_lm_generator(
             cfg, prompt_len=args.prompt, max_new=max_new, batch=batch,
             max_len=capacity,  # equal allocations across the three runs
+            kv_quant=kv_quant,
         )
         fence(gen(params, prompt))  # compile + warm
         t0 = time.perf_counter()
@@ -83,20 +102,32 @@ def _bench_one(args, batch: int, kv_heads: int, window: int) -> dict:
     t_pre, t1, t2 = timed(1), timed(n1), timed(n2)
     ms_per_tok = (t2 - t1) / (n2 - n1) * 1e3
     kv = cfg.kv_heads
-    elt = cfg.dtype.itemsize
     # windowed rows use the O(window)-memory ring cache (the generator's
     # rolling auto-mode); read the real allocation from init_kv_cache so
-    # the reported bytes cannot drift from what the generator builds
+    # the reported bytes cannot drift from what the generator builds —
+    # including the int8 + f32-scale layout of the quantized cache
     from ddl_tpu.infer.decode import init_kv_cache
 
     rolling = bool(window) and window < capacity
-    alloc = jax.eval_shape(
-        lambda: init_kv_cache(cfg, batch, capacity, rolling=rolling)
-    )[0][0].shape[1]
+    layer0 = jax.eval_shape(
+        lambda: init_kv_cache(
+            cfg, batch, capacity, rolling=rolling, quant=kv_quant
+        )
+    )[0]
+    alloc = layer0[0].shape[1]
+    layer_bytes = sum(
+        int(np.prod(a.shape)) * a.dtype.itemsize
+        for a in jax.tree_util.tree_leaves(layer0)
+    )
     span = min(window, capacity) if window else capacity
+    param_bytes = sum(
+        int(np.prod(a.shape)) * a.dtype.itemsize
+        for a in jax.tree_util.tree_leaves(params)
+    )
     return {
         "heads": f"{cfg.n_heads}q/{kv}kv",
         "window": window,
+        "quant": quant,
         "prompt": args.prompt,
         "max_len": capacity,
         "batch": batch,
@@ -104,12 +135,9 @@ def _bench_one(args, batch: int, kv_heads: int, window: int) -> dict:
         "decode_ms_per_tok": round(ms_per_tok, 3),
         "decode_tok_per_sec": round(batch / (ms_per_tok / 1e3), 1),
         # allocation vs what one decode step actually reads per layer
-        "cache_bytes_per_layer": int(
-            2 * batch * alloc * kv * cfg.head_dim * elt
-        ),
-        "read_bytes_per_step_layer": int(
-            2 * batch * span * kv * cfg.head_dim * elt
-        ),
+        "cache_bytes_per_layer": layer_bytes,
+        "read_bytes_per_step_layer": int(layer_bytes * span / max(alloc, 1)),
+        "param_bytes": param_bytes,
     }
 
 
@@ -134,6 +162,10 @@ def main() -> None:
                     "crossed with the config grid — the serving question: "
                     "how do weights/cache amortise across concurrent "
                     "streams (overrides --batch)")
+    ap.add_argument("--quant", default="none",
+                    help="comma-separated quant modes crossed with the "
+                    "grid: none (bf16), kv (int8 KV cache), kv+w (int8 "
+                    "cache AND int8 weight streaming) — ops/quant.py")
     args = ap.parse_args()
 
     from ddl_tpu.utils.compile_cache import enable_compile_cache
@@ -163,22 +195,28 @@ def main() -> None:
         if args.batches
         else [args.batch]
     )
+    quants = [q.strip() for q in args.quant.split(",")]
+    bad = [q for q in quants if q not in ("none", "kv", "kv+w")]
+    if bad:
+        ap.error(f"--quant modes must be none|kv|kv+w, got {bad}")
     for b in batches:
         for kv, win in grid:
-            try:
-                print(json.dumps(_bench_one(args, b, kv, win)))
-            except Exception as e:  # OOM rows are results, not crashes:
-                # a B=32 MHA full cache is 2x9.7 GB through the scan
-                # carry and does not fit a 16 GB chip — that line IS the
-                # GQA/window story
-                msg = str(e)
-                oom = "hbm" in msg.lower() or "memory" in msg.lower()
-                if not oom:
-                    raise
-                print(json.dumps({
-                    "heads": f"{args.d_model // 64}q/{kv or args.d_model // 64}kv",
-                    "window": win, "batch": b, "error": "hbm_oom",
-                }))
+            for qm in quants:
+                try:
+                    print(json.dumps(_bench_one(args, b, kv, win, qm)),
+                          flush=True)
+                except Exception as e:  # OOM rows are results, not
+                    # crashes: a B=32 MHA full cache is 2x9.7 GB through
+                    # the scan carry and does not fit a 16 GB chip — that
+                    # line IS the GQA/window/int8 story
+                    if not _is_oom(e):
+                        raise
+                    print(json.dumps({
+                        "heads": f"{args.d_model // 64}q/"
+                                 f"{kv or args.d_model // 64}kv",
+                        "window": win, "quant": qm, "batch": b,
+                        "error": "hbm_oom",
+                    }), flush=True)
 
 
 if __name__ == "__main__":

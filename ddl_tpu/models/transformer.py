@@ -32,6 +32,14 @@ import jax
 import jax.numpy as jnp
 
 from ddl_tpu.ops.attention import dense_attention
+from ddl_tpu.ops.quant import (
+    QuantKV,
+    kv_attend,
+    kv_map,
+    kv_set_slots,
+    kv_slice,
+    kv_write,
+)
 
 __all__ = [
     "LMConfig",
@@ -66,6 +74,42 @@ class LMConfig:
     num_experts: int = 0
     expert_top_k: int = 2
     capacity_factor: float = 1.5
+    # Post-warm-up capacity target.  An UNTRAINED router drops a third of
+    # its token-choices at cf 1.0 (measured: drop-frac 0.36 -> 0.005 over
+    # 400 steps as the aux loss balances load, training_logs/lm-moe-r4),
+    # so capacity_factor keeps warm-up headroom — but a CONVERGED router
+    # doesn't need it, and the extra slots are pure dispatch/FFN overhead
+    # (cf 1.5 taxes the step −20% vs the dense MLP, cf 1.0 −12.7%;
+    # PERF.md MoE table).  The trainer (train/lm_trainer.py) anneals
+    # capacity_factor down to this value once the LIVE ``moe_drop_frac``
+    # metric stays under ``capacity_anneal_drop`` (one recompile at the
+    # switch; params/optimizer state are capacity-independent).  Set equal
+    # to capacity_factor (or >= it) to disable annealing.
+    capacity_factor_min: float = 1.0
+    # Router drop fraction below which capacity anneals to
+    # capacity_factor_min (checked at each trainer logging period).
+    # Caveat: the pipeline-parallel step metrics do not surface
+    # ``moe_drop_frac`` (router stats are sown inside the manual pipe
+    # region), so metric-driven annealing is inert there — pipelined MoE
+    # runs should set ``capacity_anneal_step`` instead.
+    capacity_anneal_drop: float = 0.02
+    # Step-count fallback for the anneal (0 = off): anneal at this
+    # optimizer step regardless of the metric — for paths that don't
+    # surface the live drop fraction (pipeline parallelism), sized from
+    # the measured router convergence (~400 steps on the round-4 corpus
+    # run, training_logs/lm-moe-r4).
+    capacity_anneal_step: int = 0
+    # How the expert-parallel exchange is issued when the mesh has an
+    # expert axis: 'gspmd' lets the partitioner insert the collectives
+    # for the dispatch/combine resharding (batch is sharded over
+    # (data, expert); the expert-sharded slots force an all-to-all);
+    # 'alltoall' issues it manually — a partial-manual shard_map over
+    # 'expert' around per-shard sort-dispatch, lax.all_to_all of the
+    # capacity slots to the expert owners, local expert FFN, and the
+    # reverse exchange (the GShard/Switch production path, exact-parity
+    # with the GSPMD path).  'auto' (default) resolves to 'alltoall' on
+    # an expert axis > 1 and 'gspmd' otherwise.
+    moe_ep: str = "auto"
     # How tokens reach their experts.  'einsum' materialises (B, S, E, C)
     # one-hot dispatch/combine tensors and moves data with matmuls; 'sort'
     # routes with argsort index math + permutation gathers (custom-VJP:
@@ -151,6 +195,16 @@ class LMConfig:
     ce_vocab_chunk: int = 0
 
     def __post_init__(self):
+        if self.moe_ep not in ("auto", "gspmd", "alltoall"):
+            raise ValueError(
+                f"moe_ep must be 'auto', 'gspmd' or 'alltoall', got "
+                f"{self.moe_ep!r}"
+            )
+        if self.num_experts and self.capacity_factor_min <= 0:
+            raise ValueError(
+                f"capacity_factor_min must be > 0, got "
+                f"{self.capacity_factor_min}"
+            )
         if self.n_kv_heads and self.n_heads % self.n_kv_heads:
             raise ValueError(
                 f"n_heads {self.n_heads} must divide by n_kv_heads "
@@ -253,6 +307,40 @@ class RMSNorm(nn.Module):
 
 
 
+class QDense(nn.Module):
+    """``nn.Dense(use_bias=False)`` twin that transparently supports
+    weight-only int8 parameter trees.
+
+    With a standard f32 ``kernel`` this is exactly ``nn.Dense`` (kernel
+    cast to the compute dtype, one matmul).  When the supplied tree
+    carries an int8 ``kernel`` plus a sibling ``scale`` (1, features)
+    leaf — built by ``ops.quant.quantize_lm_params`` — it computes
+    ``(x @ W8) * s``, the per-output-channel dequant, with the int8→bf16
+    convert fused by XLA into the matmul operand read (the weight is
+    streamed from HBM at half width; the scale multiplies the activation-
+    sized output).  The param NAME and init are identical to ``nn.Dense``,
+    so training checkpoints, sharding rules and the converter are
+    unaffected; quantization is purely a property of the applied tree.
+    """
+
+    features: int
+    dtype: Any
+    kernel_init: Any
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            self.kernel_init,
+            (x.shape[-1], self.features),
+            jnp.float32,
+        )
+        y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        if self.has_variable("params", "scale"):
+            y = y * self.get_variable("params", "scale").astype(self.dtype)
+        return y
+
+
 class Attention(nn.Module):
     """Causal self-attention.  Two modes share the same parameters:
 
@@ -289,11 +377,9 @@ class Attention(nn.Module):
         )
 
         def proj(name, heads):
-            y = nn.Dense(
+            y = QDense(
                 heads * cfg.head_dim,
-                use_bias=False,
                 dtype=cfg.dtype,
-                param_dtype=jnp.float32,
                 kernel_init=qkv_kernel,
                 name=name,
             )(x)
@@ -325,8 +411,7 @@ class Attention(nn.Module):
         elif rolling:
             if not cfg.attn_window:
                 raise ValueError("rolling decode cache requires attn_window")
-            ck, cv = kv_cache
-            cap = ck.shape[1]
+            cap = kv_cache[0].shape[1]
             if t > 1:
                 # prefill: the ring holds nothing older than these tokens,
                 # so attend the fresh K/V directly (banded causal) and
@@ -337,16 +422,12 @@ class Attention(nn.Module):
                 o = core(q, k, v)
                 keep = min(cap, t)
                 slots = (offset + t - keep + jnp.arange(keep)) % cap
-                ck = ck.at[:, slots].set(k[:, -keep:].astype(ck.dtype))
-                cv = cv.at[:, slots].set(v[:, -keep:].astype(cv.dtype))
+                kv_cache = kv_set_slots(
+                    kv_cache, k[:, -keep:], v[:, -keep:], slots
+                )
             else:
                 slot = offset % cap
-                ck = jax.lax.dynamic_update_slice(
-                    ck, k.astype(ck.dtype), (0, slot, 0, 0)
-                )
-                cv = jax.lax.dynamic_update_slice(
-                    cv, v.astype(cv.dtype), (0, slot, 0, 0)
-                )
+                kv_cache = kv_write(kv_cache, k, v, slot)
                 # slot s holds the newest position congruent to s (mod
                 # cap); never-written slots derive negative positions
                 key_pos = offset - ((offset - jnp.arange(cap)) % cap)
@@ -355,11 +436,12 @@ class Attention(nn.Module):
                     & (key_pos[None, :] > offset - cfg.attn_window)
                     & (key_pos[None, :] >= 0)
                 )
-                o = dense_attention(q, ck, cv, mask=mask)
-            ck = nn.with_logical_constraint(ck, spec)
-            cv = nn.with_logical_constraint(cv, spec)
+                o = kv_attend(q, kv_cache, mask)
+            kv_cache = kv_map(
+                lambda a: nn.with_logical_constraint(a, spec), kv_cache
+            )
             o = nn.with_logical_constraint(o, spec)
-            new_cache = (ck, cv)
+            new_cache = kv_cache
         elif t > 1 and isinstance(offset, int) and offset == 0:
             # prefill: the cache holds nothing older than these tokens, so
             # attend the fresh K/V directly — causal (+window) over the
@@ -368,51 +450,45 @@ class Attention(nn.Module):
             # O(T^2) (O(T*W) windowed / O(T*block) flash) rather than
             # O(T*capacity): a B=8, T=4096 prefill against an 8K cache
             # would otherwise materialise a 13 GB score tensor and OOM.
-            ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
-            ck = nn.with_logical_constraint(ck, spec)
-            cv = nn.with_logical_constraint(cv, spec)
+            kv_cache = kv_write(kv_cache, k, v, 0)
+            kv_cache = kv_map(
+                lambda a: nn.with_logical_constraint(a, spec), kv_cache
+            )
             core = self.attn_core or partial(
                 dense_attention, causal=True, window=cfg.attn_window
             )
             o = nn.with_logical_constraint(core(q, k, v), spec)
-            new_cache = (ck, cv)
+            new_cache = kv_cache
         else:
-            ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, offset, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, offset, 0, 0))
-            ck = nn.with_logical_constraint(ck, spec)
-            cv = nn.with_logical_constraint(cv, spec)
+            kv_cache = kv_write(kv_cache, k, v, offset)
+            kv_cache = kv_map(
+                lambda a: nn.with_logical_constraint(a, spec), kv_cache
+            )
             # queries at global positions offset+i attend keys <= that
             # position; padded cache slots beyond offset+t are masked out.
             q_pos = (offset + jnp.arange(t))[:, None]
-            span = ck.shape[1]
-            ak, av = ck, cv
+            cap = kv_cache[0].shape[1]
+            span = cap
+            att_cache = kv_cache
             start = 0
-            if cfg.attn_window and cfg.attn_window + t - 1 < ck.shape[1]:
+            if cfg.attn_window and cfg.attn_window + t - 1 < cap:
                 # windowed decode reads an O(window) slice, not the whole
                 # cache: the span (window + t - 1) covers every key any of
                 # the t queries can see, and the positional mask below
                 # handles the clamped warm-up region exactly.
                 span = cfg.attn_window + t - 1
-                start = jnp.clip(
-                    offset + t - span, 0, ck.shape[1] - span
-                )
-                ak = jax.lax.dynamic_slice_in_dim(ck, start, span, axis=1)
-                av = jax.lax.dynamic_slice_in_dim(cv, start, span, axis=1)
+                start = jnp.clip(offset + t - span, 0, cap - span)
+                att_cache = kv_slice(kv_cache, start, span)
             key_pos = start + jnp.arange(span)
             mask = key_pos[None, :] <= q_pos  # (T, span)
             if cfg.attn_window:
                 mask &= key_pos[None, :] > q_pos - cfg.attn_window
-            o = dense_attention(q, ak, av, mask=mask)
+            o = kv_attend(q, att_cache, mask)
             o = nn.with_logical_constraint(o, spec)
-            new_cache = (ck, cv)
-        out = nn.Dense(
+            new_cache = kv_cache
+        out = QDense(
             cfg.d_model,
-            use_bias=False,
             dtype=cfg.dtype,
-            param_dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("heads", "embed")
             ),
@@ -428,11 +504,9 @@ class Mlp(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = nn.Dense(
+        h = QDense(
             cfg.d_ff,
-            use_bias=False,
             dtype=cfg.dtype,
-            param_dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", "mlp")
             ),
@@ -441,11 +515,9 @@ class Mlp(nn.Module):
         h = nn.with_logical_constraint(
             nn.gelu(h), ("batch", "act_seq", "act_mlp")
         )
-        out = nn.Dense(
+        out = QDense(
             cfg.d_model,
-            use_bias=False,
             dtype=cfg.dtype,
-            param_dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("mlp", "embed")
             ),
@@ -642,6 +714,84 @@ def _combine_gather_bwd(res, g):
 _combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
 
 
+def _expert_axis_size() -> int:
+    """Size of the ``expert`` mesh axis in the ambient (abstract) mesh —
+    1 when tracing without a mesh context (plain CPU tests, decode on a
+    single device), which routes MoE to the GSPMD dispatch."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return 1
+    if mesh is None or getattr(mesh, "empty", False):
+        return 1
+    return dict(mesh.shape).get("expert", 1)
+
+
+def _ep_alltoall_moe(x, gates, wi, wo, *, top_k, capacity, ep, dt):
+    """Manual expert-parallel MoE FFN: the GShard/Switch production path.
+
+    A partial-manual ``shard_map`` over the ``expert`` mesh axis (the same
+    construction as the pipeline's manual-over-``pipe`` region,
+    ``parallel/lm_pipeline.py``; ``data``/``seq``/``model`` stay under
+    GSPMD).  Each expert shard, holding ``B/ep`` token rows and ``E/ep``
+    experts:
+
+    1. routes its local tokens with the sort dispatch (argsort + gather,
+       custom-VJP — identical slot assignment to the einsum path),
+    2. ``lax.all_to_all``s the (ep, B_loc, E_loc*C, D) capacity slots so
+       every slot lands on its expert's shard — ONE fused exchange where
+       the GSPMD path's resharding may lower to all-gather+slice,
+    3. runs the local experts' FFN with the source-shard dim as an extra
+       einsum batch axis (no resharding of the received block), and
+    4. reverses the exchange and combines locally (weighted gather).
+
+    ``frac``/``kept`` routing stats are pmean'd over the axis, so the aux
+    loss and router metrics match the GSPMD path exactly (parity:
+    tests/test_transformer.py).  x: (B, S, D) batch-sharded over
+    (data, expert); gates (B, S, E) f32; wi/wo (E, D, F)/(E, F, D)
+    expert-sharded.  Returns (y, frac, kept).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    e = gates.shape[-1]
+    e_loc = e // ep
+
+    def body(x_l, gates_l, wi_l, wo_l):
+        bl, _, d = x_l.shape
+        (slot_token, slot_valid, slot_choice, choice_slot, choice_keep,
+         choice_weight, frac, kept) = _sort_dispatch(gates_l, top_k, capacity)
+        xe = _dispatch_gather(
+            x_l, slot_token, slot_valid, choice_slot, choice_keep
+        )  # (B_loc, E*C, D), expert-major slots
+        send = xe.reshape(bl, ep, e_loc * capacity, d).transpose(1, 0, 2, 3)
+        recv = jax.lax.all_to_all(send, "expert", 0, 0, tiled=True)
+        # recv[j] = shard j's slots for MY experts -> (E_loc, ep, B_loc, C, D)
+        he = recv.reshape(ep, bl, e_loc, capacity, d).transpose(2, 0, 1, 3, 4)
+        h = nn.gelu(jnp.einsum("eabcd,edf->eabcf", he, wi_l.astype(dt)))
+        ye = jnp.einsum("eabcf,efd->eabcd", h, wo_l.astype(dt))
+        back = ye.transpose(1, 2, 0, 3, 4).reshape(ep, bl, e_loc * capacity, d)
+        ret = jax.lax.all_to_all(back, "expert", 0, 0, tiled=True)
+        # ret[j] = my tokens' results from shard j's experts -> global
+        # expert-major slot order again
+        ye_flat = ret.transpose(1, 0, 2, 3).reshape(bl, e * capacity, d)
+        yc = _combine_gather(ye_flat, choice_slot, slot_choice, slot_valid)
+        y = (yc * choice_weight[..., None].astype(dt)).sum(axis=1)
+        return (
+            y,
+            jax.lax.pmean(frac, "expert"),
+            jax.lax.pmean(kept, "expert"),
+        )
+
+    sm = jax.shard_map(
+        body,
+        in_specs=(P("expert"), P("expert"), P("expert"), P("expert")),
+        out_specs=(P("expert"), P(), P()),
+        axis_names={"expert"},
+        check_vma=False,
+    )
+    return sm(x, gates, wi, wo)
+
+
 class MoeMlp(nn.Module):
     """Top-k mixture-of-experts MLP with expert parallelism.
 
@@ -683,7 +833,56 @@ class MoeMlp(nn.Module):
             name="router",
         )(x.astype(jnp.float32))
         gates = jax.nn.softmax(router_logits, axis=-1)  # (B, S, E)
-        if dispatch_impl == "sort":
+
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                ("expert", "embed", "mlp"),
+            ),
+            (e, d, cfg.d_ff),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(batch_axis=(0,)),
+                ("expert", "mlp", "embed"),
+            ),
+            (e, cfg.d_ff, d),
+            jnp.float32,
+        )
+        dt = cfg.dtype
+
+        # manual expert-parallel exchange (moe_ep='alltoall', or 'auto'
+        # with an expert mesh axis): per-shard sort dispatch + explicit
+        # lax.all_to_all of the capacity slots; int8 expert banks stay on
+        # the GSPMD path (the scales would have to thread the manual
+        # region, and int8 serving meshes are expert=1)
+        ep = _expert_axis_size() if cfg.moe_ep != "gspmd" else 1
+        use_a2a = (
+            ep > 1
+            and e % ep == 0
+            and not self.has_variable("params", "wi_scale")
+        )
+        if cfg.moe_ep == "alltoall" and not use_a2a:
+            # explicit request unfulfillable at this trace: no expert mesh
+            # axis visible (single-device decode/eval of an alltoall-
+            # trained config is legitimate — warn, don't break it)
+            import warnings
+
+            warnings.warn(
+                "moe_ep='alltoall' requested but no usable expert mesh "
+                f"axis is visible at trace time (expert axis size {ep}, "
+                f"E={e}); falling back to the GSPMD dispatch",
+                stacklevel=2,
+            )
+        if use_a2a:
+            y, frac, kept = _ep_alltoall_moe(
+                x.astype(dt), gates, wi, wo,
+                top_k=cfg.expert_top_k, capacity=capacity, ep=ep, dt=dt,
+            )
+        elif dispatch_impl == "sort":
             (slot_token, slot_valid, slot_choice, choice_slot, choice_keep,
              choice_weight, frac, kept) = _sort_dispatch(
                 gates, cfg.expert_top_k, capacity
@@ -709,58 +908,50 @@ class MoeMlp(nn.Module):
         load = frac / jnp.maximum(frac.sum(), 1e-9)
         self.sow("intermediates", "moe_expert_load", load)
 
-        wi = self.param(
-            "wi",
-            nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(batch_axis=(0,)),
-                ("expert", "embed", "mlp"),
-            ),
-            (e, d, cfg.d_ff),
-            jnp.float32,
-        )
-        wo = self.param(
-            "wo",
-            nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(batch_axis=(0,)),
-                ("expert", "mlp", "embed"),
-            ),
-            (e, cfg.d_ff, d),
-            jnp.float32,
-        )
-        dt = cfg.dtype
-        if dispatch_impl == "sort":
-            # dispatch = batch-local permutation gather of each slot's
-            # source token (custom-VJP: backward is gathers too), then the
-            # same expert-sharded layout as the einsum path so the
-            # act_expert constraint induces the identical all-to-all
-            # under EP
-            xe = _dispatch_gather(
-                x.astype(dt), slot_token, slot_valid, choice_slot,
-                choice_keep,
-            )  # (B, E*C, D)
-            xe = xe.reshape(b, e, capacity, d).transpose(1, 0, 2, 3)
-        else:
-            xe = jnp.einsum(
-                "bsec,bsd->ebcd", dispatch.astype(dt), x.astype(dt)
+        if not use_a2a:
+            if dispatch_impl == "sort":
+                # dispatch = batch-local permutation gather of each slot's
+                # source token (custom-VJP: backward is gathers too), then
+                # the same expert-sharded layout as the einsum path so the
+                # act_expert constraint induces the identical all-to-all
+                # under EP
+                xe = _dispatch_gather(
+                    x.astype(dt), slot_token, slot_valid, choice_slot,
+                    choice_keep,
+                )  # (B, E*C, D)
+                xe = xe.reshape(b, e, capacity, d).transpose(1, 0, 2, 3)
+            else:
+                xe = jnp.einsum(
+                    "bsec,bsd->ebcd", dispatch.astype(dt), x.astype(dt)
+                )
+            xe = nn.with_logical_constraint(
+                xe, ("act_expert", "moe_batch", None, "act_embed")
             )
-        xe = nn.with_logical_constraint(
-            xe, ("act_expert", "batch", None, "act_embed")
-        )
-        h = nn.gelu(jnp.einsum("ebcd,edf->ebcf", xe, wi.astype(dt)))
-        h = nn.with_logical_constraint(h, ("act_expert", "batch", None, "act_mlp"))
-        ye = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(dt))
-        ye = nn.with_logical_constraint(
-            ye, ("act_expert", "batch", None, "act_embed")
-        )
-        if dispatch_impl == "sort":
-            # combine = gather each token-choice's slot output, weight by
-            # the renormalised gate, sum over the K choices
-            ye_flat = ye.transpose(1, 0, 2, 3).reshape(b, e * capacity, d)
-            yc = _combine_gather(ye_flat, choice_slot, slot_choice,
-                                 slot_valid)
-            y = (yc * choice_weight[..., None].astype(dt)).sum(axis=1)
-        else:
-            y = jnp.einsum("bsec,ebcd->bsd", combine.astype(dt), ye)
+            # weight-only int8 expert banks (ops.quant.quantize_lm_params):
+            # per-(expert, out-channel) scales dequant the einsum outputs
+            h = jnp.einsum("ebcd,edf->ebcf", xe, wi.astype(dt))
+            if self.has_variable("params", "wi_scale"):
+                # (E, 1, F) -> (E, 1, 1, F) against (E, B, C, F)
+                h = h * self.get_variable("params", "wi_scale")[:, None].astype(dt)
+            h = nn.gelu(h)
+            h = nn.with_logical_constraint(
+                h, ("act_expert", "moe_batch", None, "act_mlp")
+            )
+            ye = jnp.einsum("ebcf,efd->ebcd", h, wo.astype(dt))
+            if self.has_variable("params", "wo_scale"):
+                ye = ye * self.get_variable("params", "wo_scale")[:, None].astype(dt)
+            ye = nn.with_logical_constraint(
+                ye, ("act_expert", "moe_batch", None, "act_embed")
+            )
+            if dispatch_impl == "sort":
+                # combine = gather each token-choice's slot output, weight
+                # by the renormalised gate, sum over the K choices
+                ye_flat = ye.transpose(1, 0, 2, 3).reshape(b, e * capacity, d)
+                yc = _combine_gather(ye_flat, choice_slot, slot_choice,
+                                     slot_valid)
+                y = (yc * choice_weight[..., None].astype(dt)).sum(axis=1)
+            else:
+                y = jnp.einsum("bsec,ebcd->bsd", combine.astype(dt), ye)
         if n_groups > 1:
             y = y.reshape(b0, s0, d)
         y = nn.with_logical_constraint(y, ("batch", "act_seq", "act_embed"))
@@ -860,6 +1051,16 @@ class LMHead(nn.Module):
             (self.cfg.vocab_size, self.cfg.d_model),
             jnp.float32,
         )
+        if self.has_variable("params", "scale"):
+            # weight-only int8 head (ops.quant.quantize_lm_params): int8
+            # kernel streamed at the activation dtype, then the
+            # per-vocab-row scale (V, 1) dequants the matmul output
+            return (
+                jnp.einsum("...d,vd->...v", x, kernel.astype(x.dtype))
+                * self.get_variable("params", "scale")[:, 0]
+            )
+        # f32 kernel: let the einsum promote (bf16 x, f32 kernel) -> f32
+        # logits — casting the kernel down would round the loss edge
         return jnp.einsum("...d,vd->...v", x, kernel)
 
 

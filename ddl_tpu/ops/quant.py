@@ -1,0 +1,267 @@
+"""Int8 quantization for the HBM-bound decode path: KV cache + weights.
+
+The serving measurements (PERF.md, round 4) showed autoregressive decode is
+HBM-bandwidth-bound at every batch size on one chip: weight streaming
+dominates at B=1, KV-cache reads at the B≈32-64 knee.  The reference has no
+inference quantization at all (its only inference surface is a loss-less
+eval pipeline, ``pp.py:146-150``); for a TPU serving path the single
+largest traffic lever is storing those bytes at half width:
+
+* **KV cache** (``QuantKV``): K/V stored int8 with a per-(token, head)
+  float32 absmax scale over ``head_dim``.  Attention never materialises a
+  dequantized cache — the int8 tensors feed the score/output einsums
+  directly (XLA fuses the int8→bf16 convert into the dot read) and the
+  scalar scales fold into the *small* tensors instead: key scales multiply
+  the (B, H, Tq, L) scores, value scales multiply the softmax probs.  HBM
+  traffic per step is the int8 bytes + L/head_dim scale floats (~+6%),
+  i.e. ~0.53x the bf16 cache read.
+
+* **Weights** (``quantize_lm_params``): per-output-channel symmetric int8
+  for every matmul kernel (attention q/k/v/out, MLP wi/wo, MoE expert
+  wi/wo, lm_head).  The quantized tree keeps the same structure/names with
+  an extra ``scale`` leaf next to each int8 ``kernel``; the model's matmul
+  modules (``models/transformer.QDense`` and friends) sniff the scale and
+  compute ``(x @ W8) * s`` — mathematically the per-channel dequant, with
+  the convert again fused into the matmul operand read.  Router, norms and
+  the embedding table (gather — reads only B rows/step) stay exact.
+
+Quantization is symmetric absmax (no zero point): ``s = amax/127``,
+``q = round(x/s)``.  Per-channel/per-token granularity bounds the relative
+error at ~0.4% RMS, which the parity tests (tests/test_quant.py) pin both
+element-wise and end-to-end (greedy-token agreement through the full
+generator).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "QuantKV",
+    "quantize_q8",
+    "dequantize_q8",
+    "quant_dense_attention",
+    "kv_write",
+    "kv_set_slots",
+    "kv_slice",
+    "kv_attend",
+    "kv_map",
+    "head_kernel",
+    "quantize_lm_params",
+]
+
+
+def quantize_q8(x, axis: int = -1):
+    """Symmetric absmax int8: returns ``(q int8, scale f32)`` with
+    ``scale`` keepdims along ``axis`` so ``q * scale ≈ x``."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_q8(q, scale, dtype=jnp.float32):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+class QuantKV(NamedTuple):
+    """Int8 KV-cache leaf set for one layer (a pytree, so it flows through
+    ``lax.scan`` carries and ``jit`` like the plain ``(k, v)`` tuple).
+
+    kq/vq: (B, L, Hkv, Dh) int8; ks/vs: (B, L, Hkv, 1) f32 per-(token,
+    head) scales."""
+
+    kq: jax.Array
+    ks: jax.Array
+    vq: jax.Array
+    vs: jax.Array
+
+
+def kv_map(fn, cache):
+    """Apply ``fn`` to every array leaf of a cache (bf16 tuple or QuantKV),
+    preserving the container type — used for sharding constraints."""
+    if isinstance(cache, QuantKV):
+        return QuantKV(*(fn(a) for a in cache))
+    return tuple(fn(a) for a in cache)
+
+
+def kv_write(cache, k, v, offset):
+    """Write new ``(B, t, Hkv, Dh)`` k/v at sequence position ``offset``
+    (``lax.dynamic_update_slice`` — in-place on TPU), quantizing on the
+    way in when the cache is a ``QuantKV``."""
+    if isinstance(cache, QuantKV):
+        kq, ks = quantize_q8(k)
+        vq, vs = quantize_q8(v)
+        at = (0, offset, 0, 0)
+        return QuantKV(
+            lax.dynamic_update_slice(cache.kq, kq, at),
+            lax.dynamic_update_slice(cache.ks, ks.astype(cache.ks.dtype), at),
+            lax.dynamic_update_slice(cache.vq, vq, at),
+            lax.dynamic_update_slice(cache.vs, vs.astype(cache.vs.dtype), at),
+        )
+    ck, cv = cache
+    at = (0, offset, 0, 0)
+    return (
+        lax.dynamic_update_slice(ck, k.astype(ck.dtype), at),
+        lax.dynamic_update_slice(cv, v.astype(cv.dtype), at),
+    )
+
+
+def kv_set_slots(cache, k, v, slots):
+    """Scatter k/v rows into (possibly non-contiguous) ring ``slots`` along
+    the sequence axis — the rolling cache's prefill write."""
+    if isinstance(cache, QuantKV):
+        kq, ks = quantize_q8(k)
+        vq, vs = quantize_q8(v)
+        return QuantKV(
+            cache.kq.at[:, slots].set(kq),
+            cache.ks.at[:, slots].set(ks.astype(cache.ks.dtype)),
+            cache.vq.at[:, slots].set(vq),
+            cache.vs.at[:, slots].set(vs.astype(cache.vs.dtype)),
+        )
+    ck, cv = cache
+    return (
+        ck.at[:, slots].set(k.astype(ck.dtype)),
+        cv.at[:, slots].set(v.astype(cv.dtype)),
+    )
+
+
+def kv_slice(cache, start, span: int):
+    """O(span) view of the cache along the sequence axis (windowed decode
+    reads a window-sized slice, not the whole allocation)."""
+    sl = lambda a: lax.dynamic_slice_in_dim(a, start, span, axis=1)
+    return kv_map(sl, cache)
+
+
+def kv_attend(q, cache, mask):
+    """Cached decode attention over a bf16 tuple or QuantKV cache.
+    q: (B, Tq, H, Dh); mask: (Tq, L) bool (True = attend)."""
+    if isinstance(cache, QuantKV):
+        return quant_dense_attention(q, *cache, mask=mask)
+    from ddl_tpu.ops.attention import dense_attention
+
+    return dense_attention(q, cache[0], cache[1], mask=mask)
+
+
+def quant_dense_attention(q, kq, ks, vq, vs, mask):
+    """Softmax attention reading an int8 K/V cache without dequantizing it.
+
+    q: (B, Tq, H, D); kq/vq: (B, L, Hkv, D) int8; ks/vs: (B, L, Hkv, 1).
+    Because each key/value row has ONE scale, ``q·(kq*s) = (q·kq)*s`` — the
+    key scales multiply the (B, Hkv, G, Tq, L) scores and the value scales
+    fold into the softmax probs, so the only full-size int8 operands feed
+    the einsums directly (convert-into-dot fuses on TPU) and the f32
+    corrections touch only score-sized tensors.  Grouped-query native:
+    ``Hkv < H`` groups by query reshape, K/V never broadcast to H heads.
+    """
+    b, tq, h, d = q.shape
+    hkv = kq.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} must divide by kv heads {hkv}")
+    g = h // hkv
+    qg = q.reshape(b, tq, hkv, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kq.astype(q.dtype))
+    # per-key scale -> (B, Hkv, 1, 1, L); rsqrt(d) folded into the same mul
+    ksb = ks.reshape(b, -1, hkv).transpose(0, 2, 1)[:, :, None, None, :]
+    scores = scores.astype(jnp.float32) * (
+        ksb / jnp.sqrt(jnp.float32(d))
+    )
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    vsb = vs.reshape(b, -1, hkv).transpose(0, 2, 1)[:, :, None, None, :]
+    pv = (probs * vsb).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", pv, vq.astype(q.dtype))
+    return out.reshape(b, tq, h, d)
+
+
+def head_kernel(lm_head_params):
+    """The lm_head kernel ready for a loss-edge einsum: dequantized back to
+    f32 when the tree is weight-only int8.  The chunked/vocab-streamed CE
+    paths (train/lm_steps.chunked_ce_loss, the pipeline loss) read the
+    kernel directly — bypassing ``LMHead``'s scale sniffing — so they must
+    go through this accessor or an int8 tree would silently drop the
+    per-vocab-row scales."""
+    k = lm_head_params["kernel"]
+    if "scale" in lm_head_params:
+        return dequantize_q8(k, lm_head_params["scale"])
+    return k
+
+
+# --- weight-only int8 ---------------------------------------------------
+
+# param names quantized per-output-channel: 2-D (in, out) matmul kernels
+_DENSE_KERNELS = ("kernel",)
+# MoE expert banks: (E, in, out) — scale per (expert, out-channel)
+_EXPERT_KERNELS = ("wi", "wo")
+_SKIP_MODULES = ("router",)  # f32 routing stays exact
+
+
+def quantize_lm_params(params):
+    """Weight-only int8 transform of an LM/ViT param tree for decode.
+
+    Returns a tree with the SAME structure and names, where every matmul
+    kernel is int8 with a sibling ``scale`` leaf:
+
+    * ``kernel`` (in, out) → int8 + ``scale`` (1, out)  [per out-channel]
+    * ``lm_head/kernel`` (V, D) → int8 + ``scale`` (V, 1) [per vocab row —
+      the head kernel is stored embedding-orientation, models/transformer
+      LMHead]
+    * MoE ``wi``/``wo`` (E, in, out) → int8 + ``wi_scale``/``wo_scale``
+      (E, 1, out)
+
+    Norm scales, the router, biases and the embedding table pass through
+    unchanged (the embedding is a gather — B rows/step, not a streaming
+    read).  The quantized tree applies through the standard modules
+    (``QDense``/``LMHead``/``MoeMlp`` sniff the scale leaves) in the
+    decode graph and the dense-CE teacher-forced eval graph; the chunked
+    CE paths read the head kernel via ``head_kernel`` (which dequants).
+
+    Boxed trees (fresh ``model.init`` output carrying ``nn.Partitioned``
+    metadata) are unboxed first; the function raises if it finds no
+    matmul kernel to quantize (a silent no-op would serve full-width
+    weights while reporting int8).
+    """
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+    n_quantized = 0
+
+    def walk(node, name):
+        nonlocal n_quantized
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, val in node.items():
+            if isinstance(val, dict):
+                out[key] = walk(val, key)
+            elif (
+                key in _DENSE_KERNELS
+                and getattr(val, "ndim", 0) == 2
+                and name not in _SKIP_MODULES
+            ):
+                axis = 1 if name == "lm_head" else 0
+                q, s = quantize_q8(val, axis=axis)
+                out[key] = q
+                out["scale"] = s
+                n_quantized += 1
+            elif key in _EXPERT_KERNELS and getattr(val, "ndim", 0) == 3:
+                q, s = quantize_q8(val, axis=1)
+                out[key] = q
+                out[f"{key}_scale"] = s
+                n_quantized += 1
+            else:
+                out[key] = val
+        return out
+
+    qparams = walk(params, "")
+    if not n_quantized:
+        raise ValueError(
+            "quantize_lm_params found no matmul kernel to quantize — "
+            "not an LM/ViT param tree?"
+        )
+    return qparams

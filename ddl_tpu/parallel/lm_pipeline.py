@@ -76,6 +76,7 @@ from ddl_tpu.models.transformer import (
     remat_block,
 )
 from ddl_tpu.ops.losses import onehot_cross_entropy_mean
+from ddl_tpu.ops.quant import head_kernel
 from ddl_tpu.parallel.buffers import masked_slice_update, masked_slot_update
 from ddl_tpu.parallel.sharding import (
     PIPE_AXIS,
@@ -989,8 +990,11 @@ def make_lm_pipeline_step_fns(
     if batch % M:
         raise ValueError(f"batch {batch} % microbatches {M} != 0")
     mb = batch // M
-    if mb % spec.data:
-        raise ValueError(f"microbatch {mb} % mesh data={spec.data} != 0")
+    if mb % (spec.data * spec.expert):
+        raise ValueError(
+            f"microbatch {mb} must divide by mesh data*expert="
+            f"{spec.data * spec.expert} (batch shards over both)"
+        )
     if seq_len % spec.seq:
         raise ValueError(f"seq_len {seq_len} % mesh seq={spec.seq} != 0")
     if cfg.num_experts and cfg.num_experts % spec.expert:
@@ -1012,7 +1016,11 @@ def make_lm_pipeline_step_fns(
     # the same construction as the non-pipelined path's manual attention,
     # minus ``pipe`` (already manual in the enclosing region).
     seq_spec = P(None, "seq")
-    manual_spec = P("data", "seq", "model", None)
+    # batch over data AND expert (the 'batch' logical rule): the fully-
+    # manual flash regions must make 'expert' manual too, or XLA would
+    # have to auto-partition the Pallas call over the residual expert
+    # sharding (which GSPMD cannot do)
+    manual_spec = P(("data", "expert"), "seq", "model", None)
     if cfg.flash:
         from functools import partial
 
@@ -1031,7 +1039,7 @@ def make_lm_pipeline_step_fns(
                 ),
                 in_specs=(manual_spec,) * 3 + (P("seq"),),
                 out_specs=manual_spec,
-                axis_names={"data", "seq", "model"},
+                axis_names={"data", "seq", "model", "expert"},
                 check_vma=False,
             )
 
@@ -1066,7 +1074,7 @@ def make_lm_pipeline_step_fns(
                 inner,
                 in_specs=(manual_spec,) * 3,
                 out_specs=manual_spec,
-                axis_names={"data", "seq", "model"},
+                axis_names={"data", "seq", "model", "expert"},
                 check_vma=False,
             )
     elif cfg.attn_impl == "ring":
@@ -1127,7 +1135,7 @@ def make_lm_pipeline_step_fns(
         else None
     )
 
-    mb_spec = NamedSharding(mesh, P(None, "data", "seq"))
+    mb_spec = NamedSharding(mesh, P(None, ("data", "expert"), "seq"))
 
     def blocks_of(params):
         return unwrap_blocks(params["blocks"])
@@ -1202,7 +1210,7 @@ def make_lm_pipeline_step_fns(
             hidden, aux = forward(params, inputs, step, return_hidden=True)
             with nn.logical_axis_rules(rules):
                 return chunked_ce_loss(
-                    cfg, hidden, params["head"]["lm_head"]["kernel"],
+                    cfg, hidden, head_kernel(params["head"]["lm_head"]),
                     targets, aux, with_accuracy=step is None,
                 )
         logits, aux = forward(params, inputs, step)
@@ -1226,7 +1234,7 @@ def make_lm_pipeline_step_fns(
                     hidden = _HeadNorm(cfg).apply({"params": head_p}, y)
                     ce, _ = fused_chunked_ce(
                         hidden,
-                        head_p["lm_head"]["kernel"],
+                        head_kernel(head_p["lm_head"]),
                         tgt,
                         cfg.ce_chunk,
                         use_onehot=True,
@@ -1265,7 +1273,7 @@ def make_lm_pipeline_step_fns(
                 )
                 tgt_mb = lax.with_sharding_constraint(
                     targets.reshape(M, mb, seq_len),
-                    NamedSharding(mesh, P(None, "data", "seq")),
+                    NamedSharding(mesh, P(None, ("data", "expert"), "seq")),
                 )
                 key_args = (
                     (dropout_step_key(rng, step),) if use_dropout else ()

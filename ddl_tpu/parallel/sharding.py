@@ -198,8 +198,19 @@ def lm_logical_rules(fsdp: bool = False) -> tuple[tuple[str, str | None], ...]:
     SURVEY.md §2.3).
     """
     return (
-        # activations
-        ("batch", "data"),
+        # activations.  ``batch`` shards over data AND expert: outside the
+        # MoE layers the expert axis acts as extra data parallelism —
+        # without it every non-MoE op (attention, norms, the loss edge)
+        # would run REPLICATED on each expert shard, an ep-fold compute
+        # duplication.  Inside ``MoeMlp`` the dispatch resharding batch
+        # (data, expert) -> expert-sharded slots is the GShard all-to-all
+        # (GSPMD inserts it; ``moe_ep='alltoall'`` issues it manually).
+        ("batch", ("data", EXPERT_AXIS)),
+        # batch sharded over data only — the expert-sharded dispatch
+        # tensors inside the MoE layer use this for their token dim (the
+        # expert axis already shards their expert dim; one mesh axis
+        # cannot shard two dims of the same array)
+        ("moe_batch", "data"),
         ("act_seq", SEQ_AXIS),
         ("act_embed", None),
         ("act_heads", MODEL_AXIS),

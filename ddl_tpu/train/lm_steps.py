@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddl_tpu.models.transformer import LMConfig, TransformerLM
 from ddl_tpu.ops.flash_attention import flash_attention
+from ddl_tpu.ops.quant import head_kernel
 from ddl_tpu.parallel.ring_attention import make_ring_self_attention
 from ddl_tpu.parallel.sharding import (
     FLASH_AUTO_MIN_T,  # noqa: F401  (re-exported: measured dispatch bound)
@@ -82,7 +83,7 @@ def make_ring_core(
     return make_ring_self_attention(
         mesh,
         causal=causal,
-        spec=P("data", "seq", "model", None),
+        spec=P(("data", "expert"), "seq", "model", None),
         jit=False,
         use_flash=use_flash,
         window=window,
@@ -240,7 +241,7 @@ def finalize_step_fns(
     lowers to bare-PartitionSpec sharding constraints, which resolve against
     the ambient mesh at trace time.
     """
-    tok_sharding = NamedSharding(mesh, P("data", "seq"))
+    tok_sharding = NamedSharding(mesh, P(("data", "expert"), "seq"))
     replicated = NamedSharding(mesh, P())
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
@@ -256,7 +257,7 @@ def finalize_step_fns(
         else:
             k = accum_steps
             b = inputs.shape[0]
-            chunk_sh = NamedSharding(mesh, P(None, "data", "seq"))
+            chunk_sh = NamedSharding(mesh, P(None, ("data", "expert"), "seq"))
             inp_c = jax.lax.with_sharding_constraint(
                 inputs.reshape(k, b // k, *inputs.shape[1:]), chunk_sh
             )
@@ -400,10 +401,11 @@ def make_lm_step_fns(
             raise ValueError(
                 f"batch {batch} % accum_steps {accum_steps} != 0"
             )
-        if (batch // accum_steps) % spec.data:
+        if (batch // accum_steps) % (spec.data * spec.expert):
             raise ValueError(
                 f"accumulation chunk {batch // accum_steps} must divide by "
-                f"mesh data={spec.data}"
+                f"mesh data*expert={spec.data * spec.expert} (batch shards "
+                "over both)"
             )
     if cfg.attn_impl not in ("dense", "ring", "ulysses"):
         raise ValueError(
@@ -416,8 +418,12 @@ def make_lm_step_fns(
             "the XLA dense attention path; the ring/Ulysses/flash cores "
             "are built causal"
         )
-    if batch % spec.data:
-        raise ValueError(f"batch {batch} must divide by mesh data={spec.data}")
+    if batch % (spec.data * spec.expert):
+        raise ValueError(
+            f"batch {batch} must divide by mesh data*expert="
+            f"{spec.data * spec.expert} (batch shards over both axes — "
+            "outside MoE layers the expert axis is extra data parallelism)"
+        )
     if seq_len % spec.seq:
         raise ValueError(f"seq_len {seq_len} must divide by mesh seq={spec.seq}")
     uses_manual_core = cfg.attn_impl in ("ring", "ulysses") or cfg.flash
@@ -447,7 +453,10 @@ def make_lm_step_fns(
         )
     mesh = build_lm_mesh(spec, devices)
     rules = lm_logical_rules(cfg.fsdp)
-    manual_spec = P("data", "seq", "model", None)
+    # batch over data AND expert — the same placement as the 'batch'
+    # logical rule, so the manual attention cores see the local batch
+    # shard instead of forcing an ep-fold replication at their boundary
+    manual_spec = P(("data", "expert"), "seq", "model", None)
     if cfg.attn_impl == "ring":
         attn_core = make_ring_core(
             mesh, use_flash=bool(cfg.flash), window=cfg.attn_window
@@ -522,7 +531,7 @@ def make_lm_step_fns(
                 else:
                     hidden, aux = out
                 loss, (none, metrics) = chunked_ce_loss(
-                    cfg, hidden, params["lm_head"]["kernel"], targets, aux,
+                    cfg, hidden, head_kernel(params["lm_head"]), targets, aux,
                     with_accuracy=step is None,
                 )
                 return loss, (none, dict(metrics, **router))

@@ -92,15 +92,9 @@ class LMTrainer(BaseTrainer):
     ) -> None:
         self.cfg, self.spec, self.run = cfg, spec, run
         self.job_id = run.job_id
-        self.fns = make_lm_step_fns(
-            cfg, spec, tx, rng if rng is not None else jax.random.key(0),
-            run.batch, run.seq_len,
-            num_microbatches=run.num_microbatches,
-            accum_steps=run.accum_steps,
-            pipeline_schedule=run.pipeline_schedule,
-            virtual_stages=run.virtual_stages,
-        )
+        self._rng = rng if rng is not None else jax.random.key(0)
         self.tx = tx
+        self.fns = self._make_fns(cfg)
 
         # periods end at the union of the cadences' multiples, so each
         # cadence fires exactly at its own multiples (log 10 / eval 4 ->
@@ -154,6 +148,59 @@ class LMTrainer(BaseTrainer):
         self.periods_run = bisect.bisect_right(
             self._boundaries, self._start_step
         )
+
+    def _make_fns(self, cfg: LMConfig):
+        run = self.run
+        return make_lm_step_fns(
+            cfg, self.spec, self.tx, self._rng, run.batch, run.seq_len,
+            num_microbatches=run.num_microbatches,
+            accum_steps=run.accum_steps,
+            pipeline_schedule=run.pipeline_schedule,
+            virtual_stages=run.virtual_stages,
+        )
+
+    def _maybe_anneal_capacity(self, m: dict) -> None:
+        """Post-warm-up MoE capacity anneal, keyed off the LIVE router
+        drop fraction: once ``moe_drop_frac`` falls under
+        ``cfg.capacity_anneal_drop`` the warm-up headroom
+        (``capacity_factor``) is pure overhead — drop to
+        ``capacity_factor_min`` and rebuild the step functions (one
+        recompile; params/optimizer state are capacity-independent, so
+        the train state carries over untouched).  See LMConfig's
+        capacity_factor_min docs for the measured warm-up/steady-state
+        numbers."""
+        cfg = self.cfg
+        if not cfg.num_experts:
+            return
+        target = min(cfg.capacity_factor_min, cfg.capacity_factor)
+        if cfg.capacity_factor <= target:
+            return
+        step = int(self.state.step)
+        drop = m.get("moe_drop_frac")
+        by_metric = drop is not None and drop <= cfg.capacity_anneal_drop
+        # step-count fallback: the pipeline path doesn't surface the live
+        # drop metric (router stats sown inside the manual pipe region)
+        by_step = (
+            cfg.capacity_anneal_step and step >= cfg.capacity_anneal_step
+        )
+        if not (by_metric or by_step):
+            return
+        reason = (
+            f"router drop_frac {drop:.4f} <= {cfg.capacity_anneal_drop}"
+            if by_metric
+            else f"step {step} >= capacity_anneal_step "
+                 f"{cfg.capacity_anneal_step}"
+        )
+        import dataclasses as _dc
+
+        self.cfg = _dc.replace(cfg, capacity_factor=target)
+        self.fns = self._make_fns(self.cfg)
+        if self.is_logging_process:
+            print(
+                f"step {step:4d} | capacity anneal: {reason} — "
+                f"capacity_factor {cfg.capacity_factor} -> {target} "
+                "(one-time recompile)"
+            )
 
     # ------------------------------------------------------------- data
 
@@ -232,7 +279,9 @@ class LMTrainer(BaseTrainer):
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
 
-                self._gspec = NamedSharding(self.fns.mesh, P("data", "seq"))
+                self._gspec = NamedSharding(
+                    self.fns.mesh, P(("data", "expert"), "seq")
+                )
 
             def sample_batch(step):
                 # pure in step -> a resumed run continues the stream exactly
@@ -343,6 +392,7 @@ class LMTrainer(BaseTrainer):
                 break
         if steps:
             metrics = {k: float(v) for k, v in m.items()}
+            self._maybe_anneal_capacity(metrics)
         return metrics, steps
 
     def log_index(self, period: int) -> int:

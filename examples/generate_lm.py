@@ -53,6 +53,11 @@ def main() -> None:
     ap.add_argument("--top-k", type=int, default=None,
                     help="restrict sampling to the k most likely tokens")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--int8", default="none", choices=["none", "kv", "kv+w"],
+                    help="int8 serving quantization (ops/quant.py): 'kv' "
+                    "stores the KV cache int8 (+per-token scales), 'kv+w' "
+                    "also streams weight-only int8 matmul kernels — the "
+                    "HBM-traffic levers for the bandwidth-bound decode")
     ap.add_argument("--cpu-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -107,6 +112,10 @@ def main() -> None:
     print(f"loaded step {int(state.step)} (saved pipe={saved_pipe} "
           f"virtual={saved_virtual})")
 
+    if args.int8 == "kv+w":
+        from ddl_tpu.ops.quant import quantize_lm_params
+
+        state = state.replace(params=quantize_lm_params(state.params))
     gen = make_lm_generator(
         cfg,
         spec,
@@ -116,6 +125,7 @@ def main() -> None:
         temperature=args.temperature,
         top_k=args.top_k,
         mesh=mesh,
+        kv_quant=args.int8 != "none",
     )
 
     if args.prompt_text is not None:

@@ -137,3 +137,42 @@ def test_lm_period_arithmetic():
         if t.log_due(p)
     }
     assert logged == {10, 20, 30, 40, 47}
+
+
+def test_moe_capacity_anneal(capsys):
+    """The trainer drops capacity_factor to capacity_factor_min once the
+    LIVE moe_drop_frac falls under capacity_anneal_drop — one step-fn
+    rebuild, train state carried over, training continues."""
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_trainer import LMRunConfig, LMTrainer
+
+    base = dict(
+        vocab_size=256, d_model=32, n_layers=1, n_heads=4, head_dim=8,
+        d_ff=64, num_experts=4, expert_top_k=2, moe_group=0,
+        compute_dtype="float32", remat=False, capacity_factor=1.5,
+        capacity_factor_min=1.0,
+    )
+    run = LMRunConfig(batch=4, seq_len=16, steps=6, log_every=2,
+                      log_dir=None, checkpoint_dir=None)
+
+    # threshold 1.0: any measured drop fraction triggers the anneal at the
+    # first period; the remaining periods step the rebuilt cf-1.0 program
+    cfg = LMConfig(**base, capacity_anneal_drop=1.0)
+    t = LMTrainer(cfg, LMMeshSpec(), optax.adam(1e-3), run)
+    step_before = int(t.state.step)
+    t.train()
+    assert t.cfg.capacity_factor == 1.0
+    assert int(t.state.step) == 6 and step_before == 0
+    assert "capacity anneal" in capsys.readouterr().out
+
+    # disabled when the target equals the running capacity
+    cfg = LMConfig(
+        **dict(base, capacity_factor_min=1.5), capacity_anneal_drop=1.0
+    )
+    t = LMTrainer(cfg, LMMeshSpec(), optax.adam(1e-3), run)
+    t.train()
+    assert t.cfg.capacity_factor == 1.5
+    assert "capacity anneal" not in capsys.readouterr().out

@@ -45,13 +45,26 @@ def snapshot_path(checkpoint_dir: str | os.PathLike, job_id: str, epoch: int) ->
     return Path(checkpoint_dir).absolute() / job_id / f"epoch_{epoch}"
 
 
+# Snapshot layout version, written into every new snapshot so future
+# migrations key off an explicit field instead of shape sniffing:
+# 2 = vocab-major lm_head kernel (round 4's layout; see LMHead).
+# Snapshots WITHOUT the field predate the marker — their lm_head
+# orientation is detected by shape (_head_migration_abstract), which is
+# ambiguous only for square heads (vocab == d_model).
+SNAPSHOT_FORMAT = 2
+
+
 def save_snapshot(
     checkpoint_dir: str | os.PathLike, job_id: str, epoch: int, state: Any,
 ) -> Path:
     path = snapshot_path(checkpoint_dir, job_id, epoch)
     path.parent.mkdir(parents=True, exist_ok=True)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, {"state": state, "epoch": epoch}, force=True)
+        ckptr.save(
+            path,
+            {"state": state, "epoch": epoch, "format": SNAPSHOT_FORMAT},
+            force=True,
+        )
     return path
 
 
@@ -69,33 +82,53 @@ def _is_head_kernel_path(key_path) -> bool:
     return any(k == "lm_head" for k in keys) and keys[-1] == "kernel"
 
 
-def _head_migration_abstract(ckptr, path, abstract):
+def _head_migration_abstract(saved, abstract):
     """Detect pre-round-4 snapshots whose lm_head kernel (and its
     param-shaped optimizer moments) were saved (d_model, vocab): round 4
     transposed the stored kernel to vocab-major (``LMHead``, PERF.md).
-    Returns an abstract tree asking Orbax for the SAVED orientation (the
-    loaded arrays are transposed after restore), or None if the snapshot
-    already matches.  Square heads (vocab == d_model, realistically only
-    toy configs) are orientation-ambiguous by shape and restore as-is."""
-    try:
-        saved = ckptr.metadata(path).item_metadata.tree["state"]
-    except Exception:
-        return None
+    ``saved`` is the snapshot's metadata 'state' subtree.  Returns an
+    abstract tree asking Orbax for the SAVED orientation (the loaded
+    arrays are transposed after restore), or None if the snapshot already
+    matches.  Only called for legacy snapshots (no 'format' field —
+    load_snapshot checks first); square heads (vocab == d_model,
+    realistically only toy configs) are orientation-ambiguous by shape
+    and restore as-is, with a warning."""
     saved_shapes = {
         _kp_norm(kp): tuple(leaf.shape)
         for kp, leaf in jax.tree_util.tree_flatten_with_path(saved)[0]
         if hasattr(leaf, "shape")
     }
     hits = 0
+    warned = False
 
     def fix(kp, leaf):
-        nonlocal hits
+        nonlocal hits, warned
         key = _kp_norm(kp)
         if (
             _is_head_kernel_path(kp)
-            and len(leaf.shape) == 2
+            and len(getattr(leaf, "shape", ())) == 2
+            and leaf.shape[0] == leaf.shape[1]
+        ):
             # a square head (vocab == d_model) is orientation-ambiguous by
             # shape: skip migration and restore as-is (pre-shim behavior)
+            # — if the legacy snapshot was in fact d_model-major, the
+            # restored kernel is silently transposed, so be loud about it
+            if not warned:
+                warned = True
+                import warnings
+
+                warnings.warn(
+                    "legacy snapshot (no format field) with a SQUARE "
+                    f"lm_head kernel {leaf.shape}: orientation cannot be "
+                    "inferred from shape; restoring as-is.  If this "
+                    "snapshot predates the vocab-major head layout, the "
+                    "restored kernel is transposed.",
+                    stacklevel=2,
+                )
+            return leaf
+        if (
+            _is_head_kernel_path(kp)
+            and len(getattr(leaf, "shape", ())) == 2
             and leaf.shape[0] != leaf.shape[1]
             and saved_shapes.get(key) == leaf.shape[::-1]
         ):
@@ -139,11 +172,41 @@ def load_snapshot(
     path = snapshot_path(checkpoint_dir, job_id, epoch)
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
     with ocp.StandardCheckpointer() as ckptr:
-        migrated = _head_migration_abstract(ckptr, path, abstract)
+        saved_md = None
+        try:
+            saved_md = ckptr.metadata(path).item_metadata.tree
+        except (OSError, ValueError, KeyError, AttributeError) as e:
+            # metadata is only needed for the format/orientation checks;
+            # restore still works without it — but say so, or a needed
+            # lm_head migration would be skipped with only an opaque
+            # shape-mismatch error later
+            import warnings
+
+            warnings.warn(
+                f"could not read snapshot metadata at {path} ({e!r}); "
+                "restoring without format/orientation checks",
+                stacklevel=2,
+            )
+        # snapshots carrying the explicit format field are vocab-major by
+        # definition — no shape sniffing; legacy ones get the migration
+        # detection (and its square-head ambiguity warning)
+        has_format = isinstance(saved_md, dict) and "format" in saved_md
+        migrated = None
+        if (
+            isinstance(saved_md, dict)
+            and not has_format
+            and "state" in saved_md
+        ):
+            migrated = _head_migration_abstract(saved_md["state"], abstract)
+        skeleton_extra = {"format": 0} if has_format else {}
         if migrated is None:
-            restored = ckptr.restore(path, {"state": abstract, "epoch": 0})
+            restored = ckptr.restore(
+                path, {"state": abstract, "epoch": 0, **skeleton_extra}
+            )
         else:
-            restored = ckptr.restore(path, {"state": migrated, "epoch": 0})
+            restored = ckptr.restore(
+                path, {"state": migrated, "epoch": 0, **skeleton_extra}
+            )
 
             def untranspose(kp, leaf, want):
                 if not hasattr(leaf, "shape") or leaf.shape == getattr(
@@ -157,6 +220,17 @@ def load_snapshot(
             restored["state"] = jax.tree_util.tree_map_with_path(
                 untranspose, restored["state"], abstract
             )
+    saved_format = int(restored.get("format", 0))
+    if saved_format > SNAPSHOT_FORMAT:
+        import warnings
+
+        warnings.warn(
+            f"snapshot at {path} has format {saved_format}, newer than "
+            f"this code's {SNAPSHOT_FORMAT} — it was written by a newer "
+            "version and may use a layout this loader does not know "
+            "about; restored values may be misinterpreted",
+            stacklevel=2,
+        )
     return restored["state"], int(restored["epoch"]) + 1
 
 
@@ -241,7 +315,9 @@ class SnapshotManager:
         self._ckptr.wait_until_finished()
         self._ckptr.save(
             path,
-            args=ocp.args.StandardSave({"state": state, "epoch": epoch}),
+            args=ocp.args.StandardSave(
+                {"state": state, "epoch": epoch, "format": SNAPSHOT_FORMAT}
+            ),
             force=True,
         )
         return path

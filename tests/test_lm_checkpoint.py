@@ -112,15 +112,80 @@ def test_legacy_head_orientation_migrates_on_load(tmp_path):
         for a, b in zip(jax.tree.leaves(legacy), jax.tree.leaves(state))
     )
     assert changed >= 3  # param + two Adam moments
-    save_snapshot(tmp_path, "legacy", 0, legacy)
+    _save_legacy(tmp_path, "legacy", 0, legacy)
 
     restored, epochs = load_snapshot(tmp_path, "legacy", 0, state)
     assert epochs == 1
     for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
-    # non-legacy snapshots take the fast path and still round-trip
+    # non-legacy snapshots take the fast path and still round-trip —
+    # and carry the explicit format field, so no shape sniffing runs
     save_snapshot(tmp_path, "new", 0, state)
+    from ddl_tpu.checkpoint import snapshot_metadata
+
+    assert "format" in snapshot_metadata(tmp_path, "new", 0)
     restored2, _ = load_snapshot(tmp_path, "new", 0, state)
     for a, b in zip(jax.tree.leaves(restored2), jax.tree.leaves(state)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # a snapshot from a NEWER writer (format > ours) restores with a loud
+    # warning instead of silently assuming the current layout
+    import warnings
+
+    import orbax.checkpoint as ocp
+
+    from ddl_tpu.checkpoint import snapshot_path
+
+    fpath = snapshot_path(tmp_path, "future", 0)
+    fpath.parent.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            fpath, {"state": state, "epoch": 0, "format": 99}, force=True
+        )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        load_snapshot(tmp_path, "future", 0, state)
+    assert any("newer than" in str(x.message) for x in w)
+
+
+def _save_legacy(checkpoint_dir, job_id, epoch, state):
+    """Write a pre-round-5 snapshot: the {state, epoch} tree WITHOUT the
+    format field (what save_snapshot produced before the marker)."""
+    import orbax.checkpoint as ocp
+
+    from ddl_tpu.checkpoint import snapshot_path
+
+    path = snapshot_path(checkpoint_dir, job_id, epoch)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"state": state, "epoch": epoch}, force=True)
+
+
+def test_legacy_square_head_warns(tmp_path):
+    """A LEGACY (format-less) snapshot with a square lm_head kernel is
+    orientation-ambiguous by shape: it restores as-is, loudly."""
+    import warnings
+
+    cfg = _cfg()  # d_model == 32; make vocab match for a square head
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, vocab_size=32)
+    fns = make_lm_step_fns(
+        cfg, LMMeshSpec(), optax.adam(1e-3), jax.random.key(0), 4, 16
+    )
+    state = fns.init_state()
+    _save_legacy(tmp_path, "sq", 0, state)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        restored, _ = load_snapshot(tmp_path, "sq", 0, state)
+    assert any("SQUARE lm_head" in str(x.message) for x in w)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the same square head saved with the format field restores silently
+    save_snapshot(tmp_path, "sq_new", 0, state)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        load_snapshot(tmp_path, "sq_new", 0, state)
+    assert not any("SQUARE lm_head" in str(x.message) for x in w)

@@ -135,6 +135,35 @@ def test_alt_block_impl_matches_concat(tiny_model_cfg, alt_impl):
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+def test_packed_bf16_close_to_concat(tiny_model_cfg, monkeypatch):
+    """In bf16 compute the packed block accumulates cross-pack partial
+    sums in bf16 (deliberate: a bf16 partial write is half the HBM
+    traffic; each pack's own contraction still accumulates f32 in the
+    MXU), diverging from the concat form's single f32-accumulated
+    matmul.  Pin the drift: multi-pack bf16 forward within bf16-level
+    tolerance of the concat form."""
+    import dataclasses
+
+    from ddl_tpu.models import densenet as dn
+
+    monkeypatch.setattr(dn, "_PACK", 8)  # force several packs
+    x = jax.random.normal(jax.random.key(2), (2, 16, 16, 3))
+    outs = {}
+    for impl in ("concat", "packed"):
+        cfg = dataclasses.replace(
+            tiny_model_cfg, dense_block_impl=impl, compute_dtype="bfloat16"
+        )
+        stages = build_stages(cfg, num_stages=1)
+        params, bstats = init_stages(stages, jax.random.key(0), image_size=16)
+        logits, _ = forward_stages(stages, params, bstats, x, train=True)
+        outs[impl] = np.asarray(logits, np.float32)
+    # bf16 has ~3 decimal digits; cross-pack reassociation costs at most
+    # a few ulps on top
+    np.testing.assert_allclose(
+        outs["concat"], outs["packed"], atol=0.05, rtol=0.02
+    )
+
+
 def test_packed_multi_pack_and_eval(tiny_model_cfg, monkeypatch):
     """The packed impl with features spanning MULTIPLE lane packs (pack
     width patched to 8 so the tiny config splits/merges/slices across

@@ -101,6 +101,11 @@ def _bench_one(
 
     t_pre, t1, t2 = timed(1), timed(n1), timed(n2)
     ms_per_tok = (t2 - t1) / (n2 - n1) * 1e3
+    if ms_per_tok <= 0:
+        # a host-contention spike in one of the two runs can make the
+        # difference negative; one resample of the pair before reporting
+        t1, t2 = timed(n1), timed(n2)
+        ms_per_tok = (t2 - t1) / (n2 - n1) * 1e3
     kv = cfg.kv_heads
     # windowed rows use the O(window)-memory ring cache (the generator's
     # rolling auto-mode); read the real allocation from init_kv_cache so

@@ -88,7 +88,7 @@ def init_kv_cache(
     cfg: LMConfig, batch: int, max_len: int, dtype=None,
     rolling: bool = False, quant: bool = False,
 ) -> tuple:
-    """Per-layer zeroed ``(k, v)`` buffers of shape (B, L, Hkv, Dh).
+    """Per-layer zeroed ``(k, v)`` buffers of shape (B, L, Hkv*Dh).
 
     ``L`` is ``max_len``, or ``min(max_len, attn_window)`` with
     ``rolling=True`` — the ring cache holds only the window, so a
@@ -113,10 +113,15 @@ def init_kv_cache(
         )
     dtype = dtype or cfg.dtype
     length = min(max_len, cfg.attn_window) if rolling else max_len
-    shape = (batch, length, cfg.kv_heads, cfg.head_dim)
+    # storage fuses (Hkv, Dh) -> Hkv*Dh so XLA's layout keeps the feature
+    # dim in lanes and the per-token cache write is in place
+    # (ops/quant.kv_fuse); readers unfuse at the attention einsum
+    shape = (batch, length, cfg.kv_heads * cfg.head_dim)
     if quant:
         q = jnp.zeros(shape, jnp.int8)
-        s = jnp.zeros(shape[:3] + (1,), jnp.float32)
+        # scales keep L minor: the decode kernel reads one aligned (L,)
+        # lane vector per head (ops/quant.QuantKV)
+        s = jnp.zeros((batch, cfg.kv_heads, length), jnp.float32)
         return tuple(QuantKV(q, s, q, s) for _ in range(cfg.n_layers))
     zero = jnp.zeros(shape, dtype)
     return tuple((zero, zero) for _ in range(cfg.n_layers))

@@ -394,6 +394,8 @@ class Attention(nn.Module):
         q = _rope(q, cfg.rope_theta, positions)
         k = _rope(k, cfg.rope_theta, positions)
         spec = ("batch", "act_seq", "act_heads", None)
+        # fused-storage cache leaves are 3-D (ops/quant.kv_fuse)
+        cache_spec = ("batch", "act_seq", "act_heads")
         q = nn.with_logical_constraint(q, spec)
         k = nn.with_logical_constraint(k, spec)
         v = nn.with_logical_constraint(v, spec)
@@ -436,10 +438,11 @@ class Attention(nn.Module):
                     & (key_pos[None, :] > offset - cfg.attn_window)
                     & (key_pos[None, :] >= 0)
                 )
-                o = kv_attend(q, kv_cache, mask)
-            kv_cache = kv_map(
-                lambda a: nn.with_logical_constraint(a, spec), kv_cache
-            )
+                o = kv_attend(
+                    q, kv_cache, mask,
+                    use_kernel=_ambient_mesh_size() <= 1,
+                )
+            kv_cache = _constrain_cache(kv_cache, cache_spec)
             o = nn.with_logical_constraint(o, spec)
             new_cache = kv_cache
         elif t > 1 and isinstance(offset, int) and offset == 0:
@@ -451,9 +454,7 @@ class Attention(nn.Module):
             # O(T*capacity): a B=8, T=4096 prefill against an 8K cache
             # would otherwise materialise a 13 GB score tensor and OOM.
             kv_cache = kv_write(kv_cache, k, v, 0)
-            kv_cache = kv_map(
-                lambda a: nn.with_logical_constraint(a, spec), kv_cache
-            )
+            kv_cache = _constrain_cache(kv_cache, cache_spec)
             core = self.attn_core or partial(
                 dense_attention, causal=True, window=cfg.attn_window
             )
@@ -461,9 +462,7 @@ class Attention(nn.Module):
             new_cache = kv_cache
         else:
             kv_cache = kv_write(kv_cache, k, v, offset)
-            kv_cache = kv_map(
-                lambda a: nn.with_logical_constraint(a, spec), kv_cache
-            )
+            kv_cache = _constrain_cache(kv_cache, cache_spec)
             # queries at global positions offset+i attend keys <= that
             # position; padded cache slots beyond offset+t are masked out.
             q_pos = (offset + jnp.arange(t))[:, None]
@@ -483,7 +482,14 @@ class Attention(nn.Module):
             mask = key_pos[None, :] <= q_pos  # (T, span)
             if cfg.attn_window:
                 mask &= key_pos[None, :] > q_pos - cfg.attn_window
-            o = kv_attend(q, att_cache, mask)
+            o = kv_attend(
+                q, att_cache, mask,
+                # the one-pass kernel attends the FULL buffer; a windowed
+                # O(span) slice keeps the einsum path
+                use_kernel=(
+                    t == 1 and span == cap and _ambient_mesh_size() <= 1
+                ),
+            )
             o = nn.with_logical_constraint(o, spec)
             new_cache = kv_cache
         out = QDense(
@@ -714,17 +720,57 @@ def _combine_gather_bwd(res, g):
 _combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
 
 
+def _ambient_mesh_shape() -> dict:
+    """Axis-name -> size of the ambient (abstract) mesh; {} when tracing
+    without a mesh context.  Shared by the decode-kernel and MoE-dispatch
+    resolution below."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return {}
+    if mesh is None or getattr(mesh, "empty", False):
+        return {}
+    return dict(mesh.shape)
+
+
+def _ambient_mesh_size() -> int:
+    """Device count of the ambient mesh — 1 without a mesh context."""
+    size = 1
+    for n in _ambient_mesh_shape().values():
+        size *= int(n)
+    return size
+
+
+def _constrain_cache(cache, spec):
+    """Sharding-constrain the decode-cache leaves — SKIPPED on a trivial
+    mesh.  The constraint lowers to a sharding custom-call between the
+    cache update and its consumers; on one device it is semantically a
+    no-op but BREAKS XLA's while-loop in-place aliasing, so every decode
+    step copied the whole cache: profiled at B=32/T=768, the 24
+    dynamic-update-slices cost ~27 us each (full-buffer copy speed) plus
+    ~0.7 ms/step of explicit copies — the majority of decode time
+    (bench/profile_decode.py, PERF.md round 5).  Multi-device decode
+    keeps the constraints (the cache's model/seq sharding needs them).
+
+    ``spec`` is the fused-storage K/V spec (B, L, Hkv*Dh); QuantKV scale
+    leaves are (B, Hkv, L) so their spec transposes the last two axes."""
+    if _ambient_mesh_size() <= 1:
+        return cache
+    if isinstance(cache, QuantKV):
+        sspec = (spec[0], spec[2], spec[1])
+        c = nn.with_logical_constraint
+        return QuantKV(
+            c(cache.kq, spec), c(cache.ks, sspec),
+            c(cache.vq, spec), c(cache.vs, sspec),
+        )
+    return kv_map(lambda a: nn.with_logical_constraint(a, spec), cache)
+
+
 def _expert_axis_size() -> int:
     """Size of the ``expert`` mesh axis in the ambient (abstract) mesh —
     1 when tracing without a mesh context (plain CPU tests, decode on a
     single device), which routes MoE to the GSPMD dispatch."""
-    try:
-        mesh = jax.sharding.get_abstract_mesh()
-    except Exception:
-        return 1
-    if mesh is None or getattr(mesh, "empty", False):
-        return 1
-    return dict(mesh.shape).get("expert", 1)
+    return int(_ambient_mesh_shape().get("expert", 1))
 
 
 def _ep_alltoall_moe(x, gates, wi, wo, *, top_k, capacity, ep, dt):

@@ -1,0 +1,223 @@
+"""Pallas decode-attention kernel: one-pass cached attention for T=1.
+
+Why a kernel, when XLA fuses attention fine at training shapes: the
+decode step's cache works AGAINST XLA's layout assignment.  The score
+einsum wants the cache's sequence dim in the 128-lane position (softmax
+over lanes), so layout assignment makes the whole cache L-minor — and a
+single-token ``dynamic_update_slice`` into an L-minor buffer lowers to a
+full-cache rewrite, ~20 us/step per buffer at B=32/L=768 (measured: the
+24 cache updates were the plurality of decode step time,
+``bench/profile_decode.py``, PERF.md round 5).  A Pallas consumer breaks
+the conflict: ``pallas_call`` operands use the default (feature-minor)
+layout, so the cache write is genuinely in place, and the kernel does
+the L-major contraction in VMEM where layout is free.  Measured effect
+at B=32, GQA 12q/4kv, window 1024: 21.8k -> 35.3k tok/s bf16, 40.2k
+with int8 cache+weights.
+
+Structure: grid (B, L/block_l), sequential over the L tiles with a
+flash-style online softmax (running max / denom / output accumulators in
+VMEM scratch, finalised at the last tile) — VMEM holds one (block_l,
+Hkv*Dh) K and V tile at a time, so cache capacity is unbounded.  Per
+L tile, each K/V head's grouped scores and value contraction run as
+small (G, block_l) dots in f32; the int8 variant folds the per-(token,
+head) scales into the scores/probs so the cache is never dequantized to
+a materialised buffer.
+
+Masking is an additive f32 bias row (0 = attend, -1e30 = masked) built
+by the caller — the same mask math as the XLA path (ring-slot positions
+or linear positions), so rolling and full-cache decode share the kernel.
+
+Used automatically by ``models/transformer.Attention`` for single-device
+T=1 decode over the full cache (multi-device decode keeps the einsum
+path — GSPMD cannot partition a custom call); interpreter mode off-TPU,
+so CPU tests exercise the identical program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["decode_attention", "quant_decode_attention"]
+
+_DEFAULT_BLOCK_L = 1024
+
+
+def _finalize(o_ref, acc_sc, l_sc, j, nl):
+    @pl.when(j == nl - 1)
+    def _():
+        o_ref[0] = (acc_sc[:] / jnp.maximum(l_sc[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def _kernel(
+    q_ref, k_ref, v_ref, bias_ref, o_ref, acc_sc, m_sc, l_sc,
+    *, hkv: int, scale: float,
+):
+    j, nl = pl.program_id(1), pl.num_programs(1)
+    h, d = q_ref.shape[1], q_ref.shape[2]
+    g = h // hkv
+
+    @pl.when(j == 0)
+    def _():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, -1e30)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    bias = bias_ref[0].astype(jnp.float32)  # (block_l,)
+    for i in range(hkv):
+        rows = slice(i * g, (i + 1) * g)
+        qh = q_ref[0, rows, :].astype(jnp.float32)  # (G, D)
+        kh = k_ref[0, :, i * d:(i + 1) * d].astype(jnp.float32)  # (bl, D)
+        s = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale + bias[None, :]  # (G, bl)
+        m = m_sc[rows, :]
+        new_m = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - new_m)
+        p = jnp.where(s > -1e29, p, 0.0)  # fully-masked tile rows
+        corr = jnp.exp(m - new_m)
+        l_sc[rows, :] = l_sc[rows, :] * corr + p.sum(-1, keepdims=True)
+        vh = v_ref[0, :, i * d:(i + 1) * d].astype(jnp.float32)
+        acc_sc[rows, :] = acc_sc[rows, :] * corr + jax.lax.dot_general(
+            p, vh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[rows, :] = new_m
+    _finalize(o_ref, acc_sc, l_sc, j, nl)
+
+
+def _quant_kernel(
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, bias_ref, o_ref,
+    acc_sc, m_sc, l_sc, *, hkv: int, scale: float,
+):
+    j, nl = pl.program_id(1), pl.num_programs(1)
+    h, d = q_ref.shape[1], q_ref.shape[2]
+    g = h // hkv
+
+    @pl.when(j == 0)
+    def _():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, -1e30)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    bias = bias_ref[0].astype(jnp.float32)
+    for i in range(hkv):
+        rows = slice(i * g, (i + 1) * g)
+        qh = q_ref[0, rows, :].astype(jnp.float32)
+        kh = k_ref[0, :, i * d:(i + 1) * d].astype(jnp.float32)
+        # per-key scale folds into the (G, bl) scores: q.(kq*s) = (q.kq)*s
+        ksr = ks_ref[0, i, :].astype(jnp.float32)  # (bl,)
+        s = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * (ksr * scale)[None, :] + bias[None, :]
+        m = m_sc[rows, :]
+        new_m = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - new_m)
+        p = jnp.where(s > -1e29, p, 0.0)
+        corr = jnp.exp(m - new_m)
+        l_sc[rows, :] = l_sc[rows, :] * corr + p.sum(-1, keepdims=True)
+        # value scale folds into the probs before the contraction
+        p = p * vs_ref[0, i, :].astype(jnp.float32)[None, :]
+        vh = v_ref[0, :, i * d:(i + 1) * d].astype(jnp.float32)
+        acc_sc[rows, :] = acc_sc[rows, :] * corr + jax.lax.dot_general(
+            p, vh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[rows, :] = new_m
+    _finalize(o_ref, acc_sc, l_sc, j, nl)
+
+
+def _interpret_default() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _block_l(L: int, block_l: int | None) -> int:
+    bl = block_l or _DEFAULT_BLOCK_L
+    bl = min(bl, L)
+    while L % bl:
+        bl -= 1
+    return bl
+
+
+@functools.partial(
+    jax.jit, static_argnames=("hkv", "block_l", "interpret")
+)
+def decode_attention(q, ck, cv, bias, *, hkv: int, block_l=None,
+                     interpret=None):
+    """q: (B, 1, H, D); ck/cv: (B, L, Hkv*Dh) bf16 fused cache;
+    bias: (1, L) f32 additive mask.  Returns (B, 1, H, D)."""
+    b, _, h, d = q.shape
+    L = ck.shape[1]
+    bl = _block_l(L, block_l)
+    if interpret is None:
+        interpret = _interpret_default()
+    out = pl.pallas_call(
+        functools.partial(_kernel, hkv=hkv, scale=1.0 / (d ** 0.5)),
+        grid=(b, L // bl),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bl, hkv * d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bl, hkv * d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bl), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q[:, 0], ck, cv, bias)
+    return out[:, None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("hkv", "block_l", "interpret")
+)
+def quant_decode_attention(q, ck, ks, cv, vs, bias, *, hkv: int,
+                           block_l=None, interpret=None):
+    """q: (B, 1, H, D); ck/cv: (B, L, Hkv*Dh) int8 fused cache;
+    ks/vs: (B, Hkv, L) f32 per-(token, head) scales (L minor, so the
+    kernel reads an aligned (block_l,) lane vector per head);
+    bias: (1, L) f32 additive mask."""
+    b, _, h, d = q.shape
+    L = ck.shape[1]
+    bl = _block_l(L, block_l)
+    if interpret is None:
+        interpret = _interpret_default()
+    out = pl.pallas_call(
+        functools.partial(_quant_kernel, hkv=hkv, scale=1.0 / (d ** 0.5)),
+        grid=(b, L // bl),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, bl, hkv * d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, bl, hkv * d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, hkv, bl), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, hkv, bl), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, bl), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, j: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q[:, 0], ck, cv, ks, vs, bias)
+    return out[:, None]

@@ -73,13 +73,38 @@ class QuantKV(NamedTuple):
     """Int8 KV-cache leaf set for one layer (a pytree, so it flows through
     ``lax.scan`` carries and ``jit`` like the plain ``(k, v)`` tuple).
 
-    kq/vq: (B, L, Hkv, Dh) int8; ks/vs: (B, L, Hkv, 1) f32 per-(token,
-    head) scales."""
+    kq/vq: (B, L, Hkv*Dh) int8; ks/vs: (B, Hkv, L) f32 per-(token, head)
+    scales.  The head/head_dim axes are FUSED in storage — see
+    ``kv_fuse`` for why (XLA layout: in-place single-token updates) —
+    and the scales keep L minor so the decode kernel reads one aligned
+    (L,) lane vector per head."""
 
     kq: jax.Array
     ks: jax.Array
     vq: jax.Array
     vs: jax.Array
+
+
+def kv_fuse(x):
+    """(B, T, H, D) -> (B, T, H*D): the cache STORAGE layout.
+
+    Why fused: for a 4-D (B, L, H, D) buffer with D < 128, XLA's padding-
+    minimising layout assignment puts L in the 128-lane position — and a
+    single-token ``dynamic_update_slice`` into an L-minor buffer lowers
+    to a full-cache rewrite (~27 us/step at B=32 L=1024, measured — it
+    WAS the majority of decode time, bench/profile_decode.py).  With H*D
+    fused the natural layout keeps the feature dim in lanes and the
+    update is genuinely in place (~1.7 us).  Readers unfuse right before
+    the attention einsums (``kv_unfuse``); XLA folds that reshape into
+    the read."""
+    b, t = x.shape[:2]
+    return x.reshape(b, t, -1)
+
+
+def kv_unfuse(x, hkv: int):
+    """(B, T, H*D) -> (B, T, H, D) view for the attention cores."""
+    b, t, hd = x.shape
+    return x.reshape(b, t, hkv, hd // hkv)
 
 
 def kv_map(fn, cache):
@@ -92,23 +117,26 @@ def kv_map(fn, cache):
 
 def kv_write(cache, k, v, offset):
     """Write new ``(B, t, Hkv, Dh)`` k/v at sequence position ``offset``
-    (``lax.dynamic_update_slice`` — in-place on TPU), quantizing on the
-    way in when the cache is a ``QuantKV``."""
+    (``lax.dynamic_update_slice`` into the fused (B, L, Hkv*Dh) storage —
+    genuinely in place on TPU, see ``kv_fuse``), quantizing on the way in
+    when the cache is a ``QuantKV``."""
     if isinstance(cache, QuantKV):
         kq, ks = quantize_q8(k)
         vq, vs = quantize_q8(v)
-        at = (0, offset, 0, 0)
+        # scale rows: (B, t, Hkv, 1) -> (B, Hkv, t) at position offset
+        ks_t = ks[..., 0].transpose(0, 2, 1).astype(cache.ks.dtype)
+        vs_t = vs[..., 0].transpose(0, 2, 1).astype(cache.vs.dtype)
         return QuantKV(
-            lax.dynamic_update_slice(cache.kq, kq, at),
-            lax.dynamic_update_slice(cache.ks, ks.astype(cache.ks.dtype), at),
-            lax.dynamic_update_slice(cache.vq, vq, at),
-            lax.dynamic_update_slice(cache.vs, vs.astype(cache.vs.dtype), at),
+            lax.dynamic_update_slice(cache.kq, kv_fuse(kq), (0, offset, 0)),
+            lax.dynamic_update_slice(cache.ks, ks_t, (0, 0, offset)),
+            lax.dynamic_update_slice(cache.vq, kv_fuse(vq), (0, offset, 0)),
+            lax.dynamic_update_slice(cache.vs, vs_t, (0, 0, offset)),
         )
     ck, cv = cache
-    at = (0, offset, 0, 0)
+    at = (0, offset, 0)
     return (
-        lax.dynamic_update_slice(ck, k.astype(ck.dtype), at),
-        lax.dynamic_update_slice(cv, v.astype(cv.dtype), at),
+        lax.dynamic_update_slice(ck, kv_fuse(k).astype(ck.dtype), at),
+        lax.dynamic_update_slice(cv, kv_fuse(v).astype(cv.dtype), at),
     )
 
 
@@ -118,40 +146,77 @@ def kv_set_slots(cache, k, v, slots):
     if isinstance(cache, QuantKV):
         kq, ks = quantize_q8(k)
         vq, vs = quantize_q8(v)
+        ks_t = ks[..., 0].transpose(0, 2, 1).astype(cache.ks.dtype)
+        vs_t = vs[..., 0].transpose(0, 2, 1).astype(cache.vs.dtype)
         return QuantKV(
-            cache.kq.at[:, slots].set(kq),
-            cache.ks.at[:, slots].set(ks.astype(cache.ks.dtype)),
-            cache.vq.at[:, slots].set(vq),
-            cache.vs.at[:, slots].set(vs.astype(cache.vs.dtype)),
+            cache.kq.at[:, slots].set(kv_fuse(kq)),
+            cache.ks.at[:, :, slots].set(ks_t),
+            cache.vq.at[:, slots].set(kv_fuse(vq)),
+            cache.vs.at[:, :, slots].set(vs_t),
         )
     ck, cv = cache
     return (
-        ck.at[:, slots].set(k.astype(ck.dtype)),
-        cv.at[:, slots].set(v.astype(cv.dtype)),
+        ck.at[:, slots].set(kv_fuse(k).astype(ck.dtype)),
+        cv.at[:, slots].set(kv_fuse(v).astype(cv.dtype)),
     )
 
 
 def kv_slice(cache, start, span: int):
     """O(span) view of the cache along the sequence axis (windowed decode
-    reads a window-sized slice, not the whole allocation)."""
-    sl = lambda a: lax.dynamic_slice_in_dim(a, start, span, axis=1)
-    return kv_map(sl, cache)
-
-
-def kv_attend(q, cache, mask):
-    """Cached decode attention over a bf16 tuple or QuantKV cache.
-    q: (B, Tq, H, Dh); mask: (Tq, L) bool (True = attend)."""
+    reads a window-sized slice, not the whole allocation).  The scale
+    leaves' sequence axis is their LAST dim (QuantKV layout)."""
     if isinstance(cache, QuantKV):
-        return quant_dense_attention(q, *cache, mask=mask)
+        sl1 = lambda a: lax.dynamic_slice_in_dim(a, start, span, axis=1)
+        sl2 = lambda a: lax.dynamic_slice_in_dim(a, start, span, axis=2)
+        return QuantKV(
+            sl1(cache.kq), sl2(cache.ks), sl1(cache.vq), sl2(cache.vs)
+        )
+    sl = lambda a: lax.dynamic_slice_in_dim(a, start, span, axis=1)
+    return tuple(sl(a) for a in cache)
+
+
+def kv_attend(q, cache, mask, use_kernel: bool = False):
+    """Cached decode attention over a (fused-storage) bf16 tuple or
+    QuantKV cache.  q: (B, Tq, H, Dh); mask: (Tq, L) bool (True =
+    attend).
+
+    ``use_kernel=True`` (single-device T=1 over the full cache) runs the
+    Pallas one-pass kernel (``ops/decode_attention.py``): default-layout
+    operands keep the cache write in place, and the L-major contraction
+    happens in VMEM instead of forcing an L-minor cache layout."""
+    d = q.shape[-1]
+    if use_kernel and q.shape[1] == 1:
+        from ddl_tpu.ops.decode_attention import (
+            decode_attention,
+            quant_decode_attention,
+        )
+
+        bias = jnp.where(mask[:1], 0.0, -1e30).astype(jnp.float32)
+        if isinstance(cache, QuantKV):
+            hkv = cache.kq.shape[-1] // d
+            return quant_decode_attention(
+                q, cache.kq, cache.ks, cache.vq, cache.vs, bias, hkv=hkv
+            )
+        hkv = cache[0].shape[-1] // d
+        return decode_attention(q, cache[0], cache[1], bias, hkv=hkv)
+    if isinstance(cache, QuantKV):
+        hkv = cache.kq.shape[-1] // d
+        return quant_dense_attention(
+            q, kv_unfuse(cache.kq, hkv), cache.ks,
+            kv_unfuse(cache.vq, hkv), cache.vs, mask=mask,
+        )
     from ddl_tpu.ops.attention import dense_attention
 
-    return dense_attention(q, cache[0], cache[1], mask=mask)
+    hkv = cache[0].shape[-1] // d
+    return dense_attention(
+        q, kv_unfuse(cache[0], hkv), kv_unfuse(cache[1], hkv), mask=mask
+    )
 
 
 def quant_dense_attention(q, kq, ks, vq, vs, mask):
     """Softmax attention reading an int8 K/V cache without dequantizing it.
 
-    q: (B, Tq, H, D); kq/vq: (B, L, Hkv, D) int8; ks/vs: (B, L, Hkv, 1).
+    q: (B, Tq, H, D); kq/vq: (B, L, Hkv, D) int8; ks/vs: (B, Hkv, L).
     Because each key/value row has ONE scale, ``q·(kq*s) = (q·kq)*s`` — the
     key scales multiply the (B, Hkv, G, Tq, L) scores and the value scales
     fold into the softmax probs, so the only full-size int8 operands feed
@@ -166,14 +231,14 @@ def quant_dense_attention(q, kq, ks, vq, vs, mask):
     g = h // hkv
     qg = q.reshape(b, tq, hkv, g, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kq.astype(q.dtype))
-    # per-key scale -> (B, Hkv, 1, 1, L); rsqrt(d) folded into the same mul
-    ksb = ks.reshape(b, -1, hkv).transpose(0, 2, 1)[:, :, None, None, :]
+    # per-key scale (B, Hkv, L) -> (B, Hkv, 1, 1, L); rsqrt(d) folded in
+    ksb = ks[:, :, None, None, :]
     scores = scores.astype(jnp.float32) * (
         ksb / jnp.sqrt(jnp.float32(d))
     )
     scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    vsb = vs.reshape(b, -1, hkv).transpose(0, 2, 1)[:, :, None, None, :]
+    vsb = vs[:, :, None, None, :]
     pv = (probs * vsb).astype(q.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", pv, vq.astype(q.dtype))
     return out.reshape(b, tq, h, d)

@@ -210,7 +210,7 @@ def test_gqa_incremental_matches_full_forward():
     ref_logits, _ = TransformerLM(cfg, None).apply({"params": params}, toks)
 
     caches = init_kv_cache(cfg, b, t)
-    assert caches[0][0].shape == (b, t, 2, 8)  # Hkv=2, half the MHA cache
+    assert caches[0][0].shape == (b, t, 2 * 8)  # Hkv=2, half the MHA cache (fused Hkv*Dh storage)
     dec = LMDecode(cfg)
     for i in range(t):
         logits, caches = dec.apply(
@@ -269,7 +269,7 @@ def test_rolling_cache_matches_linear_and_is_o_window():
         )["params"]
     )
     caches = init_kv_cache(cfg, 2, 64, rolling=True)
-    assert caches[0][0].shape == (2, 6, 4, 8)  # (B, window, Hkv, Dh)
+    assert caches[0][0].shape == (2, 6, 4 * 8)  # (B, window, Hkv*Dh fused)
     rng = np.random.default_rng(0)
     for prompt_len, max_new in ((12, 10), (3, 15)):
         prompt = jnp.asarray(

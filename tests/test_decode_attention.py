@@ -1,0 +1,78 @@
+"""Pallas decode-attention kernel (ops/decode_attention.py) vs the XLA
+reference — including MULTI-TILE caches (the online-softmax accumulator
+path across L tiles, which the generator tests' tiny caches never split).
+Interpreter mode on CPU; the identical program compiles on TPU (chip
+rates in PERF.md round 5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl_tpu.ops.attention import dense_attention
+from ddl_tpu.ops.decode_attention import (
+    decode_attention,
+    quant_decode_attention,
+)
+from ddl_tpu.ops.quant import kv_fuse, quantize_q8
+
+
+def _mk(b=2, L=16, h=8, hkv=4, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, 1, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, L, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, L, hkv, d)), jnp.float32)
+    mask = jnp.asarray(rng.random((1, L)) > 0.3).at[:, 0].set(True)
+    return q, k, v, mask
+
+
+@pytest.mark.parametrize("block_l", [None, 4], ids=["one-tile", "4-tiles"])
+def test_kernel_matches_dense(block_l):
+    q, k, v, mask = _mk()
+    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    got = decode_attention(
+        q, kv_fuse(k), kv_fuse(v), bias, hkv=4, block_l=block_l,
+        interpret=True,
+    )
+    want = dense_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("block_l", [None, 4], ids=["one-tile", "4-tiles"])
+def test_quant_kernel_matches_dequantized(block_l):
+    q, k, v, mask = _mk(seed=1)
+    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    kq, ks = quantize_q8(k)
+    vq, vs = quantize_q8(v)
+    got = quant_decode_attention(
+        q, kv_fuse(kq), ks[..., 0].transpose(0, 2, 1),
+        kv_fuse(vq), vs[..., 0].transpose(0, 2, 1), bias,
+        hkv=4, block_l=block_l, interpret=True,
+    )
+    from ddl_tpu.ops.quant import dequantize_q8
+
+    want = dense_attention(
+        q, dequantize_q8(kq, ks), dequantize_q8(vq, vs), mask=mask
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
+    )
+
+
+def test_kernel_mha_and_fully_masked_tile():
+    """MHA (hkv == h) and a bias whose whole LAST tile is masked — the
+    accumulator must ignore it (exp-zeroed rows), not poison the output."""
+    q, k, v, _ = _mk(h=4, hkv=4, seed=2)
+    L = k.shape[1]
+    mask = jnp.ones((1, L), bool).at[:, L // 2:].set(False)
+    bias = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    got = decode_attention(
+        q, kv_fuse(k), kv_fuse(v), bias, hkv=4, block_l=L // 2,
+        interpret=True,
+    )
+    want = dense_attention(q, k, v, mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
+    )

@@ -71,7 +71,10 @@ def test_quant_attention_matches_dequantized_reference():
     vq, vs = quantize_q8(v)
     mask = jnp.asarray(rng.random((tq, L)) > 0.3)
     mask = mask.at[:, 0].set(True)  # no fully-masked row
-    got = quant_dense_attention(q, kq, ks, vq, vs, mask)
+    got = quant_dense_attention(
+        q, kq, ks[..., 0].transpose(0, 2, 1), vq,
+        vs[..., 0].transpose(0, 2, 1), mask,
+    )  # scales are (B, Hkv, L) in cache layout
     want = dense_attention(
         q, dequantize_q8(kq, ks), dequantize_q8(vq, vs), mask=mask
     )

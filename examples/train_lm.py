@@ -49,6 +49,19 @@ def main() -> None:
     ap.add_argument("--dropout", type=float, default=0.0,
                     help="residual dropout rate")
     ap.add_argument("--experts", type=int, default=0, help="0 = dense MLP")
+    ap.add_argument("--capacity-factor", type=float, default=1.5,
+                    help="MoE warm-up expert capacity (see LMConfig)")
+    ap.add_argument("--capacity-factor-min", type=float, default=1.0,
+                    help="post-warm-up capacity the trainer anneals to "
+                    "once the live router drop fraction converges "
+                    "(= --capacity-factor disables the anneal)")
+    ap.add_argument("--capacity-anneal-step", type=int, default=0,
+                    help="anneal at this step regardless of the metric "
+                    "(pipelined MoE runs, whose metrics lack drop_frac)")
+    ap.add_argument("--moe-ep", default="auto",
+                    choices=["auto", "gspmd", "alltoall"],
+                    help="expert-parallel exchange: manual lax.all_to_all "
+                    "dispatch or GSPMD-inserted collectives")
     ap.add_argument("--fsdp", action="store_true")
     ap.add_argument("--attn", default=None, choices=["dense", "ring", "ulysses"],
                     help="attention impl (default: ring when --seq > 1, else dense)")
@@ -156,6 +169,10 @@ def main() -> None:
         head_dim=args.d_model // 8,
         d_ff=4 * args.d_model,
         num_experts=args.experts,
+        capacity_factor=args.capacity_factor,
+        capacity_factor_min=args.capacity_factor_min,
+        capacity_anneal_step=args.capacity_anneal_step,
+        moe_ep=args.moe_ep,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
         attn_impl=args.attn
         or (("ulysses" if flash is True else "ring") if args.seq > 1 else "dense"),

@@ -38,11 +38,14 @@ from ddl_tpu.utils.timing import fence
 
 
 def _is_oom(e: Exception) -> bool:
-    """XLA allocation failure — the runtime error whose status is
-    RESOURCE_EXHAUSTED (matching the typed status, not free text like
-    'memory', which unrelated errors could contain)."""
+    """XLA allocation failure: the RESOURCE_EXHAUSTED runtime status, or
+    the compiler's canonical compile-time OOM line — which some
+    transports (the dev tunnel's remote-compile wrapper) re-wrap as
+    INTERNAL, hiding the typed status.  Both are matched on exact XLA
+    phrasing, not loose substrings like 'memory'."""
     return isinstance(e, jax.errors.JaxRuntimeError) and (
         "RESOURCE_EXHAUSTED" in str(e)
+        or "Ran out of memory in memory space hbm" in str(e)
     )
 
 

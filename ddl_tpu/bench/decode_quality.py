@@ -58,14 +58,13 @@ def main() -> None:
     import jax.numpy as jnp
     import optax
 
-    from ddl_tpu.checkpoint import load_snapshot
+    from ddl_tpu.checkpoint import load_params
     from ddl_tpu.data.lm_corpus import TokenCorpus
     from ddl_tpu.infer import make_lm_generator
     from ddl_tpu.models.transformer import LMConfig
     from ddl_tpu.ops.quant import quantize_lm_params
-    from ddl_tpu.parallel.lm_pipeline import abstract_lm_state
     from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh
-    from ddl_tpu.train.lm_steps import make_lm_step_fns
+    from ddl_tpu.train.lm_steps import LMTrainState, make_lm_step_fns
     from ddl_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -85,11 +84,10 @@ def main() -> None:
     )
     spec = LMMeshSpec()
     mesh = build_lm_mesh(spec)
-    state, _ = load_snapshot(
-        args.checkpoint_dir, args.job_id, args.step,
-        abstract_lm_state(cfg, optax.adam(1e-3), 1, mesh=mesh),
-    )
-    params = state.params
+    # params-only restore: the skeleton comes from the snapshot's own
+    # metadata, so any optimizer chain/schedule the training run used is
+    # irrelevant here
+    params = load_params(args.checkpoint_dir, args.job_id, args.step)
     qparams = quantize_lm_params(params)
 
     # --- held-out ppl: exact vs weight-only int8 -------------------------
@@ -107,7 +105,10 @@ def main() -> None:
         )
 
     def heldout_ce(p) -> float:
-        st = state.replace(params=p)
+        # evaluate only reads .params; a placeholder opt_state suffices
+        st = LMTrainState(
+            step=jnp.zeros((), jnp.int32), params=p, opt_state=()
+        )
         ces = []
         for bi in range(n_eval):
             idx = range(bi * args.batch, (bi + 1) * args.batch)

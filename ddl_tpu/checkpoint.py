@@ -234,6 +234,30 @@ def load_snapshot(
     return restored["state"], int(restored["epoch"]) + 1
 
 
+def load_params(
+    checkpoint_dir: str | os.PathLike, job_id: str, epoch: int
+) -> Any:
+    """Restore ONLY the parameter tree of a snapshot.
+
+    The restore skeleton is derived from the snapshot's own metadata
+    (shape/dtype per leaf), so no optimizer needs reconstructing — the
+    decode/eval tools (``bench/decode_quality.py``) cannot know the
+    training run's optax chain (schedules/weight-decay change the
+    opt_state structure, and a mismatched skeleton fails the restore)."""
+    path = snapshot_path(checkpoint_dir, job_id, epoch)
+    md = snapshot_metadata(checkpoint_dir, job_id, epoch)
+
+    def to_abstract(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+        return leaf
+
+    abstract = jax.tree.map(to_abstract, md)
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, abstract)
+    return restored["state"]["params"]
+
+
 def snapshot_metadata(
     checkpoint_dir: str | os.PathLike, job_id: str, epoch: int
 ) -> Any:

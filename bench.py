@@ -108,7 +108,7 @@ def main() -> None:
     # chip utilization: executed FLOPs from XLA cost analysis / peak bf16
     from ddl_tpu.bench.mfu import append_mfu
 
-    append_mfu(out, fns.train, slopes[1], state, images, labels)
+    append_mfu(out, fns.train, slope, state, images, labels)
     print(json.dumps(out))
 
 

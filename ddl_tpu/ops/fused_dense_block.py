@@ -1,0 +1,216 @@
+"""Experimental Pallas kernel: a whole DenseNet dense block, VMEM-resident.
+
+The round-4 packed rewrite (models/densenet.py) removed the O(L^2)
+concat copies; the profile's remaining architecture-mandated traffic is
+the **conv input re-reads** — every dense layer re-reads the whole
+feature prefix from HBM for its 1x1 conv.  This kernel is the named
+next lever (PERF.md round 4): hold the growing feature map in VMEM
+SCRATCH across all L layers of a block, so HBM sees exactly one block
+input read, one streamed pass over the layer weights, and one block
+output write.
+
+Scope (deliberately): EVAL-mode forward only.
+* Eval mode because train-mode BatchNorm needs cross-image batch
+  statistics per layer — a grid-wide reduction between layers that a
+  per-image kernel cannot do in one pass.
+* Forward-only because the backward re-reads are the larger half of the
+  re-read traffic, and a fused backward needs hand-written gradients for
+  the whole block (see the experiment record in PERF.md round 5 for the
+  measured forward delta and the go/no-go analysis this produced).
+
+Layout: grid (B, L), L sequential ("arbitrary"); scratch X (H*W, P)
+bf16 holds the feature map.  Mosaic requires lane-dim stores at
+128-aligned offsets, so the column layout is pack-aligned: the block
+input sits FRONT-PADDED to the lane width ([0:pad0] zeros, then C0
+channels — padding done outside the kernel), each 32-channel growth
+strip lands in an open-pack scratch at a STATIC phase offset
+(`pl.when` on layer%4), and full packs flush to X at 128-aligned
+offsets.  Unwritten columns are zero and the per-layer affine/kernel
+tensors are zero-padded to the same layout, so full-width compute is
+exact — trading ~2x 1x1-conv MXU FLOPs (the step has headroom) for the
+HBM re-reads (it does not).  The 3x3 conv runs as 9 shifted
+(H*W, bn) @ (bn, growth) matmuls over a zero halo (jnp.pad — scatter
+has no Mosaic lowering).
+
+Parity: tests/test_fused_dense_block.py pins the kernel against the
+textbook concat eval forward in interpreter mode (the kernel's own
+growth/pack geometry at growth 32 / pack 128 is exercised on-chip by
+the PERF.md experiment).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["block_pad", "fused_dense_block_eval", "pack_block_params"]
+
+_BN_EPS = 1e-5
+_LANE = 128
+
+
+def pack_block_params(layer_params, layer_stats, c0: int, growth: int):
+    """Fold the per-layer BN params + running stats into affine vectors
+    and pad every per-layer tensor to the kernel's pack-aligned column
+    layout ([0:pad0] zeros, then the features).
+
+    ``layer_params[i]`` is the denselayer{i+1} param subtree (norm1/
+    conv1/norm2/conv2), ``layer_stats[i]`` its batch_stats.  Returns a
+    dict of arrays with leading layer dim."""
+    L = len(layer_params)
+    pad0, p_total = block_pad(c0, L, growth)
+    a1 = jnp.zeros((L, p_total), jnp.float32)
+    b1 = jnp.zeros((L, p_total), jnp.float32)
+    w1_list, a2, b2, w2_list = [], [], [], []
+    for i, (p, st) in enumerate(zip(layer_params, layer_stats)):
+        lo, hi = pad0, pad0 + c0 + i * growth
+        n1, n2 = p["norm1"], p["norm2"]
+        s1 = jax.lax.rsqrt(st["norm1"]["var"] + _BN_EPS) * n1["scale"]
+        a1 = a1.at[i, lo:hi].set(s1)
+        b1 = b1.at[i, lo:hi].set(n1["bias"] - st["norm1"]["mean"] * s1)
+        w1 = p["conv1"]["kernel"][0, 0]  # (c_in, bn)
+        w1_list.append(
+            jnp.zeros((p_total, w1.shape[1]), jnp.float32)
+            .at[lo:hi].set(w1)
+        )
+        s2 = jax.lax.rsqrt(st["norm2"]["var"] + _BN_EPS) * n2["scale"]
+        a2.append(s2)
+        b2.append(n2["bias"] - st["norm2"]["mean"] * s2)
+        w2_list.append(
+            p["conv2"]["kernel"].reshape(9, w1.shape[1], growth)
+        )
+    # unit middle axis: Mosaic needs a block's second-to-last dim to be
+    # 8-divisible OR the full array dim; (1, C) blocks of (L, C) are not
+    return {
+        "a1": a1[:, None],
+        "b1": b1[:, None],
+        "w1": jnp.stack(w1_list),
+        "a2": jnp.stack(a2)[:, None],
+        "b2": jnp.stack(b2)[:, None],
+        "w2": jnp.stack(w2_list),
+    }
+
+
+def block_pad(c0: int, n_layers: int, growth: int) -> tuple[int, int]:
+    """(pad0, p_total) of the kernel's pack-aligned column layout —
+    static ints derived from the block geometry (shared by
+    pack_block_params, the kernel wrapper, and callers slicing the
+    padded output)."""
+    pad0 = (-c0) % _LANE
+    p_total = pad0 + c0 + n_layers * growth
+    p_total += (-p_total) % _LANE
+    return pad0, p_total
+
+
+def _kernel(
+    x0_ref, a1_ref, b1_ref, w1_ref, a2_ref, b2_ref, w2_ref, o_ref,
+    x_sc, pack_sc,
+    *, h: int, w: int, c0: int, growth: int, pad0: int, dtype,
+):
+    li = pl.program_id(1)
+    nl = pl.num_programs(1)
+    s = h * w
+    per_pack = _LANE // growth  # strips per lane pack
+
+    @pl.when(li == 0)
+    def _():
+        x_sc[:] = jnp.zeros_like(x_sc)
+        # block input, front-padded to the lane width by the caller
+        x_sc[:, : pad0 + c0] = (
+            x0_ref[0].reshape(s, pad0 + c0).astype(x_sc.dtype)
+        )
+
+    phase = li % per_pack
+
+    @pl.when(phase == 0)
+    def _():
+        pack_sc[:] = jnp.zeros_like(pack_sc)
+
+    x = x_sc[:].astype(jnp.float32)  # (S, P); cols past prefix are 0
+    hid = jnp.maximum(x * a1_ref[0] + b1_ref[0], 0.0)
+    y1 = jax.lax.dot_general(
+        hid.astype(dtype), w1_ref[0].astype(dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )  # (S, bn)
+    h2 = jnp.maximum(y1 * a2_ref[0] + b2_ref[0], 0.0)
+    h2 = h2.astype(dtype)
+    bn = h2.shape[1]
+    # 3x3 conv, padding 1: nine shifted matmuls over a zero halo
+    hp = jnp.pad(h2.reshape(h, w, bn), ((1, 1), (1, 1), (0, 0)))
+    acc = jnp.zeros((s, growth), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            win = hp[dy:dy + h, dx:dx + w].reshape(s, bn)
+            acc = acc + jax.lax.dot_general(
+                win, w2_ref[0, dy * 3 + dx].astype(dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+    # strip -> open pack at a STATIC lane offset (one branch per phase)
+    for k in range(per_pack):
+        @pl.when(phase == k)
+        def _(k=k):
+            pack_sc[:, k * growth:(k + 1) * growth] = acc.astype(
+                pack_sc.dtype
+            )
+    # flush the open pack EVERY layer (the next layer reads x_sc, which
+    # must include this strip) — a 128-aligned VMEM store, cheap
+    pack_idx = (pad0 + c0) // _LANE + li // per_pack
+    x_sc[:, pl.dslice(pack_idx * _LANE, _LANE)] = pack_sc[:]
+
+    @pl.when(li == nl - 1)
+    def _():
+        o_ref[0] = x_sc[:].reshape(h, w, x_sc.shape[1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("c0", "growth", "interpret"))
+def fused_dense_block_eval(x0, packed, *, c0: int, growth: int,
+                           interpret=None):
+    """x0: (B, H, W, C0) block input; ``packed`` from
+    ``pack_block_params``.  Returns (B, H, W, pad0 + Cmax [+ tail pad])
+    — the caller slices ``[..., pad0 : pad0 + Cmax]`` for the dense
+    concatenated features (kept padded here so every kernel store stays
+    lane-aligned)."""
+    b, h, w, _ = x0.shape
+    L = packed["a1"].shape[0]
+    pad0, p_total = block_pad(c0, L, growth)
+    bn = packed["w1"].shape[2]
+    if _LANE % growth:
+        raise ValueError(f"growth {growth} must divide the lane width")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    dtype = x0.dtype
+    x0p = jnp.pad(x0, ((0, 0), (0, 0), (0, 0), (pad0, 0)))
+    kern = functools.partial(
+        _kernel, h=h, w=w, c0=c0, growth=growth, pad0=pad0, dtype=dtype,
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(b, L),
+        in_specs=[
+            pl.BlockSpec((1, h, w, pad0 + c0), lambda i, l: (i, 0, 0, 0)),
+            pl.BlockSpec((1, 1, p_total), lambda i, l: (l, 0, 0)),
+            pl.BlockSpec((1, 1, p_total), lambda i, l: (l, 0, 0)),
+            pl.BlockSpec((1, p_total, bn), lambda i, l: (l, 0, 0)),
+            pl.BlockSpec((1, 1, bn), lambda i, l: (l, 0, 0)),
+            pl.BlockSpec((1, 1, bn), lambda i, l: (l, 0, 0)),
+            pl.BlockSpec((1, 9, bn, growth), lambda i, l: (l, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, h, w, p_total), lambda i, l: (i, 0, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, p_total), dtype),
+        scratch_shapes=[
+            pltpu.VMEM((h * w, p_total), dtype),
+            pltpu.VMEM((h * w, _LANE), dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x0p, packed["a1"], packed["b1"], packed["w1"], packed["a2"],
+      packed["b2"], packed["w2"])

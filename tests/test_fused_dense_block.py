@@ -1,0 +1,61 @@
+"""Fused VMEM-resident dense-block kernel (ops/fused_dense_block.py) vs
+the textbook concat DenseBlock — eval-mode forward parity, interpreter
+mode.  (The experiment's chip measurements and go/no-go analysis live in
+PERF.md round 5.)"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddl_tpu.models.densenet import DenseBlock
+from ddl_tpu.ops.fused_dense_block import (
+    block_pad,
+    fused_dense_block_eval,
+    pack_block_params,
+)
+
+
+def test_fused_block_matches_concat_eval():
+    c0, growth, bn_size, L = 16, 8, 2, 4
+    b, h, w = 2, 6, 5
+    block = DenseBlock(L, growth, bn_size, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (b, h, w, c0))
+    variables = block.init(jax.random.key(1), x, train=False)
+    # make running stats non-trivial: one train-mode step, keep mutations
+    _, upd = block.apply(variables, x, train=True, mutable=["batch_stats"])
+    variables = {"params": variables["params"], **upd}
+
+    want = block.apply(variables, x, train=False)
+
+    layers = [variables["params"][f"denselayer{i + 1}"] for i in range(L)]
+    stats = [variables["batch_stats"][f"denselayer{i + 1}"] for i in range(L)]
+    packed = pack_block_params(layers, stats, c0, growth)
+    got = fused_dense_block_eval(
+        x, packed, c0=c0, growth=growth, interpret=True
+    )
+    pad0, _ = block_pad(c0, L, growth)
+    got = got[..., pad0:pad0 + c0 + L * growth]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_fused_block_respects_conv_padding():
+    """Edge pixels exercise the explicit zero halo of the in-kernel 3x3."""
+    c0, growth, bn_size, L = 8, 8, 1, 2
+    b, h, w = 1, 3, 3
+    block = DenseBlock(L, growth, bn_size, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(2), (b, h, w, c0)) * 2.0
+    variables = block.init(jax.random.key(3), x, train=False)
+    want = block.apply(variables, x, train=False)
+    layers = [variables["params"][f"denselayer{i + 1}"] for i in range(L)]
+    stats = [variables["batch_stats"][f"denselayer{i + 1}"] for i in range(L)]
+    packed = pack_block_params(layers, stats, c0, growth)
+    got = fused_dense_block_eval(
+        x, packed, c0=c0, growth=growth, interpret=True
+    )
+    pad0, _ = block_pad(c0, L, growth)
+    got = got[..., pad0:pad0 + c0 + L * growth]
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )

@@ -1100,7 +1100,10 @@ class LMHead(nn.Module):
         if self.has_variable("params", "scale"):
             # weight-only int8 head (ops.quant.quantize_lm_params): int8
             # kernel streamed at the activation dtype, then the
-            # per-vocab-row scale (V, 1) dequants the matmul output
+            # per-vocab-row scale (V, 1) dequants the matmul output.
+            # (An MXU-streamed Pallas matvec for this tiny-M apply was
+            # built and measured SLOWER than XLA's multiply-reduce
+            # lowering — ops/int8_matvec.py, PERF.md round 5.)
             return (
                 jnp.einsum("...d,vd->...v", x, kernel.astype(x.dtype))
                 * self.get_variable("params", "scale")[:, 0]

@@ -1,0 +1,41 @@
+"""Int8 tiny-M matmul kernel (ops/int8_matvec.py) vs the XLA reference,
+both weight layouts, interpreter mode.  The kernel is a recorded
+NEGATIVE experiment (measured slower than XLA's lowering, PERF.md
+round 5) and is not wired into the model — the tests keep the artifact
+honest."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddl_tpu.ops.int8_matvec import MATVEC_MAX_ROWS, int8_matmul_small_m
+
+
+@pytest.mark.parametrize("m", [1, 3, 8])
+@pytest.mark.parametrize("contract_last", [False, True],
+                         ids=["DxO", "OxD"])
+def test_matches_xla_reference(m, contract_last):
+    rng = np.random.default_rng(0)
+    d, o = 64, 384
+    x = jnp.asarray(rng.normal(size=(m, d)), jnp.float32)
+    w8 = jnp.asarray(rng.integers(-127, 127, (o, d) if contract_last
+                                  else (d, o)), jnp.int8)
+    scale = jnp.asarray(rng.random((1, o)) * 0.01, jnp.float32)
+    got = int8_matmul_small_m(
+        x, w8, scale, contract_last=contract_last, block_o=128,
+        interpret=True,
+    )
+    wf = w8.astype(jnp.float32)
+    want = (x @ (wf.T if contract_last else wf)) * scale
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-3, rtol=1e-4
+    )
+    assert got.shape == (m, o)
+
+
+def test_rejects_large_m():
+    x = jnp.zeros((MATVEC_MAX_ROWS + 1, 16), jnp.float32)
+    w8 = jnp.zeros((16, 32), jnp.int8)
+    with pytest.raises(ValueError, match="use the XLA path"):
+        int8_matmul_small_m(x, w8, jnp.ones((1, 32)), interpret=True)

@@ -44,13 +44,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["decode_attention", "quant_decode_attention"]
 
-# Per-stage VMEM budget for one K or V tile (bl x fused bytes).  Mosaic
-# double-buffers both tiles, so the working set is ~4x this; 3.5 MB
-# keeps the biggest case (bf16 MHA at d_model 768: fused 768, bl 2048)
-# inside the ~16 MB scoped limit, and the measured stream rate at that
-# shape is 745 GB/s for bl=2048 vs 666 at 1024 (+12%, PERF.md round 5).
+# Per-stage VMEM budget for one K or V tile.  Mosaic double-buffers both
+# tiles and the kernel also materialises f32 per-head slices, so the
+# working set is several times this; 3.5 MB with rows costed at bf16
+# width (int8 tiles spend the difference on their f32 dequant slices)
+# keeps the largest auto-picked case (bl 2048 at fused width 768) inside
+# the ~16 MB scoped limit — compile-probed: bl>2048 at that width fails.
+# Measured at B=32/L=6144 MHA bf16: 745 GB/s at bl=2048 vs 666 at 1024.
 _TILE_BYTES = 3_500_000
 _MIN_BLOCK_L = 512
+_MAX_AUTO_BLOCK_L = 2048
 
 
 def _finalize(o_ref, acc_sc, l_sc, j, nl):
@@ -146,12 +149,21 @@ def _interpret_default() -> bool:
 
 
 def _block_l(L: int, block_l: int | None, fused: int, itemsize: int) -> int:
-    """Sequence tile size: the largest 512-multiple whose K/V tile fits
-    the per-stage VMEM budget (bigger tiles stream measurably faster),
-    shrunk to a divisor of L."""
+    """Sequence tile size: the largest 512-multiple in [512, 2048] whose
+    K/V tile fits the per-stage VMEM budget (bigger tiles stream
+    measurably faster), shrunk to a divisor of L.  Rows are costed at
+    bf16 width regardless of cache dtype — the int8 kernel's f32 dequant
+    slices eat the byte savings, so giving int8 bigger tiles would walk
+    past the compile-probed scoped-VMEM boundary.  Very wide fused rows
+    (> ~3.4 KB at bf16) can exceed the budget even at the 512 floor;
+    such configs should pass ``block_l`` explicitly."""
+    del itemsize  # rows costed at bf16 width (see above)
     if block_l is None:
-        by_budget = _TILE_BYTES // max(fused * itemsize, 1)
-        block_l = max(_MIN_BLOCK_L, (by_budget // 512) * 512)
+        by_budget = _TILE_BYTES // max(fused * 2, 1)
+        block_l = min(
+            _MAX_AUTO_BLOCK_L,
+            max(_MIN_BLOCK_L, (by_budget // 512) * 512),
+        )
     bl = min(block_l, L)
     while L % bl:
         bl -= 1

@@ -109,6 +109,11 @@ def _bench_one(
         # difference negative; one resample of the pair before reporting
         t1, t2 = timed(n1), timed(n2)
         ms_per_tok = (t2 - t1) / (n2 - n1) * 1e3
+    if ms_per_tok <= 0:
+        raise RuntimeError(
+            f"host contention: decode slope non-positive after resample "
+            f"({ms_per_tok:.4f} ms/tok) — rerun on a quieter machine"
+        )
     kv = cfg.kv_heads
     # windowed rows use the O(window)-memory ring cache (the generator's
     # rolling auto-mode); read the real allocation from init_kv_cache so

@@ -88,6 +88,16 @@ def main() -> None:
     # metadata, so any optimizer chain/schedule the training run used is
     # irrelevant here
     params = load_params(args.checkpoint_dir, args.job_id, args.step)
+    from ddl_tpu.parallel.lm_pipeline import saved_pipe_stages
+
+    if saved_pipe_stages(params) > 1:
+        raise SystemExit(
+            "this snapshot is in the pipeline-parallel layout; "
+            "decode_quality restores params only and does not "
+            "restructure stages — resume it once with --pipe 1 (or "
+            "decode via examples/generate_lm.py, which converts the "
+            "layout) and point this tool at the re-saved snapshot"
+        )
     qparams = quantize_lm_params(params)
 
     # --- held-out ppl: exact vs weight-only int8 -------------------------

@@ -912,15 +912,22 @@ class MoeMlp(nn.Module):
             and not self.has_variable("params", "wi_scale")
         )
         if cfg.moe_ep == "alltoall" and not use_a2a:
-            # explicit request unfulfillable at this trace: no expert mesh
-            # axis visible (single-device decode/eval of an alltoall-
-            # trained config is legitimate — warn, don't break it)
+            # explicit request unfulfillable at this trace (single-device
+            # decode/eval of an alltoall-trained config is legitimate —
+            # warn with the ACTUAL failed guard, don't break it)
             import warnings
 
+            if ep <= 1:
+                why = ("no expert mesh axis (>1) is visible at trace "
+                       f"time (expert axis size {ep})")
+            elif e % ep:
+                why = f"num_experts {e} does not divide by the {ep}-way axis"
+            else:
+                why = ("the tree carries int8 expert scales, which the "
+                       "manual exchange does not thread")
             warnings.warn(
-                "moe_ep='alltoall' requested but no usable expert mesh "
-                f"axis is visible at trace time (expert axis size {ep}, "
-                f"E={e}); falling back to the GSPMD dispatch",
+                f"moe_ep='alltoall' requested but {why}; falling back "
+                "to the GSPMD dispatch",
                 stacklevel=2,
             )
         if use_a2a:

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["decode_attention", "quant_decode_attention"]
+__all__ = ["decode_attention", "pick_block_l", "quant_decode_attention"]
 
 # Per-stage VMEM budget for one K or V tile.  Mosaic double-buffers both
 # tiles and the kernel also materialises f32 per-head slices, so the
@@ -148,25 +148,48 @@ def _interpret_default() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+def pick_block_l(L: int, fused: int) -> int | None:
+    """Legal sequence tile for a cache of L rows and ``fused`` feature
+    width, or None when the kernel cannot tile this shape.
+
+    A tile must be a 128-multiple divisor of L (Mosaic lane/sublane
+    alignment — a partial block's dims must be aligned unless they equal
+    the full array dims), sized so the K/V tile fits the per-stage VMEM
+    budget; rows are costed at bf16 width regardless of cache dtype
+    (the int8 kernel's f32 dequant slices eat the byte savings — an
+    unclamped int8 tile both neared the compile-probed scoped-VMEM
+    boundary and measured SLOWER).  When no aligned divisor exists
+    (e.g. L=3000), a single full-L tile is always alignment-legal and
+    is used if it fits a relaxed budget; otherwise return None and the
+    caller keeps the XLA einsum path."""
+    limit = min(
+        _MAX_AUTO_BLOCK_L,
+        max(_MIN_BLOCK_L, (_TILE_BYTES // max(fused * 2, 1) // 512) * 512),
+    )
+    if L <= limit:
+        return L  # single tile: block dims == array dims, always legal
+    for bl in range(limit - limit % 128, 0, -128):
+        if L % bl == 0:
+            return bl
+    if L * fused * 2 <= 2 * _TILE_BYTES:
+        return L
+    return None
+
+
 def _block_l(L: int, block_l: int | None, fused: int, itemsize: int) -> int:
-    """Sequence tile size: the largest 512-multiple in [512, 2048] whose
-    K/V tile fits the per-stage VMEM budget (bigger tiles stream
-    measurably faster), shrunk to a divisor of L.  Rows are costed at
-    bf16 width regardless of cache dtype — the int8 kernel's f32 dequant
-    slices eat the byte savings, so giving int8 bigger tiles would walk
-    past the compile-probed scoped-VMEM boundary.  Very wide fused rows
-    (> ~3.4 KB at bf16) can exceed the budget even at the 512 floor;
-    such configs should pass ``block_l`` explicitly."""
-    del itemsize  # rows costed at bf16 width (see above)
-    if block_l is None:
-        by_budget = _TILE_BYTES // max(fused * 2, 1)
-        block_l = min(
-            _MAX_AUTO_BLOCK_L,
-            max(_MIN_BLOCK_L, (by_budget // 512) * 512),
+    del itemsize  # rows costed at bf16 width (see pick_block_l)
+    if block_l is not None:
+        bl = min(block_l, L)
+        while L % bl:
+            bl -= 1
+        return bl
+    bl = pick_block_l(L, fused)
+    if bl is None:
+        raise ValueError(
+            f"no legal sequence tile for L={L}, fused width {fused}; "
+            "gate on pick_block_l() before selecting the kernel, or "
+            "pass block_l explicitly"
         )
-    bl = min(block_l, L)
-    while L % bl:
-        bl -= 1
     return bl
 
 

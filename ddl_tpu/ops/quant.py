@@ -188,17 +188,23 @@ def kv_attend(q, cache, mask, use_kernel: bool = False):
     if use_kernel and q.shape[1] == 1:
         from ddl_tpu.ops.decode_attention import (
             decode_attention,
+            pick_block_l,
             quant_decode_attention,
         )
 
-        bias = jnp.where(mask[:1], 0.0, -1e30).astype(jnp.float32)
-        if isinstance(cache, QuantKV):
-            hkv = cache.kq.shape[-1] // d
-            return quant_decode_attention(
-                q, cache.kq, cache.ks, cache.vq, cache.vs, bias, hkv=hkv
-            )
-        hkv = cache[0].shape[-1] // d
-        return decode_attention(q, cache[0], cache[1], bias, hkv=hkv)
+        fused = (cache.kq if isinstance(cache, QuantKV) else cache[0]).shape[-1]
+        L = (cache.kq if isinstance(cache, QuantKV) else cache[0]).shape[1]
+        # cache lengths with no alignment-legal tile keep the einsum path
+        if pick_block_l(L, fused) is not None:
+            bias = jnp.where(mask[:1], 0.0, -1e30).astype(jnp.float32)
+            if isinstance(cache, QuantKV):
+                hkv = fused // d
+                return quant_decode_attention(
+                    q, cache.kq, cache.ks, cache.vq, cache.vs, bias,
+                    hkv=hkv,
+                )
+            hkv = fused // d
+            return decode_attention(q, cache[0], cache[1], bias, hkv=hkv)
     if isinstance(cache, QuantKV):
         hkv = cache.kq.shape[-1] // d
         return quant_dense_attention(

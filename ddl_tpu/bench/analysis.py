@@ -90,6 +90,28 @@ def throughput_per_job(log_dir: Path) -> dict[str, dict[str, float]]:
     return out
 
 
+def phase_breakdown_per_job(log_dir: Path) -> dict[str, dict[str, float]]:
+    """Per-job step-phase totals (seconds) from the structured event
+    streams (``ddl_tpu/obs/``) that trainers write beside the CSVs —
+    the sub-period attribution the reference's CSV schema cannot carry.
+    Jobs without an event stream (reference-framework runs, pre-obs
+    logs) are simply absent."""
+    from ddl_tpu.obs.report import load_run, summarize_run
+
+    out: dict[str, dict[str, float]] = {}
+    by_job = log_dir / "by_job_id"
+    if not by_job.is_dir():
+        return out
+    for job_dir in sorted(by_job.glob("*")):
+        events = load_run(log_dir, job_dir.name)
+        if not events:
+            continue
+        summary = summarize_run(events)
+        if summary["phases"]:
+            out[job_dir.name] = summary["phases"]
+    return out
+
+
 def comm_time_summary(log_dir: Path) -> dict[str, dict]:
     """Per-job mean round-trip excluding iteration 0 (notebook cell 9)."""
     f = log_dir / "communication_time.csv"
@@ -126,6 +148,13 @@ def main(argv=None):
     print("== mean throughput per job ==")
     for job, rates in throughput_per_job(log_dir).items():
         print(f"  {job}: " + " ".join(f"{m}={v:.1f}" for m, v in rates.items()))
+    print("== step-phase breakdown per job (s, from event streams) ==")
+    for job, phases in phase_breakdown_per_job(log_dir).items():
+        body = " ".join(
+            f"{name}={dur:.2f}"
+            for name, dur in sorted(phases.items(), key=lambda kv: -kv[1])
+        )
+        print(f"  {job}: {body}")
     print("== communication round-trip per job ==")
     for job, r in comm_time_summary(log_dir).items():
         print(f"  {job}: mean={r['mean_ms']:.3f}ms init={r['init_ms']:.1f}ms n={r['iterations']}")

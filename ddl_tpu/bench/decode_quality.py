@@ -87,7 +87,10 @@ def main() -> None:
     # params-only restore: the skeleton comes from the snapshot's own
     # metadata, so any optimizer chain/schedule the training run used is
     # irrelevant here
-    params = load_params(args.checkpoint_dir, args.job_id, args.step)
+    # vocab_size resolves a format-less snapshot's lm_head orientation
+    params = load_params(
+        args.checkpoint_dir, args.job_id, args.step, vocab_size=cfg.vocab_size
+    )
     from ddl_tpu.parallel.lm_pipeline import saved_pipe_stages
 
     if saved_pipe_stages(params) > 1:

@@ -68,6 +68,15 @@ def save_snapshot(
     return path
 
 
+def _metadata_tree(ckptr, path):
+    """The saved item's metadata tree, across orbax versions: newer
+    checkpointers wrap it (``.item_metadata.tree``), older ones return
+    the tree directly."""
+    md = ckptr.metadata(path)
+    md = getattr(md, "item_metadata", md)
+    return getattr(md, "tree", md)
+
+
 def _kp_norm(key_path) -> tuple:
     """Normalise a tree key path to comparable strings (DictKey /
     GetAttrKey / SequenceKey all stringify differently)."""
@@ -174,7 +183,7 @@ def load_snapshot(
     with ocp.StandardCheckpointer() as ckptr:
         saved_md = None
         try:
-            saved_md = ckptr.metadata(path).item_metadata.tree
+            saved_md = _metadata_tree(ckptr, path)
         except (OSError, ValueError, KeyError, AttributeError) as e:
             # metadata is only needed for the format/orientation checks;
             # restore still works without it — but say so, or a needed
@@ -235,7 +244,10 @@ def load_snapshot(
 
 
 def load_params(
-    checkpoint_dir: str | os.PathLike, job_id: str, epoch: int
+    checkpoint_dir: str | os.PathLike,
+    job_id: str,
+    epoch: int,
+    vocab_size: int | None = None,
 ) -> Any:
     """Restore ONLY the parameter tree of a snapshot.
 
@@ -243,19 +255,103 @@ def load_params(
     (shape/dtype per leaf), so no optimizer needs reconstructing — the
     decode/eval tools (``bench/decode_quality.py``) cannot know the
     training run's optax chain (schedules/weight-decay change the
-    opt_state structure, and a mismatched skeleton fails the restore)."""
+    opt_state structure, and a mismatched skeleton fails the restore).
+    Only the ``params`` subtree's bytes are read (a partial-tree
+    restore — the opt_state, at ~2x the params bytes for Adam, stays on
+    disk), and the snapshot ``format`` field gets the same treatment as
+    ``load_snapshot``: newer-writer snapshots warn, and format-less
+    snapshots get the lm_head orientation check.  Unlike
+    ``load_snapshot`` there is no caller-supplied abstract tree to
+    shape-compare against, so pass ``vocab_size`` (the decode tools
+    know their LMConfig) to resolve a format-less head's orientation
+    exactly; without it, a format-less non-square head restores
+    as-saved with a loud warning rather than being guessed at — a
+    format-less snapshot may be either orientation (the field and the
+    vocab-major layout did not land in the same snapshot population)."""
+    import numpy as np
+
     path = snapshot_path(checkpoint_dir, job_id, epoch)
     md = snapshot_metadata(checkpoint_dir, job_id, epoch)
+    params_md = md["state"]["params"]
 
     def to_abstract(leaf):
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
             return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
         return leaf
 
-    abstract = jax.tree.map(to_abstract, md)
-    with ocp.StandardCheckpointer() as ckptr:
-        restored = ckptr.restore(path, abstract)
-    return restored["state"]["params"]
+    abstract = jax.tree.map(to_abstract, params_md)
+    has_format = isinstance(md, dict) and "format" in md
+    skeleton: dict = {"state": {"params": abstract}}
+    restore_args: dict = {
+        "state": {
+            "params": jax.tree.map(
+                lambda _: ocp.RestoreArgs(restore_type=np.ndarray), abstract
+            )
+        }
+    }
+    if has_format:
+        skeleton["format"] = 0
+        restore_args["format"] = ocp.RestoreArgs(restore_type=int)
+    # transforms={} puts the handler in partial-restore mode: saved
+    # subtrees absent from the skeleton (opt_state, batch_stats, epoch)
+    # are skipped, not read
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(
+            path,
+            args=ocp.args.PyTreeRestore(
+                item=skeleton, transforms={}, restore_args=restore_args
+            ),
+        )
+    saved_format = int(restored.get("format", 0)) if has_format else 0
+    if saved_format > SNAPSHOT_FORMAT:
+        import warnings
+
+        warnings.warn(
+            f"snapshot at {path} has format {saved_format}, newer than "
+            f"this code's {SNAPSHOT_FORMAT} — it was written by a newer "
+            "version and may use a layout this loader does not know "
+            "about; restored values may be misinterpreted",
+            stacklevel=2,
+        )
+    params = restored["state"]["params"]
+    if not has_format:
+        # Format-less snapshot: the head may be either orientation (the
+        # skeleton here comes from the snapshot's own metadata, so
+        # load_snapshot's shape comparison has nothing to compare
+        # against).  With the caller's vocab_size the orientation is
+        # decidable exactly; without it, restore as-saved and say so.
+        def migrate(kp, leaf):
+            if (
+                _is_head_kernel_path(kp)
+                and len(getattr(leaf, "shape", ())) == 2
+            ):
+                import warnings
+
+                if leaf.shape[0] == leaf.shape[1]:
+                    warnings.warn(
+                        "format-less snapshot with a SQUARE lm_head "
+                        f"kernel {leaf.shape}: orientation cannot be "
+                        "inferred; restoring as-is.  If this snapshot "
+                        "predates the vocab-major head layout, the "
+                        "restored kernel is transposed.",
+                        stacklevel=3,
+                    )
+                    return leaf
+                if vocab_size is None:
+                    warnings.warn(
+                        "format-less snapshot: lm_head kernel "
+                        f"{leaf.shape} orientation unverified (pass "
+                        "vocab_size= to migrate a pre-vocab-major "
+                        "snapshot exactly); restoring as-saved",
+                        stacklevel=3,
+                    )
+                    return leaf
+                if leaf.shape[0] != vocab_size and leaf.shape[1] == vocab_size:
+                    return np.transpose(leaf)  # saved (d_model, vocab)
+            return leaf
+
+        params = jax.tree_util.tree_map_with_path(migrate, params)
+    return params
 
 
 def snapshot_metadata(
@@ -274,7 +370,7 @@ def snapshot_metadata(
                else f" (job {job_id!r} has no snapshots)")
         )
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.metadata(path).item_metadata.tree
+        return _metadata_tree(ckptr, path)
 
 
 def resolve_resume(

@@ -11,17 +11,34 @@ plus dotted overrides, e.g.
 
     python -m ddl_tpu.cli --preset dp_pp --set mesh.data=4 mesh.pipe=2 \
         data.global_batch_size=40 train.max_epochs=30
+
+Run inspection over the structured event streams every trainer writes
+(``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
+
+    python -m ddl_tpu.cli obs summarize <job_id> [--log-dir DIR]
+    python -m ddl_tpu.cli obs tail <job_id> [-n 20]
+    python -m ddl_tpu.cli obs diff <job_a> <job_b>
 """
 
 from __future__ import annotations
 
 import json
-
-from ddl_tpu.config import parse_cli, to_dict
-from ddl_tpu.launch import bootstrap, world_info
+import sys
 
 
 def main(argv=None) -> None:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "obs":
+        # pure event-file analysis: no JAX init, runs anywhere the log
+        # directory is mounted
+        from ddl_tpu.obs.report import main as obs_main
+
+        return obs_main(argv[1:])
+
+    from ddl_tpu.config import parse_cli, to_dict
+    from ddl_tpu.launch import bootstrap, world_info
+
     cfg = parse_cli(argv)
     bootstrap()
     info = world_info()

@@ -1,0 +1,131 @@
+"""Version-gated JAX API shims.
+
+The framework is written against current JAX surface names
+(``jax.set_mesh``, ``jax.shard_map`` with ``check_vma``/``axis_names``,
+``pallas.tpu.CompilerParams``); older runtimes spell the same features
+differently (``Mesh.__enter__``, ``jax.experimental.shard_map`` with
+``check_rep``/``auto``, ``TPUCompilerParams``).  Rather than scatter
+try/except at 25 call sites, ``install()`` — run once at package import
+— aliases the modern names onto an old runtime when they are missing.
+On a current JAX every branch is a no-op.  The shim only fills holes —
+with ONE deliberate exception: on old runtimes ``jax.jit`` is wrapped
+to drop ``donate_argnums``/``donate_argnames``, because old jaxlib
+mis-aliases donated buffers under shard_map (runtime INTERNAL
+"Expected aliased input ... same size" errors, and a segfault on the
+SIGTERM-preemption path).  Donation is purely a memory optimization,
+so on those runtimes its savings are forfeited rather than crashing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+__all__ = ["install"]
+
+
+@contextlib.contextmanager
+def _set_mesh(mesh):
+    # Modern jax.set_mesh sets the ambient mesh; the legacy equivalent
+    # for "flax logical rules + with_sharding_constraint resolve against
+    # this mesh" is the Mesh context manager (thread-resources env).
+    with mesh:
+        yield
+
+
+def _ambient_mesh():
+    from jax._src import mesh as mesh_lib
+
+    m = mesh_lib.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def _shard_map_compat(
+    f=None,
+    *,
+    mesh=None,
+    in_specs=None,
+    out_specs=None,
+    check_vma=None,
+    check_rep=None,
+    axis_names=None,
+    **kwargs,
+):
+    """Modern ``jax.shard_map`` front over the legacy
+    ``jax.experimental.shard_map``: decorator form (``f=None``),
+    ``check_vma`` -> ``check_rep``, ``axis_names`` (manual axes) ->
+    ``auto`` (its complement), ambient mesh when ``mesh`` is omitted."""
+    if f is None:
+        return functools.partial(
+            _shard_map_compat,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+            check_rep=check_rep,
+            axis_names=axis_names,
+            **kwargs,
+        )
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    if mesh is None:
+        mesh = _ambient_mesh()
+        if mesh is None:
+            raise ValueError(
+                "shard_map without an explicit mesh needs an ambient one "
+                "(wrap the call in jax.set_mesh(mesh))"
+            )
+    if check_rep is None:
+        check_rep = check_vma
+    if check_rep is not None:
+        kwargs["check_rep"] = check_rep
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+    return legacy_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def _axis_size(axis_name):
+    # psum of a literal 1 is special-cased to the static axis size on
+    # every JAX that predates lax.axis_size
+    return jax.lax.psum(1, axis_name)
+
+
+def _jit_without_donation(orig_jit):
+    """Old runtimes mis-alias donated buffers under shard_map (runtime
+    INTERNAL: "Expected aliased input ... to have the same size");
+    donation is purely an optimization, so on those runtimes strip it
+    rather than crash."""
+
+    @functools.wraps(orig_jit)
+    def jit(*args, **kwargs):
+        kwargs.pop("donate_argnums", None)
+        kwargs.pop("donate_argnames", None)
+        return orig_jit(*args, **kwargs)
+
+    return jit
+
+
+def install() -> None:
+    modern = hasattr(jax, "set_mesh")
+    if not modern:
+        jax.set_mesh = _set_mesh
+        jax.jit = _jit_without_donation(jax.jit)
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"
+        ):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:
+        pass

@@ -141,6 +141,7 @@ def make_lm_generator(
     max_len: int | None = None,
     rolling: bool | None = None,
     kv_quant: bool = False,
+    obs=None,
 ):
     """Build a jitted ``generate(params, prompt, rng) -> tokens`` function.
 
@@ -180,6 +181,16 @@ def make_lm_generator(
     *weights* too, pass ``ops.quant.quantize_lm_params(params)`` as the
     params — no generator flag needed (the matmul modules sniff the
     quantized tree).
+
+    ``obs`` (an ``obs.events.EventWriter``) turns on per-request
+    telemetry: each ``run()`` emits a ``decode_request`` span with
+    ``dispatch``/``wait`` child spans and one ``decode`` event carrying
+    request tokens/s.  Prefill and the per-token scan are ONE fused XLA
+    program, so there is no host boundary to time individual decode
+    steps at — the dispatch/wait split is the finest host-visible
+    attribution; per-step device time lives in the profiler trace
+    (``bench/profile_decode.py``).  The fence it needs makes the request
+    synchronous, which serving callers are anyway.
     """
     if max_len is None:
         max_len = prompt_len + max_new
@@ -271,10 +282,43 @@ def make_lm_generator(
         out_shardings=tok_sharding,
     )
 
+    warmed = False
+
     def run(params, prompt, rng=None):
+        nonlocal warmed
         if rng is None:
             rng = jax.random.key(0)
-        with jax.set_mesh(mesh):
-            return jitted(params, prompt, rng)
+        if obs is None:
+            with jax.set_mesh(mesh):
+                return jitted(params, prompt, rng)
+        from time import perf_counter
+
+        from ddl_tpu.utils.timing import fence
+
+        # the first request pays the XLA compile; flag it so summaries
+        # can exclude it from steady-state tokens/s (the same warmup
+        # discipline as bench/analysis.comm_time_summary)
+        warm, warmed = warmed, True
+        t0 = perf_counter()
+        with obs.span(
+            "decode_request", prompt_len=prompt_len, max_new=max_new,
+            batch=batch,
+        ):
+            with obs.span("dispatch"):
+                with jax.set_mesh(mesh):
+                    toks = jitted(params, prompt, rng)
+            with obs.span("wait"):
+                fence(toks)
+        dur = perf_counter() - t0
+        obs.emit(
+            "decode",
+            prompt_len=prompt_len,
+            new_tokens=max_new,
+            batch=batch,
+            dur=dur,
+            tok_per_s=batch * max_new / dur if dur > 0 else None,
+            warm=warm,
+        )
+        return toks
 
     return run

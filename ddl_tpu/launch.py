@@ -21,7 +21,24 @@ import os
 
 import jax
 
-__all__ = ["bootstrap", "world_info", "force_cpu_devices"]
+__all__ = ["bootstrap", "host_id", "world_info", "force_cpu_devices"]
+
+
+def host_id() -> int:
+    """This process's host index for telemetry (``obs/events.py`` stamps
+    it into every event).  The launcher env (``DDL_HOST_ID``, falling
+    back to the multihost rank ``DDL_PROCESS_ID``) wins so event files
+    are correctly attributed even before/without ``bootstrap()``; else
+    the JAX process index (0 on a single host)."""
+    # set-but-empty vars count as unset (launchers template them from
+    # possibly-empty scheduler vars), matching bootstrap()'s tolerance
+    env = os.environ.get("DDL_HOST_ID") or os.environ.get("DDL_PROCESS_ID")
+    if env:
+        return int(env)
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
 
 
 def force_cpu_devices(n: int) -> None:
@@ -91,6 +108,7 @@ def world_info() -> dict:
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
+        "host_id": host_id(),
         "local_devices": [str(d) for d in jax.local_devices()],
         "global_device_count": jax.device_count(),
         "platform": jax.devices()[0].platform,

@@ -1,0 +1,39 @@
+"""Unified runtime telemetry: structured events, step-phase spans,
+stall watchdog, anomaly detection, and run inspection.
+
+The reference's only observability is append-only per-metric CSVs on a
+NAS (``single.py:260-269``).  This package is the shared event model the
+CSVs lack: every trainer family and the decode path write one JSONL
+event stream per host (``obs/events.py``), with per-step phase spans
+(``obs/steptrace.py``), a liveness watchdog that dumps thread stacks
+instead of hanging silently (``obs/watchdog.py``), rolling anomaly
+detectors (``obs/anomaly.py``), and a run-inspection CLI
+(``obs/report.py``, ``python -m ddl_tpu.cli obs ...``).
+
+The CSVs keep the reference schema and stay the cross-run aggregation
+surface (``bench/analysis.py``); the event stream adds what they cannot
+express — nesting, per-host liveness, and sub-period attribution.
+"""
+
+from ddl_tpu.obs.anomaly import (
+    AnomalyMonitor,
+    HBMGrowthDetector,
+    LossSpikeDetector,
+    ThroughputRegressionDetector,
+)
+from ddl_tpu.obs.events import EventWriter, events_path, read_events
+from ddl_tpu.obs.steptrace import PHASES, StepTrace
+from ddl_tpu.obs.watchdog import Watchdog
+
+__all__ = [
+    "AnomalyMonitor",
+    "EventWriter",
+    "HBMGrowthDetector",
+    "LossSpikeDetector",
+    "PHASES",
+    "StepTrace",
+    "ThroughputRegressionDetector",
+    "Watchdog",
+    "events_path",
+    "read_events",
+]

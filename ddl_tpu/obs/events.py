@@ -1,0 +1,135 @@
+"""Structured JSONL event/span writer.
+
+One file per host at ``<log_dir>/by_job_id/<job_id>/events-h<host>.jsonl``
+— beside the reference-schema metric CSVs, so a run directory carries
+both views of the same run.  Every line is one JSON object with a fixed
+envelope:
+
+    ts    wall-clock unix seconds (cross-host alignment, NTP precision)
+    mono  monotonic seconds (exact ordering/durations within a host)
+    run   run id — one per trainer/process launch (DDL_RUN_ID or random)
+    host  process index (multihost runs write disjoint files)
+    step  step/period context, or null
+    kind  event kind ("span", "period", "heartbeat", "stall", ...)
+
+plus kind-specific fields.  Spans add ``name``/``dur`` and record their
+nesting (``parent``/``depth``) from a per-thread span stack, so a phase
+inside a period inside a run reconstructs without timestamps agreeing
+across threads.  Writes are line-buffered and flushed per event — a
+hung or SIGKILLed job keeps everything up to its last completed event,
+which is the point (the watchdog's stall dump must survive the death it
+predicts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from pathlib import Path
+
+__all__ = ["EventWriter", "events_path", "read_events"]
+
+
+def events_path(log_dir: str | os.PathLike, job_id: str, host: int = 0) -> Path:
+    return Path(log_dir) / "by_job_id" / job_id / f"events-h{host:03d}.jsonl"
+
+
+def _default_host() -> int:
+    from ddl_tpu.launch import host_id
+
+    return host_id()
+
+
+class EventWriter:
+    """Append JSON event lines; thread-safe (the watchdog thread emits
+    through the same writer as the training loop)."""
+
+    def __init__(
+        self,
+        log_dir: str | os.PathLike,
+        job_id: str,
+        host: int | None = None,
+        run_id: str | None = None,
+    ) -> None:
+        self.job_id = job_id
+        self.host = _default_host() if host is None else int(host)
+        self.run_id = run_id or os.environ.get("DDL_RUN_ID") or uuid.uuid4().hex[:12]
+        self.path = events_path(log_dir, job_id, self.host)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._file = open(self.path, "a", buffering=1)
+        self._spans = threading.local()  # per-thread open-span name stack
+
+    def emit(self, kind: str, step: int | None = None, **fields) -> dict:
+        event = {
+            "ts": time.time(),
+            "mono": time.monotonic(),
+            "run": self.run_id,
+            "host": self.host,
+            "step": step,
+            "kind": kind,
+            **fields,
+        }
+        line = json.dumps(event, default=_jsonable)
+        with self._lock:
+            if self._file.closed:  # e.g. a second train() after finish()
+                self._file = open(self.path, "a", buffering=1)
+            self._file.write(line + "\n")
+            self._file.flush()
+        return event
+
+    @contextmanager
+    def span(self, name: str, step: int | None = None, **fields):
+        """Time a region and emit one ``span`` event on exit, recording
+        its parent/depth from this thread's open-span stack."""
+        stack = getattr(self._spans, "stack", None)
+        if stack is None:
+            stack = self._spans.stack = []
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            stack.pop()
+            self.emit(
+                "span", step=step, name=name, dur=dur,
+                parent=parent, depth=len(stack), **fields,
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._file.closed:
+                self._file.close()
+
+
+def _jsonable(x):
+    """Fallback encoder: numpy scalars and anything else stringifiable."""
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return str(x)
+
+
+def read_events(path: str | os.PathLike) -> list[dict]:
+    """Parse one event file; tolerates a torn final line (the writer may
+    have died mid-write — everything before it is still valid)."""
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except FileNotFoundError:
+        pass
+    return events

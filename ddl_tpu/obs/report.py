@@ -1,0 +1,292 @@
+"""Run inspection over the JSONL event stream.
+
+``python -m ddl_tpu.cli obs <command>``:
+
+    summarize <job_id>          throughput trend, phase breakdown table,
+                                anomalies, stalls, peak HBM, per-host
+                                liveness
+    tail <job_id> [-n N]        last N events, rendered one per line
+    diff <job_a> <job_b>        phase/throughput comparison of two runs
+
+Pure stdlib + the event files — no JAX import, so it runs anywhere the
+NAS/log directory is mounted (the reference's analysis had the same
+property for its CSVs; ``bench/analysis.py`` keeps that role and calls
+into this module for the event-side sections).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from collections import defaultdict
+from pathlib import Path
+
+from ddl_tpu.obs.events import read_events
+
+__all__ = [
+    "diff_runs",
+    "load_run",
+    "main",
+    "render_summary",
+    "summarize_run",
+]
+
+
+def _job_dir(log_dir: str | os.PathLike, job_id: str) -> Path:
+    return Path(log_dir) / "by_job_id" / job_id
+
+
+def load_run(log_dir: str | os.PathLike, job_id: str) -> list[dict]:
+    """All hosts' events for a job, ordered by wall clock (cross-host
+    monotonic clocks don't compare; ts is NTP-close)."""
+    events = []
+    for f in sorted(_job_dir(log_dir, job_id).glob("events-h*.jsonl")):
+        events.extend(read_events(f))
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events
+
+
+def summarize_run(events: list[dict]) -> dict:
+    """Aggregate one run's events into the summary dict the CLI renders."""
+    phases: dict[str, float] = defaultdict(float)
+    # Run-level totals come from ONE representative host: every host
+    # emits its own period events for the same global periods, so
+    # summing across hosts would report N-times-inflated steps/elapsed/
+    # phase seconds on exactly the multihost runs this tool targets.
+    # (The per-host section below keeps the per-host view.)
+    all_periods = [e for e in events if e.get("kind") == "period"]
+    p_host = min((e.get("host", 0) for e in all_periods), default=0)
+    periods = [e for e in all_periods if e.get("host", 0) == p_host]
+    for e in periods:
+        for name, dur in (e.get("phases") or {}).items():
+            phases[name] += dur
+    if not periods:  # span-only streams (e.g. decode) still break down
+        # top-level spans only: a parent's duration already contains its
+        # children's, so summing every depth would double-count
+        for e in events:
+            if e.get("kind") == "span" and not e.get("depth"):
+                phases[e.get("name", "?")] += e.get("dur", 0.0)
+
+    sps = [e["steps_per_sec"] for e in periods if e.get("steps_per_sec")]
+    half = len(sps) // 2
+    trend = None
+    if half >= 1:
+        first = sum(sps[:half]) / half
+        second = sum(sps[half:]) / (len(sps) - half)
+        trend = {"first_half": first, "second_half": second,
+                 "ratio": second / first if first else None}
+
+    # Per-host liveness: span/heartbeat steps are one global monotone
+    # counter per host (every family stamps global steps), so they are
+    # the straggler comparator; period events' step column is the CSV
+    # 'epoch' index (a different unit for the epoch families) and is
+    # used only when a host emitted no finer-grained signal at all —
+    # consistent across hosts, since all run the same configuration.
+    hosts: dict[int, dict] = {}
+    for e in events:
+        h = e.get("host", 0)
+        rec = hosts.setdefault(
+            h, {"last_step": None, "_period_step": None, "last_ts": None,
+                "stalls": 0}
+        )
+        step = e.get("step")
+        if step is not None:
+            if e.get("kind") in ("span", "heartbeat", "stall"):
+                rec["last_step"] = (
+                    step if rec["last_step"] is None
+                    else max(rec["last_step"], step)
+                )
+            elif e.get("kind") == "period":
+                rec["_period_step"] = step
+        if e.get("kind") == "stall":
+            rec["stalls"] += 1
+        rec["last_ts"] = e.get("ts", rec["last_ts"])
+    for rec in hosts.values():
+        if rec["last_step"] is None:
+            rec["last_step"] = rec.pop("_period_step")
+        else:
+            rec.pop("_period_step")
+
+    decodes = [e for e in events if e.get("kind") == "decode"]
+    decode = None
+    if decodes:
+        # steady-state rate: warm requests only (the first request per
+        # generator pays the XLA compile), unless nothing warm exists
+        warm = [e for e in decodes if e.get("warm")] or decodes
+        rates = [e["tok_per_s"] for e in warm if e.get("tok_per_s")]
+        decode = {
+            "requests": len(decodes),
+            "tokens": sum(e.get("new_tokens", 0) * e.get("batch", 1)
+                          for e in decodes),
+            "mean_tok_per_s": sum(rates) / len(rates) if rates else None,
+        }
+
+    hbm = [e["hbm_peak_bytes"] for e in periods if e.get("hbm_peak_bytes")]
+    return {
+        "runs": sorted({e.get("run") for e in events if e.get("run")}),
+        "events": len(events),
+        "periods": len(periods),
+        "steps": sum(e.get("steps", 0) for e in periods),
+        "elapsed": sum(e.get("elapsed", 0.0) for e in periods),
+        "compiles": sum(e.get("compiles", 0) for e in periods),
+        "phases": dict(phases),
+        "throughput_trend": trend,
+        "anomalies": [e for e in events if e.get("kind") == "anomaly"],
+        "stalls": [e for e in events if e.get("kind") == "stall"],
+        "peak_hbm_bytes": max(hbm) if hbm else None,
+        "hosts": hosts,
+        "decode": decode,
+    }
+
+
+def render_summary(s: dict, job_id: str = "") -> str:
+    lines = []
+    title = f"run summary{f' — {job_id}' if job_id else ''}"
+    lines.append(f"== {title} ==")
+    lines.append(
+        f"runs: {len(s['runs'])} | events: {s['events']} | periods: "
+        f"{s['periods']} | steps: {s['steps']} | compiles: {s['compiles']}"
+    )
+    trend = s["throughput_trend"]
+    if trend:
+        lines.append(
+            f"throughput: {trend['first_half']:.2f} -> "
+            f"{trend['second_half']:.2f} steps/s "
+            f"(x{trend['ratio']:.2f} second half vs first)"
+        )
+    if s["peak_hbm_bytes"]:
+        lines.append(f"peak HBM: {s['peak_hbm_bytes'] / 1e9:.2f} GB")
+    if s["phases"]:
+        total = sum(s["phases"].values()) or 1.0
+        lines.append("-- phase breakdown --")
+        lines.append(f"{'phase':<12} {'total_s':>10} {'share':>7}")
+        for name, dur in sorted(
+            s["phases"].items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(f"{name:<12} {dur:>10.3f} {dur / total:>6.1%}")
+    if s["decode"]:
+        d = s["decode"]
+        rate = (
+            f"{d['mean_tok_per_s']:.1f} tok/s"
+            if d["mean_tok_per_s"] else "n/a"
+        )
+        lines.append(
+            f"decode: {d['requests']} requests, {d['tokens']} tokens, {rate}"
+        )
+    lines.append(f"-- anomalies ({len(s['anomalies'])}) --")
+    for a in s["anomalies"]:
+        lines.append(
+            f"  [{a.get('type')}] step {a.get('idx', a.get('step'))}: "
+            f"value {a.get('value'):.4g} vs baseline {a.get('baseline'):.4g}"
+        )
+    if s["stalls"]:
+        lines.append(f"-- stalls ({len(s['stalls'])}) --")
+        for st in s["stalls"]:
+            lines.append(
+                f"  host {st.get('host')}: last step {st.get('step')}, "
+                f"{st.get('age', 0):.1f}s past deadline "
+                f"{st.get('deadline', 0):.1f}s "
+                f"({len(st.get('stacks', {}))} thread stacks captured)"
+            )
+    if len(s["hosts"]) > 1:
+        lines.append("-- hosts --")
+        steps = {h: r["last_step"] for h, r in s["hosts"].items()}
+        ahead = max((v for v in steps.values() if v is not None), default=None)
+        for h, rec in sorted(s["hosts"].items()):
+            behind = (
+                f" (behind by {ahead - rec['last_step']})"
+                if ahead is not None and rec["last_step"] is not None
+                and rec["last_step"] < ahead
+                else ""
+            )
+            lines.append(
+                f"  host {h}: last step {rec['last_step']}"
+                f"{behind}, stalls {rec['stalls']}"
+            )
+    return "\n".join(lines)
+
+
+def diff_runs(sa: dict, sb: dict, job_a: str, job_b: str) -> str:
+    lines = [f"== diff: {job_a} vs {job_b} =="]
+
+    def rate(s):
+        return s["steps"] / s["elapsed"] if s["elapsed"] else None
+
+    ra, rb = rate(sa), rate(sb)
+    if ra and rb:
+        lines.append(
+            f"steps/s: {ra:.2f} vs {rb:.2f} (x{rb / ra:.2f})"
+        )
+    lines.append(f"{'phase':<12} {job_a[:14]:>14} {job_b[:14]:>14} {'delta':>8}")
+    for name in sorted(set(sa["phases"]) | set(sb["phases"])):
+        a = sa["phases"].get(name, 0.0)
+        b = sb["phases"].get(name, 0.0)
+        delta = f"{(b - a) / a:+.0%}" if a else "new"
+        lines.append(f"{name:<12} {a:>13.3f}s {b:>13.3f}s {delta:>8}")
+    lines.append(
+        f"anomalies: {len(sa['anomalies'])} vs {len(sb['anomalies'])} | "
+        f"stalls: {len(sa['stalls'])} vs {len(sb['stalls'])} | "
+        f"compiles: {sa['compiles']} vs {sb['compiles']}"
+    )
+    return "\n".join(lines)
+
+
+def _render_event(e: dict) -> str:
+    kind = e.get("kind", "?")
+    base = f"[h{e.get('host', 0)}] {kind:<10} step={e.get('step')}"
+    extras = {
+        k: v
+        for k, v in e.items()
+        if k not in ("ts", "mono", "run", "host", "step", "kind", "stacks")
+    }
+    body = " ".join(
+        f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+        for k, v in extras.items()
+    )
+    return f"{base} {body}"
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="ddl_tpu obs", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    # shared flags live on a parent so they are accepted after the
+    # subcommand too (``obs summarize job --log-dir DIR``)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--log-dir", default="training_logs")
+    sub = ap.add_subparsers(dest="command", required=True)
+    p_sum = sub.add_parser(
+        "summarize", parents=[common], help="one run's summary"
+    )
+    p_sum.add_argument("job_id")
+    p_tail = sub.add_parser(
+        "tail", parents=[common], help="last N events of a run"
+    )
+    p_tail.add_argument("job_id")
+    p_tail.add_argument("-n", type=int, default=20)
+    p_diff = sub.add_parser("diff", parents=[common], help="compare two runs")
+    p_diff.add_argument("job_a")
+    p_diff.add_argument("job_b")
+    args = ap.parse_args(argv)
+
+    if args.command == "summarize":
+        events = load_run(args.log_dir, args.job_id)
+        if not events:
+            raise SystemExit(
+                f"no events for job {args.job_id!r} under {args.log_dir} "
+                f"(looked for {_job_dir(args.log_dir, args.job_id)}/events-h*.jsonl)"
+            )
+        print(render_summary(summarize_run(events), args.job_id))
+    elif args.command == "tail":
+        events = load_run(args.log_dir, args.job_id)
+        for e in events[-args.n:]:
+            print(_render_event(e))
+    elif args.command == "diff":
+        sa = summarize_run(load_run(args.log_dir, args.job_a))
+        sb = summarize_run(load_run(args.log_dir, args.job_b))
+        print(diff_runs(sa, sb, args.job_a, args.job_b))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,236 @@
+"""Per-step phase attribution for the shared training loop.
+
+Splits each step/period of a run into a fixed phase vocabulary —
+
+    data_wait    host-side batch production (loader / corpus sampling)
+    h2d          host-to-device transfer + global-array assembly
+    step         dispatch of the compiled train step
+    fence        blocking on device completion / metric fetch
+    eval         period-boundary evaluation
+    checkpoint   snapshot writes
+    logging      console + CSV emission
+
+— as ``span`` events (``obs/events.py``), accumulated per period and
+emitted as one ``period`` event carrying the phase-total breakdown,
+throughput, recompile count (via ``jax.monitoring``'s backend-compile
+duration events), and the HBM watermark (``utils/memory.hbm_stats``).
+XLA dispatch is asynchronous, so ``step`` measures *dispatch* and the
+device time it hides surfaces in ``fence`` — the two together bound the
+compiled program; ``utils/timing.fence`` is the true-completion fence
+behind the ``fence`` phase.
+
+``AnomalyMonitor`` rides along: every ``end_period`` feeds the rolling
+detectors, and ``finish()`` surfaces everything they caught.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+from ddl_tpu.obs.anomaly import AnomalyMonitor
+from ddl_tpu.obs.events import EventWriter
+
+__all__ = ["PHASES", "StepTrace"]
+
+PHASES = (
+    "data_wait",
+    "h2d",
+    "step",
+    "fence",
+    "eval",
+    "checkpoint",
+    "logging",
+)
+
+
+class _CompileCounter:
+    """Process-wide recompile counter fed by ``jax.monitoring``'s
+    backend-compile duration events.  Registered once, never removed
+    (listener registries are append-only); counts every XLA backend
+    compile after the first use, which is exactly the recompile signal
+    a steady-state training loop wants to see stay flat."""
+
+    _shared = None
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.secs = 0.0
+
+    @classmethod
+    def shared(cls) -> "_CompileCounter":
+        if cls._shared is None:
+            counter = cls()
+            try:
+                from jax import monitoring
+
+                def _on_duration(event, duration, **kw):
+                    if "backend_compile" in event:
+                        counter.count += 1
+                        counter.secs += duration
+
+                monitoring.register_event_duration_secs_listener(_on_duration)
+            except Exception:
+                pass
+            cls._shared = counter
+        return cls._shared
+
+
+class StepTrace:
+    """The object a trainer threads through its loop.
+
+    ``phase(name)`` is the single instrumentation primitive: a context
+    manager that times the region, emits a ``span`` event, adds the
+    duration to the current period's totals, and beats the watchdog
+    (when one is attached) so the stall deadline bounds a phase, not a
+    whole period.
+    """
+
+    def __init__(
+        self,
+        writer: EventWriter,
+        anomaly: AnomalyMonitor | None = None,
+        emit_step_spans: bool = True,
+    ) -> None:
+        self.writer = writer
+        self.anomaly = anomaly if anomaly is not None else AnomalyMonitor(writer)
+        self.emit_step_spans = emit_step_spans
+        self.watchdog = None
+        self._compiles = _CompileCounter.shared()
+        self._period = None
+        self._period_compiles = self._compiles.count
+        self._totals: dict[str, float] = defaultdict(float)
+        self.run_totals: dict[str, float] = defaultdict(float)
+        self._needs_run_start = False  # set by finish() for train() reuse
+
+    @classmethod
+    def create(
+        cls,
+        log_dir,
+        job_id: str,
+        family: str,
+        host: int | None = None,
+        emit_step_spans: bool | None = None,
+        **writer_kwargs,
+    ) -> "StepTrace":
+        """One-line trainer wiring: build the writer, emit ``run_start``.
+
+        ``emit_step_spans=None`` reads the ``DDL_OBS_STEP_SPANS`` env
+        var (``0``/``false`` disables) — the operator escape hatch for
+        runs where two flushed JSONL writes per step onto a NAS is real
+        overhead; period events (phase totals, throughput, anomalies)
+        keep flowing either way."""
+        if emit_step_spans is None:
+            env = os.environ.get("DDL_OBS_STEP_SPANS", "").lower()
+            emit_step_spans = env not in ("0", "false", "off")
+        writer = EventWriter(log_dir, job_id, host=host, **writer_kwargs)
+        writer.emit("run_start", family=family, job_id=job_id)
+        return cls(writer, emit_step_spans=emit_step_spans)
+
+    @contextmanager
+    def phase(self, name: str, step: int | None = None, **fields):
+        t0 = time.perf_counter()
+        try:
+            if self.emit_step_spans:
+                with self.writer.span(
+                    name, step=step, period=self._period, **fields
+                ):
+                    yield
+            else:
+                yield
+        finally:
+            dur = time.perf_counter() - t0
+            self._totals[name] += dur
+            self.run_totals[name] += dur
+            if self.watchdog is not None:
+                self.watchdog.beat(step)
+
+    def fence(self, tree, step: int | None = None) -> None:
+        """Block until ``tree``'s device values exist, attributed to the
+        ``fence`` phase (``utils/timing.fence`` — block + readback)."""
+        from ddl_tpu.utils.timing import fence
+
+        with self.phase("fence", step=step):
+            fence(tree)
+
+    def begin_period(self, period: int) -> None:
+        if self._needs_run_start:
+            # a second train() on the same trainer: mark the new segment
+            # so run_end consumers don't attribute it to the previous one
+            self.writer.emit("run_start", resumed=True)
+            self._needs_run_start = False
+        self._period = period
+        self._totals = defaultdict(float)
+        self._period_compiles = self._compiles.count
+        if self.watchdog is not None:
+            self.watchdog.beat()
+
+    def end_period(
+        self,
+        period: int,
+        idx: int,
+        elapsed: float,
+        steps: int,
+        metrics: dict | None = None,
+    ) -> dict:
+        """Emit the per-period summary event and feed the anomaly
+        detectors; returns the phase-total dict."""
+        from ddl_tpu.utils.memory import hbm_stats
+
+        phases = dict(self._totals)
+        mem = None
+        try:
+            mem = hbm_stats()
+        except Exception:
+            pass
+        loss = None
+        if metrics:
+            raw = metrics.get("loss")
+            loss = float(raw) if raw is not None else None
+        steps_per_sec = steps / elapsed if elapsed > 0 else 0.0
+        self.writer.emit(
+            "period",
+            step=idx,
+            period=period,
+            steps=steps,
+            elapsed=elapsed,
+            steps_per_sec=steps_per_sec,
+            phases=phases,
+            loss=loss,
+            compiles=self._compiles.count - self._period_compiles,
+            hbm_bytes_in_use=mem["bytes_in_use"] if mem else None,
+            hbm_peak_bytes=mem["peak_bytes_in_use"] if mem else None,
+        )
+        self.anomaly.observe_period(
+            idx,
+            loss=loss,
+            steps_per_sec=steps_per_sec,
+            hbm_bytes=mem["bytes_in_use"] if mem else None,
+        )
+        self._period = None
+        return phases
+
+    def finish(self, verbose: bool = True) -> list[dict]:
+        """End-of-run: emit ``run_end`` with the whole-run phase totals
+        and anomaly count, print what the detectors caught, close the
+        stream.  Returns the anomaly list."""
+        anomalies = self.anomaly.anomalies
+        self.writer.emit(
+            "run_end",
+            phases=dict(self.run_totals),
+            anomalies=len(anomalies),
+            stalls=self.watchdog.stalls if self.watchdog else 0,
+        )
+        if verbose and anomalies:
+            print(f"[obs] {len(anomalies)} anomalies detected this run:")
+            for line in self.anomaly.summary_lines():
+                print(f"[obs]   {line}")
+        self.writer.close()
+        # reset per-run state so a second train() on the same trainer
+        # reports its own segment, not cumulative double-counted totals
+        self.run_totals = defaultdict(float)
+        self.anomaly = AnomalyMonitor(self.writer)
+        self._needs_run_start = True
+        return anomalies

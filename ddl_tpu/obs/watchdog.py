@@ -1,0 +1,116 @@
+"""Per-host liveness heartbeat + hung-step stack dumps.
+
+A hung collective (one host died, the others block in all-reduce) or a
+wedged Mosaic kernel kills a multihost run *silently*: every surviving
+process sits inside a device wait with nothing on stdout.  The watchdog
+is a daemon thread per host that (a) emits ``heartbeat`` events — last
+completed step, seconds since — so the run-inspection CLI can tell
+which host stopped advancing first, and (b) when no beat arrives within
+``deadline_s``, dumps every Python thread's stack plus the
+last-completed step as a ``stall`` event *before* the job dies.  It
+never kills anything itself — the stall may be a one-off (preemptible
+storage, first-compile) and the deadline is the operator's call.  Set
+the deadline above the worst-case first-step compile, or read a
+first-step "stall" for what it is: a stack dump showing the program
+inside XLA compilation — visibility, not a false death.
+
+The training loop calls ``beat(step)`` at step granularity (wired
+through ``StepTrace.phase``), so the deadline bounds one step, not one
+period.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+
+__all__ = ["Watchdog"]
+
+
+def thread_stacks() -> dict[str, str]:
+    """Formatted stacks of every live Python thread, keyed by thread
+    name (the caller's marked with ``*``)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, f"thread-{ident}")
+        if ident == me:
+            name = f"*{name}"
+        out[name] = "".join(traceback.format_stack(frame))
+    return out
+
+
+class Watchdog:
+    def __init__(
+        self,
+        writer,
+        deadline_s: float,
+        interval_s: float | None = None,
+    ) -> None:
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.writer = writer
+        self.deadline_s = float(deadline_s)
+        # poll fast enough that a stall is caught within ~1.25 deadlines
+        self.interval_s = (
+            float(interval_s) if interval_s is not None
+            else max(self.deadline_s / 4.0, 0.01)
+        )
+        self._lock = threading.Lock()
+        self._last_beat = time.monotonic()
+        self._last_step: int | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._dumped = False
+        self.stalls = 0
+
+    def beat(self, step: int | None = None) -> None:
+        with self._lock:
+            self._last_beat = time.monotonic()
+            if step is not None:
+                self._last_step = step
+
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="ddl-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5 * self.interval_s)
+            self._thread = None
+
+    __enter__ = start
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            with self._lock:
+                age = time.monotonic() - self._last_beat
+                step = self._last_step
+            self.writer.emit("heartbeat", step=step, age=age)
+            if age > self.deadline_s:
+                if not self._dumped:
+                    # one dump per stall: the stacks won't change while
+                    # the process is wedged, and re-arming on recovery
+                    # keeps a flaky run from flooding the stream
+                    self._dumped = True
+                    self.stalls += 1
+                    self.writer.emit(
+                        "stall",
+                        step=step,
+                        age=age,
+                        deadline=self.deadline_s,
+                        stacks=thread_stacks(),
+                    )
+            else:
+                self._dumped = False

@@ -160,8 +160,11 @@ def pick_block_l(L: int, fused: int) -> int | None:
     unclamped int8 tile both neared the compile-probed scoped-VMEM
     boundary and measured SLOWER).  When no aligned divisor exists
     (e.g. L=3000), a single full-L tile is always alignment-legal and
-    is used if it fits a relaxed budget; otherwise return None and the
-    caller keeps the XLA einsum path."""
+    is used if it fits _TILE_BYTES — the same per-tile envelope the
+    probe validated; Mosaic double-buffers both K and V tiles plus the
+    f32 per-head slices, so admitting a larger "relaxed" tile here can
+    blow the ~16 MB scoped VMEM and fail at runtime.  Above the budget,
+    return None and the caller keeps the XLA einsum path."""
     limit = min(
         _MAX_AUTO_BLOCK_L,
         max(_MIN_BLOCK_L, (_TILE_BYTES // max(fused * 2, 1) // 512) * 512),
@@ -171,18 +174,38 @@ def pick_block_l(L: int, fused: int) -> int | None:
     for bl in range(limit - limit % 128, 0, -128):
         if L % bl == 0:
             return bl
-    if L * fused * 2 <= 2 * _TILE_BYTES:
+    if L * fused * 2 <= _TILE_BYTES:
         return L
     return None
 
 
-def _block_l(L: int, block_l: int | None, fused: int, itemsize: int) -> int:
+def _block_l(
+    L: int, block_l: int | None, fused: int, itemsize: int,
+    interpret: bool = False,
+) -> int:
     del itemsize  # rows costed at bf16 width (see pick_block_l)
     if block_l is not None:
-        bl = min(block_l, L)
-        while L % bl:
-            bl -= 1
-        return bl
+        if block_l >= L:
+            return L  # full array: block dims == array dims, always legal
+        if interpret:
+            # the interpreter has no alignment rules; tests use tiny
+            # tiles to exercise the multi-tile accumulator path
+            bl = block_l
+            while L % bl:
+                bl -= 1
+            return bl
+        # partial tiles must be 128-multiple divisors of L (the Mosaic
+        # lane/sublane alignment rule the module docstring states) —
+        # step down in 128s rather than hand Mosaic an unaligned tile
+        # (e.g. L=1000, block_l=512 must not land on 500)
+        for bl in range(block_l - block_l % 128, 0, -128):
+            if L % bl == 0:
+                return bl
+        raise ValueError(
+            f"block_l={block_l} has no 128-multiple divisor of L={L} at "
+            "or below it; pass a 128-multiple divisor of L, block_l >= L "
+            "(single tile), or block_l=None to auto-pick"
+        )
     bl = pick_block_l(L, fused)
     if bl is None:
         raise ValueError(
@@ -202,9 +225,9 @@ def decode_attention(q, ck, cv, bias, *, hkv: int, block_l=None,
     bias: (1, L) f32 additive mask.  Returns (B, 1, H, D)."""
     b, _, h, d = q.shape
     L = ck.shape[1]
-    bl = _block_l(L, block_l, hkv * d, ck.dtype.itemsize)
     if interpret is None:
         interpret = _interpret_default()
+    bl = _block_l(L, block_l, hkv * d, ck.dtype.itemsize, interpret)
     out = pl.pallas_call(
         functools.partial(_kernel, hkv=hkv, scale=1.0 / (d ** 0.5)),
         grid=(b, L // bl),
@@ -240,9 +263,9 @@ def quant_decode_attention(q, ck, ks, cv, vs, bias, *, hkv: int,
     bias: (1, L) f32 additive mask."""
     b, _, h, d = q.shape
     L = ck.shape[1]
-    bl = _block_l(L, block_l, hkv * d, ck.dtype.itemsize)
     if interpret is None:
         interpret = _interpret_default()
+    bl = _block_l(L, block_l, hkv * d, ck.dtype.itemsize, interpret)
     out = pl.pallas_call(
         functools.partial(_quant_kernel, hkv=hkv, scale=1.0 / (d ** 0.5)),
         grid=(b, L // bl),

@@ -37,7 +37,7 @@ from ddl_tpu import checkpoint as ckpt
 from ddl_tpu.models.transformer import LMConfig
 from ddl_tpu.parallel.sharding import LMMeshSpec
 from ddl_tpu.train.lm_steps import make_lm_step_fns
-from ddl_tpu.train.loop import BaseTrainer
+from ddl_tpu.train.loop import BaseTrainer, _phase
 from ddl_tpu.utils import MetricLogger
 
 __all__ = ["LMRunConfig", "LMTrainer"]
@@ -123,6 +123,7 @@ class LMTrainer(BaseTrainer):
             if run.log_dir
             else None
         )
+        self._init_obs(run.log_dir, run.job_id, "lm", proc)
         self.halt_on_nan = run.halt_on_nan
         self.preemption_save = run.preemption_save
         self.profile_dir = run.profile_dir
@@ -385,13 +386,20 @@ class LMTrainer(BaseTrainer):
         p0, p1 = self._period_bounds(period)
         metrics, steps = {}, 0
         for i in range(p0, p1):
-            inp, tgt = self._sample_batch(i)
-            self.state, m = self.fns.train(self.state, inp, tgt)
+            # data_wait covers corpus sampling AND the host->device /
+            # global-array assembly (they are one call here); step is the
+            # compiled-step dispatch, whose hidden device time lands in
+            # the period-end fence below
+            with _phase(self.obs, "data_wait", step=i):
+                inp, tgt = self._sample_batch(i)
+            with _phase(self.obs, "step", step=i):
+                self.state, m = self.fns.train(self.state, inp, tgt)
             steps += 1
             if guard is not None and guard.requested:
                 break
         if steps:
-            metrics = {k: float(v) for k, v in m.items()}
+            with _phase(self.obs, "fence", step=p0 + steps - 1):
+                metrics = {k: float(v) for k, v in m.items()}
             self._maybe_anneal_capacity(metrics)
         return metrics, steps
 

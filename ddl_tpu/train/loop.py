@@ -26,6 +26,8 @@ snapshot.  ``train/trainer.py`` (CNN), ``train/lm_trainer.py`` and
 
 from __future__ import annotations
 
+import os
+from contextlib import nullcontext
 from time import perf_counter
 
 import jax
@@ -34,6 +36,11 @@ import numpy as np
 from ddl_tpu.utils.memory import hbm_stats
 
 __all__ = ["BaseTrainer"]
+
+
+def _phase(obs, name: str, step: int | None = None):
+    """Obs phase context, or a no-op when the trainer runs untraced."""
+    return obs.phase(name, step=step) if obs is not None else nullcontext()
 
 
 class BaseTrainer:
@@ -75,6 +82,12 @@ class BaseTrainer:
     # CSV name for the per-period wall time; step-based families relabel it
     # (their periods are windows, not epochs) and log their own epoch_time.
     time_metric = "epoch_time"
+    # Structured event tracing (obs/steptrace.StepTrace), set by families
+    # that construct an EventWriter; None runs the loop untraced.
+    obs = None
+    # Hung-step watchdog deadline in seconds (0/None = off); families may
+    # set it, and the DDL_WATCHDOG_S env var is the operator override.
+    watchdog_s = None
 
     # ---------------------------------------------------------- overrides
 
@@ -94,6 +107,15 @@ class BaseTrainer:
 
     def wait_for_saves(self) -> None:
         return None
+
+    def _init_obs(self, log_dir, job_id: str, family: str, host: int) -> None:
+        """Shared trainer wiring for the structured event stream (every
+        host writes its own file; obs/events.py).  No-op without a log
+        dir, so the obs story tracks the CSV one."""
+        if log_dir:
+            from ddl_tpu.obs import StepTrace
+
+            self.obs = StepTrace.create(log_dir, job_id, family, host=host)
 
     @property
     def best_label(self) -> str:
@@ -152,6 +174,31 @@ class BaseTrainer:
 
     def _train_loop(self, max_periods: int | None, guard) -> None:
         max_periods = max_periods or self.num_periods
+        obs = self.obs
+        watchdog = None
+        if obs is not None:
+            # the env var is the operator OVERRIDE (set it to raise the
+            # deadline past a long first compile, or to 0 to disable),
+            # so it wins over a family-set watchdog_s
+            env = os.environ.get("DDL_WATCHDOG_S")
+            if env not in (None, ""):
+                deadline = float(env)
+            else:
+                deadline = self.watchdog_s or 0
+            if deadline > 0:
+                from ddl_tpu.obs.watchdog import Watchdog
+
+                watchdog = Watchdog(obs.writer, deadline).start()
+                obs.watchdog = watchdog
+        try:
+            self._run_periods(max_periods, guard, obs)
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+            if obs is not None:
+                obs.finish(verbose=getattr(self, "is_logging_process", True))
+
+    def _run_periods(self, max_periods: int, guard, obs) -> None:
         # Profile one post-warmup period when configured (the reference's
         # only timing is perf_counter epoch walls, single.py:171-174; this
         # captures a full XLA device trace instead).
@@ -161,6 +208,8 @@ class BaseTrainer:
         for period in range(self.periods_run, max_periods):
             if period == profile_period:
                 jax.profiler.start_trace(self.profile_dir)
+            if obs is not None:
+                obs.begin_period(period)
             start = perf_counter()
             train_metrics, steps = self.run_period(period, guard)
             elapsed = perf_counter() - start
@@ -175,38 +224,55 @@ class BaseTrainer:
                 )
             idx = self.log_index(period)
             if self.log_due(period):
-                print(
-                    self.format_train_line(period, elapsed, steps, train_metrics)
-                )
-                if self.logger is not None and self.is_logging_process:
-                    self.logger.log_many(train_metrics, idx)
-                    self.logger.log(self.time_metric, elapsed, idx)
-                    # steps/sec/chip is BASELINE.json's target metric; the
-                    # reference only logs epoch_time (steps derived offline).
-                    self.logger.log("steps_per_sec", steps / elapsed, idx)
-                    self.logger.log_many(self.rate_metrics(steps, elapsed), idx)
-                    # HBM watermark (no reference analog; utils/memory.py)
-                    mem = hbm_stats()
-                    if mem is not None:
-                        self.logger.log(
-                            "hbm_peak_bytes", mem["peak_bytes_in_use"], idx
+                with _phase(obs, "logging", step=idx):
+                    print(
+                        self.format_train_line(
+                            period, elapsed, steps, train_metrics
                         )
+                    )
+                    if self.logger is not None and self.is_logging_process:
+                        self.logger.log_many(train_metrics, idx)
+                        self.logger.log(self.time_metric, elapsed, idx)
+                        # steps/sec/chip is BASELINE.json's target metric;
+                        # the reference only logs epoch_time (steps derived
+                        # offline).
+                        self.logger.log("steps_per_sec", steps / elapsed, idx)
+                        self.logger.log_many(
+                            self.rate_metrics(steps, elapsed), idx
+                        )
+                        # HBM watermark (no reference analog; utils/memory.py)
+                        mem = hbm_stats()
+                        if mem is not None:
+                            self.logger.log(
+                                "hbm_peak_bytes", mem["peak_bytes_in_use"], idx
+                            )
 
-            eval_metrics = self.evaluate_period(period)
+            with _phase(obs, "eval", step=idx):
+                eval_metrics = self.evaluate_period(period)
             if eval_metrics:
-                print(self.format_eval_line(period, eval_metrics))
-                if self.logger is not None and self.is_logging_process:
-                    self.logger.log_many(eval_metrics, idx)
+                with _phase(obs, "logging", step=idx):
+                    print(self.format_eval_line(period, eval_metrics))
+                    if self.logger is not None and self.is_logging_process:
+                        self.logger.log_many(eval_metrics, idx)
 
             if self._improved(eval_metrics) or self.snapshot_due(period):
-                self.save_snapshot(period)
-            self.periods_run = period + 1
-            if guard is not None and guard.requested:
+                with _phase(obs, "checkpoint", step=idx):
+                    self.save_snapshot(period)
+            preempted = guard is not None and guard.requested
+            if preempted:
                 # Preempted (SIGTERM): checkpoint what we have and exit
                 # cleanly; the partially-trained period is saved under its
                 # own number, so the relaunch resumes at the next one.
-                self.save_snapshot(period)
-                self.wait_for_saves()
+                # Save BEFORE end_period so the blocking final commit —
+                # the interesting cost of a preempted run — lands in this
+                # period's checkpoint phase total.
+                with _phase(obs, "checkpoint", step=idx):
+                    self.save_snapshot(period)
+                    self.wait_for_saves()
+            if obs is not None:
+                obs.end_period(period, idx, elapsed, steps, train_metrics)
+            self.periods_run = period + 1
+            if preempted:
                 print(
                     f"Preempted at {self.period_label.lower()} {period}; "
                     f"snapshot committed. Resume with {self.resume_hint(period)}"

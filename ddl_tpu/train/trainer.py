@@ -32,7 +32,7 @@ from ddl_tpu.config import Config
 from ddl_tpu.data import DataLoader, ShardedEpochSampler, build_datasets, shard_batch
 from ddl_tpu.models import build_stages, stage_boundary_shapes
 from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
-from ddl_tpu.train.loop import BaseTrainer
+from ddl_tpu.train.loop import BaseTrainer, _phase
 from ddl_tpu.train.state import create_train_state, make_optimizer
 from ddl_tpu.train.steps import make_dp_step_fns
 from ddl_tpu.utils import MetricLogger, masked_classification_eval
@@ -182,6 +182,7 @@ class Trainer(BaseTrainer):
             model_start_job_id=self._resume_job,
         )
         self.is_logging_process = proc == 0
+        self._init_obs(cfg.train.log_dir, self.job_id, "cnn", proc)
         self.epochs_run = 0
         # shared-loop knobs (train/loop.BaseTrainer)
         self.num_periods = cfg.train.max_epochs
@@ -263,13 +264,28 @@ class Trainer(BaseTrainer):
         self.train_loader.set_epoch(epoch)
         losses, preds, targets = [], [], []
         steps = 0
-        for images, labels in self.train_loader:
-            gi, gl = shard_batch(self.mesh, images, labels)
+        # event steps are GLOBAL (epoch * steps/epoch + i) so the obs
+        # liveness/straggler comparison sees one monotone counter per
+        # host, the same unit the LM family's global step gives it
+        step_base = epoch * len(self.train_loader)
+        it = iter(self.train_loader)
+        while True:
+            # data_wait = host-side batch production (the loader), h2d =
+            # device placement, step = compiled-step dispatch; the device
+            # time dispatch hides surfaces in the period-end fence phase
+            with _phase(self.obs, "data_wait", step=step_base + steps):
+                batch = next(it, None)
+            if batch is None:
+                break
+            images, labels = batch
+            with _phase(self.obs, "h2d", step=step_base + steps):
+                gi, gl = shard_batch(self.mesh, images, labels)
             if self.grad_stats_fn is not None and self.is_logging_process:
                 # before the train step: it donates (consumes) self.state
                 stats = jax.device_get(self.grad_stats_fn(self.state, gi, gl))
                 self.logger.log_gradient_stats(stats, step=steps)
-            self.state, loss, pred = self.step_fns.train(self.state, gi, gl)
+            with _phase(self.obs, "step", step=step_base + steps):
+                self.state, loss, pred = self.step_fns.train(self.state, gi, gl)
             losses.append(loss)
             preds.append(pred)
             targets.append(gl)
@@ -278,9 +294,10 @@ class Trainer(BaseTrainer):
                 break
         if steps == 0:
             raise RuntimeError("empty epoch: dataset smaller than one batch")
-        mean_loss = float(np.mean([_to_host(l) for l in losses]))
-        y_pred = np.concatenate([_to_host(p) for p in preds])
-        y_true = np.concatenate([_to_host(t) for t in targets])
+        with _phase(self.obs, "fence", step=step_base + steps):
+            mean_loss = float(np.mean([_to_host(l) for l in losses]))
+            y_pred = np.concatenate([_to_host(p) for p in preds])
+            y_true = np.concatenate([_to_host(t) for t in targets])
         accuracy = float(np.mean(y_pred == y_true))
         return {"loss": mean_loss, "train_accuracy": accuracy}, steps
 

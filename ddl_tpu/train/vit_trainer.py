@@ -25,7 +25,7 @@ from ddl_tpu.data import (
 )
 from ddl_tpu.models.vit import ViTConfig
 from ddl_tpu.parallel.sharding import LMMeshSpec
-from ddl_tpu.train.loop import BaseTrainer
+from ddl_tpu.train.loop import BaseTrainer, _phase
 from ddl_tpu.train.vit_steps import make_vit_step_fns
 from ddl_tpu.utils import MetricLogger, masked_classification_eval
 
@@ -111,6 +111,7 @@ class ViTTrainer(BaseTrainer):
             if run.log_dir
             else None
         )
+        self._init_obs(run.log_dir, run.job_id, "vit", proc)
         self.num_periods = run.epochs
         self.halt_on_nan = run.halt_on_nan
         self.preemption_save = run.preemption_save and bool(run.checkpoint_dir)
@@ -139,10 +140,24 @@ class ViTTrainer(BaseTrainer):
     def run_period(self, epoch: int, guard=None):
         self.train_loader.set_epoch(epoch)
         losses, steps = [], 0
-        for images, labels in self.train_loader:
-            gi, gl = shard_batch(self.fns.mesh, images, labels)
-            self.state, m = self.fns.train(self.state, gi, gl)
-            losses.append(float(m["loss"]))
+        # global event steps (epoch * steps/epoch + i) — one monotone
+        # counter per host for the obs liveness/straggler comparison
+        step_base = epoch * len(self.train_loader)
+        it = iter(self.train_loader)
+        while True:
+            with _phase(self.obs, "data_wait", step=step_base + steps):
+                batch = next(it, None)
+            if batch is None:
+                break
+            images, labels = batch
+            with _phase(self.obs, "h2d", step=step_base + steps):
+                gi, gl = shard_batch(self.fns.mesh, images, labels)
+            with _phase(self.obs, "step", step=step_base + steps):
+                self.state, m = self.fns.train(self.state, gi, gl)
+            # this family fetches the loss per step, so the fence phase is
+            # per-step too (the CNN/LM families fence once per period)
+            with _phase(self.obs, "fence", step=step_base + steps):
+                losses.append(float(m["loss"]))
             steps += 1
             if guard is not None and guard.requested:
                 break

@@ -58,6 +58,10 @@ def main() -> None:
                     "stores the KV cache int8 (+per-token scales), 'kv+w' "
                     "also streams weight-only int8 matmul kernels — the "
                     "HBM-traffic levers for the bandwidth-bound decode")
+    ap.add_argument("--obs-log-dir", default=None,
+                    help="emit per-request decode telemetry (tokens/s, "
+                    "dispatch/wait spans) into this log dir's event "
+                    "stream; inspect with `ddl_tpu obs summarize`")
     ap.add_argument("--cpu-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -116,6 +120,11 @@ def main() -> None:
         from ddl_tpu.ops.quant import quantize_lm_params
 
         state = state.replace(params=quantize_lm_params(state.params))
+    obs = None
+    if args.obs_log_dir:
+        from ddl_tpu.obs import EventWriter
+
+        obs = EventWriter(args.obs_log_dir, args.job_id)
     gen = make_lm_generator(
         cfg,
         spec,
@@ -126,6 +135,7 @@ def main() -> None:
         top_k=args.top_k,
         mesh=mesh,
         kv_quant=args.int8 != "none",
+        obs=obs,
     )
 
     if args.prompt_text is not None:

@@ -76,3 +76,43 @@ def test_kernel_mha_and_fully_masked_tile():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-4
     )
+
+
+def test_pick_block_l_fallback_capped_at_tile_budget():
+    """Awkward L (no 128-multiple divisor): the single full-L tile is
+    used only within the compile-probed per-tile budget; above it the
+    caller must keep the XLA einsum path (ADVICE round 5)."""
+    from ddl_tpu.ops.decode_attention import _TILE_BYTES, pick_block_l
+
+    fused = 768  # 12 heads x 64, the probed width
+    # no aligned divisor, single tile within budget -> full-L tile
+    assert pick_block_l(2200, fused) == 2200
+    assert 2200 * fused * 2 <= _TILE_BYTES
+    # no aligned divisor, single tile over budget -> None (einsum path);
+    # the old relaxed 2x budget admitted these and risked scoped-VMEM
+    # compile failures at runtime
+    for L in (2500, 3000, 4500):
+        assert pick_block_l(L, fused) is None, L
+    # aligned divisors keep tiling regardless of L
+    assert pick_block_l(4096, fused) in (1024, 2048)
+
+
+def test_explicit_block_l_respects_mosaic_alignment():
+    """Explicit block_l on the compiled (non-interpret) path: partial
+    tiles step down in 128-multiples, and an unalignable request raises
+    a descriptive error instead of an opaque Mosaic one (ADVICE round 5)."""
+    import pytest
+
+    from ddl_tpu.ops.decode_attention import _block_l
+
+    # 128-multiple divisor found by stepping down (512 -> 256 for L=1280)
+    assert _block_l(1280, 512, 768, 2, interpret=False) == 256
+    assert _block_l(1024, 512, 768, 2, interpret=False) == 512
+    # block_l >= L: the full array is always alignment-legal
+    assert _block_l(1000, 1000, 768, 2, interpret=False) == 1000
+    assert _block_l(1000, 2048, 768, 2, interpret=False) == 1000
+    # L=1000 with block_l=512 must NOT land on the unaligned 500
+    with pytest.raises(ValueError, match="128-multiple"):
+        _block_l(1000, 512, 768, 2, interpret=False)
+    # the interpreter has no alignment rules: tiny test tiles still work
+    assert _block_l(16, 4, 64, 2, interpret=True) == 4

@@ -189,3 +189,70 @@ def test_legacy_square_head_warns(tmp_path):
         warnings.simplefilter("always")
         load_snapshot(tmp_path, "sq_new", 0, state)
     assert not any("SQUARE lm_head" in str(x.message) for x in w)
+
+
+def test_load_params_honors_format_field(tmp_path):
+    """``load_params`` (the decode tools' params-only restore) applies
+    the same format handling as ``load_snapshot`` (ADVICE round 5): a
+    legacy format-less snapshot gets the lm_head orientation migration,
+    a newer-writer snapshot warns, and the restore skeleton is just the
+    params subtree."""
+    import dataclasses
+    import warnings
+
+    import orbax.checkpoint as ocp
+
+    from ddl_tpu.checkpoint import load_params, save_snapshot, snapshot_path
+
+    cfg = dataclasses.replace(_cfg(), vocab_size=48)  # non-square head
+    fns = make_lm_step_fns(
+        cfg, LMMeshSpec(), optax.adam(1e-3), jax.random.key(0), 4, 16
+    )
+    state = fns.init_state()
+
+    # modern snapshot: params round-trip exactly, params subtree only
+    save_snapshot(tmp_path, "modern", 0, state)
+    params = load_params(tmp_path, "modern", 0)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # legacy snapshot (no format field, head saved (d_model, vocab)):
+    # with the caller's vocab_size the kernel migrates back to
+    # vocab-major on load
+    def t_head(kp, leaf):
+        keys = [getattr(k, "key", getattr(k, "name", k)) for k in kp]
+        if "lm_head" in keys and keys[-1] == "kernel":
+            return jnp.transpose(leaf)
+        return leaf
+
+    legacy = jax.tree_util.tree_map_with_path(t_head, state)
+    _save_legacy(tmp_path, "legacy-lp", 0, legacy)
+    params = load_params(tmp_path, "legacy-lp", 0, vocab_size=48)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # a format-less snapshot that is ALREADY vocab-major (written after
+    # the layout change but before the marker) must NOT be transposed
+    _save_legacy(tmp_path, "legacy-vm", 0, state)
+    params = load_params(tmp_path, "legacy-vm", 0, vocab_size=48)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # without vocab_size the orientation is unverifiable: restore
+    # as-saved, loudly
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        params = load_params(tmp_path, "legacy-lp", 0)
+    assert any("orientation unverified" in str(x.message) for x in w)
+
+    # newer-writer snapshot: loud warning, not silent misinterpretation
+    fpath = snapshot_path(tmp_path, "future-lp", 0)
+    fpath.parent.mkdir(parents=True, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            fpath, {"state": state, "epoch": 0, "format": 99}, force=True
+        )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        load_params(tmp_path, "future-lp", 0)
+    assert any("newer than" in str(x.message) for x in w)

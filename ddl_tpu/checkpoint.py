@@ -21,7 +21,9 @@ shardings).  The reference's DCP resume is fixed-topology.
 
 from __future__ import annotations
 
+import json
 import os
+import zlib
 from pathlib import Path
 from typing import Any
 
@@ -29,14 +31,21 @@ import jax
 import jax.numpy as jnp
 import orbax.checkpoint as ocp
 
+from ddl_tpu.utils import faultinject
+from ddl_tpu.utils.backoff import Backoff, retry_with_backoff
+
 __all__ = [
     "save_snapshot",
     "load_snapshot",
     "snapshot_path",
     "snapshot_metadata",
     "latest_epoch",
+    "latest_valid_epoch",
     "resolve_resume",
     "run_resume_load",
+    "verify_snapshot",
+    "write_manifest",
+    "SnapshotCorruptError",
     "SnapshotManager",
 ]
 
@@ -54,17 +63,125 @@ def snapshot_path(checkpoint_dir: str | os.PathLike, job_id: str, epoch: int) ->
 SNAPSHOT_FORMAT = 2
 
 
+# ---------------------------------------------------------------------------
+# Snapshot integrity: commit manifest, verification, corrupt-aware discovery
+# ---------------------------------------------------------------------------
+
+# Written into the snapshot directory AFTER the Orbax write completes:
+# its presence is the commit marker (a snapshot without one either
+# predates this layer — "legacy" — or was torn mid-write), and its
+# per-file size+CRC32 records are the integrity check restore runs
+# against, catching the truncated/bit-rotted files a flaky shared NAS
+# produces *after* a successful commit.
+MANIFEST_NAME = "ddl_manifest.json"
+
+# Bounded retry for snapshot-save I/O errors (shared-NAS writes flake):
+# total attempts = _SAVE_RETRIES + 1.
+_SAVE_RETRIES = 2
+
+
+class SnapshotCorruptError(RuntimeError):
+    """A snapshot failed its integrity check (truncated/corrupt/partial).
+    Auto-resume reacts by falling back to the previous good snapshot."""
+
+
+def _crc32(path: Path, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                return crc
+            crc = zlib.crc32(block, crc)
+
+
+def _snapshot_files(path: Path):
+    return sorted(
+        p for p in path.rglob("*")
+        if p.is_file() and p.name != MANIFEST_NAME
+    )
+
+
+def write_manifest(path: str | os.PathLike, **extra) -> Path:
+    """Commit marker + checksum manifest, written atomically (temp file +
+    ``os.replace``) so a torn manifest write cannot masquerade as a
+    committed snapshot."""
+    path = Path(path)
+    files = {
+        p.relative_to(path).as_posix(): {
+            "size": p.stat().st_size,
+            "crc32": _crc32(p),
+        }
+        for p in _snapshot_files(path)
+    }
+    manifest = path / MANIFEST_NAME
+    tmp = manifest.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps({"files": files, **extra}, indent=0))
+    os.replace(tmp, manifest)
+    return manifest
+
+
+def verify_snapshot(path: str | os.PathLike) -> tuple[bool, str]:
+    """``(ok, reason)`` for a snapshot directory.
+
+    Three validity states: *verified* (manifest present, every file's
+    size and CRC32 match), *legacy* (no manifest — predates the
+    integrity layer; restorable but unverifiable, so it stays valid),
+    and *corrupt* (manifest unreadable, files missing, or contents
+    drifted — truncation, torn writes, bit rot)."""
+    path = Path(path)
+    if not path.is_dir():
+        return False, "missing"
+    manifest = path / MANIFEST_NAME
+    if not manifest.exists():
+        return True, "legacy (no integrity manifest)"
+    try:
+        recorded = json.loads(manifest.read_text())["files"]
+    except (OSError, ValueError, KeyError) as e:
+        return False, f"unreadable manifest ({e!r})"
+    for rel, rec in recorded.items():
+        f = path / rel
+        if not f.is_file():
+            return False, f"missing file {rel}"
+        size = f.stat().st_size
+        if size != rec["size"]:
+            return False, (
+                f"size mismatch in {rel} ({size} != {rec['size']} bytes — "
+                "truncated write?)"
+            )
+        if _crc32(f) != rec["crc32"]:
+            return False, f"checksum mismatch in {rel}"
+    return True, f"verified ({len(recorded)} files)"
+
+
 def save_snapshot(
     checkpoint_dir: str | os.PathLike, job_id: str, epoch: int, state: Any,
 ) -> Path:
     path = snapshot_path(checkpoint_dir, job_id, epoch)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(
-            path,
-            {"state": state, "epoch": epoch, "format": SNAPSHOT_FORMAT},
-            force=True,
+
+    def attempt() -> None:
+        faultinject.io_check("save")
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(
+                path,
+                {"state": state, "epoch": epoch, "format": SNAPSHOT_FORMAT},
+                force=True,
+            )
+
+    def note(e, i):
+        print(
+            f"snapshot save to {path} failed ({e}); "
+            f"retry {i + 1}/{_SAVE_RETRIES}"
         )
+
+    retry_with_backoff(
+        attempt, retries=_SAVE_RETRIES, exceptions=(OSError,),
+        backoff=Backoff(base=0.5, factor=2.0, max_delay=10.0),
+        on_retry=note,
+    )
+    write_manifest(path, epoch=epoch, format=SNAPSHOT_FORMAT)
+    faultinject.corrupt_check(path)
     return path
 
 
@@ -169,6 +286,7 @@ def load_snapshot(
     job_id: str,
     epoch: int,
     abstract_state: Any,
+    verify: bool = True,
 ) -> tuple[Any, int]:
     """Restore a snapshot; returns ``(state, epochs_run)`` where training
     resumes at ``epochs_run = saved_epoch + 1`` (reference ``single.py:124``).
@@ -179,6 +297,16 @@ def load_snapshot(
     tree (with the requested sharding, when the abstract leaf carries
     one)."""
     path = snapshot_path(checkpoint_dir, job_id, epoch)
+    # callers that just picked this epoch via latest_valid_epoch pass
+    # verify=False — the manifest CRC pass reads every byte, and doing
+    # it twice back-to-back doubles resume latency on the very NAS the
+    # check defends against
+    if verify:
+        ok, reason = verify_snapshot(path)
+        if not ok:
+            raise SnapshotCorruptError(
+                f"snapshot at {path} failed its integrity check: {reason}"
+            )
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
     with ocp.StandardCheckpointer() as ckptr:
         saved_md = None
@@ -391,7 +519,7 @@ def resolve_resume(
         return explicit
     if not auto or not checkpoint_dir:
         return None
-    last = latest_epoch(checkpoint_dir, job_id)
+    last = latest_valid_epoch(checkpoint_dir, job_id)
     if last is not None:
         print(
             f"auto-resume: job {job_id!r} has a snapshot at {unit} {last} "
@@ -427,12 +555,23 @@ class SnapshotManager:
         self.checkpoint_dir = checkpoint_dir
         self.job_id = job_id
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        # the in-flight save whose manifest is still owed: the manifest
+        # (= commit marker) may only be written after the async write
+        # finishes, or verification would bless a half-written snapshot
+        self._pending: Path | None = None
+
+    def _finish_pending(self) -> None:
+        if self._pending is not None:
+            write_manifest(self._pending)
+            faultinject.corrupt_check(self._pending)
+            self._pending = None
 
     def save(self, epoch: int, state: Any) -> Path:
         path = snapshot_path(self.checkpoint_dir, self.job_id, epoch)
         path.parent.mkdir(parents=True, exist_ok=True)
         # one outstanding save at a time: wait for the previous commit
         self._ckptr.wait_until_finished()
+        self._finish_pending()
         self._ckptr.save(
             path,
             args=ocp.args.StandardSave(
@@ -440,24 +579,53 @@ class SnapshotManager:
             ),
             force=True,
         )
+        self._pending = path
         return path
 
     def wait(self) -> None:
         self._ckptr.wait_until_finished()
+        self._finish_pending()
 
     def close(self) -> None:
         self._ckptr.wait_until_finished()
+        self._finish_pending()
         self._ckptr.close()
 
 
 def latest_epoch(checkpoint_dir: str | os.PathLike, job_id: str) -> int | None:
     """Highest epoch snapshot available for a job, or None."""
+    epochs = snapshot_epochs(checkpoint_dir, job_id)
+    return epochs[-1] if epochs else None
+
+
+def snapshot_epochs(
+    checkpoint_dir: str | os.PathLike, job_id: str
+) -> list[int]:
+    """All snapshot epochs for a job, ascending (validity not checked)."""
     job_dir = Path(checkpoint_dir) / job_id
     if not job_dir.is_dir():
-        return None
-    epochs = [
+        return []
+    return sorted(
         int(p.name.removeprefix("epoch_"))
         for p in job_dir.iterdir()
         if p.name.startswith("epoch_") and p.name.removeprefix("epoch_").isdigit()
-    ]
-    return max(epochs) if epochs else None
+    )
+
+
+def latest_valid_epoch(
+    checkpoint_dir: str | os.PathLike, job_id: str
+) -> int | None:
+    """Newest snapshot that passes integrity verification — the rollback/
+    auto-resume target.  Corrupt or partial snapshots are skipped with a
+    loud note (the fallback the issue of a torn NAS write demands);
+    legacy manifest-less snapshots count as valid."""
+    for epoch in reversed(snapshot_epochs(checkpoint_dir, job_id)):
+        path = snapshot_path(checkpoint_dir, job_id, epoch)
+        ok, reason = verify_snapshot(path)
+        if ok:
+            return epoch
+        print(
+            f"skipping snapshot at {path}: {reason} — "
+            "falling back to the previous snapshot"
+        )
+    return None

@@ -12,29 +12,69 @@ plus dotted overrides, e.g.
     python -m ddl_tpu.cli --preset dp_pp --set mesh.data=4 mesh.pipe=2 \
         data.global_batch_size=40 train.max_epochs=30
 
-Run inspection over the structured event streams every trainer writes
-(``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
+Fault-tolerant launches go through the auto-resume supervisor
+(``ddl_tpu/supervisor.py``): the trainer runs as a child process and is
+relaunched after a preemption, crash, or watchdog-detected hang,
+auto-resuming from the latest valid snapshot with no manual resume args:
+
+    python -m ddl_tpu.cli train --supervise --max-restarts 5 \
+        --preset dp --set train.max_epochs=30
+
+(the leading ``train`` subcommand is optional and accepted for symmetry
+with ``obs``).  Run inspection over the structured event streams every
+trainer writes (``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
 
     python -m ddl_tpu.cli obs summarize <job_id> [--log-dir DIR]
     python -m ddl_tpu.cli obs tail <job_id> [-n 20]
     python -m ddl_tpu.cli obs diff <job_a> <job_b>
+    python -m ddl_tpu.cli obs baseline <job_id> --out FILE
+    python -m ddl_tpu.cli obs diff <job_id> --baseline FILE [--fail-slowdown 0.5]
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import sys
 
 
 def main(argv=None) -> None:
     if argv is None:
         argv = sys.argv[1:]
+    argv = list(argv)
     if argv and argv[0] == "obs":
         # pure event-file analysis: no JAX init, runs anywhere the log
         # directory is mounted
         from ddl_tpu.obs.report import main as obs_main
 
         return obs_main(argv[1:])
+    if argv and argv[0] == "train":
+        argv = argv[1:]
+
+    # supervision flags are peeled off before config parsing: the
+    # supervisor process must not initialise JAX (the child owns the
+    # devices), so it never reaches parse_cli/bootstrap
+    sup = argparse.ArgumentParser(add_help=False)
+    sup.add_argument("--supervise", action="store_true")
+    sup.add_argument("--max-restarts", type=int, default=None)
+    sup_args, rest = sup.parse_known_args(argv)
+    if sup_args.max_restarts is not None and not sup_args.supervise:
+        # loud, not silently dropped: the user believes crash-relaunch
+        # is armed
+        raise SystemExit("--max-restarts requires --supervise")
+    if sup_args.supervise:
+        from ddl_tpu.supervisor import supervise_command
+
+        raise SystemExit(
+            supervise_command(
+                [sys.executable, "-m", "ddl_tpu.cli", *rest],
+                max_restarts=(
+                    5 if sup_args.max_restarts is None
+                    else sup_args.max_restarts
+                ),
+            )
+        )
 
     from ddl_tpu.config import parse_cli, to_dict
     from ddl_tpu.launch import bootstrap, world_info
@@ -49,6 +89,12 @@ def main(argv=None) -> None:
 
     trainer = Trainer(cfg)
     trainer.train()
+    if trainer.preempted and os.environ.get("DDL_SUPERVISED") == "1":
+        # tell the supervisor this was a resumable interruption, not a
+        # completed run — it relaunches and auto-resume does the rest
+        from ddl_tpu.supervisor import EXIT_PREEMPTED
+
+        raise SystemExit(EXIT_PREEMPTED)
 
 
 if __name__ == "__main__":

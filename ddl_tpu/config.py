@@ -136,6 +136,15 @@ class TrainConfig:
     # Failure detection (absent in the reference — SURVEY.md section 5): halt
     # with a clear diagnostic when the training loss goes non-finite.
     halt_on_nan: bool = True
+    # Non-finite-loss policy: "halt" (above) or "recover" — skip the bad
+    # epoch's metrics/eval/snapshot, and after nan_max_consecutive hits
+    # roll back to the latest valid snapshot with a reduced-LR grace
+    # window (train/recovery.RecoveryPolicy; updates scaled by
+    # nan_grace_scale for nan_grace_periods epochs).
+    nan_policy: str = "halt"
+    nan_max_consecutive: int = 3
+    nan_grace_scale: float = 0.1
+    nan_grace_periods: int = 2
     # Preemption handling (absent in the reference): catch SIGTERM, finish
     # the in-flight step, checkpoint, and exit cleanly for relaunch+resume.
     preemption_save: bool = True
@@ -156,6 +165,11 @@ class Config:
     def validate(self) -> "Config":
         if self.strategy not in ("single", "dp", "pp", "dp_pp"):
             raise ValueError(f"unknown strategy {self.strategy!r}")
+        if self.train.nan_policy not in ("halt", "recover"):
+            raise ValueError(
+                f"unknown nan_policy {self.train.nan_policy!r} "
+                "(want 'halt' or 'recover')"
+            )
         if self.strategy == "single" and self.mesh.num_devices != 1:
             raise ValueError("strategy 'single' requires a (1,1) mesh")
         if self.strategy == "dp" and self.mesh.pipe != 1:

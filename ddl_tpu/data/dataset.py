@@ -68,6 +68,10 @@ class AptosImageDataset:
     def __getitem__(self, idx: int) -> Tuple[np.ndarray, int]:
         from PIL import Image
 
+        # NAS-mounted PNG reads flake transiently (OSError); resilience
+        # lives ONE layer up, in DataLoader's bounded backoff retry, so
+        # every retry is counted into the io_retry obs stream — a second
+        # retry here would multiply attempts invisibly
         with Image.open(self.image_path(idx)) as im:
             arr = np.asarray(im.convert("RGB"), dtype=np.uint8)
         return arr, self.labels[idx]

@@ -25,6 +25,8 @@ from typing import Iterator, Tuple
 import numpy as np
 
 from ddl_tpu.data.sampler import ShardedEpochSampler
+from ddl_tpu.utils import faultinject
+from ddl_tpu.utils.backoff import Backoff, retry_with_backoff
 
 __all__ = ["DataLoader", "shard_batch"]
 
@@ -41,6 +43,8 @@ class DataLoader:
         prefetch_depth: int = 2,
         seed: int = 0,
         pad_last_batch: bool = False,
+        io_retries: int = 2,
+        on_retry=None,
     ) -> None:
         self.dataset = dataset
         self.batch_size = batch_size
@@ -55,6 +59,38 @@ class DataLoader:
         # and the consumer masks rows with label -1 (deterministic
         # full-coverage eval, reference single.py:199-258)
         self.pad_last_batch = pad_last_batch
+        # Transient-I/O resilience: a flaky NAS read (OSError) is retried
+        # with bounded backoff instead of killing the epoch; retries are
+        # counted here and surfaced to the caller (trainers emit them as
+        # ``io_retry`` obs events).  io_retries=0 restores fail-fast.
+        self.io_retries = max(0, io_retries)
+        self.on_retry = on_retry
+        self.retry_count = 0
+        # one policy object for the loader's lifetime — _fetch runs once
+        # per sample in the hot path, and Backoff construction seeds an
+        # RNG from OS entropy
+        self._backoff = Backoff(base=0.05, factor=4.0, max_delay=2.0)
+
+    def _note_retry(self, exc: BaseException, attempt: int) -> None:
+        self.retry_count += 1
+        if self.on_retry is not None:
+            self.on_retry(exc, attempt)
+
+    def _retry_io(self, fn):
+        return retry_with_backoff(
+            fn,
+            retries=self.io_retries,
+            exceptions=(OSError,),
+            backoff=self._backoff,
+            on_retry=self._note_retry,
+        )
+
+    def _fetch(self, idx) -> Tuple[np.ndarray, int]:
+        def attempt():
+            faultinject.io_check("batch")
+            return self.dataset[int(idx)]
+
+        return self._retry_io(attempt)
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
@@ -84,9 +120,9 @@ class DataLoader:
         if images is None:
             if self.num_workers > 0:
                 with ThreadPoolExecutor(self.num_workers) as pool:
-                    samples = list(pool.map(self.dataset.__getitem__, idxs))
+                    samples = list(pool.map(self._fetch, idxs))
             else:
-                samples = [self.dataset[i] for i in idxs]
+                samples = [self._fetch(i) for i in idxs]
             images = np.stack([s[0] for s in samples])
         labels = np.asarray(
             [self.dataset.labels[i] for i in idxs]
@@ -112,7 +148,8 @@ class DataLoader:
                 return None
             self._hw = hw
         h, w = self._hw
-        return native.load_batch(paths, h, w)
+        # the native decoder reads the same NAS files — same retry policy
+        return self._retry_io(lambda: native.load_batch(paths, h, w))
 
     def _batches(self) -> Iterator[np.ndarray]:
         idxs = np.asarray(list(self.sampler.indices()))
@@ -131,11 +168,17 @@ class DataLoader:
         """Yield collated (uint8 images, int32 labels), prefetching ahead."""
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_depth)
         sentinel = object()
+        # a producer-thread failure must reach the consumer as the
+        # original exception, not as a silently truncated epoch (which
+        # would train on a shorter epoch and report nothing)
+        error: list[BaseException] = []
 
         def producer():
             try:
                 for batch_idxs in self._batches():
                     q.put(self._collate(batch_idxs))
+            except BaseException as e:
+                error.append(e)
             finally:
                 q.put(sentinel)
 
@@ -147,6 +190,8 @@ class DataLoader:
                 break
             yield item
         t.join()
+        if error:
+            raise error[0]
 
 
 def shard_batch(mesh, images: np.ndarray, labels: np.ndarray):

@@ -78,6 +78,7 @@ def bootstrap(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    init_retries: int | None = None,
 ) -> None:
     """Join the multi-host world if one is configured; no-op otherwise.
 
@@ -85,21 +86,51 @@ def bootstrap(
     ``DDL_PROCESS_ID`` env vars (the launcher sets these); else Cloud TPU
     metadata auto-detection via ``jax.distributed.initialize()``'s defaults
     when ``DDL_MULTIHOST=1``.
+
+    The coordinator handshake is retried with exponential backoff and
+    jitter (``init_retries`` re-dials, default 3, env override
+    ``DDL_INIT_RETRIES``): after a preemption relaunch the hosts come up
+    seconds apart, and the first workers to dial would otherwise die on a
+    connection refusal the coordinator fixes moments later.  Jitter keeps
+    a relaunched pod's N hosts from re-dialing in lockstep.
     """
     coordinator_address = coordinator_address or os.environ.get("DDL_COORDINATOR")
     if num_processes is None and os.environ.get("DDL_NUM_PROCESSES"):
         num_processes = int(os.environ["DDL_NUM_PROCESSES"])
     if process_id is None and os.environ.get("DDL_PROCESS_ID"):
         process_id = int(os.environ["DDL_PROCESS_ID"])
+    if init_retries is None:
+        init_retries = int(os.environ.get("DDL_INIT_RETRIES", "3"))
 
     if coordinator_address is not None:
-        jax.distributed.initialize(
+        initialize = lambda: jax.distributed.initialize(  # noqa: E731
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
     elif os.environ.get("DDL_MULTIHOST") == "1":
-        jax.distributed.initialize()
+        initialize = lambda: jax.distributed.initialize()  # noqa: E731
+    else:
+        return
+
+    from ddl_tpu.utils.backoff import Backoff, retry_with_backoff
+
+    def note(e, attempt):
+        print(
+            f"[ddl_tpu] jax.distributed.initialize failed ({e}); "
+            f"retry {attempt + 1}/{init_retries}"
+        )
+
+    # transient handshake failures only (connection refused while the
+    # coordinator comes up); a ValueError is a misconfigured world spec
+    # and must fail fast on every host
+    retry_with_backoff(
+        initialize,
+        retries=init_retries,
+        exceptions=(RuntimeError, OSError),
+        backoff=Backoff(base=2.0, factor=2.0, max_delay=60.0, jitter=0.5),
+        on_retry=note,
+    )
 
 
 def world_info() -> dict:

@@ -47,8 +47,14 @@ class LossSpikeDetector:
 
     def observe(self, loss: float) -> dict | None:
         loss = float(loss)
+        if not np.isfinite(loss):
+            # never admit a non-finite loss into the baseline window: one
+            # NaN period (routine under nan_policy="recover") would make
+            # mean/threshold NaN and silently disable spike detection for
+            # the next `window` periods — exactly when the run is shaky
+            return None
         out = None
-        if len(self.values) >= self.min_points and np.isfinite(loss):
+        if len(self.values) >= self.min_points:
             mean = float(np.mean(self.values))
             std = max(
                 float(np.std(self.values)),
@@ -160,9 +166,24 @@ class AnomalyMonitor:
                 self.writer.emit("anomaly", step=idx, **a)
         return found
 
+    def record(self, idx: int, type: str, **fields) -> dict:
+        """Record an externally-detected anomaly (e.g. the training
+        loop's non-finite-loss policy) into the same stream and summary
+        the rolling detectors feed."""
+        a = {"type": type, "idx": idx, **fields}
+        self.anomalies.append(a)
+        if self.writer is not None:
+            self.writer.emit("anomaly", step=idx, **a)
+        return a
+
     def summary_lines(self) -> list[str]:
-        return [
-            f"[{a['type']}] step {a['idx']}: value {a['value']:.4g} "
-            f"vs baseline {a['baseline']:.4g}"
-            for a in self.anomalies
-        ]
+        lines = []
+        for a in self.anomalies:
+            base = (
+                f" vs baseline {a['baseline']:.4g}" if "baseline" in a else ""
+            )
+            lines.append(
+                f"[{a['type']}] step {a['idx']}: "
+                f"value {a.get('value', float('nan')):.4g}{base}"
+            )
+        return lines

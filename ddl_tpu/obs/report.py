@@ -7,6 +7,10 @@
                                 liveness
     tail <job_id> [-n N]        last N events, rendered one per line
     diff <job_a> <job_b>        phase/throughput comparison of two runs
+    baseline <job_id> --out F   store one run's summary as a JSON baseline
+    diff <job> --baseline F     compare a run against a stored baseline;
+                                --fail-slowdown 0.5 exits nonzero on a
+                                >50% steps/s regression (the CI gate)
 
 Pure stdlib + the event files — no JAX import, so it runs anywhere the
 NAS/log directory is mounted (the reference's analysis had the same
@@ -17,6 +21,7 @@ into this module for the event-side sections).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 from collections import defaultdict
 from pathlib import Path
@@ -175,9 +180,13 @@ def render_summary(s: dict, job_id: str = "") -> str:
         )
     lines.append(f"-- anomalies ({len(s['anomalies'])}) --")
     for a in s["anomalies"]:
+        base = (
+            f" vs baseline {a['baseline']:.4g}"
+            if a.get("baseline") is not None else ""
+        )
         lines.append(
             f"  [{a.get('type')}] step {a.get('idx', a.get('step'))}: "
-            f"value {a.get('value'):.4g} vs baseline {a.get('baseline'):.4g}"
+            f"value {a.get('value', float('nan')):.4g}{base}"
         )
     if s["stalls"]:
         lines.append(f"-- stalls ({len(s['stalls'])}) --")
@@ -206,13 +215,13 @@ def render_summary(s: dict, job_id: str = "") -> str:
     return "\n".join(lines)
 
 
+def _rate(s: dict) -> float | None:
+    return s["steps"] / s["elapsed"] if s["elapsed"] else None
+
+
 def diff_runs(sa: dict, sb: dict, job_a: str, job_b: str) -> str:
     lines = [f"== diff: {job_a} vs {job_b} =="]
-
-    def rate(s):
-        return s["steps"] / s["elapsed"] if s["elapsed"] else None
-
-    ra, rb = rate(sa), rate(sb)
+    ra, rb = _rate(sa), _rate(sb)
     if ra and rb:
         lines.append(
             f"steps/s: {ra:.2f} vs {rb:.2f} (x{rb / ra:.2f})"
@@ -265,9 +274,29 @@ def main(argv=None) -> None:
     )
     p_tail.add_argument("job_id")
     p_tail.add_argument("-n", type=int, default=20)
-    p_diff = sub.add_parser("diff", parents=[common], help="compare two runs")
+    p_diff = sub.add_parser(
+        "diff", parents=[common],
+        help="compare two runs, or one run against a stored baseline",
+    )
     p_diff.add_argument("job_a")
-    p_diff.add_argument("job_b")
+    p_diff.add_argument("job_b", nargs="?")
+    p_diff.add_argument(
+        "--baseline",
+        help="stored baseline JSON (from `obs baseline`) to diff "
+        "job_a against instead of a second job",
+    )
+    p_diff.add_argument(
+        "--fail-slowdown", type=float, default=None, metavar="FRAC",
+        help="CI regression gate: exit nonzero when the run under test "
+        "— job_a with --baseline, else job_b — is more than FRAC "
+        "slower (steps/s) than its comparison run",
+    )
+    p_base = sub.add_parser(
+        "baseline", parents=[common],
+        help="store one run's summary as a JSON baseline for later diffs",
+    )
+    p_base.add_argument("job_id")
+    p_base.add_argument("--out", default="obs_baseline.json")
     args = ap.parse_args(argv)
 
     if args.command == "summarize":
@@ -283,9 +312,48 @@ def main(argv=None) -> None:
         for e in events[-args.n:]:
             print(_render_event(e))
     elif args.command == "diff":
-        sa = summarize_run(load_run(args.log_dir, args.job_a))
-        sb = summarize_run(load_run(args.log_dir, args.job_b))
-        print(diff_runs(sa, sb, args.job_a, args.job_b))
+        sb = summarize_run(load_run(args.log_dir, args.job_a))
+        name_b = args.job_a
+        if args.baseline:
+            stored = json.loads(Path(args.baseline).read_text())
+            sa = stored["summary"]
+            name_a = f"baseline:{stored.get('job_id', '?')}"
+        elif args.job_b:
+            # two-job diff keeps its original orientation (a vs b)
+            sa, sb = sb, summarize_run(load_run(args.log_dir, args.job_b))
+            name_a, name_b = name_b, args.job_b
+        else:
+            raise SystemExit("obs diff needs a second job id or --baseline")
+        print(diff_runs(sa, sb, name_a, name_b))
+        if args.fail_slowdown is not None:
+            ra, rb = _rate(sa), _rate(sb)
+            if not ra or not rb:
+                # a run that emitted no period events must not pass the
+                # gate by default — that is the shape of a crashed smoke
+                raise SystemExit(
+                    f"FAIL: cannot compute steps/s "
+                    f"({name_a}: {ra}, {name_b}: {rb}) — no period "
+                    "events? the regression gate needs both rates"
+                )
+            if rb < (1.0 - args.fail_slowdown) * ra:
+                raise SystemExit(
+                    f"FAIL: {name_b} at {rb:.2f} steps/s is more than "
+                    f"{args.fail_slowdown:.0%} below {name_a} "
+                    f"({ra:.2f} steps/s)"
+                )
+            print(
+                f"OK: throughput within the {args.fail_slowdown:.0%} "
+                "regression gate"
+            )
+    elif args.command == "baseline":
+        events = load_run(args.log_dir, args.job_id)
+        if not events:
+            raise SystemExit(
+                f"no events for job {args.job_id!r} under {args.log_dir}"
+            )
+        payload = {"job_id": args.job_id, "summary": summarize_run(events)}
+        Path(args.out).write_text(json.dumps(payload, indent=1))
+        print(f"wrote baseline for {args.job_id!r} to {args.out}")
 
 
 if __name__ == "__main__":

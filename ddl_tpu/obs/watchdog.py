@@ -7,12 +7,17 @@ is a daemon thread per host that (a) emits ``heartbeat`` events — last
 completed step, seconds since — so the run-inspection CLI can tell
 which host stopped advancing first, and (b) when no beat arrives within
 ``deadline_s``, dumps every Python thread's stack plus the
-last-completed step as a ``stall`` event *before* the job dies.  It
-never kills anything itself — the stall may be a one-off (preemptible
-storage, first-compile) and the deadline is the operator's call.  Set
-the deadline above the worst-case first-step compile, or read a
-first-step "stall" for what it is: a stack dump showing the program
-inside XLA compilation — visibility, not a false death.
+last-completed step as a ``stall`` event *before* the job dies.  In its
+default ``on_stall="dump"`` mode it never kills anything itself — the
+stall may be a one-off (preemptible storage, first-compile) and the
+deadline is the operator's call; under supervision
+(``on_stall="exit"``, set via ``DDL_WATCHDOG_ACTION`` by
+``--supervise``) it escalates to dump-then-``os._exit(75)`` so the
+supervisor relaunches a hung collective.  Either way, set the deadline
+above the worst-case first-step compile, or read a first-step "stall"
+for what it is: a stack dump showing the program inside XLA
+compilation — visibility (or, supervised, a pointless relaunch), not a
+false death.
 
 The training loop calls ``beat(step)`` at step granularity (wired
 through ``StepTrace.phase``), so the deadline bounds one step, not one
@@ -49,9 +54,27 @@ class Watchdog:
         writer,
         deadline_s: float,
         interval_s: float | None = None,
+        on_stall: str = "dump",
+        exit_fn=None,
     ) -> None:
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if on_stall not in ("dump", "exit"):
+            import warnings
+
+            warnings.warn(
+                f"unknown watchdog action {on_stall!r}; using 'dump'",
+                stacklevel=2,
+            )
+            on_stall = "dump"
+        # "dump" = stacks-only (round-6 behaviour: the deadline is the
+        # operator's call and a stall may be a one-off).  "exit" = the
+        # supervised escalation: dump, then exit with the resumable code
+        # so the auto-resume supervisor relaunches a hung collective.
+        # os._exit, not sys.exit: the main thread is wedged inside a
+        # device wait and will never unwind an exception.
+        self.on_stall = on_stall
+        self._exit_fn = exit_fn
         self.writer = writer
         self.deadline_s = float(deadline_s)
         # poll fast enough that a stall is caught within ~1.25 deadlines
@@ -110,7 +133,26 @@ class Watchdog:
                         step=step,
                         age=age,
                         deadline=self.deadline_s,
+                        action=self.on_stall,
                         stacks=thread_stacks(),
                     )
+                    if self.on_stall == "exit":
+                        self._escalate(step, age)
             else:
                 self._dumped = False
+
+    def _escalate(self, step, age) -> None:
+        import os
+
+        from ddl_tpu.supervisor import EXIT_PREEMPTED
+
+        self.writer.emit(
+            "watchdog_exit", step=step, age=age, code=EXIT_PREEMPTED
+        )
+        print(
+            f"[watchdog] no step progress for {age:.1f}s (deadline "
+            f"{self.deadline_s:.1f}s); stacks dumped, exiting resumable "
+            f"({EXIT_PREEMPTED}) for the supervisor to relaunch"
+        )
+        exit_fn = self._exit_fn if self._exit_fn is not None else os._exit
+        exit_fn(EXIT_PREEMPTED)

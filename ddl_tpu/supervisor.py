@@ -1,0 +1,191 @@
+"""Auto-resume supervisor: relaunch the trainer until the run completes.
+
+The reference's recovery story is a human re-submitting the job with
+manual ``snapshot_job_id``/``snapshot_epoch`` args (SURVEY.md §5).  On
+preemptible TPU pods that human is woken several times a night, so this
+module closes the loop: ``ddl_tpu train --supervise --max-restarts N``
+runs the trainer as a child process and relaunches it after a preemption
+or crash.  Resume needs no arguments — the trainers auto-discover the
+latest *valid* snapshot for their job id (``checkpoint.resolve_resume``
+skips corrupt/partial ones), so relaunch == resume by construction.
+
+Exit-code protocol (how the child tells the supervisor what happened):
+
+    0                run complete — stop
+    EXIT_PREEMPTED   resumable interruption: SIGTERM-style preemption
+    (75, EX_TEMPFAIL) after a committed snapshot, or the stall watchdog's
+                     dump-then-exit escalation.  Relaunched immediately
+                     (the interruption was external; backing off would
+                     only lose training time), and does NOT consume the
+                     crash budget — a multi-day run on preemptible pods
+                     is evicted routinely, and each eviction made
+                     snapshot progress.  A *streak* of resumable exits
+                     with no progress signal in between does back off
+                     (a watchdog deadline set below the first-step
+                     compile must not burn relaunches at full speed),
+                     and a generous safety cap (``max_preemptions``,
+                     default 1000) bounds the pathological always-75
+                     loop.
+    anything else    a crash.  Relaunched after exponential backoff with
+                     jitter (``utils/backoff.Backoff``) so a crash-looping
+                     job doesn't hammer the scheduler/NAS, up to
+                     ``max_restarts`` crash relaunches.
+
+The restart policy is separated from process management: ``Supervisor``
+drives any ``attempt_fn(restart_index) -> exit_code`` (tests inject
+callables and fake clocks), while ``supervise_command`` supplies the
+subprocess runner the CLI uses.  Children get ``DDL_SUPERVISED=1`` (the
+trainer exits ``EXIT_PREEMPTED`` after a preemption snapshot instead of
+0), ``DDL_RESTART_COUNT``, and — unless the operator overrides it —
+``DDL_WATCHDOG_ACTION=exit``, escalating the stall watchdog from
+dump-stacks to dump-then-exit-resumable so a hung collective is
+restarted instead of hanging forever.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Callable
+
+from ddl_tpu.utils.backoff import Backoff
+
+__all__ = ["EXIT_PREEMPTED", "Supervisor", "supervise_command"]
+
+# EX_TEMPFAIL from sysexits.h: "temporary failure, retry later" — exactly
+# a preemption's semantics, and distinguishable from crash exit codes
+# (1, 2, 134, 139, ...) without inventing a private protocol.
+EXIT_PREEMPTED = 75
+
+
+class Supervisor:
+    """Run ``attempt_fn`` until it returns 0 or restarts are exhausted.
+
+    ``attempt_fn(restart_index)`` returns the attempt's exit code; an
+    exception it raises counts as a crash (exit code 1).  ``sleep`` and
+    ``backoff`` are injectable so tests run in virtual time.
+    """
+
+    def __init__(
+        self,
+        attempt_fn: Callable[[int], int],
+        max_restarts: int = 5,
+        max_preemptions: int = 1000,
+        backoff: Backoff | None = None,
+        sleep: Callable[[float], None] = time.sleep,
+        log: Callable[[str], None] = print,
+        streak_window_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.attempt_fn = attempt_fn
+        self.max_restarts = max_restarts
+        self.max_preemptions = max_preemptions
+        # an attempt that ran at least this long before its resumable
+        # exit made real progress (compiled, trained, snapshotted) — it
+        # is a genuine eviction, not a livelock iteration, and ends the
+        # backoff streak
+        self.streak_window_s = streak_window_s
+        self.clock = clock
+        self.backoff = backoff if backoff is not None else Backoff(
+            base=1.0, factor=2.0, max_delay=120.0, jitter=0.5
+        )
+        self.sleep = sleep
+        self.log = log
+        self.restarts = 0
+        self.crashes = 0
+        self.preemptions = 0
+        # consecutive resumable exits with no crash in between: the
+        # first relaunches immediately (a real eviction), but a STREAK
+        # backs off like a crash loop — e.g. a watchdog deadline set
+        # below the first-step compile would otherwise burn
+        # max_preemptions full recompiles at full speed
+        self._consec_resumable = 0
+
+    def run(self) -> int:
+        while True:
+            t0 = self.clock()
+            try:
+                rc = int(self.attempt_fn(self.restarts))
+            except Exception as e:
+                self.log(f"[supervisor] attempt raised {type(e).__name__}: {e}")
+                rc = 1
+            if self.clock() - t0 >= self.streak_window_s:
+                # long-lived attempt = forward progress: the next
+                # resumable exit relaunches immediately again
+                self._consec_resumable = 0
+            if rc == 0:
+                if self.restarts:
+                    self.log(
+                        f"[supervisor] run complete after {self.restarts} "
+                        f"relaunch(es) ({self.preemptions} preemption(s), "
+                        f"{self.crashes} crash(es))"
+                    )
+                return 0
+            self.restarts += 1
+            if rc == EXIT_PREEMPTED:
+                self.preemptions += 1
+                self._consec_resumable += 1
+                if self.preemptions > self.max_preemptions:
+                    self.log(
+                        f"[supervisor] giving up: {self.max_preemptions} "
+                        "resumable exits — something re-preempts every "
+                        "attempt"
+                    )
+                    return rc
+                delay = (
+                    0.0 if self._consec_resumable == 1
+                    else self.backoff.delay(self._consec_resumable - 2)
+                )
+                self.log(
+                    f"[supervisor] resumable exit ({rc}); relaunching"
+                    + (f" in {delay:.1f}s" if delay else "")
+                    + f" (preemption {self.preemptions}, crash budget "
+                    f"untouched at {self.crashes}/{self.max_restarts})"
+                )
+                if delay > 0:
+                    self.sleep(delay)
+                continue
+            self._consec_resumable = 0
+            self.crashes += 1
+            if self.crashes > self.max_restarts:
+                self.log(
+                    f"[supervisor] giving up: exit code {rc} after "
+                    f"{self.max_restarts} crash relaunches"
+                )
+                return rc
+            delay = self.backoff.delay(self.crashes - 1)
+            self.log(
+                f"[supervisor] crash (exit {rc}); relaunching in "
+                f"{delay:.1f}s (crash {self.crashes}/{self.max_restarts})"
+            )
+            if delay > 0:
+                self.sleep(delay)
+
+
+def supervise_command(
+    argv: list[str],
+    max_restarts: int = 5,
+    env: dict | None = None,
+    **kwargs,
+) -> int:
+    """Supervise ``argv`` as a child process (the CLI's ``--supervise``).
+
+    Each attempt inherits the environment plus the supervision contract
+    vars; the child's own auto-resume does the snapshot discovery."""
+
+    def attempt(restart_index: int) -> int:
+        child_env = dict(os.environ if env is None else env)
+        child_env["DDL_SUPERVISED"] = "1"
+        child_env["DDL_RESTART_COUNT"] = str(restart_index)
+        # escalate the watchdog so a hung collective becomes a relaunch;
+        # the operator's explicit setting wins
+        child_env.setdefault("DDL_WATCHDOG_ACTION", "exit")
+        # injected faults model one-off events (an eviction does not
+        # recur on relaunch); fault specs count per process, so drop
+        # them for relaunches unless explicitly pinned
+        if restart_index > 0 and not child_env.get("DDL_FAULT_PERSIST"):
+            child_env.pop("DDL_FAULT", None)
+        return subprocess.call(argv, env=child_env)
+
+    return Supervisor(attempt, max_restarts=max_restarts, **kwargs).run()

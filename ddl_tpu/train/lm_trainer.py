@@ -38,7 +38,7 @@ from ddl_tpu.models.transformer import LMConfig
 from ddl_tpu.parallel.sharding import LMMeshSpec
 from ddl_tpu.train.lm_steps import make_lm_step_fns
 from ddl_tpu.train.loop import BaseTrainer, _phase
-from ddl_tpu.utils import MetricLogger
+from ddl_tpu.utils import MetricLogger, faultinject
 
 __all__ = ["LMRunConfig", "LMTrainer"]
 
@@ -71,6 +71,14 @@ class LMRunConfig:
     log_dir: str | None = "training_logs"  # default-on CSV observability
     log_every: int = 10  # console/CSV cadence in steps
     halt_on_nan: bool = True
+    # Non-finite-loss policy: "halt" (round-1 behaviour, honors
+    # halt_on_nan) or "recover" (skip the bad window; after
+    # nan_max_consecutive hits, roll back to the latest valid snapshot
+    # with a reduced-LR grace window — train/recovery.RecoveryPolicy).
+    nan_policy: str = "halt"
+    nan_max_consecutive: int = 3
+    nan_grace_scale: float = 0.1
+    nan_grace_periods: int = 2
     preemption_save: bool = True
     profile_dir: str | None = None
 
@@ -125,6 +133,9 @@ class LMTrainer(BaseTrainer):
         )
         self._init_obs(run.log_dir, run.job_id, "lm", proc)
         self.halt_on_nan = run.halt_on_nan
+        from ddl_tpu.train.recovery import make_policy
+
+        self.recovery = make_policy(run)
         self.preemption_save = run.preemption_save
         self.profile_dir = run.profile_dir
         self.save_best = bool(run.checkpoint_dir) and bool(run.eval_every)
@@ -152,12 +163,32 @@ class LMTrainer(BaseTrainer):
 
     def _make_fns(self, cfg: LMConfig):
         run = self.run
+        from ddl_tpu.train.recovery import scale_tx
+
         return make_lm_step_fns(
-            cfg, self.spec, self.tx, self._rng, run.batch, run.seq_len,
+            cfg, self.spec, scale_tx(self.tx, self.update_scale), self._rng,
+            run.batch, run.seq_len,
             num_microbatches=run.num_microbatches,
             accum_steps=run.accum_steps,
             pipeline_schedule=run.pipeline_schedule,
             virtual_stages=run.virtual_stages,
+        )
+
+    def _rebuild_step_fns(self) -> None:
+        self.fns = self._make_fns(self.cfg)
+
+    def _snapshot_store(self):
+        run = self.run
+        return (run.checkpoint_dir, run.job_id) if run.checkpoint_dir else None
+
+    def _rollback_restore(self, step: int) -> None:
+        run = self.run
+        self.state, _ = ckpt.load_snapshot(
+            run.checkpoint_dir, run.job_id, step, self.state, verify=False
+        )
+        self._start_step = int(self.state.step)
+        self.periods_run = bisect.bisect_right(
+            self._boundaries, self._start_step
         )
 
     def _maybe_anneal_capacity(self, m: dict) -> None:
@@ -335,9 +366,13 @@ class LMTrainer(BaseTrainer):
         )
         saved_pipe = saved_pipe_stages(saved_md["state"]["params"])
         saved_virtual = saved_virtual_stages(saved_md["state"]["params"])
+        # auto-discovered steps were integrity-verified by resolve_resume;
+        # only an explicit --resume-step still needs the check here
+        verify = run.resume_step is not None
         if saved_pipe == self.spec.pipe and saved_virtual == run.virtual_stages:
             self.state, _ = ckpt.load_snapshot(
-                run.checkpoint_dir, run.job_id, resume_step, self.state
+                run.checkpoint_dir, run.job_id, resume_step, self.state,
+                verify=verify,
             )
             print("resumed (snapshots are mesh-independent)")
         else:
@@ -358,6 +393,7 @@ class LMTrainer(BaseTrainer):
                     self.cfg, self.tx, saved_pipe, mesh=self.fns.mesh,
                     virtual=saved_virtual,
                 ),
+                verify=verify,
             )
             if self.spec.pipe > 1:
                 if saved_pipe > 1:  # restage: merge, then re-split below
@@ -395,6 +431,7 @@ class LMTrainer(BaseTrainer):
             with _phase(self.obs, "step", step=i):
                 self.state, m = self.fns.train(self.state, inp, tgt)
             steps += 1
+            faultinject.check_step(i, guard)
             if guard is not None and guard.requested:
                 break
         if steps:

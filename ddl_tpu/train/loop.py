@@ -10,13 +10,19 @@ concern lives here exactly once —
 * the period loop (a period is an epoch for the vision families, a fixed
   step window for the LM family) with wall-clock timing,
 * default-on CSV metric logging (``utils/csv_logger.MetricLogger``),
-* the NaN watchdog (halt with a pointer at the last good snapshot),
+* the NaN policy: halt with a pointer at the last good snapshot
+  (``nan_policy="halt"``), or recover in-loop (``"recover"``): skip the
+  bad period's metrics/eval/snapshot, and after K consecutive hits roll
+  back to the last valid snapshot with a reduced-LR grace window
+  (``train/recovery.RecoveryPolicy``),
 * the ``jax.profiler`` trace hook (one post-warmup period),
 * preemption handling (SIGTERM → finish the in-flight period → snapshot →
   clean exit, ``utils/preemption.PreemptionGuard``),
 * snapshot gating: best-eval-metric improvements (QWK for the vision
   families, val perplexity for the LM) and/or a fixed cadence,
-* HBM watermark logging (``utils/memory.hbm_stats``).
+* HBM watermark logging (``utils/memory.hbm_stats``),
+* fault-injection hooks (``utils/faultinject``) so every recovery path
+  above is provable by a CPU-only test.
 
 Families subclass :class:`BaseTrainer` and implement only what is genuinely
 family-specific: how to run one period, how to evaluate, and how to write a
@@ -33,6 +39,7 @@ from time import perf_counter
 import jax
 import numpy as np
 
+from ddl_tpu.utils import faultinject
 from ddl_tpu.utils.memory import hbm_stats
 
 __all__ = ["BaseTrainer"]
@@ -88,6 +95,15 @@ class BaseTrainer:
     # Hung-step watchdog deadline in seconds (0/None = off); families may
     # set it, and the DDL_WATCHDOG_S env var is the operator override.
     watchdog_s = None
+    # In-loop non-finite-loss recovery (train/recovery.RecoveryPolicy) or
+    # None; with None, halt_on_nan keeps its round-1 halt semantics.
+    recovery = None
+    # Update scaling during a post-rollback grace window; families that
+    # can honor it override set_update_scale (one step-fn rebuild).
+    update_scale = 1.0
+    # True after a preemption-triggered early exit — the CLI turns this
+    # into the supervisor's resumable exit code when supervised.
+    preempted = False
 
     # ---------------------------------------------------------- overrides
 
@@ -107,6 +123,58 @@ class BaseTrainer:
 
     def wait_for_saves(self) -> None:
         return None
+
+    def _snapshot_store(self) -> tuple | None:
+        """``(checkpoint_dir, job_id)`` when this trainer checkpoints,
+        else None — the handle the rollback template walks for valid
+        snapshots.  Families with checkpointing override; the default
+        keeps checkpoint-less runs on the halt path."""
+        return None
+
+    def _rebuild_step_fns(self) -> None:
+        """Rebuild the compiled step functions after the optimizer wrap
+        changed (grace entry/exit, ``recovery.scale_tx``).  Default
+        no-op for stubs/tests."""
+
+    def _rollback_restore(self, epoch: int) -> None:
+        """Restore ``self.state`` from the (already-verified) snapshot
+        ``epoch`` and rewind the family's resume cursor."""
+        raise NotImplementedError
+
+    def rollback_to_snapshot(self) -> bool:
+        """Restore the latest *valid* snapshot and rewind the resume
+        cursor; return False when there is nothing to roll back to."""
+        store = self._snapshot_store()
+        if store is None:
+            return False
+        self.wait_for_saves()  # commit any in-flight async snapshot first
+        from ddl_tpu import checkpoint as ckpt
+
+        epoch = ckpt.latest_valid_epoch(*store)
+        if epoch is None:
+            return False
+        self._rollback_restore(epoch)
+        print(f"[recovery] restored snapshot {epoch}")
+        return True
+
+    def set_update_scale(self, scale: float) -> None:
+        """Scale subsequent optimizer updates by ``scale`` (the
+        reduced-LR grace after a rollback): one step-function rebuild
+        per dial turn, state-tree-identical (``recovery.scale_tx``)."""
+        if scale == self.update_scale:
+            return
+        self.update_scale = scale
+        self._rebuild_step_fns()
+
+    def _note_io_retry(self, exc: BaseException, attempt: int) -> None:
+        """Data-loader retry callback: count transient-I/O retries into
+        the obs event stream so a degrading NAS is visible before it
+        becomes an outage."""
+        self.io_retries = getattr(self, "io_retries", 0) + 1
+        if self.obs is not None:
+            self.obs.writer.emit(
+                "io_retry", error=str(exc), attempt=attempt
+            )
 
     def _init_obs(self, log_dir, job_id: str, family: str, host: int) -> None:
         """Shared trainer wiring for the structured event stream (every
@@ -188,7 +256,13 @@ class BaseTrainer:
             if deadline > 0:
                 from ddl_tpu.obs.watchdog import Watchdog
 
-                watchdog = Watchdog(obs.writer, deadline).start()
+                # under supervision (DDL_SUPERVISED) the supervisor sets
+                # DDL_WATCHDOG_ACTION=exit: stall -> dump stacks -> exit
+                # resumable -> relaunch, instead of hanging forever
+                action = os.environ.get("DDL_WATCHDOG_ACTION", "dump")
+                watchdog = Watchdog(
+                    obs.writer, deadline, on_stall=action
+                ).start()
                 obs.watchdog = watchdog
         try:
             self._run_periods(max_periods, guard, obs)
@@ -205,7 +279,10 @@ class BaseTrainer:
         profile_period = None
         if self.profile_dir:
             profile_period = min(self.periods_run + 1, max_periods - 1)
-        for period in range(self.periods_run, max_periods):
+        # a while over the resume cursor, not a for over a frozen range:
+        # the recovery policy's rollback rewinds periods_run mid-run
+        while self.periods_run < max_periods:
+            period = self.periods_run
             if period == profile_period:
                 jax.profiler.start_trace(self.profile_dir)
             if obs is not None:
@@ -215,14 +292,46 @@ class BaseTrainer:
             elapsed = perf_counter() - start
             if period == profile_period:
                 jax.profiler.stop_trace()
+            train_metrics = faultinject.poison_loss(train_metrics)
             loss = train_metrics.get("loss")
-            if self.halt_on_nan and loss is not None and not np.isfinite(loss):
-                raise RuntimeError(
-                    f"Non-finite training loss {loss} at "
-                    f"{self.period_label.lower()} {period}; halting. "
-                    f"Last snapshot: {self.last_snapshot_hint()}"
-                )
             idx = self.log_index(period)
+            if loss is not None and not np.isfinite(loss):
+                handled = self._handle_nonfinite(period, idx, loss, obs)
+                if handled:
+                    # the bad period is not logged/evaluated/snapshotted;
+                    # its period event still flows (the obs stream must
+                    # show the excursion, not hide it)
+                    if obs is not None:
+                        obs.end_period(
+                            period, idx, elapsed, steps, train_metrics
+                        )
+                    if guard is not None and guard.requested:
+                        # preempted mid-recovery: exit inside the grace
+                        # window NOW, without snapshotting the poisoned
+                        # period — the relaunch resumes from the last
+                        # good snapshot
+                        self.preempted = True
+                        self.wait_for_saves()
+                        print(
+                            f"Preempted during non-finite-loss recovery "
+                            f"at {self.period_label.lower()} {period}; "
+                            f"exiting without snapshotting the poisoned "
+                            f"period. Last good snapshot: "
+                            f"{self.last_snapshot_hint()}"
+                        )
+                        return
+                    continue
+                if self.halt_on_nan:
+                    raise RuntimeError(
+                        f"Non-finite training loss {loss} at "
+                        f"{self.period_label.lower()} {period}; halting. "
+                        f"Last snapshot: {self.last_snapshot_hint()}"
+                    )
+            elif self.recovery is not None and self.recovery.on_finite():
+                self.set_update_scale(1.0)
+                print(
+                    "[recovery] grace window over; update scale back to 1.0"
+                )
             if self.log_due(period):
                 with _phase(obs, "logging", step=idx):
                     print(
@@ -273,12 +382,68 @@ class BaseTrainer:
                 obs.end_period(period, idx, elapsed, steps, train_metrics)
             self.periods_run = period + 1
             if preempted:
+                self.preempted = True
                 print(
                     f"Preempted at {self.period_label.lower()} {period}; "
                     f"snapshot committed. Resume with {self.resume_hint(period)}"
                 )
                 return
         self.wait_for_saves()
+
+    def _handle_nonfinite(self, period, idx, loss, obs) -> bool:
+        """Recovery-policy reaction to a non-finite period loss; returns
+        True when the policy absorbed it (skip or rollback), False to
+        fall through to halt_on_nan."""
+        if self.recovery is None:
+            return False
+        pol = self.recovery
+        action = pol.on_nonfinite()
+        if obs is not None:
+            obs.anomaly.record(
+                idx,
+                "nonfinite_loss",
+                value=float(loss),
+                consecutive=pol.consecutive,
+                action=action,
+            )
+        label = self.period_label.lower()
+        if action == "skip":
+            print(
+                f"[recovery] non-finite loss ({loss}) at {label} {period}: "
+                f"skipping the period "
+                f"({pol.consecutive}/{pol.max_consecutive} consecutive)"
+            )
+            self.periods_run = period + 1
+            return True
+        if pol.rollbacks >= pol.max_rollbacks:
+            raise RuntimeError(
+                f"Non-finite training loss persisted through "
+                f"{pol.rollbacks} rollback(s); giving up. "
+                f"Last snapshot: {self.last_snapshot_hint()}"
+            )
+        if not self.rollback_to_snapshot():
+            raise RuntimeError(
+                f"Non-finite training loss for {pol.consecutive} "
+                f"consecutive {label}s and no snapshot to roll back to. "
+                f"Last snapshot: {self.last_snapshot_hint()}"
+            )
+        hits = pol.consecutive
+        pol.on_rollback()
+        self.set_update_scale(pol.grace_scale)
+        if obs is not None:
+            obs.writer.emit(
+                "rollback",
+                step=idx,
+                resumed_at=self.periods_run,
+                grace_scale=pol.grace_scale,
+                grace_periods=pol.grace_periods,
+            )
+        print(
+            f"[recovery] non-finite loss for {hits} consecutive {label}s: "
+            f"rolled back to {label} {self.periods_run}; reduced-LR grace "
+            f"x{pol.grace_scale} for {pol.grace_periods} {label}(s)"
+        )
+        return True
 
     def last_snapshot_hint(self):
         return "none"

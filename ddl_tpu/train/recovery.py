@@ -1,0 +1,121 @@
+"""In-loop recovery policy: what a run does about a non-finite loss.
+
+``halt_on_nan`` (round 1) turned a NaN excursion into a clean death with
+a pointer at the last snapshot — a human still had to react.  This
+module is the no-human version, driven by ``train/loop.BaseTrainer``:
+
+* a non-finite period loss is recorded as an ``anomaly`` event (the
+  ``obs/anomaly.py`` stream CI and ``obs summarize`` already read) and
+  the period's metrics/eval/snapshot are **skipped** — a transient spike
+  costs one period, not the run;
+* after ``max_consecutive`` non-finite periods the policy declares the
+  optimizer state poisoned and asks the trainer to **roll back** to the
+  latest *valid* snapshot (``checkpoint.latest_valid_epoch`` — corrupt
+  ones are skipped), entering a **reduced-LR grace window**: the next
+  ``grace_periods`` finite periods run with updates scaled by
+  ``grace_scale``, stepping gently off the cliff edge that produced the
+  excursion instead of re-walking straight back into it;
+* rollbacks are bounded (``max_rollbacks``): a run that NaNs through
+  repeated rollback+grace cycles has a real bug and dies loudly.
+
+``scale_tx`` implements the grace mechanically: it wraps an optax
+transformation so the *updates* (not the gradients — Adam's moment
+normalisation is preserved) are multiplied by a constant, with an
+**unchanged state tree**, so a snapshot written before the wrap restores
+into the wrapped optimizer and vice versa.  Entering/leaving grace costs
+one step-function rebuild (a recompile) — rollbacks are rare enough
+that simplicity wins over a traced hyperparameter.
+"""
+
+from __future__ import annotations
+
+__all__ = ["RecoveryPolicy", "make_policy", "scale_tx"]
+
+
+def make_policy(run) -> "RecoveryPolicy | None":
+    """Build the policy a run config asks for — ``None`` for ``"halt"``,
+    a ``RecoveryPolicy`` for ``"recover"``, a loud error for anything
+    else (a typo'd policy name must not silently fall back to halting)."""
+    if run.nan_policy not in ("halt", "recover"):
+        raise ValueError(
+            f"unknown nan_policy {run.nan_policy!r} "
+            "(want 'halt' or 'recover')"
+        )
+    if run.nan_policy == "halt":
+        return None
+    return RecoveryPolicy(
+        max_consecutive=run.nan_max_consecutive,
+        grace_scale=run.nan_grace_scale,
+        grace_periods=run.nan_grace_periods,
+    )
+
+
+class RecoveryPolicy:
+    """Consecutive-failure counter + rollback/grace bookkeeping.
+
+    The loop calls ``on_nonfinite()`` per bad period (returns ``"skip"``
+    or ``"rollback"``), ``on_rollback()`` when the trainer restored a
+    snapshot, and ``on_finite()`` per good period (returns True exactly
+    when a grace window just ended and the update scale must return to
+    1).
+    """
+
+    def __init__(
+        self,
+        max_consecutive: int = 3,
+        grace_scale: float = 0.1,
+        grace_periods: int = 2,
+        max_rollbacks: int = 2,
+    ) -> None:
+        if max_consecutive < 1:
+            raise ValueError(
+                f"max_consecutive must be >= 1, got {max_consecutive}"
+            )
+        self.max_consecutive = max_consecutive
+        self.grace_scale = grace_scale
+        self.grace_periods = grace_periods
+        self.max_rollbacks = max_rollbacks
+        self.consecutive = 0
+        self.grace_left = 0
+        self.rollbacks = 0
+        self.skipped = 0
+
+    @property
+    def in_grace(self) -> bool:
+        return self.grace_left > 0
+
+    def on_nonfinite(self) -> str:
+        self.consecutive += 1
+        if self.consecutive >= self.max_consecutive:
+            return "rollback"
+        self.skipped += 1
+        return "skip"
+
+    def on_rollback(self) -> None:
+        self.rollbacks += 1
+        self.consecutive = 0
+        self.grace_left = self.grace_periods
+
+    def on_finite(self) -> bool:
+        self.consecutive = 0
+        if self.grace_left > 0:
+            self.grace_left -= 1
+            return self.grace_left == 0
+        return False
+
+
+def scale_tx(tx, scale: float):
+    """``tx`` with its emitted updates multiplied by ``scale``, keeping
+    ``tx``'s state tree bit-identical (snapshot-compatible both ways:
+    ``scale == 1`` wraps are free to skip)."""
+    if scale == 1.0:
+        return tx
+    import jax
+    import optax
+
+    def update(grads, state, params=None):
+        updates, new_state = tx.update(grads, state, params)
+        scaled = jax.tree.map(lambda u: u * scale, updates)
+        return scaled, new_state
+
+    return optax.GradientTransformation(tx.init, update)

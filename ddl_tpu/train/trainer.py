@@ -35,7 +35,7 @@ from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
 from ddl_tpu.train.loop import BaseTrainer, _phase
 from ddl_tpu.train.state import create_train_state, make_optimizer
 from ddl_tpu.train.steps import make_dp_step_fns
-from ddl_tpu.utils import MetricLogger, masked_classification_eval
+from ddl_tpu.utils import MetricLogger, faultinject, masked_classification_eval
 
 __all__ = ["Trainer", "resolve_job_id"]
 
@@ -85,31 +85,7 @@ class Trainer(BaseTrainer):
             self.state = self.state.replace(params=p, batch_stats=bs)
             if skipped:
                 print(f"[ddl_tpu] pretrained overlay skipped keys: {skipped}")
-        compute_dtype = jnp.dtype(cfg.model.compute_dtype)
-        if pipelined:
-            from ddl_tpu.parallel.pipeline import make_pipeline_step_fns
-
-            self.step_fns = make_pipeline_step_fns(
-                self.stages,
-                self.tx,
-                self.mesh,
-                compute_dtype,
-                num_microbatches=cfg.train.num_microbatches,
-                boundary_shapes=stage_boundary_shapes(cfg.model, cfg.data.image_size),
-                num_classes=cfg.model.num_classes,
-                remat=cfg.model.remat,
-                schedule=cfg.train.pipeline_schedule,
-            )
-        else:
-            from ddl_tpu.ops import get_normalizer
-
-            self.step_fns = make_dp_step_fns(
-                self.stages,
-                self.tx,
-                self.mesh,
-                compute_dtype,
-                normalizer=get_normalizer(cfg.model.pallas_normalize),
-            )
+        self._rebuild_step_fns()
         self.grad_stats_fn = None
         if cfg.train.log_gradient_stats and not pipelined:
             from ddl_tpu.train.steps import make_grad_stats_fn
@@ -137,6 +113,7 @@ class Trainer(BaseTrainer):
             ),
             num_workers=cfg.data.num_workers,
             drop_last=cfg.data.drop_last,
+            on_retry=self._note_io_retry,
         )
         # Eval is deterministic and full-coverage: ordered (no shuffle), no
         # dropped tail — sentinel padding keeps batch shapes static (one
@@ -155,6 +132,7 @@ class Trainer(BaseTrainer):
             num_workers=cfg.data.num_workers,
             drop_last=False,
             pad_last_batch=True,
+            on_retry=self._note_io_retry,
         )
         if len(test_ds) == 0:
             raise ValueError("empty eval set")
@@ -187,6 +165,9 @@ class Trainer(BaseTrainer):
         # shared-loop knobs (train/loop.BaseTrainer)
         self.num_periods = cfg.train.max_epochs
         self.halt_on_nan = cfg.train.halt_on_nan
+        from ddl_tpu.train.recovery import make_policy
+
+        self.recovery = make_policy(cfg.train)
         self.preemption_save = cfg.train.preemption_save
         self.profile_dir = cfg.train.profile_dir
         self.save_best = cfg.train.save_best_qwk
@@ -194,6 +175,51 @@ class Trainer(BaseTrainer):
         self._snapshot_mgr = None
         if self._resume_job is not None:
             self._load_snapshot()
+
+    def _rebuild_step_fns(self) -> None:
+        """(Re)build the compiled step functions — also the grace dial:
+        during a post-rollback grace window the optimizer is wrapped so
+        its updates are scaled by ``update_scale`` (state-tree-identical,
+        ``train/recovery.scale_tx``)."""
+        cfg = self.cfg
+        from ddl_tpu.train.recovery import scale_tx
+
+        tx = scale_tx(self.tx, self.update_scale)
+        compute_dtype = jnp.dtype(cfg.model.compute_dtype)
+        if cfg.strategy in ("pp", "dp_pp"):
+            from ddl_tpu.parallel.pipeline import make_pipeline_step_fns
+
+            self.step_fns = make_pipeline_step_fns(
+                self.stages,
+                tx,
+                self.mesh,
+                compute_dtype,
+                num_microbatches=cfg.train.num_microbatches,
+                boundary_shapes=stage_boundary_shapes(cfg.model, cfg.data.image_size),
+                num_classes=cfg.model.num_classes,
+                remat=cfg.model.remat,
+                schedule=cfg.train.pipeline_schedule,
+            )
+        else:
+            from ddl_tpu.ops import get_normalizer
+
+            self.step_fns = make_dp_step_fns(
+                self.stages,
+                tx,
+                self.mesh,
+                compute_dtype,
+                normalizer=get_normalizer(cfg.model.pallas_normalize),
+            )
+
+    def _snapshot_store(self):
+        t = self.cfg.train
+        return (t.checkpoint_dir, self.job_id) if t.checkpoint_dir else None
+
+    def _rollback_restore(self, epoch: int) -> None:
+        self.state, self.epochs_run = ckpt.load_snapshot(
+            self.cfg.train.checkpoint_dir, self.job_id, epoch, self.state,
+            verify=False,
+        )
 
     # ------------------------------------------------------------------
 
@@ -217,9 +243,11 @@ class Trainer(BaseTrainer):
             return
         print(f"Loading snapshot from {path}")
         self.state, self.epochs_run = ckpt.run_resume_load(
+            # an auto-discovered epoch was integrity-verified by
+            # resolve_resume moments ago; only explicit resumes re-verify
             lambda: ckpt.load_snapshot(
                 t.checkpoint_dir, self._resume_job, self._resume_epoch,
-                self.state,
+                self.state, verify=not self._resume_auto,
             ),
             auto=self._resume_auto,
             desc=str(path),
@@ -290,6 +318,7 @@ class Trainer(BaseTrainer):
             preds.append(pred)
             targets.append(gl)
             steps += 1
+            faultinject.check_step(step_base + steps - 1, guard)
             if guard is not None and guard.requested:
                 break
         if steps == 0:

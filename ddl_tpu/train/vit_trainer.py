@@ -27,7 +27,7 @@ from ddl_tpu.models.vit import ViTConfig
 from ddl_tpu.parallel.sharding import LMMeshSpec
 from ddl_tpu.train.loop import BaseTrainer, _phase
 from ddl_tpu.train.vit_steps import make_vit_step_fns
-from ddl_tpu.utils import MetricLogger, masked_classification_eval
+from ddl_tpu.utils import MetricLogger, faultinject, masked_classification_eval
 
 __all__ = ["ViTRunConfig", "ViTTrainer"]
 
@@ -49,6 +49,11 @@ class ViTRunConfig:
     job_id: str = "vit"
     log_dir: str | None = "training_logs"  # default-on CSV observability
     halt_on_nan: bool = True
+    # "halt" | "recover" — see LMRunConfig.nan_policy
+    nan_policy: str = "halt"
+    nan_max_consecutive: int = 3
+    nan_grace_scale: float = 0.1
+    nan_grace_periods: int = 2
     preemption_save: bool = True
     profile_dir: str | None = None
 
@@ -70,14 +75,9 @@ class ViTTrainer(BaseTrainer):
     ) -> None:
         self.cfg, self.spec, self.run = cfg, spec, run
         self.job_id = run.job_id
-        self.fns = make_vit_step_fns(
-            cfg, spec, tx, rng if rng is not None else jax.random.key(0),
-            run.batch,
-            num_microbatches=run.num_microbatches,
-            accum_steps=run.accum_steps,
-            pipeline_schedule=run.pipeline_schedule,
-            virtual_stages=run.virtual_stages,
-        )
+        self.tx = tx
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self.fns = self._make_fns()
 
         dc = data if data is not None else DataConfig(
             image_size=cfg.image_size,
@@ -91,6 +91,7 @@ class ViTTrainer(BaseTrainer):
         self.train_loader = DataLoader(
             train_ds, run.batch // n_proc,
             sampler=ShardedEpochSampler(len(train_ds), n_proc, proc, seed=0),
+            on_retry=self._note_io_retry,
         )
         # deterministic full-coverage eval: ordered, sentinel-padded to
         # static shapes, padded rows (label -1) masked out — same contract
@@ -102,6 +103,7 @@ class ViTTrainer(BaseTrainer):
                 shuffle=False, drop_last=False, pad_mode="sentinel", seed=1,
             ),
             drop_last=False, pad_last_batch=True,
+            on_retry=self._note_io_retry,
         )
 
         self.is_logging_process = proc == 0
@@ -114,6 +116,9 @@ class ViTTrainer(BaseTrainer):
         self._init_obs(run.log_dir, run.job_id, "vit", proc)
         self.num_periods = run.epochs
         self.halt_on_nan = run.halt_on_nan
+        from ddl_tpu.train.recovery import make_policy
+
+        self.recovery = make_policy(run)
         self.preemption_save = run.preemption_save and bool(run.checkpoint_dir)
         self.profile_dir = run.profile_dir
         self.save_best = run.save_best_qwk and bool(run.checkpoint_dir)
@@ -126,14 +131,42 @@ class ViTTrainer(BaseTrainer):
         )
         if run.checkpoint_dir and resume_epoch is not None:
             self.state, self.periods_run = ckpt.run_resume_load(
+                # auto-discovered epochs were verified by resolve_resume
                 lambda: ckpt.load_snapshot(
-                    run.checkpoint_dir, run.job_id, resume_epoch, self.state
+                    run.checkpoint_dir, run.job_id, resume_epoch, self.state,
+                    verify=run.resume_epoch is not None,
                 ),
                 auto=run.resume_epoch is None,
                 desc=f"job {run.job_id!r} epoch {resume_epoch}",
                 hint="pass --fresh (auto_resume=False)",
             )
             print(f"resumed; continuing at epoch {self.periods_run}")
+
+    def _make_fns(self):
+        run = self.run
+        from ddl_tpu.train.recovery import scale_tx
+
+        return make_vit_step_fns(
+            self.cfg, self.spec, scale_tx(self.tx, self.update_scale),
+            self._rng, run.batch,
+            num_microbatches=run.num_microbatches,
+            accum_steps=run.accum_steps,
+            pipeline_schedule=run.pipeline_schedule,
+            virtual_stages=run.virtual_stages,
+        )
+
+    def _rebuild_step_fns(self) -> None:
+        self.fns = self._make_fns()
+
+    def _snapshot_store(self):
+        run = self.run
+        return (run.checkpoint_dir, run.job_id) if run.checkpoint_dir else None
+
+    def _rollback_restore(self, epoch: int) -> None:
+        self.state, self.periods_run = ckpt.load_snapshot(
+            self.run.checkpoint_dir, self.run.job_id, epoch, self.state,
+            verify=False,
+        )
 
     # ------------------------------------------------------- loop hooks
 
@@ -159,6 +192,7 @@ class ViTTrainer(BaseTrainer):
             with _phase(self.obs, "fence", step=step_base + steps):
                 losses.append(float(m["loss"]))
             steps += 1
+            faultinject.check_step(step_base + steps - 1, guard)
             if guard is not None and guard.requested:
                 break
         if steps == 0:

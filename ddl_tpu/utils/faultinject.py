@@ -1,0 +1,250 @@
+"""Deterministic fault injection for proving recovery paths end-to-end.
+
+A fault-tolerance layer that has never seen a fault is decoration; this
+harness lets tests (and operators, via the ``DDL_FAULT`` env var) inject
+the exact failures the runtime claims to survive, at a deterministic
+point, with no hardware involved:
+
+    DDL_FAULT="preempt@step:12"        preemption signal at global step 12
+    DDL_FAULT="crash@step:8"           raise InjectedCrash at step 8
+    DDL_FAULT="nan@step:5"             poison the enclosing period's loss
+    DDL_FAULT="stall@step:4:30"        sleep 30s at step 4 (trips watchdog)
+    DDL_FAULT="corrupt_ckpt@save:2"    corrupt the 2nd snapshot after commit
+    DDL_FAULT="io@save:1:2"            OSError on save attempts 1 and 2
+    DDL_FAULT="io@batch:5"             OSError on the 5th loader sample read
+
+Grammar: comma-separated ``kind@site:at[:arg]`` specs.  ``site`` is an
+instrumentation point (``step`` in the training loops, ``save``/
+``restore`` in ``checkpoint.py``, ``batch`` in ``data/loader.py``);
+``at`` is the 0-based coordinate for externally-counted sites (the
+global step) or the 1-based call count for internally-counted ones
+(saves, batch reads); ``arg`` is the stall duration in seconds for
+``stall`` and the repeat count for ``io`` (default 1).  Each spec fires
+exactly ``repeat`` times and then stays quiet, so an auto-resumed
+relaunch of the same process *would* re-fire — which is why relaunch
+tests clear ``DDL_FAULT`` (or use ``activate()``/``deactivate()``) for
+the resumed attempt, exactly like a real preemption not recurring.
+
+Every hook is a no-op (one ``is None`` check) when no injector is
+active; production code pays nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+__all__ = [
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedCrash",
+    "activate",
+    "active",
+    "check_step",
+    "corrupt_check",
+    "deactivate",
+    "io_check",
+    "poison_loss",
+]
+
+KINDS = ("preempt", "crash", "nan", "stall", "corrupt_ckpt", "io")
+
+
+class InjectedCrash(RuntimeError):
+    """The crash the harness raises for ``crash@...`` specs — a stand-in
+    for any unhandled trainer exception the supervisor must survive."""
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    site: str
+    at: int
+    arg: float | None = None
+    fired: int = 0
+
+    @property
+    def repeat(self) -> int:
+        return int(self.arg) if self.kind == "io" and self.arg else 1
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        """``kind@site:at[:arg]`` -> FaultSpec, with loud errors."""
+        try:
+            kind, _, rest = text.strip().partition("@")
+            site, _, coord = rest.partition(":")
+            at, _, arg = coord.partition(":")
+            spec = cls(
+                kind=kind.strip(),
+                site=site.strip(),
+                at=int(at),
+                arg=float(arg) if arg else None,
+            )
+        except ValueError as e:
+            raise ValueError(
+                f"bad fault spec {text!r} (want kind@site:at[:arg], e.g. "
+                f"preempt@step:12 or io@save:1:2): {e}"
+            ) from None
+        if spec.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {spec.kind!r} in {text!r} "
+                f"(known: {', '.join(KINDS)})"
+            )
+        if not spec.site:
+            raise ValueError(f"empty fault site in {text!r}")
+        return spec
+
+
+class FaultInjector:
+    """Holds the parsed specs plus per-site call counters; ``fire()`` is
+    the single matching primitive every hook goes through."""
+
+    def __init__(self, specs: list[FaultSpec]) -> None:
+        self.specs = specs
+        self.counts: dict[str, int] = {}
+        self.nan_pending = False
+        self.log: list[tuple[str, str, int]] = []  # (kind, site, coord)
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultInjector":
+        return cls(
+            [FaultSpec.parse(p) for p in text.split(",") if p.strip()]
+        )
+
+    def fire(
+        self,
+        site: str,
+        at: int | None = None,
+        kinds: tuple[str, ...] | None = None,
+    ) -> list[FaultSpec]:
+        """Faults due at this visit of ``site``, restricted to ``kinds``.
+        With ``at`` the site is externally indexed (fires once the
+        coordinate reaches ``spec.at``); without it an internal 1-based
+        call counter is used, keyed per (site, kinds) so hooks that share
+        a site name (save-attempt vs save-commit) count independently."""
+        if at is None:
+            key = f"{site}|{','.join(kinds) if kinds else '*'}"
+            self.counts[key] = at = self.counts.get(key, 0) + 1
+        due = []
+        for s in self.specs:
+            if (
+                s.site == site
+                and (kinds is None or s.kind in kinds)
+                and s.fired < s.repeat
+                and at >= s.at
+            ):
+                s.fired += 1
+                self.log.append((s.kind, site, at))
+                due.append(s)
+        return due
+
+
+# --------------------------------------------------------------------------
+# module-level activation: lazily from DDL_FAULT, or explicitly by tests
+# --------------------------------------------------------------------------
+
+_injector: FaultInjector | None = None
+_env_checked = False
+
+
+def activate(spec: str) -> FaultInjector:
+    global _injector, _env_checked
+    _injector = FaultInjector.parse(spec)
+    _env_checked = True
+    return _injector
+
+
+def deactivate() -> None:
+    global _injector, _env_checked
+    _injector = None
+    # re-arm the env check so a fresh DDL_FAULT is picked up next time
+    _env_checked = False
+
+
+def active() -> FaultInjector | None:
+    global _injector, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        env = os.environ.get("DDL_FAULT")
+        if env:
+            _injector = FaultInjector.parse(env)
+    return _injector
+
+
+# --------------------------------------------------------------------------
+# instrumentation hooks (each a no-op when nothing is active)
+# --------------------------------------------------------------------------
+
+
+def check_step(step: int, guard=None) -> None:
+    """Per-training-step hook (all three trainer families).  Handles the
+    step-site faults: ``preempt`` requests the preemption guard (snapshot
+    + clean resumable exit), ``crash`` raises, ``stall`` sleeps past the
+    watchdog deadline, ``nan`` marks the period's loss for poisoning."""
+    inj = active()
+    if inj is None:
+        return
+    for f in inj.fire(
+        "step", at=step, kinds=("preempt", "crash", "stall", "nan")
+    ):
+        if f.kind == "preempt":
+            if guard is not None:
+                guard.request()
+        elif f.kind == "crash":
+            raise InjectedCrash(f"injected crash at step {step}")
+        elif f.kind == "stall":
+            time.sleep(f.arg if f.arg else 30.0)
+        elif f.kind == "nan":
+            inj.nan_pending = True
+
+
+def poison_loss(metrics: dict) -> dict:
+    """Period-end hook (``train/loop.py``): if a ``nan`` fault fired this
+    period, replace the loss with NaN so the recovery policy sees exactly
+    what a diverged step produces."""
+    inj = active()
+    if inj is not None and inj.nan_pending:
+        inj.nan_pending = False
+        metrics = dict(metrics)
+        metrics["loss"] = float("nan")
+    return metrics
+
+
+def io_check(site: str) -> None:
+    """Raise an injected OSError for ``io@<site>`` specs — placed at the
+    top of retryable I/O operations (snapshot save attempts, loader
+    sample reads)."""
+    inj = active()
+    if inj is None:
+        return
+    if inj.fire(site, kinds=("io",)):
+        raise OSError(f"injected I/O error at {site}")
+
+
+def corrupt_check(path) -> None:
+    """Post-commit hook (``checkpoint.py``): for ``corrupt_ckpt@save``
+    specs, truncate the largest data file of the just-committed snapshot
+    — the shape of a torn shared-NAS write — so integrity verification
+    must catch it."""
+    inj = active()
+    if inj is None:
+        return
+    if inj.fire("save", kinds=("corrupt_ckpt",)):
+        corrupt_snapshot(path)
+
+
+def corrupt_snapshot(path) -> None:
+    """Truncate the largest non-manifest file under ``path`` in place."""
+    from pathlib import Path
+
+    files = [
+        p for p in Path(path).rglob("*")
+        if p.is_file() and p.name != "ddl_manifest.json"
+    ]
+    if not files:
+        raise FileNotFoundError(f"nothing to corrupt under {path}")
+    victim = max(files, key=lambda p: p.stat().st_size)
+    size = victim.stat().st_size
+    with open(victim, "r+b") as fh:
+        fh.truncate(size // 2)

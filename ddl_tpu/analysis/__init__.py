@@ -1,0 +1,23 @@
+"""Static analysis for the ddl_tpu framework: ``ddl_tpu lint``.
+
+Two engines behind one CLI (``analysis/cli.py``):
+
+* **AST lint rules** (``astlint.py``) — host-sync and nondeterminism
+  inside traced functions, bare/over-broad excepts in recovery paths,
+  legacy-JAX spellings that bypass ``compat.py``, unregistered obs
+  event names, unknown ``PartitionSpec`` axes, missing jit donation.
+  Pure ``ast`` — no JAX import, runs anywhere in milliseconds.
+* **Sharding contract checker** (``contracts.py``) — abstract-evals the
+  registered step-function factories (CNN / LM / ViT / decode) under a
+  small simulated mesh and validates the cross-module composition the
+  AST rules cannot see: trace-clean lowering, no silently replicated
+  large parameters, boundary specs drawn from the mesh vocabulary.
+
+Findings flow through a committed baseline (``LINT_BASELINE.json``) and
+per-line ``# ddl-lint: disable=<rule>`` suppressions (``findings.py``),
+so CI fails only on *new* findings.
+"""
+
+from ddl_tpu.analysis.findings import Finding, load_baseline, save_baseline
+
+__all__ = ["Finding", "load_baseline", "save_baseline"]

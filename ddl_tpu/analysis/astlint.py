@@ -1,0 +1,686 @@
+"""AST lint rules over the ddl_tpu package — no JAX import required.
+
+The classes of bug these rules catch share one property: they are
+*silent* on a TPU run.  A ``float()`` inside a jitted step either throws
+a ConcretizationError at trace time (best case) or forces a host
+round-trip per step (worst case — the step graph is cut and MFU halves
+with no error anywhere); an unknown mesh axis in a ``PartitionSpec``
+replicates the array instead of sharding it; an obs event emitted under
+a typo'd name silently never matches any dashboard/CI query.
+
+Engine: per module, build the set of **traced functions** — functions
+whose code runs under a JAX trace — then apply host-interop rules only
+inside that set (a ``float()`` in the host-side logging path is fine;
+the same call inside ``loss_fn`` is a bug).  Traced functions are found
+by reference, not by name:
+
+* a function passed to (or decorating with) a JAX transform —
+  ``jax.jit`` / ``grad`` / ``value_and_grad`` / ``vmap`` / ``shard_map``
+  / ``lax.scan|cond|while_loop|fori_loop`` / ``checkpoint`` /
+  ``pallas_call`` — is a traced root;
+* **sink parameters** propagate interprocedurally within a module: if
+  function ``F`` passes its parameter ``p`` into a transform (or into
+  another function's sink parameter, or calls ``p`` from traced code),
+  then any local function passed as ``p`` at an ``F`` call site is
+  traced — this is how ``loss_fn`` handed through
+  ``finalize_step_fns`` → ``jax.value_and_grad`` is found;
+* functions lexically nested in a traced function, and functions called
+  by name from traced code, are traced (closure to fixpoint).
+
+Cross-module calls are not followed — the rules are per-file by design
+(fast, no imports); the sharding-contract checker (``contracts.py``)
+covers the cross-module composition at trace level.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+
+from ddl_tpu.analysis.findings import Finding, suppressed
+
+__all__ = ["Registry", "lint_file", "lint_package", "load_registry", "MESH_AXES"]
+
+# The mesh-axis vocabulary (parallel/mesh.py + parallel/sharding.py).
+# PartitionSpec literals anywhere in the package must draw from this set
+# (or from an axis tuple declared in a same-module Mesh(...) literal).
+MESH_AXES = frozenset({"data", "pipe", "seq", "model", "expert"})
+
+# Calls that put their function arguments under a JAX trace.
+_TRANSFORMS = frozenset({
+    "jax.jit", "jit", "nn.jit",
+    "jax.grad", "jax.value_and_grad", "jax.vjp", "jax.jvp", "jax.linearize",
+    "jax.vmap", "jax.pmap",
+    "jax.shard_map", "shard_map",
+    "jax.checkpoint", "jax.remat", "nn.remat", "checkpoint", "remat",
+    "jax.eval_shape",
+    "jax.lax.scan", "lax.scan", "jax.lax.cond", "lax.cond",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.map", "lax.map",
+    "jax.lax.associative_scan", "lax.associative_scan",
+    "pl.pallas_call", "pallas_call",
+})
+
+# Host-synchronisation calls: inside traced code these either fail the
+# trace or silently cut the compiled program at a host round-trip.
+_HOST_SYNC_DOTTED = frozenset({
+    "jax.device_get", "device_get",
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.block_until_ready",
+})
+_HOST_SYNC_METHODS = frozenset({"item", "block_until_ready"})
+
+_NONDET_DOTTED = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+})
+
+# Modules whose exception handling gates checkpoint/recovery decisions:
+# an over-broad swallow here turns a real corruption into silent data
+# loss, so `except Exception` without a re-raise is flagged.
+_RECOVERY_MODULES = frozenset({
+    "checkpoint.py",
+    "supervisor.py",
+    "train/recovery.py",
+    "train/loop.py",
+    "utils/preemption.py",
+    "utils/backoff.py",
+    "utils/faultinject.py",
+    "obs/watchdog.py",
+    "obs/steptrace.py",
+})
+
+# Step-function factory modules: every jitted train step must declare
+# buffer donation (checked here) — whether the runtime honors it is the
+# contract checker's runtime concern (compat.py strips donation on old
+# jaxlib, an explicit waiver).
+_STEP_MODULES = frozenset({
+    "train/steps.py",
+    "train/lm_steps.py",
+    "train/vit_steps.py",
+    "parallel/lm_pipeline.py",
+})
+
+
+@dataclasses.dataclass
+class Registry:
+    """Names the obs-event rule validates against, parsed from
+    ``ddl_tpu/obs/events.py`` without importing it."""
+
+    event_kinds: frozenset[str]
+    anomaly_types: frozenset[str]
+
+
+def load_registry(package_root: Path) -> Registry:
+    """Parse EVENT_KINDS / ANOMALY_TYPES tuples out of obs/events.py."""
+    src = (Path(package_root) / "obs" / "events.py").read_text()
+    tree = ast.parse(src)
+    found: dict[str, frozenset] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if target.id in ("EVENT_KINDS", "ANOMALY_TYPES"):
+            values = [
+                e.value
+                for e in ast.walk(node.value)
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+            found[target.id] = frozenset(values)
+    return Registry(
+        event_kinds=found.get("EVENT_KINDS", frozenset()),
+        anomaly_types=found.get("ANOMALY_TYPES", frozenset()),
+    )
+
+
+# ---------------------------------------------------------------------------
+# module model: functions, imports, traced-set inference
+# ---------------------------------------------------------------------------
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@dataclasses.dataclass
+class _Func:
+    node: ast.AST
+    name: str
+    parent: "_Func | None"
+    params: tuple[str, ...]
+    sink_params: set[str] = dataclasses.field(default_factory=set)
+
+
+class _Module:
+    """One parsed module with enough structure for the traced-set
+    inference: functions (with lexical nesting), every call site (with
+    its innermost enclosing function), and the import alias map."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.funcs: dict[int, _Func] = {}
+        self.by_name: dict[str, list[_Func]] = {}
+        self.calls: list[tuple[ast.Call, _Func | None]] = []
+        self.imports: dict[str, str] = {}  # local alias -> real module
+        self._index(tree)
+
+    def _index(self, tree: ast.Module) -> None:
+        stack: list[_Func] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, _FUNC_NODES):
+                name = getattr(node, "name", "<lambda>")
+                args = node.args
+                params = tuple(
+                    a.arg
+                    for a in (
+                        args.posonlyargs + args.args + args.kwonlyargs
+                    )
+                )
+                fn = _Func(node, name, stack[-1] if stack else None, params)
+                self.funcs[id(node)] = fn
+                self.by_name.setdefault(name, []).append(fn)
+                stack.append(fn)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                stack.pop()
+                return
+            if isinstance(node, ast.Call):
+                self.calls.append((node, stack[-1] if stack else None))
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}" if node.module
+                        else alias.name
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(tree)
+
+    # -- resolution helpers -------------------------------------------------
+
+    def resolve_func(self, expr: ast.AST) -> _Func | None:
+        """A Name (or functools.partial(Name, ...)) referring to a
+        module function, else None."""
+        if isinstance(expr, ast.Call) and _is_partial(expr.func):
+            return self.resolve_func(expr.args[0]) if expr.args else None
+        if isinstance(expr, ast.Name):
+            candidates = self.by_name.get(expr.id)
+            return candidates[-1] if candidates else None
+        return None
+
+    def enclosing_chain(self, fn: _Func | None):
+        while fn is not None:
+            yield fn
+            fn = fn.parent
+
+
+def _is_partial(func_expr: ast.AST) -> bool:
+    d = _dotted(func_expr)
+    return d in ("partial", "functools.partial")
+
+
+def _is_transform(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    if d in _TRANSFORMS:
+        return True
+    # partial(jax.jit, ...) / partial(lax.scan, ...) as the callee
+    if _is_partial(call.func):
+        return False  # handled at the inner-arg level by callers
+    return False
+
+
+def _func_args(call: ast.Call):
+    """Every expression passed to a call (positional + keyword)."""
+    yield from call.args
+    for kw in call.keywords:
+        if kw.value is not None:
+            yield kw.value
+
+
+def _infer_traced(mod: _Module) -> set[int]:
+    """Fixpoint over {traced functions} x {sink parameters}."""
+    traced: set[int] = set()
+
+    # seeds: decorators that are transforms
+    for fn in mod.funcs.values():
+        for dec in getattr(fn.node, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            d = _dotted(target)
+            if d in _TRANSFORMS:
+                traced.add(id(fn.node))
+            elif isinstance(dec, ast.Call) and _is_partial(dec.func):
+                if dec.args and _dotted(dec.args[0]) in _TRANSFORMS:
+                    traced.add(id(fn.node))
+
+    changed = True
+    while changed:
+        changed = False
+
+        for call, enclosing in mod.calls:
+            callee_d = _dotted(call.func)
+
+            # (1) function reference passed into a transform -> traced root
+            transform_call = callee_d in _TRANSFORMS or (
+                _is_partial(call.func)
+                and call.args
+                and _dotted(call.args[0]) in _TRANSFORMS
+            )
+            if transform_call:
+                for arg in _func_args(call):
+                    target = mod.resolve_func(arg)
+                    if target is not None and id(target.node) not in traced:
+                        traced.add(id(target.node))
+                        changed = True
+                # a parameter of an enclosing function fed to a transform
+                # makes that parameter a sink
+                for arg in _func_args(call):
+                    base = arg
+                    if isinstance(arg, ast.Call) and _is_partial(arg.func):
+                        base = arg.args[0] if arg.args else arg
+                    if isinstance(base, ast.Name) and enclosing is not None:
+                        for outer in mod.enclosing_chain(enclosing):
+                            if base.id in outer.params and (
+                                base.id not in outer.sink_params
+                            ):
+                                outer.sink_params.add(base.id)
+                                changed = True
+
+            # (2) call to a local function with sink params: map args
+            callee_fn = mod.resolve_func(call.func)
+            if callee_fn is not None and callee_fn.sink_params:
+                bound: list[tuple[str, ast.AST]] = []
+                for i, arg in enumerate(call.args):
+                    if i < len(callee_fn.params):
+                        bound.append((callee_fn.params[i], arg))
+                for kw in call.keywords:
+                    if kw.arg is not None:
+                        bound.append((kw.arg, kw.value))
+                for pname, arg in bound:
+                    if pname not in callee_fn.sink_params:
+                        continue
+                    target = mod.resolve_func(arg)
+                    if target is not None and id(target.node) not in traced:
+                        traced.add(id(target.node))
+                        changed = True
+                    base = arg
+                    if isinstance(arg, ast.Call) and _is_partial(arg.func):
+                        base = arg.args[0] if arg.args else arg
+                    if isinstance(base, ast.Name) and enclosing is not None:
+                        for outer in mod.enclosing_chain(enclosing):
+                            if base.id in outer.params and (
+                                base.id not in outer.sink_params
+                            ):
+                                outer.sink_params.add(base.id)
+                                changed = True
+
+            # (3) inside a traced function: called names become traced,
+            # and a *called parameter* of an enclosing function is a sink
+            # (accumulate_grads' scan body calling grad_fn)
+            if enclosing is not None and id(enclosing.node) in traced:
+                target = mod.resolve_func(call.func)
+                if target is not None and id(target.node) not in traced:
+                    traced.add(id(target.node))
+                    changed = True
+                if isinstance(call.func, ast.Name):
+                    for outer in mod.enclosing_chain(enclosing):
+                        if call.func.id in outer.params and (
+                            call.func.id not in outer.sink_params
+                        ):
+                            outer.sink_params.add(call.func.id)
+                            changed = True
+
+        # (4) lexical nesting: children of traced functions are traced
+        for fn in mod.funcs.values():
+            if id(fn.node) in traced:
+                continue
+            if fn.parent is not None and id(fn.parent.node) in traced:
+                traced.add(id(fn.node))
+                changed = True
+
+    return traced
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _iter_with_enclosing(tree: ast.Module, mod: _Module):
+    """(node, innermost enclosing _Func or None) for every node."""
+    stack: list[_Func] = []
+
+    def visit(node: ast.AST):
+        entered = False
+        if isinstance(node, _FUNC_NODES):
+            stack.append(mod.funcs[id(node)])
+            entered = True
+        yield node, (stack[-1] if stack else None)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+        if entered:
+            stack.pop()
+
+    # yield with the *enclosing* function, so a FunctionDef node itself
+    # reports under its own scope (fine for our rules)
+    yield from visit(tree)
+
+
+def _rule_traced_interop(
+    tree, mod: _Module, traced: set[int], rel: str, add
+) -> None:
+    for node, enclosing in _iter_with_enclosing(tree, mod):
+        if enclosing is None or id(enclosing.node) not in traced:
+            continue
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            full = None
+            if d is not None:
+                first, *rest = d.split(".")
+                full = ".".join([mod.imports.get(first, first)] + rest)
+            if d in _HOST_SYNC_DOTTED or full in _HOST_SYNC_DOTTED:
+                add(node, "host-sync",
+                    f"{d}() inside traced function "
+                    f"'{enclosing.name}' forces a host sync (or fails the "
+                    "trace); keep device values on device until the period "
+                    "fence")
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHODS
+                and not node.args
+            ):
+                add(node, "host-sync",
+                    f".{node.func.attr}() inside traced function "
+                    f"'{enclosing.name}' forces a host sync per call")
+            elif isinstance(node.func, ast.Name) and node.func.id == "float":
+                add(node, "host-sync",
+                    f"float() inside traced function '{enclosing.name}' "
+                    "concretizes a tracer (host sync / trace error); use "
+                    "jnp.float32 or .astype for dtype casts")
+            elif full is not None:
+                if d in _NONDET_DOTTED or full in _NONDET_DOTTED:
+                    add(node, "nondeterminism",
+                        f"{d}() inside traced function '{enclosing.name}': "
+                        "wall-clock reads bake a constant into the compiled "
+                        "program (and differ across hosts)")
+                elif full.startswith(("random.", "numpy.random.")):
+                    add(node, "nondeterminism",
+                        f"{d}() inside traced function '{enclosing.name}': "
+                        "Python/NumPy RNG is host-side and per-process; use "
+                        "jax.random with an explicit key")
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            it = node.iter
+            is_set = isinstance(it, ast.Set) or (
+                isinstance(it, ast.Call)
+                and _dotted(it.func) in ("set", "frozenset")
+            )
+            if is_set:
+                add(node if isinstance(node, ast.For) else it,
+                    "nondeterminism",
+                    f"iteration over a set inside traced function "
+                    f"'{enclosing.name}': set order varies per process, so "
+                    "traced program structure diverges across hosts; sort "
+                    "or use a tuple")
+
+
+def _rule_excepts(tree, rel: str, add) -> None:
+    in_recovery = rel_suffix(rel) in _RECOVERY_MODULES
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            add(node, "bare-except",
+                "bare 'except:' swallows KeyboardInterrupt/SystemExit too; "
+                "name the exceptions (or 'except Exception' plus a re-raise)")
+            continue
+        if not in_recovery:
+            continue
+        names = []
+        exprs = (
+            node.type.elts if isinstance(node.type, ast.Tuple)
+            else [node.type]
+        )
+        for e in exprs:
+            d = _dotted(e)
+            if d is not None:
+                names.append(d.split(".")[-1])
+        if any(n in ("Exception", "BaseException") for n in names):
+            has_raise = any(
+                isinstance(n, ast.Raise) for n in ast.walk(node)
+            )
+            if not has_raise:
+                add(node, "broad-except",
+                    f"'except {'/'.join(names)}' without re-raise in a "
+                    "checkpoint/recovery path can mask corruption as "
+                    "success; narrow the exception list or re-raise")
+
+
+def _rule_compat(tree, rel: str, add) -> None:
+    if rel_suffix(rel) == "compat.py":
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            m = node.module or ""
+            if m.startswith("jax.experimental.shard_map") or (
+                m == "jax.experimental"
+                and any(a.name in ("shard_map", "pjit") for a in node.names)
+            ):
+                add(node, "compat-bypass",
+                    "legacy jax.experimental.shard_map/pjit import bypasses "
+                    "the compat.py shim; use jax.shard_map / jax.jit "
+                    "(compat installs them on old runtimes)")
+            elif m.startswith("jax.experimental.pjit"):
+                add(node, "compat-bypass",
+                    "legacy pjit import; use jax.jit (compat.py guarantees "
+                    "the modern surface)")
+        elif isinstance(node, ast.Attribute):
+            d = _dotted(node)
+            if d and (
+                d.startswith("jax.experimental.shard_map")
+                or d.startswith("jax.experimental.pjit")
+            ):
+                add(node, "compat-bypass",
+                    f"direct {d} use bypasses the compat.py shim; use the "
+                    "modern jax.* name")
+            elif node.attr == "TPUCompilerParams":
+                add(node, "compat-bypass",
+                    "TPUCompilerParams is the legacy spelling; use "
+                    "pltpu.CompilerParams (compat.py aliases it on old "
+                    "runtimes)")
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "check_rep":
+                    add(node, "compat-bypass",
+                        "check_rep= is the legacy shard_map kwarg; pass "
+                        "check_vma= (compat.py translates on old runtimes)")
+
+
+def _rule_obs_events(tree, registry: Registry, rel: str, add) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if node.func.attr == "emit":
+            kind = None
+            if node.args and isinstance(node.args[0], ast.Constant):
+                kind = node.args[0].value
+            for kw in node.keywords:
+                if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                    kind = kw.value.value
+            if isinstance(kind, str) and kind not in registry.event_kinds:
+                add(node, "obs-event-unregistered",
+                    f"obs event kind {kind!r} is not in "
+                    "obs/events.py EVENT_KINDS; register it (or fix the "
+                    "typo) so dashboards and CI queries can rely on the "
+                    "name")
+        elif node.func.attr == "record":
+            base = _dotted(node.func.value)
+            if base is None or not base.split(".")[-1] == "anomaly":
+                continue
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+                t = node.args[1].value
+                if isinstance(t, str) and t not in registry.anomaly_types:
+                    add(node, "anomaly-type-unregistered",
+                        f"anomaly type {t!r} is not in obs/events.py "
+                        "ANOMALY_TYPES; register it so the obs summary and "
+                        "alert queries see it")
+
+
+def _pspec_names(tree, mod: _Module) -> set[str]:
+    """Local aliases bound to jax.sharding.PartitionSpec."""
+    names = set()
+    for alias, real in mod.imports.items():
+        if real.endswith("PartitionSpec"):
+            names.add(alias)
+    names.update({"PartitionSpec"})
+    return names
+
+
+def _rule_pspec(tree, mod: _Module, rel: str, add) -> None:
+    pnames = _pspec_names(tree, mod)
+    # axis names declared by a same-module Mesh((...), ("ring",)) literal
+    # extend the allowed set (bench/comm.py builds its own ring mesh)
+    extra: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) in (
+            "Mesh", "jax.sharding.Mesh"
+        ):
+            for arg in list(node.args[1:]) + [
+                kw.value for kw in node.keywords if kw.arg == "axis_names"
+            ]:
+                for e in ast.walk(arg):
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, str
+                    ):
+                        extra.add(e.value)
+    allowed = MESH_AXES | extra
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d not in pnames and d != "jax.sharding.PartitionSpec":
+            continue
+        for arg in node.args:
+            consts = (
+                [arg] if isinstance(arg, ast.Constant)
+                else list(ast.walk(arg)) if isinstance(arg, ast.Tuple)
+                else []
+            )
+            for e in consts:
+                if (
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                    and e.value not in allowed
+                ):
+                    add(node, "pspec-unknown-axis",
+                        f"PartitionSpec axis {e.value!r} is not a mesh axis "
+                        f"({'/'.join(sorted(allowed))}); XLA would treat "
+                        "the dimension as replicated — a silent memory/"
+                        "throughput loss, never an error")
+
+
+def _rule_donation(tree, mod: _Module, rel: str, add) -> None:
+    if rel_suffix(rel) not in _STEP_MODULES:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or _dotted(node.func) not in (
+            "jax.jit", "jit"
+        ):
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Name):
+            continue
+        if "train" not in node.args[0].id:
+            continue
+        kwargs = {kw.arg for kw in node.keywords}
+        if not kwargs & {"donate_argnums", "donate_argnames"}:
+            add(node, "donation-missing",
+                f"jax.jit({node.args[0].id}, ...) without donate_argnums: "
+                "the train state is copied instead of donated — 2x state "
+                "HBM held across the update (compat.py strips donation on "
+                "old runtimes; new step factories must still declare it)")
+
+
+def rel_suffix(rel: str) -> str:
+    """'ddl_tpu/train/loop.py' -> 'train/loop.py' (module path within
+    the package, for the per-module rule scopes)."""
+    parts = Path(rel).parts
+    if parts and parts[0] == "ddl_tpu":
+        parts = parts[1:]
+    return "/".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_file(
+    path: str | Path, repo_root: str | Path, registry: Registry
+) -> list[Finding]:
+    path = Path(path)
+    try:
+        rel = path.relative_to(repo_root).as_posix()
+    except ValueError:  # explicit file outside the repo (CLI paths arg)
+        rel = path.as_posix()
+    src = path.read_text()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 1, "syntax-error", str(e.msg))]
+    lines = src.splitlines()
+    mod = _Module(tree)
+    traced = _infer_traced(mod)
+    findings: list[Finding] = []
+
+    def add(node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        src_line = lines[line - 1] if 0 < line <= len(lines) else ""
+        if suppressed(src_line, rule):
+            return
+        findings.append(Finding(rel, line, rule, message))
+
+    _rule_traced_interop(tree, mod, traced, rel, add)
+    _rule_excepts(tree, rel, add)
+    _rule_compat(tree, rel, add)
+    _rule_obs_events(tree, registry, rel, add)
+    _rule_pspec(tree, mod, rel, add)
+    _rule_donation(tree, mod, rel, add)
+    return sorted(findings)
+
+
+def lint_package(
+    package_root: str | Path, files: list[Path] | None = None
+) -> list[Finding]:
+    """Run every AST rule over the package (or an explicit file list).
+    ``package_root`` is the ``ddl_tpu`` directory; paths in findings are
+    relative to its parent (the repo root)."""
+    package_root = Path(package_root)
+    repo_root = package_root.parent
+    registry = load_registry(package_root)
+    if files is None:
+        files = sorted(package_root.rglob("*.py"))
+    findings: list[Finding] = []
+    for f in files:
+        findings.extend(lint_file(f, repo_root, registry))
+    return sorted(findings)

@@ -1,0 +1,110 @@
+"""``ddl_tpu lint`` — the CLI front of the static-analysis subsystem.
+
+    python -m ddl_tpu.cli lint                       # human-readable
+    python -m ddl_tpu.cli lint --json                # machine-readable
+    python -m ddl_tpu.cli lint --baseline LINT_BASELINE.json
+    python -m ddl_tpu.cli lint --baseline LINT_BASELINE.json --update-baseline
+    python -m ddl_tpu.cli lint --no-contracts path/to/file.py ...
+
+Exit codes: 0 = clean (every finding baselined or suppressed), 1 = new
+findings.  With ``--baseline`` the committed ``LINT_BASELINE.json``
+gates CI: pre-existing findings don't fail the build, new ones do, and
+stale entries are reported so the baseline only ever shrinks
+(``--update-baseline`` rewrites it after intentional changes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ddl_tpu lint", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="specific files to lint (default: the whole package; "
+        "explicit paths run the AST rules only)",
+    )
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="baseline JSON: findings listed there do not fail the run",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite --baseline (default LINT_BASELINE.json) with the "
+        "current findings and exit 0",
+    )
+    ap.add_argument(
+        "--no-contracts", action="store_true",
+        help="skip the sharding-contract probes (AST rules only — "
+        "no JAX, runs in milliseconds)",
+    )
+    args = ap.parse_args(argv)
+
+    from ddl_tpu.analysis.findings import save_baseline
+    from ddl_tpu.analysis.runner import package_root, run_lint
+
+    files = [Path(p) for p in args.paths] or None
+    baseline_path = args.baseline
+    if args.update_baseline and baseline_path is None:
+        baseline_path = package_root().parent / "LINT_BASELINE.json"
+
+    result = run_lint(
+        files=files,
+        contracts=not args.no_contracts and files is None,
+        baseline_path=(
+            baseline_path
+            if baseline_path and Path(baseline_path).exists()
+            else None
+        ),
+    )
+
+    if args.update_baseline:
+        save_baseline(baseline_path, result.findings)
+        print(
+            f"wrote {len(result.findings)} finding(s) to {baseline_path}"
+        )
+        return 0
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "new": [f.to_dict() for f in result.new],
+                "baselined": [f.to_dict() for f in result.known],
+                "stale_baseline": [f.to_dict() for f in result.stale],
+                "notes": result.notes,
+                "ok": result.ok,
+            },
+            indent=1,
+        ))
+        return 0 if result.ok else 1
+
+    for f in result.new:
+        print(f.format())
+    for note in result.notes:
+        print(f"note: {note}")
+    if result.known:
+        print(f"{len(result.known)} baselined finding(s) (not failing)")
+    if result.stale:
+        print(
+            f"{len(result.stale)} stale baseline entr(ies) — fixed or "
+            "moved; run --update-baseline to shrink the baseline:"
+        )
+        for f in result.stale:
+            print(f"  stale: {f.format()}")
+    if result.ok:
+        print("lint: clean")
+        return 0
+    print(f"lint: {len(result.new)} new finding(s)")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

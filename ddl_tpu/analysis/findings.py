@@ -1,0 +1,96 @@
+"""Findings, per-line suppressions, and the committed baseline.
+
+A finding is one diagnostic from either analysis engine (the AST rules in
+``astlint.py`` or the sharding-contract probes in ``contracts.py``):
+``rule`` (stable id), ``path`` (repo-relative), ``line`` and ``message``.
+
+Two escape hatches keep the linter honest instead of nagging:
+
+* **per-line suppression** — a trailing ``# ddl-lint: disable=<rule>``
+  (or bare ``# ddl-lint: disable`` for every rule) on the flagged line
+  acknowledges an intentional violation *in the code itself*, next to
+  the justification comment a reviewer will demand anyway;
+* **the baseline** — ``LINT_BASELINE.json`` at the repo root records
+  pre-existing findings so wiring the linter into CI doesn't require
+  fixing the world first.  A finding matches a baseline entry on
+  ``(rule, path, message)`` (line numbers drift with unrelated edits);
+  CI fails only on findings *not* in the baseline, and reports stale
+  entries so the baseline shrinks as code improves
+  (``--update-baseline`` rewrites it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from pathlib import Path
+
+__all__ = [
+    "Finding",
+    "suppressed",
+    "load_baseline",
+    "save_baseline",
+    "split_by_baseline",
+]
+
+_SUPPRESS_RE = re.compile(r"#\s*ddl-lint:\s*disable(?:=([\w\-,\s]+))?")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    path: str  # repo-relative, posix separators
+    line: int
+    rule: str
+    message: str
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        """Baseline-matching key: line numbers drift, content doesn't."""
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def suppressed(source_line: str, rule: str) -> bool:
+    """True when ``source_line`` carries a suppression comment covering
+    ``rule`` — ``# ddl-lint: disable`` (all rules) or
+    ``# ddl-lint: disable=rule-a,rule-b``."""
+    m = _SUPPRESS_RE.search(source_line)
+    if not m:
+        return False
+    if m.group(1) is None:
+        return True
+    rules = {r.strip() for r in m.group(1).split(",")}
+    return rule in rules
+
+
+def load_baseline(path: str | Path) -> list[Finding]:
+    data = json.loads(Path(path).read_text())
+    return [Finding(**entry) for entry in data["findings"]]
+
+
+def save_baseline(path: str | Path, findings: list[Finding]) -> None:
+    payload = {
+        "version": 1,
+        "findings": [f.to_dict() for f in sorted(findings)],
+    }
+    Path(path).write_text(json.dumps(payload, indent=1) + "\n")
+
+
+def split_by_baseline(
+    findings: list[Finding], baseline: list[Finding]
+) -> tuple[list[Finding], list[Finding], list[Finding]]:
+    """``(new, known, stale)``: findings absent from the baseline (CI
+    fails on these), findings the baseline covers, and baseline entries
+    no longer produced (candidates for ``--update-baseline``)."""
+    known_keys = {f.key for f in baseline}
+    current_keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in known_keys]
+    known = [f for f in findings if f.key in known_keys]
+    stale = [f for f in baseline if f.key not in current_keys]
+    return new, known, stale

@@ -1,0 +1,62 @@
+"""Orchestration for ``ddl_tpu lint``: engines → baseline → verdict."""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+from ddl_tpu.analysis.findings import (
+    Finding,
+    load_baseline,
+    split_by_baseline,
+)
+
+__all__ = ["LintResult", "package_root", "run_lint"]
+
+
+def package_root() -> Path:
+    return Path(__file__).resolve().parents[1]
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]  # everything produced this run
+    new: list[Finding]  # not covered by the baseline -> CI fails
+    known: list[Finding]  # baselined (pre-existing, tracked)
+    stale: list[Finding]  # baseline entries no longer produced
+    notes: list[str]  # informational (waivers, skips)
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+def run_lint(
+    root: Path | None = None,
+    files: list[Path] | None = None,
+    contracts: bool = True,
+    baseline_path: str | Path | None = None,
+) -> LintResult:
+    """Run both engines and fold in the baseline.
+
+    ``contracts=False`` keeps the run pure-AST (no JAX import — usable
+    on a log-analysis host, and what editors want on save)."""
+    from ddl_tpu.analysis.astlint import lint_package
+
+    root = root or package_root()
+    findings = list(lint_package(root, files=files))
+    notes: list[str] = []
+    if contracts and files is None:
+        from ddl_tpu.analysis.contracts import run_contracts
+
+        report = run_contracts()
+        findings.extend(report.findings)
+        notes.extend(report.notes)
+    findings.sort()
+    baseline = (
+        load_baseline(baseline_path) if baseline_path is not None else []
+    )
+    new, known, stale = split_by_baseline(findings, baseline)
+    return LintResult(
+        findings=findings, new=new, known=known, stale=stale, notes=notes
+    )

@@ -25,7 +25,7 @@ import json
 import os
 import zlib
 from pathlib import Path
-from typing import Any
+from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,7 @@ __all__ = [
     "run_resume_load",
     "verify_snapshot",
     "write_manifest",
+    "gc_snapshots",
     "SnapshotCorruptError",
     "SnapshotManager",
 ]
@@ -610,6 +611,72 @@ def snapshot_epochs(
         for p in job_dir.iterdir()
         if p.name.startswith("epoch_") and p.name.removeprefix("epoch_").isdigit()
     )
+
+
+# Snapshots this process already CRC-verified (immutable after commit,
+# so per-save GC re-verification of the keep window would re-read every
+# byte of every kept snapshot — ~keep x snapshot-size of NAS traffic
+# per save for nothing).  Only positive results are cached: a corrupt
+# snapshot gets deleted, and restore-time verification still reads the
+# real bytes, so later bit rot is caught where it matters.
+_gc_verified: set[tuple[str, str, int]] = set()
+
+
+def gc_snapshots(
+    checkpoint_dir: str | os.PathLike,
+    job_id: str,
+    keep: int,
+    protect: Sequence[int] = (),
+) -> list[tuple[Path, str]]:
+    """Delete old snapshots, keeping the newest ``keep`` **valid** ones.
+
+    Corrupt snapshots never count toward ``keep``: a multi-day run with
+    ``keep=2`` whose newest write was torn by a NAS flake must still
+    hold two *restorable* snapshots, not one good one plus a corpse —
+    the exact fallback chain ``latest_valid_epoch`` walks on rollback/
+    auto-resume.  Corrupt snapshots are deleted (they can never be
+    restored) along with valid ones older than the keep window.
+    ``protect`` epochs (the best-eval-metric snapshot the save gate just
+    wrote) are never deleted and occupy no keep slot — ``keep`` bounds
+    the *cadence* retention, not the gated one.
+
+    An in-flight async save is safe: Orbax commits atomically (tmp-dir
+    rename), so an uncommitted snapshot is invisible to
+    ``snapshot_epochs``, and a committed-but-manifestless one counts as
+    valid ("legacy") and is the newest — inside the keep window.
+
+    Returns ``[(path, reason), ...]`` for what was removed."""
+    import shutil
+
+    if keep is None or keep <= 0:
+        return []
+    protected = set(protect)
+    removed: list[tuple[Path, str]] = []
+    valid_kept = 0
+    for epoch in reversed(snapshot_epochs(checkpoint_dir, job_id)):
+        if epoch in protected:
+            continue
+        path = snapshot_path(checkpoint_dir, job_id, epoch)
+        if valid_kept < keep:
+            cache_key = (str(Path(checkpoint_dir).absolute()), job_id, epoch)
+            if cache_key in _gc_verified:
+                valid_kept += 1
+                continue
+            ok, reason = verify_snapshot(path)
+            if ok:
+                _gc_verified.add(cache_key)
+                valid_kept += 1
+                continue
+            reason = f"corrupt ({reason}); does not count toward keep={keep}"
+        else:
+            reason = f"older than the {keep} newest valid snapshots"
+        try:
+            shutil.rmtree(path)
+        except OSError as e:
+            print(f"snapshot GC could not remove {path}: {e}")
+            continue
+        removed.append((path, reason))
+    return removed
 
 
 def latest_valid_epoch(

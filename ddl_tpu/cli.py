@@ -29,6 +29,12 @@ trainer writes (``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
     python -m ddl_tpu.cli obs diff <job_a> <job_b>
     python -m ddl_tpu.cli obs baseline <job_id> --out FILE
     python -m ddl_tpu.cli obs diff <job_id> --baseline FILE [--fail-slowdown 0.5]
+
+Static analysis (``ddl_tpu/analysis/``): AST anti-pattern rules plus the
+sharding-contract probes, gated by the committed ``LINT_BASELINE.json``:
+
+    python -m ddl_tpu.cli lint [--json] [--baseline LINT_BASELINE.json]
+        [--update-baseline] [--no-contracts] [paths...]
 """
 
 from __future__ import annotations
@@ -49,6 +55,12 @@ def main(argv=None) -> None:
         from ddl_tpu.obs.report import main as obs_main
 
         return obs_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # static analysis (analysis/): AST rules + sharding-contract
+        # probes; the probes force a simulated CPU mesh themselves
+        from ddl_tpu.analysis.cli import main as lint_main
+
+        raise SystemExit(lint_main(argv[1:]))
     if argv and argv[0] == "train":
         argv = argv[1:]
 

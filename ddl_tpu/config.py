@@ -133,6 +133,10 @@ class TrainConfig:
     save_best_qwk: bool = True
     # Commit snapshots asynchronously (training continues during the write).
     async_checkpoint: bool = True
+    # Snapshot GC: keep only the newest K *valid* snapshots after each
+    # save (corrupt/torn ones never count toward K and are removed —
+    # checkpoint.gc_snapshots).  0 = keep everything.
+    keep_snapshots: int = 0
     # Failure detection (absent in the reference — SURVEY.md section 5): halt
     # with a clear diagnostic when the training loss goes non-finite.
     halt_on_nan: bool = True

@@ -48,7 +48,13 @@ from ddl_tpu.parallel.sharding import (
     validate_kv_head_sharding,
 )
 
-__all__ = ["LMDecode", "init_kv_cache", "make_lm_generator"]
+__all__ = ["LMDecode", "DECODE_TOKEN_SPEC", "init_kv_cache", "make_lm_generator"]
+
+# Jit-boundary sharding for prompt/output token batches: batch over
+# data (tensor-parallel decode shards heads over 'model' *inside* the
+# program via the logical rules).  Named once so the generator and the
+# sharding-contract checker (analysis/contracts.py) agree.
+DECODE_TOKEN_SPEC = P("data")
 
 
 class LMDecode(nn.Module):
@@ -274,7 +280,7 @@ def make_lm_generator(
         )
         return toks.T  # (B, max_new)
 
-    tok_sharding = NamedSharding(mesh, P("data"))
+    tok_sharding = NamedSharding(mesh, DECODE_TOKEN_SPEC)
 
     jitted = jax.jit(
         generate,
@@ -321,4 +327,15 @@ def make_lm_generator(
         )
         return toks
 
+    # sharding contract + lowering handles for `ddl_tpu lint`
+    # (analysis/contracts.py): decode has no train state to donate, and
+    # serving replicas intentionally hold full parameter copies when the
+    # mesh has no model axis — replication is checked against the spec
+    run.contract = {
+        "in_specs": {"prompt": DECODE_TOKEN_SPEC},
+        "donate_state": False,
+        "replicated_params_ok": True,
+    }
+    run.jitted = jitted
+    run.mesh = mesh
     return run

@@ -28,10 +28,50 @@ import os
 import threading
 import time
 import uuid
+import warnings
 from contextlib import contextmanager
 from pathlib import Path
 
-__all__ = ["EventWriter", "events_path", "read_events"]
+__all__ = [
+    "EVENT_KINDS",
+    "ANOMALY_TYPES",
+    "EventWriter",
+    "events_path",
+    "read_events",
+]
+
+# ---------------------------------------------------------------------------
+# Event-name registry.  Every ``kind`` emitted anywhere in the package
+# must be listed here: dashboards, `obs summarize`, and CI queries match
+# events BY NAME, so a typo'd kind is a silently-invisible event stream.
+# The static analyzer (`ddl_tpu lint`, analysis/astlint.py) checks every
+# ``.emit("<kind>")`` call site against this tuple without importing
+# JAX; ``EventWriter.emit`` warns at runtime for dynamic kinds the
+# linter cannot see.  Extend the tuple in the same change that emits the
+# new kind.
+# ---------------------------------------------------------------------------
+EVENT_KINDS = (
+    # events.py / steptrace.py envelope
+    "span", "run_start", "run_end", "period",
+    # watchdog.py liveness
+    "heartbeat", "stall", "watchdog_exit",
+    # anomaly.py detectors + loop recovery
+    "anomaly", "rollback",
+    # loop.py data-path retries
+    "io_retry",
+    # infer/decode.py per-request serving telemetry
+    "decode",
+    # supervisor.py restart lifecycle
+    "supervisor_start", "supervisor_relaunch", "supervisor_done",
+)
+
+# ``type`` values carried by "anomaly" events (AnomalyMonitor.record and
+# the rolling detectors in obs/anomaly.py).
+ANOMALY_TYPES = (
+    "loss_spike", "throughput_regression", "hbm_growth", "nonfinite_loss",
+)
+
+_warned_kinds: set[str] = set()
 
 
 def events_path(log_dir: str | os.PathLike, job_id: str, host: int = 0) -> Path:
@@ -65,6 +105,17 @@ class EventWriter:
         self._spans = threading.local()  # per-thread open-span name stack
 
     def emit(self, kind: str, step: int | None = None, **fields) -> dict:
+        if kind not in EVENT_KINDS and kind not in _warned_kinds:
+            # warn (once per kind), don't drop: ad-hoc kinds in probes/
+            # tests still flow, but anything shipping in the package is
+            # caught here at runtime and by `ddl_tpu lint` statically
+            _warned_kinds.add(kind)
+            warnings.warn(
+                f"obs event kind {kind!r} is not registered in "
+                "ddl_tpu.obs.events.EVENT_KINDS; consumers matching by "
+                "name will not see it",
+                stacklevel=2,
+            )
         event = {
             "ts": time.time(),
             "mono": time.monotonic(),

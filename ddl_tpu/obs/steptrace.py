@@ -72,7 +72,10 @@ class _CompileCounter:
                         counter.secs += duration
 
                 monitoring.register_event_duration_secs_listener(_on_duration)
-            except Exception:
+            except (ImportError, AttributeError):
+                # no jax.monitoring on this runtime: the recompile
+                # counter stays at 0 — observability degrades, the run
+                # doesn't
                 pass
             cls._shared = counter
         return cls._shared
@@ -180,11 +183,9 @@ class StepTrace:
         from ddl_tpu.utils.memory import hbm_stats
 
         phases = dict(self._totals)
-        mem = None
-        try:
-            mem = hbm_stats()
-        except Exception:
-            pass
+        # hbm_stats degrades to None itself on backends without memory
+        # stats (utils/memory.py) — no try needed here
+        mem = hbm_stats()
         loss = None
         if metrics:
             raw = metrics.get("loss")

@@ -77,8 +77,15 @@ class Supervisor:
         log: Callable[[str], None] = print,
         streak_window_s: float = 60.0,
         clock: Callable[[], float] = time.monotonic,
+        events=None,
     ) -> None:
         self.attempt_fn = attempt_fn
+        # an obs EventWriter (or None): restart decisions land in the
+        # same structured stream the trainers write, so `obs summarize`
+        # shows WHY a run has three run_start segments — the ROADMAP
+        # item "surface supervisor restarts as obs events from the
+        # supervisor itself" (it previously only printed)
+        self.events = events
         self.max_restarts = max_restarts
         self.max_preemptions = max_preemptions
         # an attempt that ran at least this long before its resumable
@@ -102,12 +109,29 @@ class Supervisor:
         # max_preemptions full recompiles at full speed
         self._consec_resumable = 0
 
+    def _emit(self, kind: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(
+                kind,
+                restarts=self.restarts,
+                crashes=self.crashes,
+                preemptions=self.preemptions,
+                **fields,
+            )
+
     def run(self) -> int:
+        self._emit(
+            "supervisor_start",
+            max_restarts=self.max_restarts,
+            max_preemptions=self.max_preemptions,
+        )
         while True:
             t0 = self.clock()
             try:
                 rc = int(self.attempt_fn(self.restarts))
-            except Exception as e:
+            # any attempt_fn exception IS the crash signal (rc=1): the
+            # supervisor must outlive whatever the child runner throws
+            except Exception as e:  # ddl-lint: disable=broad-except
                 self.log(f"[supervisor] attempt raised {type(e).__name__}: {e}")
                 rc = 1
             if self.clock() - t0 >= self.streak_window_s:
@@ -121,6 +145,7 @@ class Supervisor:
                         f"relaunch(es) ({self.preemptions} preemption(s), "
                         f"{self.crashes} crash(es))"
                     )
+                self._emit("supervisor_done", rc=0, gave_up=False)
                 return 0
             self.restarts += 1
             if rc == EXIT_PREEMPTED:
@@ -132,6 +157,7 @@ class Supervisor:
                         "resumable exits — something re-preempts every "
                         "attempt"
                     )
+                    self._emit("supervisor_done", rc=rc, gave_up=True)
                     return rc
                 delay = (
                     0.0 if self._consec_resumable == 1
@@ -143,6 +169,10 @@ class Supervisor:
                     + f" (preemption {self.preemptions}, crash budget "
                     f"untouched at {self.crashes}/{self.max_restarts})"
                 )
+                self._emit(
+                    "supervisor_relaunch", reason="preempt", rc=rc,
+                    delay=delay,
+                )
                 if delay > 0:
                     self.sleep(delay)
                 continue
@@ -153,14 +183,42 @@ class Supervisor:
                     f"[supervisor] giving up: exit code {rc} after "
                     f"{self.max_restarts} crash relaunches"
                 )
+                self._emit("supervisor_done", rc=rc, gave_up=True)
                 return rc
             delay = self.backoff.delay(self.crashes - 1)
             self.log(
                 f"[supervisor] crash (exit {rc}); relaunching in "
                 f"{delay:.1f}s (crash {self.crashes}/{self.max_restarts})"
             )
+            self._emit(
+                "supervisor_relaunch", reason="crash", rc=rc, delay=delay,
+            )
             if delay > 0:
                 self.sleep(delay)
+
+
+def _supervisor_events(env_map):
+    """An EventWriter aimed at the same log tree the child trainer
+    writes (DDL_LOG_DIR / DDL_JOB_ID, matching config.py's env-driven
+    defaults), so supervisor restart events land in the job's stream.
+    The supervisor process must never initialise JAX — the child owns
+    the devices — hence ``host=0`` is passed explicitly (EventWriter's
+    host auto-detection goes through ``launch.host_id``).  Returns None
+    when the log directory is unwritable (events are telemetry, not a
+    reason to refuse supervision)."""
+    from ddl_tpu.obs.events import EventWriter
+
+    log_dir = env_map.get("DDL_LOG_DIR", "training_logs")
+    job_id = (
+        env_map.get("DDL_JOB_ID")
+        or env_map.get("TORCHX_JOB_ID")
+        or "local"
+    ).split("/")[-1]
+    try:
+        return EventWriter(log_dir, job_id, host=0)
+    except OSError as e:
+        print(f"[supervisor] obs events disabled ({e})")
+        return None
 
 
 def supervise_command(
@@ -188,4 +246,12 @@ def supervise_command(
             child_env.pop("DDL_FAULT", None)
         return subprocess.call(argv, env=child_env)
 
-    return Supervisor(attempt, max_restarts=max_restarts, **kwargs).run()
+    kwargs.setdefault(
+        "events", _supervisor_events(os.environ if env is None else env)
+    )
+    sup = Supervisor(attempt, max_restarts=max_restarts, **kwargs)
+    try:
+        return sup.run()
+    finally:
+        if sup.events is not None:
+            sup.events.close()

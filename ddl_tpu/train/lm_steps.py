@@ -43,10 +43,18 @@ from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
 __all__ = [
     "LMTrainState",
     "LMStepFns",
+    "TOKEN_SPEC",
     "make_lm_step_fns",
     "make_ring_core",
     "finalize_step_fns",
 ]
+
+# The jit-boundary sharding for token batches (inputs AND targets): batch
+# over data x expert (outside MoE layers the expert axis is extra data
+# parallelism — the 'batch' logical rule in parallel/sharding.py), sequence
+# over seq.  Named once so the factories, the sharding-contract checker
+# (analysis/contracts.py), and tests all agree by construction.
+TOKEN_SPEC = P(("data", "expert"), "seq")
 
 
 class LMTrainState(struct.PyTreeNode):
@@ -241,7 +249,7 @@ def finalize_step_fns(
     lowers to bare-PartitionSpec sharding constraints, which resolve against
     the ambient mesh at trace time.
     """
-    tok_sharding = NamedSharding(mesh, P(("data", "expert"), "seq"))
+    tok_sharding = NamedSharding(mesh, TOKEN_SPEC)
     replicated = NamedSharding(mesh, P())
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
@@ -306,6 +314,13 @@ def finalize_step_fns(
             out_shardings=replicated,
         )
     )
+    # machine-readable sharding contract: what this factory promises at
+    # its jit boundary, validated by `ddl_tpu lint` (analysis/contracts)
+    train.contract = {
+        "in_specs": {"inputs": TOKEN_SPEC, "targets": TOKEN_SPEC},
+        "donate_state": True,
+        "replicated_params_ok": False,
+    }
     return LMStepFns(
         train=train,
         evaluate=evaluate,

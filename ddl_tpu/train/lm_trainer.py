@@ -63,6 +63,9 @@ class LMRunConfig:
     eval_frac: float = 0.05  # tail fraction of corpus windows held out
     checkpoint_dir: str | None = None
     save_every: int = 50  # snapshot cadence in steps
+    # keep only the newest K valid snapshots (0 = all); corrupt ones
+    # never count toward K — see checkpoint.gc_snapshots
+    keep_snapshots: int = 0
     resume_step: int | None = None
     # With no explicit resume_step, continue from this job id's latest
     # snapshot automatically when one exists (relaunch == resume).
@@ -136,6 +139,7 @@ class LMTrainer(BaseTrainer):
         from ddl_tpu.train.recovery import make_policy
 
         self.recovery = make_policy(run)
+        self.keep_snapshots = run.keep_snapshots
         self.preemption_save = run.preemption_save
         self.profile_dir = run.profile_dir
         self.save_best = bool(run.checkpoint_dir) and bool(run.eval_every)

@@ -104,6 +104,14 @@ class BaseTrainer:
     # True after a preemption-triggered early exit — the CLI turns this
     # into the supervisor's resumable exit code when supervised.
     preempted = False
+    # Snapshot garbage collection: keep the newest K *valid* snapshots
+    # (corrupt ones never count toward K — checkpoint.gc_snapshots);
+    # 0 = unlimited.  Families set it from their run config.
+    keep_snapshots = 0
+    # The best-eval-metric snapshot's store key (set by the loop when a
+    # save was gated on improvement): GC never deletes it — keep bounds
+    # the cadence retention, not the best-model one.
+    best_snapshot_epoch = None
 
     # ---------------------------------------------------------- overrides
 
@@ -156,6 +164,28 @@ class BaseTrainer:
         self._rollback_restore(epoch)
         print(f"[recovery] restored snapshot {epoch}")
         return True
+
+    def _gc_snapshots(self) -> None:
+        """Keep-last-K snapshot GC after a save (no-op unless the family
+        checkpoints and ``keep_snapshots`` > 0).  Only the logging
+        process prunes — every host shares the snapshot store."""
+        store = self._snapshot_store()
+        if (
+            not self.keep_snapshots
+            or store is None
+            or not getattr(self, "is_logging_process", True)
+        ):
+            return
+        from ddl_tpu import checkpoint as ckpt
+
+        protect = (
+            (self.best_snapshot_epoch,)
+            if self.best_snapshot_epoch is not None else ()
+        )
+        for path, reason in ckpt.gc_snapshots(
+            *store, keep=self.keep_snapshots, protect=protect
+        ):
+            print(f"[gc] removed snapshot {path}: {reason}")
 
     def set_update_scale(self, scale: float) -> None:
         """Scale subsequent optimizer updates by ``scale`` (the
@@ -364,9 +394,17 @@ class BaseTrainer:
                     if self.logger is not None and self.is_logging_process:
                         self.logger.log_many(eval_metrics, idx)
 
-            if self._improved(eval_metrics) or self.snapshot_due(period):
+            improved = self._improved(eval_metrics)
+            if improved or self.snapshot_due(period):
                 with _phase(obs, "checkpoint", step=idx):
                     self.save_snapshot(period)
+                    if improved:
+                        # idx is the snapshot's store key in every
+                        # family (epoch for CNN/ViT, the boundary step
+                        # for the LM — the same mapping save_snapshot
+                        # uses); GC must never reap the best model
+                        self.best_snapshot_epoch = idx
+                    self._gc_snapshots()
             preempted = guard is not None and guard.requested
             if preempted:
                 # Preempted (SIGTERM): checkpoint what we have and exit
@@ -378,6 +416,7 @@ class BaseTrainer:
                 with _phase(obs, "checkpoint", step=idx):
                     self.save_snapshot(period)
                     self.wait_for_saves()
+                    self._gc_snapshots()
             if obs is not None:
                 obs.end_period(period, idx, elapsed, steps, train_metrics)
             self.periods_run = period + 1

@@ -30,7 +30,12 @@ from ddl_tpu.models.densenet import forward_stages
 from ddl_tpu.ops import cross_entropy_loss, normalize_images
 from ddl_tpu.train.state import TrainState
 
-__all__ = ["StepFns", "make_dp_step_fns", "make_grad_stats_fn"]
+__all__ = ["StepFns", "BATCH_SPEC", "make_dp_step_fns", "make_grad_stats_fn"]
+
+# Jit-boundary sharding for image/label batches on the (data, pipe)
+# mesh; named once so the factory and the sharding-contract checker
+# (analysis/contracts.py) agree by construction.
+BATCH_SPEC = P("data")
 
 
 class StepFns(NamedTuple):
@@ -78,7 +83,7 @@ def make_dp_step_fns(
         return logits
 
     replicated = NamedSharding(mesh, P())
-    batch_sharding = NamedSharding(mesh, P("data"))
+    batch_sharding = NamedSharding(mesh, BATCH_SPEC)
 
     train = jax.jit(
         train_step,
@@ -91,6 +96,14 @@ def make_dp_step_fns(
         in_shardings=(replicated, batch_sharding),
         out_shardings=batch_sharding,
     )
+    # sharding contract for `ddl_tpu lint` (analysis/contracts.py): DDP
+    # keeps full parameter replicas by design, so replicated params are
+    # contractual here — the checker skips its replication rule
+    train.contract = {
+        "in_specs": {"images": BATCH_SPEC, "labels": BATCH_SPEC},
+        "donate_state": True,
+        "replicated_params_ok": True,
+    }
     return StepFns(train=train, evaluate=evaluate)
 
 

@@ -168,6 +168,7 @@ class Trainer(BaseTrainer):
         from ddl_tpu.train.recovery import make_policy
 
         self.recovery = make_policy(cfg.train)
+        self.keep_snapshots = cfg.train.keep_snapshots
         self.preemption_save = cfg.train.preemption_save
         self.profile_dir = cfg.train.profile_dir
         self.save_best = cfg.train.save_best_qwk

@@ -29,7 +29,12 @@ from ddl_tpu.parallel.sharding import (
     validate_kv_head_sharding,
 )
 
-__all__ = ["ViTTrainState", "ViTStepFns", "make_vit_step_fns"]
+__all__ = ["ViTTrainState", "ViTStepFns", "IMAGE_SPEC", "make_vit_step_fns"]
+
+# Jit-boundary sharding for image/label batches: batch over data (the
+# ViT family does not use the expert axis).  Named once so the factory
+# and the sharding-contract checker (analysis/contracts.py) agree.
+IMAGE_SPEC = P("data")
 
 
 class ViTTrainState(struct.PyTreeNode):
@@ -203,7 +208,7 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
     def eval_step(state, images):
         return forward(state.params, images)
 
-    img_sharding = NamedSharding(mesh, P("data"))
+    img_sharding = NamedSharding(mesh, IMAGE_SPEC)
     replicated = NamedSharding(mesh, P())
 
     from ddl_tpu.parallel.mesh import with_ambient_mesh
@@ -211,13 +216,24 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
     def _with_mesh(fn):
         return with_ambient_mesh(mesh, fn)
 
+    train = _with_mesh(jax.jit(
+        train_step,
+        in_shardings=(None, img_sharding, img_sharding),
+        out_shardings=(None, replicated),
+        donate_argnums=(0,),
+    ))
+    # sharding contract for `ddl_tpu lint` (analysis/contracts.py).  The
+    # patch/position embeddings live on the 'embed' logical axis, which
+    # the rule table deliberately leaves unsharded without FSDP — an
+    # explicit waiver, so their replication is contractual, not silent.
+    train.contract = {
+        "in_specs": {"images": IMAGE_SPEC, "labels": IMAGE_SPEC},
+        "donate_state": True,
+        "replicated_params_ok": False,
+        "replicated_ok_leaves": ("patch_embed", "pos_embed"),
+    }
     return ViTStepFns(
-        train=_with_mesh(jax.jit(
-            train_step,
-            in_shardings=(None, img_sharding, img_sharding),
-            out_shardings=(None, replicated),
-            donate_argnums=(0,),
-        )),
+        train=train,
         evaluate=_with_mesh(jax.jit(
             eval_step, in_shardings=(None, img_sharding),
         )),

@@ -41,6 +41,9 @@ class ViTRunConfig:
     pipeline_schedule: str = "gpipe"
     virtual_stages: int = 1
     checkpoint_dir: str | None = "checkpoints"
+    # keep only the newest K valid snapshots (0 = all); corrupt ones
+    # never count toward K — see checkpoint.gc_snapshots
+    keep_snapshots: int = 0
     resume_epoch: int | None = None
     # With no explicit resume_epoch, continue from this job id's latest
     # snapshot automatically when one exists (relaunch == resume).
@@ -119,6 +122,7 @@ class ViTTrainer(BaseTrainer):
         from ddl_tpu.train.recovery import make_policy
 
         self.recovery = make_policy(run)
+        self.keep_snapshots = run.keep_snapshots
         self.preemption_save = run.preemption_save and bool(run.checkpoint_dir)
         self.profile_dir = run.profile_dir
         self.save_best = run.save_best_qwk and bool(run.checkpoint_dir)
@@ -187,17 +191,20 @@ class ViTTrainer(BaseTrainer):
                 gi, gl = shard_batch(self.fns.mesh, images, labels)
             with _phase(self.obs, "step", step=step_base + steps):
                 self.state, m = self.fns.train(self.state, gi, gl)
-            # this family fetches the loss per step, so the fence phase is
-            # per-step too (the CNN/LM families fence once per period)
-            with _phase(self.obs, "fence", step=step_base + steps):
-                losses.append(float(m["loss"]))
+            # keep the per-step loss ON DEVICE: float()-ing it here would
+            # block every step on the compiled program (the host-sync
+            # anti-pattern `ddl_tpu lint` flags) — fetch once per epoch,
+            # like the CNN/LM families
+            losses.append(m["loss"])
             steps += 1
             faultinject.check_step(step_base + steps - 1, guard)
             if guard is not None and guard.requested:
                 break
         if steps == 0:
             raise RuntimeError("empty epoch: dataset smaller than one batch")
-        return {"loss": float(np.mean(losses))}, steps
+        with _phase(self.obs, "fence", step=step_base + steps - 1):
+            loss = float(np.mean([np.asarray(l) for l in losses]))
+        return {"loss": loss}, steps
 
     def evaluate_period(self, epoch: int) -> dict:
         self.test_loader.set_epoch(epoch)

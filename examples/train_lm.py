@@ -119,6 +119,9 @@ def main() -> None:
                     help="save a snapshot every --save-every steps (and on "
                     "held-out perplexity improvements / SIGTERM preemption)")
     ap.add_argument("--save-every", type=int, default=50)
+    ap.add_argument("--keep-snapshots", type=int, default=0,
+                    help="snapshot GC: keep only the newest K valid "
+                    "snapshots (corrupt ones never count; 0 = keep all)")
     ap.add_argument("--resume-step", type=int, default=None,
                     help="restore the snapshot saved at this step (any mesh, "
                     "any pipeline layout — the saved layout is read from the "
@@ -207,6 +210,7 @@ def main() -> None:
         eval_frac=args.eval_frac,
         checkpoint_dir=args.checkpoint_dir,
         save_every=args.save_every,
+        keep_snapshots=args.keep_snapshots,
         resume_step=args.resume_step,
         auto_resume=not args.fresh,
         job_id=args.job_id,

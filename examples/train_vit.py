@@ -60,6 +60,9 @@ def main() -> None:
     ap.add_argument("--cpu-devices", type=int, default=0)
     ap.add_argument("--checkpoint-dir", default="checkpoints",
                     help="QWK-gated / preemption snapshot dir ('' disables)")
+    ap.add_argument("--keep-snapshots", type=int, default=0,
+                    help="snapshot GC: keep only the newest K valid "
+                    "snapshots (corrupt ones never count; 0 = keep all)")
     ap.add_argument("--resume-epoch", type=int, default=None,
                     help="restore the snapshot saved at this epoch")
     ap.add_argument("--fresh", action="store_true",
@@ -114,6 +117,7 @@ def main() -> None:
         pipeline_schedule=args.pipeline_schedule,
         virtual_stages=args.virtual_stages,
         checkpoint_dir=args.checkpoint_dir or None,
+        keep_snapshots=args.keep_snapshots,
         resume_epoch=args.resume_epoch,
         auto_resume=not args.fresh,
         job_id=args.job_id,

@@ -1,0 +1,39 @@
+"""Known-bad fixture: pspec / compat / obs-event / bare-except
+violations.  Parsed by tests/test_analysis.py — never imported."""
+
+from jax.experimental.shard_map import shard_map  # compat-bypass
+from jax.sharding import Mesh, PartitionSpec as P
+
+BAD_SPEC = P("data", "batch_x")  # pspec-unknown-axis ('batch_x')
+OK_SPEC = P(("data", "expert"), "seq")
+
+# a module-declared mesh axis extends the allowed vocabulary
+RING_MESH_AXES = ("ring",)
+
+
+def build_ring(devices):
+    return Mesh(devices, ("ring",))
+
+
+RING_SPEC = P("ring")  # fine: declared by the Mesh literal above
+
+
+def legacy_shard(f, mesh):
+    return shard_map(
+        f, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_rep=False,  # compat-bypass: legacy kwarg
+    )
+
+
+def emit_things(writer, obs):
+    writer.emit("period", step=0)  # registered: fine
+    writer.emit("detonation", step=0)  # obs-event-unregistered
+    obs.anomaly.record(3, "loss_spike", value=1.0)  # registered: fine
+    obs.anomaly.record(3, "gremlins", value=1.0)  # anomaly-type-unregistered
+
+
+def swallow_everything(fn):
+    try:
+        return fn()
+    except:  # noqa: E722  bare-except (flagged package-wide)
+        return None

@@ -1,0 +1,41 @@
+"""Known-bad fixture: every host-sync / nondeterminism violation class
+inside traced code, plus the sink-parameter interprocedural flow.
+Parsed by tests/test_analysis.py — never imported or executed."""
+
+import random
+import time
+
+import jax
+import numpy as np
+
+
+def make_step(tx):
+    def loss_fn(params, x, y):
+        t = time.time()  # nondeterminism: wall clock in trace
+        r = random.random()  # nondeterminism: host RNG in trace
+        v = float(x.sum())  # host-sync: float() on a tracer
+        return v + t + r
+
+    def train_step(state, x, y):
+        loss = loss_fn(state.params, x, y)
+        loss.item()  # host-sync: .item()
+        np.asarray(loss)  # host-sync: np.asarray
+        jax.device_get(loss)  # host-sync: device_get
+        loss.block_until_ready()  # host-sync: block_until_ready
+        for k in {"a", "b"}:  # nondeterminism: set iteration
+            loss = loss + 1
+        return state, loss
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def takes_a_loss_fn(f):
+    # sink parameter: anything passed as `f` lands under a trace
+    return jax.value_and_grad(f)
+
+
+def make_other():
+    def inner_loss(p, x):
+        return float(x.mean())  # host-sync via the sink-param flow
+
+    return takes_a_loss_fn(inner_loss)

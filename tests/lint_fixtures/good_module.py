@@ -1,0 +1,42 @@
+"""Known-good fixture: the same idioms done right — none of these may
+produce a finding.  Parsed by tests/test_analysis.py — never imported."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+TOKEN_SPEC = P(("data", "expert"), "seq")
+
+
+def make_step(tx):
+    def loss_fn(params, x, y):
+        logits = x @ params
+        return jnp.mean((logits - y) ** 2)
+
+    def train_step(state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(state, x, y)
+        # dtype cast, not concretization — allowed in traced code
+        return state - 0.1 * grads, loss.astype(jnp.float32)
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def host_side_epoch_loop(step, state, batches):
+    # host code may sync, time, and convert freely
+    losses = []
+    t0 = time.time()
+    for x, y in batches:
+        state, loss = step(state, x, y)
+        losses.append(loss)
+    mean = float(np.mean([np.asarray(l) for l in losses]))
+    return state, mean, time.time() - t0
+
+
+def careful_io(path):
+    try:
+        return open(path).read()
+    except (OSError, ValueError):
+        return None

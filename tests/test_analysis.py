@@ -1,0 +1,391 @@
+"""Static analysis (`ddl_tpu lint`, ddl_tpu/analysis/): every AST rule
+and every sharding-contract violation class, exercised through known-good
+/ known-bad fixture modules (tests/lint_fixtures/) plus unit probes —
+and the CI gate itself: lint over the shipped package must match the
+committed LINT_BASELINE.json exactly.
+"""
+
+import json
+import shutil
+from pathlib import Path
+
+import pytest
+
+from ddl_tpu.analysis.astlint import lint_file, lint_package, load_registry
+from ddl_tpu.analysis.findings import (
+    Finding,
+    load_baseline,
+    save_baseline,
+    split_by_baseline,
+    suppressed,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+PACKAGE = REPO / "ddl_tpu"
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+REGISTRY = load_registry(PACKAGE)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def _lint_fixture(name):
+    return lint_file(FIXTURES / name, REPO, REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# AST rules: known-bad fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_bad_traced_fixture_every_interop_class():
+    fs = _lint_fixture("bad_traced.py")
+    by_rule = {}
+    for f in fs:
+        by_rule.setdefault(f.rule, []).append(f)
+    # 3 nondet: time.time, random.random, set iteration
+    assert len(by_rule["nondeterminism"]) == 3
+    # 6 host-sync: float (x2: loss_fn + sink-flow inner_loss), .item,
+    # np.asarray, device_get, block_until_ready
+    assert len(by_rule["host-sync"]) == 6
+    assert set(by_rule) == {"nondeterminism", "host-sync"}
+    # every finding carries a real line in the fixture
+    src_lines = (FIXTURES / "bad_traced.py").read_text().splitlines()
+    for f in fs:
+        assert 1 <= f.line <= len(src_lines)
+
+
+def test_sink_param_flow_reaches_indirect_loss_fn():
+    fs = _lint_fixture("bad_traced.py")
+    assert any(
+        f.rule == "host-sync" and "inner_loss" in f.message for f in fs
+    ), "loss fn handed through a helper into value_and_grad must be traced"
+
+
+def test_bad_misc_fixture_rules():
+    fs = _lint_fixture("bad_misc.py")
+    rules = _rules(fs)
+    assert rules.count("compat-bypass") == 2  # legacy import + check_rep
+    assert rules.count("pspec-unknown-axis") == 1
+    assert rules.count("obs-event-unregistered") == 1
+    assert rules.count("anomaly-type-unregistered") == 1
+    assert rules.count("bare-except") == 1
+    assert len(fs) == 6
+    bad_axis = next(f for f in fs if f.rule == "pspec-unknown-axis")
+    assert "batch_x" in bad_axis.message
+    # the module-declared 'ring' mesh axis is allowed
+    assert not any("'ring'" in f.message for f in fs)
+
+
+def test_good_fixture_is_clean():
+    assert _lint_fixture("good_module.py") == []
+
+
+# ---------------------------------------------------------------------------
+# AST rules: module-scoped rules (recovery excepts, step-module donation)
+# ---------------------------------------------------------------------------
+
+
+def _lint_tmp(tmp_path, rel, source):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return lint_file(p, tmp_path, REGISTRY)
+
+
+BROAD_EXCEPT_SRC = """
+def load(path):
+    try:
+        return open(path).read()
+    except Exception:
+        return None
+
+def load_reraise(path):
+    try:
+        return open(path).read()
+    except Exception as e:
+        raise RuntimeError("context") from e
+"""
+
+
+def test_broad_except_flagged_in_recovery_modules_only(tmp_path):
+    fs = _lint_tmp(tmp_path, "checkpoint.py", BROAD_EXCEPT_SRC)
+    # the swallowing handler is flagged; the re-raising one is not
+    assert _rules(fs) == ["broad-except"]
+    assert fs[0].line == 5
+    assert _lint_tmp(tmp_path, "bench/whatever.py", BROAD_EXCEPT_SRC) == []
+
+
+def test_donation_rule_in_step_modules(tmp_path):
+    src = """
+import jax
+
+def make(train_step):
+    return jax.jit(train_step, in_shardings=(None,))
+"""
+    fs = _lint_tmp(tmp_path, "train/steps.py", src)
+    assert _rules(fs) == ["donation-missing"]
+    ok = src.replace("in_shardings=(None,)",
+                     "in_shardings=(None,), donate_argnums=(0,)")
+    assert _lint_tmp(tmp_path, "train/steps.py", ok) == []
+    # outside the step modules the rule does not apply
+    assert _lint_tmp(tmp_path, "bench/lm.py", src) == []
+
+
+def test_suppression_comment_silences_one_rule(tmp_path):
+    src = """
+import jax
+
+def step(x):
+    return float(x)  # ddl-lint: disable=host-sync
+
+jax.jit(step)
+"""
+    assert _lint_tmp(tmp_path, "m.py", src) == []
+    # the suppression names a different rule -> finding stays
+    other = src.replace("disable=host-sync", "disable=nondeterminism")
+    assert _rules(_lint_tmp(tmp_path, "m.py", other)) == ["host-sync"]
+
+
+def test_suppressed_helper():
+    assert suppressed("x = 1  # ddl-lint: disable", "anything")
+    assert suppressed("x = 1  # ddl-lint: disable=a,b", "b")
+    assert not suppressed("x = 1  # ddl-lint: disable=a", "b")
+    assert not suppressed("x = 1  # noqa", "a")
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip_and_split(tmp_path):
+    a = Finding("p.py", 3, "host-sync", "m1")
+    b = Finding("p.py", 9, "bare-except", "m2")
+    c = Finding("q.py", 1, "host-sync", "m3")
+    save_baseline(tmp_path / "b.json", [a, b])
+    loaded = load_baseline(tmp_path / "b.json")
+    assert set(loaded) == {a, b}
+    # b was fixed; c is new; a moved lines (still baselined by content)
+    moved = Finding("p.py", 30, "host-sync", "m1")
+    new, known, stale = split_by_baseline([moved, c], loaded)
+    assert new == [c]
+    assert known == [moved]
+    assert stale == [b]
+
+
+def test_shipped_package_matches_committed_baseline():
+    """The CI gate: AST lint over the shipped package produces exactly
+    the findings in LINT_BASELINE.json — new findings fail tier-1, and
+    fixed ones must shrink the baseline (--update-baseline)."""
+    baseline = load_baseline(REPO / "LINT_BASELINE.json")
+    findings = lint_package(PACKAGE)
+    new, _known, stale = split_by_baseline(findings, baseline)
+    assert new == [], (
+        "new lint findings not in LINT_BASELINE.json:\n"
+        + "\n".join(f.format() for f in new)
+    )
+    assert stale == [], (
+        "stale baseline entries (fixed findings) — run "
+        "`ddl_tpu lint --baseline LINT_BASELINE.json --update-baseline`:\n"
+        + "\n".join(f.format() for f in stale)
+    )
+
+
+def test_event_registry_covers_package_emits():
+    """Every emit(<literal>) in the package names a registered kind —
+    the registry rule over the real tree, independent of the baseline."""
+    fs = [
+        f for f in lint_package(PACKAGE)
+        if f.rule in ("obs-event-unregistered", "anomaly-type-unregistered")
+    ]
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# sharding contracts: each violation class at unit level
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_mesh():
+    from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh
+
+    return build_lm_mesh(LMMeshSpec(data=2, model=2))
+
+
+def _probe():
+    from ddl_tpu.analysis import contracts
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    return contracts._Probe(make_lm_step_fns)
+
+
+def test_contract_axis_violation(small_mesh):
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.analysis.contracts import _check_boundary
+
+    probe = _probe()
+    _check_boundary(
+        probe,
+        {"in_specs": {"inputs": P("data", "batch_x")}},
+        small_mesh,
+    )
+    assert _rules(probe.findings) == ["contract-axis"]
+    assert "batch_x" in probe.findings[0].message
+    assert probe.findings[0].path.endswith("train/lm_steps.py")
+
+
+def test_contract_boundary_violation(small_mesh):
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.analysis.contracts import _check_boundary
+
+    probe = _probe()
+    _check_boundary(
+        probe, {"in_specs": {"inputs": P(None, "seq")}}, small_mesh
+    )
+    assert _rules(probe.findings) == ["contract-boundary"]
+
+
+def test_contract_replication_violation_and_waiver(small_mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddl_tpu.analysis.contracts import _check_params
+
+    replicated = jax.device_put(
+        jnp.zeros((128, 128)), NamedSharding(small_mesh, P())
+    )
+    sharded = jax.device_put(
+        jnp.zeros((128, 128)), NamedSharding(small_mesh, P("model", None))
+    )
+    params = {"big_replicated": replicated, "big_sharded": sharded}
+    probe = _probe()
+    _check_params(
+        probe, params, small_mesh,
+        {"replicated_params_ok": False},
+    )
+    assert _rules(probe.findings) == ["contract-replicated"]
+    assert "big_replicated" in probe.findings[0].message
+
+    waived = _probe()
+    _check_params(
+        waived, params, small_mesh,
+        {"replicated_params_ok": False,
+         "replicated_ok_leaves": ("big_replicated",)},
+    )
+    assert waived.findings == []
+    assert any("waived" in n for n in waived.notes)
+
+
+def test_contract_trace_violation():
+    from ddl_tpu.analysis.contracts import _lower
+
+    class Boom:
+        def lower(self, *a):
+            raise ValueError("rank mismatch: everything is broken")
+
+    probe = _probe()
+    _lower(probe, Boom(), 1, 2, what="synthetic step")
+    assert _rules(probe.findings) == ["contract-trace"]
+    assert "rank mismatch" in probe.findings[0].message
+
+
+def test_contract_probes_run_clean():
+    """The shipped factories satisfy their own contracts end to end
+    (slow-ish: builds all four probe step families on the CPU mesh)."""
+    from ddl_tpu.analysis.contracts import run_contracts
+
+    report = run_contracts()
+    assert report.findings == [], "\n".join(
+        f.format() for f in report.findings
+    )
+    # the ViT embed waiver must be visible, not silent
+    assert any("patch_embed" in n for n in report.notes)
+
+
+def test_lm_factory_declares_contract():
+    import jax
+    import optax
+
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import TOKEN_SPEC, make_lm_step_fns
+
+    from ddl_tpu.models.transformer import LMConfig
+
+    fns = make_lm_step_fns(
+        LMConfig(vocab_size=64, d_model=16, n_layers=1, n_heads=2,
+                 head_dim=8, d_ff=32, compute_dtype="float32"),
+        LMMeshSpec(), optax.sgd(0.1), jax.random.key(0), batch=2, seq_len=8,
+    )
+    c = fns.train.contract
+    assert c["in_specs"]["inputs"] == TOKEN_SPEC
+    assert c["donate_state"] is True
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_lint_cli_clean_package_and_json(capsys):
+    from ddl_tpu.analysis.cli import main
+
+    rc = main([
+        "--no-contracts", "--baseline", str(REPO / "LINT_BASELINE.json"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0 and "lint: clean" in out
+
+    rc = main([
+        "--json", "--no-contracts",
+        "--baseline", str(REPO / "LINT_BASELINE.json"),
+    ])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["ok"] and payload["new"] == []
+
+
+def test_lint_cli_fails_on_violations_with_file_line(tmp_path, capsys):
+    from ddl_tpu.analysis.cli import main
+
+    bad = tmp_path / "bad.py"
+    shutil.copy(FIXTURES / "bad_traced.py", bad)
+    rc = main([str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "bad.py:20" in out or "bad.py:" in out  # file:line findings
+    assert "[host-sync]" in out
+
+
+def test_lint_cli_update_baseline(tmp_path, capsys):
+    from ddl_tpu.analysis.cli import main
+
+    bad = tmp_path / "bad.py"
+    shutil.copy(FIXTURES / "bad_misc.py", bad)
+    baseline = tmp_path / "base.json"
+    # seed the baseline from the current findings...
+    rc = main([str(bad), "--baseline", str(baseline), "--update-baseline"])
+    assert rc == 0 and baseline.exists()
+    capsys.readouterr()
+    # ...after which the same findings are known, not new
+    rc = main([str(bad), "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "baselined finding(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# runtime registry guard
+# ---------------------------------------------------------------------------
+
+
+def test_event_writer_warns_on_unregistered_kind(tmp_path):
+    from ddl_tpu.obs import EventWriter
+
+    w = EventWriter(tmp_path, "job", host=0)
+    with pytest.warns(UserWarning, match="not registered"):
+        w.emit("definitely_not_registered_kind")
+    w.close()

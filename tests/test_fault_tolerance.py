@@ -382,12 +382,24 @@ def test_supervise_command_subprocess_crash_then_success(tmp_path):
     )
     env = dict(os.environ)
     env["DDL_FAULT"] = "crash@step:1"
+    env["DDL_LOG_DIR"] = str(tmp_path / "logs")
+    env["DDL_JOB_ID"] = "supcmd"
     env.pop("DDL_FAULT_PERSIST", None)
     rc = supervise_command(
         [sys.executable, "-c", prog], max_restarts=2, env=env,
         backoff=Backoff(base=0.01, jitter=0.0), log=lambda m: None,
     )
     assert rc == 0 and marker.read_text() == "2"
+    # the supervisor's own lifecycle events landed in the job's stream
+    from ddl_tpu.obs import events_path, read_events
+
+    kinds = [
+        e["kind"]
+        for e in read_events(events_path(tmp_path / "logs", "supcmd"))
+    ]
+    assert kinds[0] == "supervisor_start"
+    assert "supervisor_relaunch" in kinds
+    assert kinds[-1] == "supervisor_done"
 
 
 def test_injected_preempt_supervised_relaunch_resumes(tmp_path):
@@ -693,3 +705,134 @@ def test_obs_diff_against_stored_baseline(tmp_path, capsys):
     with pytest.raises(SystemExit, match="FAIL"):
         obs_main(["diff", "slow", "--log-dir", str(logs),
                   "--baseline", str(base), "--fail-slowdown", "0.5"])
+
+
+# ---------------------------------------------------------------------------
+# snapshot garbage collection (keep-last-K valid)
+# ---------------------------------------------------------------------------
+
+
+def test_gc_snapshots_keeps_newest_k_valid(tmp_path):
+    state = {"w": np.arange(8.0)}
+    paths = [ckpt.save_snapshot(tmp_path, "job", e, state) for e in range(5)]
+    removed = ckpt.gc_snapshots(tmp_path, "job", keep=2)
+    assert ckpt.snapshot_epochs(tmp_path, "job") == [3, 4]
+    assert {p for p, _ in removed} == {paths[0], paths[1], paths[2]}
+    # keep=0 disables GC entirely
+    ckpt.save_snapshot(tmp_path, "job2", 0, state)
+    assert ckpt.gc_snapshots(tmp_path, "job2", keep=0) == []
+    assert ckpt.snapshot_epochs(tmp_path, "job2") == [0]
+
+
+def test_gc_corrupt_snapshots_do_not_count_toward_keep(tmp_path):
+    """Fault-injection acceptance: a snapshot corrupted at commit time
+    (torn NAS write) must not occupy a keep slot — K means K
+    *restorable* snapshots."""
+    state = {"w": np.arange(16.0)}
+    faultinject.activate("corrupt_ckpt@save:3")  # poison the 3rd save
+    for e in range(4):
+        ckpt.save_snapshot(tmp_path, "job", e, state)
+    faultinject.deactivate()
+    assert not ckpt.verify_snapshot(
+        ckpt.snapshot_path(tmp_path, "job", 2)
+    )[0]
+
+    removed = ckpt.gc_snapshots(tmp_path, "job", keep=2)
+    # epochs 3 and 1 are the two newest VALID; the corrupt 2 and the old
+    # 0 are both removed
+    assert ckpt.snapshot_epochs(tmp_path, "job") == [1, 3]
+    reasons = {p.name: r for p, r in removed}
+    assert "corrupt" in reasons["epoch_2"]
+    assert "older" in reasons["epoch_0"]
+    # what's left restores
+    assert ckpt.latest_valid_epoch(tmp_path, "job") == 3
+
+
+def test_trainer_gc_prunes_after_each_save(tmp_path):
+    """End to end through the shared loop: keep_snapshots=2 leaves only
+    the two newest snapshots after a run that saved three times."""
+    t = _tiny_lm(tmp_path, "lm-gc", steps=6, save_every=2, log_dir=None,
+                 keep_snapshots=2)
+    t.train()
+    assert ckpt.snapshot_epochs(tmp_path / "ckpt", "lm-gc") == [4, 6]
+    # and the run still resumes from what was kept
+    resumed = _tiny_lm(tmp_path, "lm-gc", steps=8, save_every=2,
+                       log_dir=None, keep_snapshots=2)
+    assert resumed._start_step == 6
+
+
+# ---------------------------------------------------------------------------
+# supervisor obs events
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_emits_lifecycle_obs_events(tmp_path):
+    from ddl_tpu.obs import EventWriter, read_events
+
+    w = EventWriter(tmp_path, "supjob", host=0)
+    codes = iter([EXIT_PREEMPTED, 7, 0])
+    sup = Supervisor(
+        lambda i: next(codes), max_restarts=3,
+        sleep=lambda s: None, log=lambda m: None, events=w,
+    )
+    assert sup.run() == 0
+    w.close()
+    events = read_events(w.path)
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "supervisor_start"
+    relaunches = [e for e in events if e["kind"] == "supervisor_relaunch"]
+    assert [e["reason"] for e in relaunches] == ["preempt", "crash"]
+    assert relaunches[1]["rc"] == 7
+    done = events[-1]
+    assert done["kind"] == "supervisor_done"
+    assert done["rc"] == 0 and done["gave_up"] is False
+
+
+def test_supervisor_emits_give_up_event(tmp_path):
+    from ddl_tpu.obs import EventWriter, read_events
+
+    w = EventWriter(tmp_path, "supjob2", host=0)
+    sup = Supervisor(
+        lambda i: 9, max_restarts=1,
+        sleep=lambda s: None, log=lambda m: None, events=w,
+    )
+    assert sup.run() == 9
+    w.close()
+    done = read_events(w.path)[-1]
+    assert done["kind"] == "supervisor_done"
+    assert done["rc"] == 9 and done["gave_up"] is True
+
+
+def test_gc_protects_best_metric_snapshot(tmp_path):
+    """A snapshot saved because the eval metric improved must survive GC
+    even when cadence saves push it out of the keep window."""
+    state = {"w": np.arange(8.0)}
+    for e in range(5):
+        ckpt.save_snapshot(tmp_path, "job", e, state)
+    removed = ckpt.gc_snapshots(tmp_path, "job", keep=2, protect=(1,))
+    assert ckpt.snapshot_epochs(tmp_path, "job") == [1, 3, 4]
+    assert {p.name for p, _ in removed} == {"epoch_0", "epoch_2"}
+
+
+def test_trainer_gc_never_reaps_best_snapshot(tmp_path):
+    """Through the shared loop: the best-val-perplexity snapshot is
+    pinned (loop sets best_snapshot_epoch on improvement saves)."""
+    t = _tiny_lm(tmp_path, "lm-best", steps=6, save_every=2, log_dir=None,
+                 keep_snapshots=1, eval_every=2)
+    # fake the held-out eval (the synthetic corpus has no eval split):
+    # the first boundary registers as the all-time best, every later one
+    # is worse, so the step-2 snapshot is the best model
+    vals = iter([1.0, 9.0, 9.0, 9.0])
+
+    def fake_eval(period):
+        if t._period_bounds(period)[1] % 2:
+            return None
+        v = next(vals)
+        return {"val_loss": v, "val_ppl": v}
+
+    t.evaluate_period = fake_eval
+    assert t.save_best  # eval_every + checkpoint_dir arm the gate
+    t.train()
+    kept = ckpt.snapshot_epochs(tmp_path / "ckpt", "lm-best")
+    assert 2 in kept, f"best snapshot reaped; kept {kept}"
+    assert kept[-1] == 6  # the cadence window still holds the newest

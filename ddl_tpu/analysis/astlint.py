@@ -82,6 +82,7 @@ _NONDET_DOTTED = frozenset({
 # loss, so `except Exception` without a re-raise is flagged.
 _RECOVERY_MODULES = frozenset({
     "checkpoint.py",
+    "coord.py",
     "supervisor.py",
     "train/recovery.py",
     "train/loop.py",
@@ -101,6 +102,18 @@ _STEP_MODULES = frozenset({
     "train/lm_steps.py",
     "train/vit_steps.py",
     "parallel/lm_pipeline.py",
+})
+
+# Pod-coordination paths: a process that hard-exits here without first
+# publishing exit intent through the rendezvous strands its peers inside
+# a dead collective until heartbeat ageout — the exact hang the coord
+# layer exists to prevent.  Any os._exit/sys.exit use (call OR the
+# function object handed around as an escape hatch) inside a function
+# that never publishes intent is flagged.
+_COORD_EXIT_MODULES = frozenset({
+    "supervisor.py",
+    "coord.py",
+    "obs/watchdog.py",
 })
 
 
@@ -621,6 +634,45 @@ def _rule_donation(tree, mod: _Module, rel: str, add) -> None:
                 "old runtimes; new step factories must still declare it)")
 
 
+def _rule_exit_intent(tree, mod: _Module, rel: str, add) -> None:
+    """In coord/supervisor/watchdog paths, an ``os._exit``/``sys.exit``
+    whose enclosing function never publishes exit intent bypasses the
+    pod protocol (the dying host's peers wait for its heartbeat to age
+    out instead of reacting to the marker).  'Publishes intent' is
+    lexical: some call in the same function whose name mentions
+    ``intent`` (``coord.publish_exit_intent_from_env``,
+    ``rv.publish_intent``)."""
+    if rel_suffix(rel) not in _COORD_EXIT_MODULES:
+        return
+    intent_scopes: set[int | None] = set()
+    exit_uses: list[tuple[ast.AST, _Func | None, str]] = []
+    call_funcs: set[int] = set()  # Attribute nodes already seen as callees
+
+    def scope_key(enclosing: _Func | None):
+        return id(enclosing.node) if enclosing is not None else None
+
+    for node, enclosing in _iter_with_enclosing(tree, mod):
+        if isinstance(node, ast.Call):
+            call_funcs.add(id(node.func))
+            d = _dotted(node.func) or ""
+            if "intent" in d.lower():
+                intent_scopes.add(scope_key(enclosing))
+            if d in ("os._exit", "sys.exit"):
+                exit_uses.append((node, enclosing, f"{d}()"))
+        elif isinstance(node, ast.Attribute) and id(node) not in call_funcs:
+            d = _dotted(node)
+            if d in ("os._exit", "sys.exit"):
+                exit_uses.append((node, enclosing, d))
+    for node, enclosing, what in exit_uses:
+        if scope_key(enclosing) not in intent_scopes:
+            add(node, "exit-without-intent",
+                f"{what} in a coord/supervisor path without publishing "
+                "exit intent first: peer hosts block inside the dead "
+                "collective until heartbeat ageout; call "
+                "coord.publish_exit_intent_from_env (or "
+                "Rendezvous.publish_intent) before exiting")
+
+
 def rel_suffix(rel: str) -> str:
     """'ddl_tpu/train/loop.py' -> 'train/loop.py' (module path within
     the package, for the per-module rule scopes)."""
@@ -666,6 +718,7 @@ def lint_file(
     _rule_obs_events(tree, registry, rel, add)
     _rule_pspec(tree, mod, rel, add)
     _rule_donation(tree, mod, rel, add)
+    _rule_exit_intent(tree, mod, rel, add)
     return sorted(findings)
 
 

@@ -300,11 +300,73 @@ def _probe_decode() -> _Probe:
     return probe
 
 
+def _probe_lm_pipeline() -> _Probe:
+    """The pipeline-parallel LM step factory (parallel/lm_pipeline.py):
+    same contract surface as the flat path (it shares
+    ``finalize_step_fns``), but the program composition under test is
+    the GPipe shard_map schedule over the ``pipe`` axis — a rule-table
+    edit that breaks stage-stacked param placement surfaces here, not in
+    the flat probe."""
+    import jax
+    import optax
+
+    from ddl_tpu.parallel.lm_pipeline import make_lm_pipeline_step_fns
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    probe = _Probe(make_lm_pipeline_step_fns)
+    # model=2 alongside pipe: embed/head run OUTSIDE the pipe region and
+    # shard over 'model' — on a pipe-only mesh they replicate by design,
+    # which would drown the replication check in waivers
+    fns = make_lm_step_fns(
+        _tiny_lm_cfg(), LMMeshSpec(data=2, pipe=2, model=2),
+        optax.adam(1e-3),
+        jax.random.key(0), batch=8, seq_len=32, num_microbatches=2,
+    )
+    _check_boundary(probe, fns.train.contract, fns.mesh)
+    state = fns.init_state()
+    tok = jax.ShapeDtypeStruct((8, 32), jax.numpy.int32)
+    _lower(probe, fns.train, state, tok, tok, what="LM pipeline train step")
+    _check_params(probe, state.params, fns.mesh, fns.train.contract)
+    return probe
+
+
+def _probe_vit_pipeline() -> _Probe:
+    """The pipeline-parallel ViT factory (vit_steps pipeline path over
+    the shared blocks-pipeline clock loop)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddl_tpu.models.vit import ViTConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.vit_steps import make_vit_step_fns
+
+    probe = _Probe(make_vit_step_fns)
+    cfg = ViTConfig(
+        image_size=16, patch_size=8, d_model=64, n_layers=2, n_heads=4,
+        head_dim=16, d_ff=256, compute_dtype="float32", remat=False,
+    )
+    fns = make_vit_step_fns(
+        cfg, LMMeshSpec(data=2, pipe=2, model=2), optax.adam(1e-3),
+        jax.random.key(0), batch=8, num_microbatches=2,
+    )
+    _check_boundary(probe, fns.train.contract, fns.mesh)
+    state = fns.init_state()
+    img = jax.ShapeDtypeStruct((8, 16, 16, 3), jnp.uint8)
+    lbl = jax.ShapeDtypeStruct((8,), jnp.int32)
+    _lower(probe, fns.train, state, img, lbl, what="ViT pipeline train step")
+    _check_params(probe, state.params, fns.mesh, fns.train.contract)
+    return probe
+
+
 PROBES = (
     ("cnn_dp", _probe_cnn),
     ("lm_flat", _probe_lm),
     ("vit_flat", _probe_vit),
     ("lm_decode", _probe_decode),
+    ("lm_pipeline", _probe_lm_pipeline),
+    ("vit_pipeline", _probe_vit_pipeline),
 )
 
 

@@ -41,6 +41,7 @@ __all__ = [
     "snapshot_metadata",
     "latest_epoch",
     "latest_valid_epoch",
+    "read_cursor",
     "resolve_resume",
     "run_resume_load",
     "verify_snapshot",
@@ -156,8 +157,16 @@ def verify_snapshot(path: str | os.PathLike) -> tuple[bool, str]:
 
 
 def save_snapshot(
-    checkpoint_dir: str | os.PathLike, job_id: str, epoch: int, state: Any,
+    checkpoint_dir: str | os.PathLike,
+    job_id: str,
+    epoch: int,
+    state: Any,
+    cursor: dict | None = None,
 ) -> Path:
+    """``cursor`` (optional) is the data-stream position this snapshot
+    represents — ``{"period", "offset", ...}`` from the training loop —
+    recorded in the commit manifest so an exact resume replays no batch
+    and skips none (``read_cursor``)."""
     path = snapshot_path(checkpoint_dir, job_id, epoch)
     path.parent.mkdir(parents=True, exist_ok=True)
 
@@ -181,9 +190,26 @@ def save_snapshot(
         backoff=Backoff(base=0.5, factor=2.0, max_delay=10.0),
         on_retry=note,
     )
-    write_manifest(path, epoch=epoch, format=SNAPSHOT_FORMAT)
+    extra = {"cursor": cursor} if cursor is not None else {}
+    write_manifest(path, epoch=epoch, format=SNAPSHOT_FORMAT, **extra)
     faultinject.corrupt_check(path)
     return path
+
+
+def read_cursor(
+    checkpoint_dir: str | os.PathLike, job_id: str, epoch: int
+) -> dict | None:
+    """The data cursor recorded at commit time, or None (pre-cursor
+    snapshots, manifest-less legacy ones, unreadable manifests).  Read
+    from the manifest, not the Orbax tree: the cursor describes the
+    HOST-side data stream and must be readable without touching array
+    bytes."""
+    manifest = snapshot_path(checkpoint_dir, job_id, epoch) / MANIFEST_NAME
+    try:
+        cursor = json.loads(manifest.read_text()).get("cursor")
+    except (OSError, ValueError):
+        return None
+    return cursor if isinstance(cursor, dict) else None
 
 
 def _metadata_tree(ckptr, path):
@@ -515,12 +541,23 @@ def resolve_resume(
     JobSet/SIGTERM relaunch with the same job id continues training with
     no extra arguments; otherwise None (fresh start).  The reference's
     manual ``snapshot_job_id``/``snapshot_epoch`` args (``ddp.py:109-110``)
-    made automatic."""
+    made automatic.
+
+    Under pod supervision (``DDL_COORD_*`` set, >1 host) the epoch is
+    chosen by RANK 0 and published through the shared-directory
+    rendezvous (``coord.agreed_resume_epoch``): a torn NAS write can
+    leave hosts seeing different ``latest_valid_epoch``, and hosts
+    restoring different snapshots into one SPMD world diverge silently
+    — one decider, one snapshot, every host."""
     if explicit is not None:
         return explicit
     if not auto or not checkpoint_dir:
         return None
-    last = latest_valid_epoch(checkpoint_dir, job_id)
+    from ddl_tpu import coord
+
+    last = coord.agreed_resume_epoch(
+        job_id, lambda: latest_valid_epoch(checkpoint_dir, job_id)
+    )
     if last is not None:
         print(
             f"auto-resume: job {job_id!r} has a snapshot at {unit} {last} "
@@ -560,14 +597,20 @@ class SnapshotManager:
         # (= commit marker) may only be written after the async write
         # finishes, or verification would bless a half-written snapshot
         self._pending: Path | None = None
+        self._pending_cursor: dict | None = None
 
     def _finish_pending(self) -> None:
         if self._pending is not None:
-            write_manifest(self._pending)
+            extra = (
+                {"cursor": self._pending_cursor}
+                if self._pending_cursor is not None else {}
+            )
+            write_manifest(self._pending, **extra)
             faultinject.corrupt_check(self._pending)
             self._pending = None
+            self._pending_cursor = None
 
-    def save(self, epoch: int, state: Any) -> Path:
+    def save(self, epoch: int, state: Any, cursor: dict | None = None) -> Path:
         path = snapshot_path(self.checkpoint_dir, self.job_id, epoch)
         path.parent.mkdir(parents=True, exist_ok=True)
         # one outstanding save at a time: wait for the previous commit
@@ -581,6 +624,7 @@ class SnapshotManager:
             force=True,
         )
         self._pending = path
+        self._pending_cursor = cursor
         return path
 
     def wait(self) -> None:

@@ -20,6 +20,16 @@ auto-resuming from the latest valid snapshot with no manual resume args:
     python -m ddl_tpu.cli train --supervise --max-restarts 5 \
         --preset dp --set train.max_epochs=30
 
+On a multihost pod, add ``--pod DIR --hosts N --host-id I`` (or the
+``DDL_COORD_*`` env) to every host's launch: the supervisors rendezvous
+over the shared directory and restart the WHOLE pod together — any
+host's resumable exit, crash, or watchdog hang relaunches every host in
+the same restart epoch, restoring the rank-0-agreed snapshot
+(``ddl_tpu/coord.py``):
+
+    python -m ddl_tpu.cli train --supervise --pod /nas/job1/coord \
+        --hosts 4 --host-id $DDL_PROCESS_ID --preset dp ...
+
 (the leading ``train`` subcommand is optional and accepted for symmetry
 with ``obs``).  Run inspection over the structured event streams every
 trainer writes (``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
@@ -70,22 +80,57 @@ def main(argv=None) -> None:
     sup = argparse.ArgumentParser(add_help=False)
     sup.add_argument("--supervise", action="store_true")
     sup.add_argument("--max-restarts", type=int, default=None)
+    # pod mode: coordinate restarts across ALL hosts of a multihost pod
+    # through a shared directory (NAS) — any host's resumable exit,
+    # crash, or hang relaunches every host together (ddl_tpu/coord.py)
+    sup.add_argument("--pod", metavar="DIR", default=None)
+    sup.add_argument("--hosts", type=int, default=None)
+    sup.add_argument("--host-id", type=int, default=None)
     sup_args, rest = sup.parse_known_args(argv)
     if sup_args.max_restarts is not None and not sup_args.supervise:
         # loud, not silently dropped: the user believes crash-relaunch
         # is armed
         raise SystemExit("--max-restarts requires --supervise")
+    if sup_args.pod is not None and not sup_args.supervise:
+        raise SystemExit("--pod requires --supervise")
+    if sup_args.pod is None and (
+        sup_args.hosts is not None or sup_args.host_id is not None
+    ):
+        # loud, not silently dropped: without --pod these hosts would
+        # each restart alone and hang at the first collective — the
+        # exact failure pod mode exists to prevent
+        raise SystemExit("--hosts/--host-id require --pod")
     if sup_args.supervise:
+        max_restarts = (
+            5 if sup_args.max_restarts is None else sup_args.max_restarts
+        )
+        child_argv = [sys.executable, "-m", "ddl_tpu.cli", *rest]
+        if sup_args.pod is not None:
+            from ddl_tpu.supervisor import supervise_pod_command
+
+            n_hosts = sup_args.hosts or int(
+                os.environ.get("DDL_COORD_HOSTS")
+                or os.environ.get("DDL_NUM_PROCESSES")
+                or 1
+            )
+            host = sup_args.host_id
+            if host is None:
+                host = int(
+                    os.environ.get("DDL_COORD_HOST")
+                    or os.environ.get("DDL_HOST_ID")
+                    or os.environ.get("DDL_PROCESS_ID")
+                    or 0
+                )
+            raise SystemExit(
+                supervise_pod_command(
+                    child_argv, sup_args.pod, host, n_hosts,
+                    max_restarts=max_restarts,
+                )
+            )
         from ddl_tpu.supervisor import supervise_command
 
         raise SystemExit(
-            supervise_command(
-                [sys.executable, "-m", "ddl_tpu.cli", *rest],
-                max_restarts=(
-                    5 if sup_args.max_restarts is None
-                    else sup_args.max_restarts
-                ),
-            )
+            supervise_command(child_argv, max_restarts=max_restarts)
         )
 
     from ddl_tpu.config import parse_cli, to_dict

@@ -1,0 +1,457 @@
+"""Pod-level coordination over a shared directory (NAS rendezvous).
+
+On a real TPU pod every host is one SPMD world: ``launch.bootstrap``
+runs one ``jax.distributed.initialize`` handshake and the mesh spans all
+hosts' chips.  That makes single-host recovery (PR 2's supervisor)
+insufficient — a lone restarted process rejoins nothing and hangs at its
+first collective while the surviving hosts block in the *previous*
+incarnation's all-reduce.  Recovery must be a coordinated, all-hosts-
+together event, and at pod scale stalls/stragglers dominate clean
+crashes (arXiv:2510.20171), so the coordination layer must also detect a
+host that stopped making progress without ever exiting.
+
+This module is that layer, built on the one medium every host of a pod
+already shares: the checkpoint/log NAS.  ``Rendezvous`` is a small
+marker-file protocol under one directory — no sockets, no leader
+election, no extra service — with four primitives:
+
+``hosts/h<i>.json``      liveness heartbeats (wall-clock ts + status +
+                         current restart epoch).  A peer whose heartbeat
+                         ages past ``stale_after_s`` while "running" is
+                         presumed wedged/dead: grounds for escalation
+                         instead of an eternal collective hang.
+``intents/h<i>.e<E>.json``  exit-intent markers, scoped to restart epoch
+                         ``E``.  Published by a supervisor whose child
+                         exited, and by the stall watchdog *before* its
+                         ``os._exit(75)`` — so peers learn a host is
+                         going down even if that host's supervisor is
+                         itself wedged.
+``epochs/e<E>.json``     the restart-epoch ledger.  Proposing epoch
+                         ``E`` is an ``O_CREAT|O_EXCL`` create of
+                         ``e<E>.json`` — exactly one proposer wins, and
+                         losers adopt the winner's record (reason,
+                         cumulative crash/preemption counts, agreed
+                         backoff delay).  Hosts can never split-brain on
+                         "which restart are we in" or "how long do we
+                         back off": both are fields of one atomically-
+                         created file.
+``barriers/<name>/h<i>`` arrival markers; a barrier completes when all
+                         ``n_hosts`` files exist.  Used to make every
+                         host kill + rejoin before *any* host relaunches
+                         (the relaunch barrier), and to hold completed
+                         hosts until the whole pod is done.
+
+plus ``agree/<key>.json`` (rank-0 publishes a value, peers wait — how
+the resume snapshot epoch is agreed even when a torn NAS write leaves
+hosts seeing different ``latest_valid_epoch``) and ``abort.json`` (a
+give-up is pod-wide, never one host quietly exiting).
+
+Atomicity: every marker is written tmp-file + ``os.replace`` (the same
+pattern as ``checkpoint.write_manifest``), so readers never observe a
+torn JSON.  Heartbeat freshness uses the *writer's* wall clock embedded
+in the payload, compared against the reader's — pod hosts are NTP-synced
+and ``stale_after_s`` is tens of seconds, so sub-second skew is noise.
+
+This module must stay importable without JAX: it runs in supervisor
+processes (which must never initialise the devices their children own)
+and inside the watchdog's escalation path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+__all__ = [
+    "BarrierTimeout",
+    "PodAborted",
+    "Rendezvous",
+    "from_env",
+    "publish_exit_intent_from_env",
+]
+
+# Environment contract (set by supervise_pod_command for both the
+# supervisor's own helpers and the trainer child it spawns):
+ENV_DIR = "DDL_COORD_DIR"
+ENV_HOSTS = "DDL_COORD_HOSTS"
+ENV_HOST = "DDL_COORD_HOST"
+ENV_EPOCH = "DDL_RESTART_EPOCH"
+ENV_TIMEOUT = "DDL_COORD_TIMEOUT_S"
+
+DEFAULT_TIMEOUT_S = 300.0
+
+
+class BarrierTimeout(RuntimeError):
+    """A peer never reached the barrier — its supervisor is gone, not
+    merely slow.  The caller aborts the pod rather than hanging."""
+
+
+class PodAborted(RuntimeError):
+    """The pod-wide give-up marker exists; stop waiting."""
+
+    def __init__(self, record: dict) -> None:
+        super().__init__(record.get("reason", "pod aborted"))
+        self.record = record
+
+
+def _write_json(path: Path, payload: dict) -> None:
+    """Atomic marker write: a reader sees the old file or the new one,
+    never a torn line (tmp + rename, the write_manifest pattern).  The
+    tmp name carries the pid so two writers racing on the same marker
+    (possible only for barriers, which are idempotent) don't clobber
+    each other's tmp."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+    tmp.write_text(json.dumps(payload))
+    os.replace(tmp, path)
+
+
+def _read_json(path: Path) -> dict | None:
+    """None for missing or torn-beyond-parse markers (the writer is
+    mid-replace or the NAS flaked; the caller polls again)."""
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+
+
+class Rendezvous:
+    """One host's handle on the shared coordination directory."""
+
+    def __init__(
+        self,
+        root: str | os.PathLike,
+        host: int,
+        n_hosts: int,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        poll_s: float = 0.05,
+        sleep=time.sleep,
+        clock=time.time,
+    ) -> None:
+        if not 0 <= host < n_hosts:
+            raise ValueError(f"host {host} out of range for {n_hosts}")
+        self.root = Path(root)
+        self.host = int(host)
+        self.n_hosts = int(n_hosts)
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s)
+        # wall clock, not monotonic: heartbeat ages are compared across
+        # processes/hosts, which share NTP time but not a monotonic base
+        self.clock = clock
+        self.sleep = sleep
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    # ------------------------------------------------------------ liveness
+
+    def publish_heartbeat(self, status: str, epoch: int, **fields) -> None:
+        _write_json(
+            self.root / "hosts" / f"h{self.host:03d}.json",
+            {
+                "ts": self.clock(),
+                "host": self.host,
+                "pid": os.getpid(),
+                "status": status,
+                "epoch": int(epoch),
+                **fields,
+            },
+        )
+
+    def peers(self) -> dict[int, dict]:
+        """Other hosts' latest heartbeats, keyed by host id, each with an
+        ``age`` (seconds since the writer stamped it)."""
+        out: dict[int, dict] = {}
+        hosts_dir = self.root / "hosts"
+        if not hosts_dir.is_dir():
+            return out
+        now = self.clock()
+        for p in hosts_dir.iterdir():
+            rec = _read_json(p)
+            if rec is None or rec.get("host") == self.host:
+                continue
+            rec["age"] = now - float(rec.get("ts", 0.0))
+            out[int(rec["host"])] = rec
+        return out
+
+    def stale_peers(self, stale_after_s: float) -> list[int]:
+        """Peers presumed wedged or dead: still marked ``running`` but
+        silent past the deadline.  Hosts in any other status ("done",
+        "restarting", "booting") are between beats by design and judged
+        by barriers instead."""
+        return sorted(
+            h for h, rec in self.peers().items()
+            if rec.get("status") == "running"
+            and rec["age"] > stale_after_s
+        )
+
+    # --------------------------------------------------------- exit intent
+
+    def publish_intent(self, reason: str, rc: int, epoch: int) -> None:
+        """Announce this host is going down (or its child already did).
+        Scoped to the restart epoch so a stale intent from a previous
+        incarnation cannot retrigger a restart after everyone moved on."""
+        _write_json(
+            self.root / "intents" / f"h{self.host:03d}.e{int(epoch)}.json",
+            {
+                "ts": self.clock(),
+                "host": self.host,
+                "reason": reason,
+                "rc": int(rc),
+                "epoch": int(epoch),
+            },
+        )
+
+    def intents(self, epoch: int, include_self: bool = False) -> list[dict]:
+        intents_dir = self.root / "intents"
+        if not intents_dir.is_dir():
+            return []
+        out = []
+        for p in sorted(intents_dir.glob(f"*.e{int(epoch)}.json")):
+            rec = _read_json(p)
+            if rec is None:
+                continue
+            if not include_self and rec.get("host") == self.host:
+                continue
+            out.append(rec)
+        return out
+
+    # ------------------------------------------------- restart-epoch ledger
+
+    def _epoch_path(self, epoch: int) -> Path:
+        return self.root / "epochs" / f"e{int(epoch)}.json"
+
+    def epoch_record(self, epoch: int) -> dict | None:
+        return _read_json(self._epoch_path(epoch))
+
+    def current_epoch(self) -> int:
+        """Highest restart epoch any host has proposed (0 = the initial
+        launch, which has no ledger entry)."""
+        epochs_dir = self.root / "epochs"
+        if not epochs_dir.is_dir():
+            return 0
+        best = 0
+        for p in epochs_dir.glob("e*.json"):
+            try:
+                best = max(best, int(p.stem[1:]))
+            except ValueError:
+                continue
+        return best
+
+    def propose_restart(
+        self,
+        cur_epoch: int,
+        reason: str,
+        crash: bool,
+        preempt: bool,
+        rc: int = 1,
+        delay_fn=None,
+    ) -> dict:
+        """First-writer-wins proposal of restart epoch ``cur_epoch + 1``.
+
+        The winning record carries everything the pod must agree on to
+        avoid split-brain: cumulative crash/preemption counts (rolled
+        forward from the previous epoch's record) and the backoff delay
+        every host sleeps before relaunching (``delay_fn(crash_count)``,
+        computed once by the proposer — N hosts must not each draw their
+        own jitter).  Losers adopt the winner's record unchanged, even if
+        they raced with a different reason: one restart event, one
+        classification."""
+        nxt = int(cur_epoch) + 1
+        prev = self.epoch_record(cur_epoch) if cur_epoch else None
+        crashes = (prev or {}).get("crashes", 0) + (1 if crash else 0)
+        preemptions = (prev or {}).get("preemptions", 0) + (
+            1 if preempt else 0
+        )
+        delay = float(delay_fn(crashes) if (crash and delay_fn) else 0.0)
+        record = {
+            "ts": self.clock(),
+            "epoch": nxt,
+            "proposer": self.host,
+            "reason": reason,
+            "crash": bool(crash),
+            # the triggering exit code rides in the record so budget
+            # aborts carry it no matter WHICH host trips the budget (an
+            # adopting bystander must not replace rc=7 with a generic 1)
+            "rc": int(rc),
+            "crashes": int(crashes),
+            "preemptions": int(preemptions),
+            "delay": delay,
+        }
+        path = self._epoch_path(nxt)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(f".{path.name}.{self.host}.tmp")
+        tmp.write_text(json.dumps(record))
+        try:
+            # hard link onto the final name: atomic create-if-absent even
+            # on NFS (O_EXCL open is not reliably atomic there)
+            os.link(tmp, path)
+        except FileExistsError:
+            # lost the race: the winner's record is the pod's truth
+            os.unlink(tmp)
+            won = None
+            deadline = self.clock() + self.timeout_s
+            while won is None:  # the winner may still be mid-replace
+                won = _read_json(path)
+                if won is None:
+                    if self.clock() > deadline:
+                        raise BarrierTimeout(
+                            f"unreadable epoch record {path}"
+                        )
+                    self.sleep(self.poll_s)
+            return won
+        os.unlink(tmp)
+        return record
+
+    # ------------------------------------------------------------ barriers
+
+    def barrier(
+        self, name: str, timeout_s: float | None = None, on_wait=None
+    ) -> None:
+        """Mark arrival and wait until all ``n_hosts`` arrive.  Raises
+        ``BarrierTimeout`` if a peer never shows (its supervisor is gone
+        — the caller aborts the pod instead of hanging the way the
+        collective it replaces would have), and ``PodAborted`` if the
+        give-up marker appears while waiting."""
+        d = self.root / "barriers" / name
+        _write_json(d / f"h{self.host:03d}", {"ts": self.clock()})
+        deadline = self.clock() + (
+            self.timeout_s if timeout_s is None else timeout_s
+        )
+        while True:
+            present = len(list(d.glob("h*")))
+            if present >= self.n_hosts:
+                return
+            ab = self.aborted()
+            if ab is not None:
+                raise PodAborted(ab)
+            if self.clock() > deadline:
+                raise BarrierTimeout(
+                    f"barrier {name!r}: {present}/{self.n_hosts} hosts "
+                    f"after {self.timeout_s if timeout_s is None else timeout_s:.0f}s"
+                )
+            if on_wait is not None:
+                on_wait()
+            self.sleep(self.poll_s)
+
+    def arrive(self, name: str) -> None:
+        """Mark arrival at a barrier WITHOUT waiting (callers that must
+        keep watching other signals poll ``barrier_complete``)."""
+        _write_json(
+            self.root / "barriers" / name / f"h{self.host:03d}",
+            {"ts": self.clock()},
+        )
+
+    def barrier_complete(self, name: str) -> bool:
+        d = self.root / "barriers" / name
+        return d.is_dir() and len(list(d.glob("h*"))) >= self.n_hosts
+
+    # ----------------------------------------------- rank-0 value agreement
+
+    def agree(self, key: str, compute_fn, timeout_s: float | None = None):
+        """Rank-0 computes and publishes a value; every other host waits
+        for it and returns the same value.  The single-decider shape that
+        keeps a torn NAS view (hosts disagreeing on ``latest_valid_epoch``)
+        from restoring different snapshots on different hosts."""
+        path = self.root / "agree" / f"{key}.json"
+        if self.host == 0:
+            value = compute_fn()
+            _write_json(path, {"ts": self.clock(), "value": value})
+            return value
+        deadline = self.clock() + (
+            self.timeout_s if timeout_s is None else timeout_s
+        )
+        while True:
+            rec = _read_json(path)
+            if rec is not None and "value" in rec:
+                return rec["value"]
+            ab = self.aborted()
+            if ab is not None:
+                raise PodAborted(ab)
+            if self.clock() > deadline:
+                raise BarrierTimeout(
+                    f"host 0 never published agreement {key!r}"
+                )
+            self.sleep(self.poll_s)
+
+    # --------------------------------------------------------------- abort
+
+    def abort(self, reason: str, rc: int) -> dict:
+        """Pod-wide give-up.  First writer wins; later aborts keep the
+        original record (one coherent story in the logs)."""
+        path = self.root / "abort.json"
+        existing = _read_json(path)
+        if existing is not None:
+            return existing
+        record = {
+            "ts": self.clock(),
+            "host": self.host,
+            "reason": reason,
+            "rc": int(rc),
+        }
+        _write_json(path, record)
+        return record
+
+    def aborted(self) -> dict | None:
+        return _read_json(self.root / "abort.json")
+
+
+# ---------------------------------------------------------------------------
+# environment-driven entry points (trainer children, watchdog escalation)
+# ---------------------------------------------------------------------------
+
+
+def from_env(env=os.environ) -> Rendezvous | None:
+    """The rendezvous this process belongs to, or None outside pod mode.
+    ``supervise_pod_command`` sets the env for both the supervisor's own
+    helpers and the trainer child it spawns."""
+    root = env.get(ENV_DIR)
+    if not root:
+        return None
+    n_hosts = int(env.get(ENV_HOSTS) or 1)
+    host = int(env.get(ENV_HOST) or env.get("DDL_HOST_ID") or 0)
+    timeout = float(env.get(ENV_TIMEOUT) or DEFAULT_TIMEOUT_S)
+    return Rendezvous(root, host, n_hosts, timeout_s=timeout)
+
+
+def restart_epoch(env=os.environ) -> int:
+    """The pod restart epoch this process was launched under (0 for the
+    initial launch / non-pod runs) — stamped into obs metadata."""
+    try:
+        return int(env.get(ENV_EPOCH) or 0)
+    except ValueError:
+        return 0
+
+
+def publish_exit_intent_from_env(reason: str, rc: int) -> bool:
+    """Best-effort exit-intent publication for escalation paths that are
+    about to hard-exit (the stall watchdog's ``os._exit(75)``): peers'
+    supervisors react to the marker instead of waiting for this host's
+    heartbeat to age out.  No-op outside pod mode; NOTHING here may
+    escape — the caller is about to ``os._exit`` a wedged process, and
+    an exception (unwritable NAS, malformed env) that aborts the
+    escalation leaves the hang this path exists to break."""
+    try:
+        rv = from_env()
+        if rv is None:
+            return False
+        rv.publish_intent(reason, rc, restart_epoch())
+        return True
+    # deliberate catch-all: see the docstring — failing to publish must
+    # degrade to heartbeat-ageout detection, never to a live hang
+    except Exception:  # ddl-lint: disable=broad-except
+        return False
+
+
+def agreed_resume_epoch(job_id: str, compute_fn):
+    """Pod-consistent resume target: rank 0 computes (its view of
+    ``checkpoint.latest_valid_epoch``) and publishes through the
+    rendezvous; every host restores the same snapshot.  Scoped by restart
+    epoch so each coordinated relaunch re-agrees against the then-current
+    snapshot store.  Falls back to the local computation outside pod mode
+    or on a single-host pod."""
+    rv = from_env()
+    if rv is None or rv.n_hosts < 2:
+        return compute_fn()
+    key = f"resume-{job_id}-e{restart_epoch()}"
+    return rv.agree(key, compute_fn)

@@ -95,6 +95,15 @@ class DataLoader:
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
 
+    def set_start_batch(self, n: int) -> None:
+        """Skip the first ``n`` batches of the NEXT iteration (one-shot;
+        later epochs start at 0).  The exact-resume path: the sampler's
+        permutation is deterministic in (seed, epoch), so dropping the
+        first ``n`` index-batches replays precisely the batches a
+        preempted epoch had not yet consumed — no sample is loaded and
+        discarded, the skip happens on indices."""
+        self._start_batch = max(0, int(n))
+
     def __len__(self) -> int:
         n = len(self.sampler)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
@@ -154,7 +163,9 @@ class DataLoader:
     def _batches(self) -> Iterator[np.ndarray]:
         idxs = np.asarray(list(self.sampler.indices()))
         n_full = len(idxs) // self.batch_size
-        for b in range(n_full):
+        skip = getattr(self, "_start_batch", 0)
+        self._start_batch = 0
+        for b in range(skip, n_full):
             yield idxs[b * self.batch_size : (b + 1) * self.batch_size]
         if not self.drop_last and n_full * self.batch_size < len(idxs):
             tail = idxs[n_full * self.batch_size :]

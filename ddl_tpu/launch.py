@@ -21,7 +21,20 @@ import os
 
 import jax
 
-__all__ = ["bootstrap", "host_id", "world_info", "force_cpu_devices"]
+__all__ = [
+    "bootstrap", "host_id", "restart_epoch", "world_info",
+    "force_cpu_devices",
+]
+
+
+def restart_epoch() -> int:
+    """The pod restart epoch this process was launched under (0 for the
+    initial launch and all non-pod runs).  Set by the pod supervisor
+    (``DDL_RESTART_EPOCH``); stamped into ``world_info`` and every obs
+    event so a run's telemetry attributes cleanly to its incarnation."""
+    from ddl_tpu import coord
+
+    return coord.restart_epoch()
 
 
 def host_id() -> int:
@@ -93,6 +106,11 @@ def bootstrap(
     seconds apart, and the first workers to dial would otherwise die on a
     connection refusal the coordinator fixes moments later.  Jitter keeps
     a relaunched pod's N hosts from re-dialing in lockstep.
+
+    After a pod-coordinated relaunch (``DDL_RESTART_EPOCH`` > 0) the env
+    still carries the SAME coordinator address/world spec, so re-init is
+    this exact path re-run — the retry loop absorbs the relaunched
+    hosts' arrival skew.
     """
     coordinator_address = coordinator_address or os.environ.get("DDL_COORDINATOR")
     if num_processes is None and os.environ.get("DDL_NUM_PROCESSES"):
@@ -140,6 +158,7 @@ def world_info() -> dict:
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
         "host_id": host_id(),
+        "restart_epoch": restart_epoch(),
         "local_devices": [str(d) for d in jax.local_devices()],
         "global_device_count": jax.device_count(),
         "platform": jax.devices()[0].platform,

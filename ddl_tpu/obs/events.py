@@ -63,6 +63,8 @@ EVENT_KINDS = (
     "decode",
     # supervisor.py restart lifecycle
     "supervisor_start", "supervisor_relaunch", "supervisor_done",
+    # pod-level coordinated recovery (coord.py + PodSupervisor)
+    "coord_barrier", "peer_stale", "pod_restart",
 )
 
 # ``type`` values carried by "anomaly" events (AnomalyMonitor.record and
@@ -98,6 +100,15 @@ class EventWriter:
         self.job_id = job_id
         self.host = _default_host() if host is None else int(host)
         self.run_id = run_id or os.environ.get("DDL_RUN_ID") or uuid.uuid4().hex[:12]
+        # pod restart epoch (DDL_RESTART_EPOCH, set by the pod
+        # supervisor): stamped into every event so telemetry attributes
+        # cleanly to an incarnation; omitted entirely outside pod mode
+        try:
+            self.restart_epoch = int(
+                os.environ.get("DDL_RESTART_EPOCH") or 0
+            )
+        except ValueError:
+            self.restart_epoch = 0
         self.path = events_path(log_dir, job_id, self.host)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
@@ -123,6 +134,10 @@ class EventWriter:
             "host": self.host,
             "step": step,
             "kind": kind,
+            **(
+                {"repoch": self.restart_epoch}
+                if self.restart_epoch else {}
+            ),
             **fields,
         }
         line = json.dumps(event, default=_jsonable)

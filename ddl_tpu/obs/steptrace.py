@@ -33,7 +33,7 @@ from contextlib import contextmanager
 from ddl_tpu.obs.anomaly import AnomalyMonitor
 from ddl_tpu.obs.events import EventWriter
 
-__all__ = ["PHASES", "StepTrace"]
+__all__ = ["PER_STEP_PHASES", "PHASES", "StepTrace"]
 
 PHASES = (
     "data_wait",
@@ -44,6 +44,12 @@ PHASES = (
     "checkpoint",
     "logging",
 )
+
+# Phases that occur once per TRAINING STEP — the only ones the 1-in-N
+# span sampler thins.  eval/checkpoint/logging fire once per period
+# boundary (one write each, and a preemption's blocking checkpoint span
+# is exactly what an incident review needs), so they always emit.
+PER_STEP_PHASES = frozenset({"data_wait", "h2d", "step", "fence"})
 
 
 class _CompileCounter:
@@ -95,11 +101,16 @@ class StepTrace:
         self,
         writer: EventWriter,
         anomaly: AnomalyMonitor | None = None,
-        emit_step_spans: bool = True,
+        emit_step_spans: bool | int = True,
     ) -> None:
         self.writer = writer
         self.anomaly = anomaly if anomaly is not None else AnomalyMonitor(writer)
-        self.emit_step_spans = emit_step_spans
+        # span emission policy: False/0 = no per-step spans, True/1 =
+        # every step, N > 1 = a 1-in-N sampler (steps where step % N == 0
+        # emit their phase spans) — per-step visibility at 1/N of the
+        # flushed-NAS-write cost on 10k-step periods.  Period events
+        # (phase totals, throughput, anomalies) always flow.
+        self.emit_step_spans = int(emit_step_spans)
         self.watchdog = None
         self._compiles = _CompileCounter.shared()
         self._period = None
@@ -115,28 +126,46 @@ class StepTrace:
         job_id: str,
         family: str,
         host: int | None = None,
-        emit_step_spans: bool | None = None,
+        emit_step_spans: bool | int | None = None,
         **writer_kwargs,
     ) -> "StepTrace":
         """One-line trainer wiring: build the writer, emit ``run_start``.
 
         ``emit_step_spans=None`` reads the ``DDL_OBS_STEP_SPANS`` env
-        var (``0``/``false`` disables) — the operator escape hatch for
-        runs where two flushed JSONL writes per step onto a NAS is real
-        overhead; period events (phase totals, throughput, anomalies)
-        keep flowing either way."""
+        var — ``0``/``false`` disables per-step spans, an integer ``N``
+        samples 1-in-N steps — the operator dial for runs where two
+        flushed JSONL writes per step onto a NAS is real overhead
+        (10k-step periods); period events (phase totals, throughput,
+        anomalies) keep flowing either way."""
         if emit_step_spans is None:
             env = os.environ.get("DDL_OBS_STEP_SPANS", "").lower()
-            emit_step_spans = env not in ("0", "false", "off")
+            if env in ("0", "false", "off"):
+                emit_step_spans = 0
+            elif env.isdigit():
+                emit_step_spans = int(env)
+            else:
+                emit_step_spans = 1
         writer = EventWriter(log_dir, job_id, host=host, **writer_kwargs)
         writer.emit("run_start", family=family, job_id=job_id)
         return cls(writer, emit_step_spans=emit_step_spans)
+
+    def _span_due(self, name: str, step: int | None) -> bool:
+        """The 1-in-N step-span sampler.  Only per-step phases are
+        thinned; period-boundary phases (eval/checkpoint/logging — one
+        write per period, not the per-step cost the sampler bounds)
+        follow the all-or-nothing setting regardless of their step tag."""
+        n = self.emit_step_spans
+        if n <= 0:
+            return False
+        if n == 1 or step is None or name not in PER_STEP_PHASES:
+            return True
+        return step % n == 0
 
     @contextmanager
     def phase(self, name: str, step: int | None = None, **fields):
         t0 = time.perf_counter()
         try:
-            if self.emit_step_spans:
+            if self._span_due(name, step):
                 with self.writer.span(
                     name, step=step, period=self._period, **fields
                 ):

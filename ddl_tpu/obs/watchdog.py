@@ -144,11 +144,18 @@ class Watchdog:
     def _escalate(self, step, age) -> None:
         import os
 
+        from ddl_tpu import coord
         from ddl_tpu.supervisor import EXIT_PREEMPTED
 
         self.writer.emit(
             "watchdog_exit", step=step, age=age, code=EXIT_PREEMPTED
         )
+        # pod mode: announce the exit through the rendezvous BEFORE
+        # dying, so peer supervisors react to the marker instead of
+        # waiting for this host's heartbeat to age out (best-effort —
+        # publication failure must never block the escalation; no-op
+        # outside pod mode)
+        coord.publish_exit_intent_from_env("watchdog_stall", EXIT_PREEMPTED)
         print(
             f"[watchdog] no step progress for {age:.1f}s (deadline "
             f"{self.deadline_s:.1f}s); stacks dumped, exiting resumable "

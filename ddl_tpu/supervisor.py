@@ -40,18 +40,48 @@ trainer exits ``EXIT_PREEMPTED`` after a preemption snapshot instead of
 ``DDL_WATCHDOG_ACTION=exit``, escalating the stall watchdog from
 dump-stacks to dump-then-exit-resumable so a hung collective is
 restarted instead of hanging forever.
+
+Injected faults (``DDL_FAULT``) follow consume-on-fire across
+relaunches: a spec that FIRED in the previous attempt is dropped from
+the relaunch env (an eviction does not recur), while specs that have not
+fired yet are preserved — so multi-fault scenarios (a second
+``preempt@step`` beyond the resume point) stay expressible.  The child
+records fired specs into ``DDL_FAULT_STATE``
+(``utils/faultinject.fire``); ``DDL_FAULT_PERSIST=1`` pins the full spec
+on every attempt instead.
+
+**Pod mode** (``PodSupervisor`` / ``supervise_pod_command``, CLI
+``--supervise --pod DIR --hosts N --host-id I``): on a multihost pod the
+trainers form ONE SPMD world, so restarting one host's child just hangs
+at the next collective.  Each host runs a PodSupervisor over a shared-
+directory rendezvous (``ddl_tpu/coord.py``): heartbeats while the child
+runs, exit-intent markers when it stops, a first-writer-wins restart-
+epoch ledger (crash budgets and the backoff delay are fields of the
+atomically-created epoch record — hosts cannot split-brain on either),
+a join barrier so every host kills and relaunches together, stale-peer
+detection (a host whose heartbeat ages out while "running" triggers a
+pod restart instead of an eternal collective hang), and a pod-wide
+abort marker so giving up is also a coordinated event.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import subprocess
 import time
+from pathlib import Path
 from typing import Callable
 
 from ddl_tpu.utils.backoff import Backoff
 
-__all__ = ["EXIT_PREEMPTED", "Supervisor", "supervise_command"]
+__all__ = [
+    "EXIT_PREEMPTED",
+    "PodSupervisor",
+    "Supervisor",
+    "supervise_command",
+    "supervise_pod_command",
+]
 
 # EX_TEMPFAIL from sysexits.h: "temporary failure, retry later" — exactly
 # a preemption's semantics, and distinguishable from crash exit codes
@@ -197,12 +227,12 @@ class Supervisor:
                 self.sleep(delay)
 
 
-def _supervisor_events(env_map):
+def _supervisor_events(env_map, host: int = 0):
     """An EventWriter aimed at the same log tree the child trainer
     writes (DDL_LOG_DIR / DDL_JOB_ID, matching config.py's env-driven
     defaults), so supervisor restart events land in the job's stream.
     The supervisor process must never initialise JAX — the child owns
-    the devices — hence ``host=0`` is passed explicitly (EventWriter's
+    the devices — hence ``host`` is passed explicitly (EventWriter's
     host auto-detection goes through ``launch.host_id``).  Returns None
     when the log directory is unwritable (events are telemetry, not a
     reason to refuse supervision)."""
@@ -215,10 +245,78 @@ def _supervisor_events(env_map):
         or "local"
     ).split("/")[-1]
     try:
-        return EventWriter(log_dir, job_id, host=0)
+        return EventWriter(log_dir, job_id, host=host)
     except OSError as e:
         print(f"[supervisor] obs events disabled ({e})")
         return None
+
+
+# ---------------------------------------------------------------------------
+# fault-spec survival across relaunches (consume-on-fire)
+# ---------------------------------------------------------------------------
+
+
+def _surviving_faults(spec_text: str, state_path) -> str:
+    """The DDL_FAULT specs that have NOT been recorded as fired in
+    ``state_path`` (one canonical spec key per line, appended by
+    ``utils/faultinject.fire`` at exhaustion).  Duplicate identical
+    specs are matched one-for-one.  A missing/unreadable state file
+    means nothing fired — everything survives (a child that crashed
+    before its fault is not a reason to disarm the fault)."""
+    from ddl_tpu.utils.faultinject import FaultSpec
+
+    consumed: collections.Counter = collections.Counter()
+    try:
+        for line in Path(state_path).read_text().splitlines():
+            if line.strip():
+                consumed[line.strip()] += 1
+    except OSError:
+        pass
+    kept = []
+    for part in spec_text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key = FaultSpec.parse(part).key
+        if consumed[key] > 0:
+            consumed[key] -= 1
+        else:
+            kept.append(part)
+    return ",".join(kept)
+
+
+def _prepare_fault_env(child_env: dict, restart_index: int, state_path) -> None:
+    """Apply the consume-on-fire relaunch rule to a child environment:
+    fired specs are dropped, unfired ones preserved; ``DDL_FAULT_PERSIST``
+    pins the full spec instead."""
+    if not child_env.get("DDL_FAULT") or child_env.get("DDL_FAULT_PERSIST"):
+        return
+    if state_path is None:
+        # no tracking available: fall back to the conservative rule
+        # (injected faults model one-off events)
+        if restart_index > 0:
+            child_env.pop("DDL_FAULT", None)
+        return
+    child_env["DDL_FAULT_STATE"] = str(state_path)
+    if restart_index > 0:
+        kept = _surviving_faults(child_env["DDL_FAULT"], state_path)
+        if kept:
+            child_env["DDL_FAULT"] = kept
+        else:
+            child_env.pop("DDL_FAULT", None)
+            child_env.pop("DDL_FAULT_STATE", None)
+
+
+def _fault_state_path(base_env: dict, hint: str):
+    """A writable per-run fault-state file, or None when no faults are
+    armed (or they are pinned)."""
+    if not base_env.get("DDL_FAULT") or base_env.get("DDL_FAULT_PERSIST"):
+        return None
+    import tempfile
+
+    fd, path = tempfile.mkstemp(prefix=f"ddl_fault_state_{hint}_")
+    os.close(fd)
+    return path
 
 
 def supervise_command(
@@ -231,27 +329,419 @@ def supervise_command(
 
     Each attempt inherits the environment plus the supervision contract
     vars; the child's own auto-resume does the snapshot discovery."""
+    base_env = dict(os.environ if env is None else env)
+    fault_state = _fault_state_path(base_env, "h0")
 
     def attempt(restart_index: int) -> int:
-        child_env = dict(os.environ if env is None else env)
+        child_env = dict(base_env)
         child_env["DDL_SUPERVISED"] = "1"
         child_env["DDL_RESTART_COUNT"] = str(restart_index)
         # escalate the watchdog so a hung collective becomes a relaunch;
         # the operator's explicit setting wins
         child_env.setdefault("DDL_WATCHDOG_ACTION", "exit")
-        # injected faults model one-off events (an eviction does not
-        # recur on relaunch); fault specs count per process, so drop
-        # them for relaunches unless explicitly pinned
-        if restart_index > 0 and not child_env.get("DDL_FAULT_PERSIST"):
-            child_env.pop("DDL_FAULT", None)
+        # consume-on-fire: fired specs are one-off events and do not
+        # recur on relaunch; unfired specs (a second preempt@step beyond
+        # the resume point) are preserved
+        _prepare_fault_env(child_env, restart_index, fault_state)
         return subprocess.call(argv, env=child_env)
 
-    kwargs.setdefault(
-        "events", _supervisor_events(os.environ if env is None else env)
-    )
+    kwargs.setdefault("events", _supervisor_events(base_env))
     sup = Supervisor(attempt, max_restarts=max_restarts, **kwargs)
     try:
         return sup.run()
     finally:
         if sup.events is not None:
             sup.events.close()
+        if fault_state is not None:
+            try:
+                os.unlink(fault_state)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# pod mode: N hosts, one SPMD world, all-together restarts
+# ---------------------------------------------------------------------------
+
+
+class PodSupervisor:
+    """One host's share of a pod-wide coordinated-restart protocol.
+
+    ``spawn_fn(restart_epoch, restart_index)`` launches this host's
+    trainer child and returns a handle with ``poll()`` / ``terminate()``
+    / ``kill()`` / ``wait(timeout=...)`` (a ``subprocess.Popen`` in
+    production; tests inject scripted fakes).  ``rv`` is the shared
+    ``coord.Rendezvous``.
+
+    The invariant the protocol maintains: **children of different
+    restart epochs never coexist.**  Any host's resumable exit, crash,
+    watchdog hang, or aged-out heartbeat leads every host through the
+    same sequence — kill the local child, agree on restart epoch E (one
+    atomically-created ledger record carrying reason, cumulative crash/
+    preemption counts, and the backoff delay), wait at the ``e<E>-join``
+    barrier until all hosts have killed theirs, sleep the agreed delay,
+    relaunch.  Budget enforcement applies the same rule to the same
+    record on every host, so give-up is pod-wide too (``abort.json``).
+    A host whose run completes (child exit 0) parks at the epoch's done
+    barrier and still joins any restart proposed while it waits — a
+    finished host must retrain alongside its peers, because the resumed
+    collective needs all of them.
+    """
+
+    def __init__(
+        self,
+        spawn_fn: Callable,
+        rv,
+        max_restarts: int = 5,
+        max_preemptions: int = 1000,
+        backoff: Backoff | None = None,
+        poll_s: float = 0.05,
+        signal_poll_s: float | None = None,
+        heartbeat_s: float = 1.0,
+        stale_after_s: float = 30.0,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        log: Callable[[str], None] = print,
+        events=None,
+    ) -> None:
+        self.spawn_fn = spawn_fn
+        self.rv = rv
+        self.max_restarts = max_restarts
+        self.max_preemptions = max_preemptions
+        self.backoff = backoff if backoff is not None else Backoff(
+            base=1.0, factor=2.0, max_delay=120.0, jitter=0.5
+        )
+        self.poll_s = poll_s
+        # the child is polled at poll_s (local, free); the NAS signals
+        # (abort/epoch/intents/heartbeats — four metadata reads) at the
+        # slower signal_poll_s, so steady-state supervision doesn't load
+        # the same NAS the checkpoints ride on.  The real signal cadence
+        # is bounded by heartbeat_s/stale_after_s anyway.
+        self.signal_poll_s = (
+            10.0 * poll_s if signal_poll_s is None else signal_poll_s
+        )
+        self.heartbeat_s = heartbeat_s
+        self.stale_after_s = stale_after_s
+        self.sleep = sleep
+        self.clock = clock
+        self.log = log
+        self.events = events
+        self.restarts = 0
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.events is not None:
+            self.events.emit(kind, pod_host=self.rv.host, **fields)
+
+    def _log(self, msg: str) -> None:
+        self.log(f"[pod-supervisor h{self.rv.host}] {msg}")
+
+    # -------------------------------------------------------------- watch
+
+    def _signals(self, epoch: int):
+        """A pod-level reason to stop waiting, or None: pod abort, a
+        newer restart epoch, a peer's exit intent, a stale peer."""
+        rv = self.rv
+        ab = rv.aborted()
+        if ab is not None:
+            return ("abort", ab)
+        rec = rv.epoch_record(epoch + 1)
+        if rec is not None:
+            return ("peer_epoch", rec)
+        intents = rv.intents(epoch)
+        if intents:
+            return ("peer_intent", intents[0])
+        if self.stale_after_s:
+            stale = rv.stale_peers(self.stale_after_s)
+            if stale:
+                self._emit("peer_stale", stale_host=stale[0], epoch=epoch)
+                self._log(
+                    f"peer h{stale[0]} heartbeat aged out "
+                    f"(> {self.stale_after_s:.0f}s); escalating to pod "
+                    "restart instead of hanging in its collective"
+                )
+                return ("peer_stale", stale[0])
+        return None
+
+    def _watch(self, child, epoch: int):
+        """Run until the local child exits or a pod signal arrives."""
+        last_hb = -float("inf")
+        last_sig = -float("inf")
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                return ("exit", int(rc))
+            now = self.clock()
+            if now - last_hb >= self.heartbeat_s:
+                self.rv.publish_heartbeat("running", epoch)
+                last_hb = now
+            if now - last_sig >= self.signal_poll_s:
+                sig = self._signals(epoch)
+                if sig is not None:
+                    return sig
+                last_sig = now
+            self.sleep(self.poll_s)
+
+    def _wait_done(self, epoch: int):
+        """Completed host: park at the done barrier, but keep watching —
+        a restart proposed while we wait pulls us back in."""
+        rv = self.rv
+        rv.publish_heartbeat("done", epoch)
+        name = f"done-e{epoch}"
+        rv.arrive(name)
+        # nothing local to poll here — everything is a NAS read, so the
+        # whole loop runs at the slower signal cadence
+        while True:
+            if rv.barrier_complete(name):
+                return ("done", None)
+            sig = self._signals(epoch)
+            if sig is not None:
+                return sig
+            self.sleep(self.signal_poll_s)
+
+    def _reap(self, child) -> None:
+        try:
+            if child.poll() is None:
+                child.terminate()
+                try:
+                    child.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+                    child.wait(timeout=10.0)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+
+    def _finish_abort(self, record: dict) -> int:
+        rc = int(record.get("rc", 1))
+        self._log(
+            f"pod aborted by h{record.get('host')}: "
+            f"{record.get('reason')} (exit {rc})"
+        )
+        self._emit("supervisor_done", rc=rc, gave_up=True, pod_abort=True)
+        return rc
+
+    # ---------------------------------------------------------------- run
+
+    def run(self) -> int:
+        from ddl_tpu.coord import BarrierTimeout, PodAborted
+
+        rv = self.rv
+        # a pre-existing abort marker is STALE state from a previous run
+        # of this coord dir: silently returning its rc (or silently
+        # clearing it) would hide that coordination never started — be
+        # loud and actionable instead
+        stale = rv.aborted()
+        if stale is not None:
+            raise RuntimeError(
+                f"coordination dir {rv.root} carries an abort marker from "
+                f"a previous run (h{stale.get('host')}: "
+                f"{stale.get('reason')}); use a fresh --pod directory per "
+                "launch (or delete the old one) so stale markers cannot "
+                "poison this pod's protocol"
+            )
+        self._emit(
+            "supervisor_start",
+            pod=True,
+            hosts=rv.n_hosts,
+            max_restarts=self.max_restarts,
+            max_preemptions=self.max_preemptions,
+        )
+        epoch = rv.current_epoch()
+        rv.publish_heartbeat("booting", epoch)
+        try:
+            t0 = self.clock()
+            rv.barrier("start")
+            self._emit("coord_barrier", name="start", wait=self.clock() - t0)
+        except BarrierTimeout as e:
+            ab = rv.abort(f"h{rv.host}: start barrier: {e}", 1)
+            return self._finish_abort(ab)
+        except PodAborted as e:
+            return self._finish_abort(e.record)
+        restart_index = 0
+        while True:
+            ab = rv.aborted()
+            if ab is not None:
+                return self._finish_abort(ab)
+            child = self.spawn_fn(epoch, restart_index)
+            self._log(
+                f"launched child (restart epoch {epoch}, "
+                f"attempt {restart_index})"
+            )
+            kind, detail = self._watch(child, epoch)
+            if kind == "exit" and detail == 0:
+                self._log("child complete; waiting for the pod")
+                kind, detail = self._wait_done(epoch)
+                if kind == "done":
+                    self._log("pod complete")
+                    self._emit("supervisor_done", rc=0, gave_up=False)
+                    return 0
+            if kind == "abort":
+                self._reap(child)
+                return self._finish_abort(detail)
+
+            # ---- coordinate a pod-wide restart -------------------------
+            if kind == "exit":
+                rc = int(detail)
+                crash = rc not in (0, EXIT_PREEMPTED)
+                preempt = rc == EXIT_PREEMPTED
+                reason = "crash" if crash else (
+                    "preempt" if preempt else "complete"
+                )
+                # tell peers promptly — they kill their children off this
+                # marker instead of waiting for our heartbeat to age out
+                rv.publish_intent(reason, rc, epoch)
+            elif kind == "peer_intent":
+                # classify from the INTENT (the peer that actually died),
+                # so the crash budget is consumed even when a bystander
+                # host wins the proposal race
+                rc = int(detail.get("rc", 1))
+                crash = rc not in (0, EXIT_PREEMPTED)
+                preempt = rc == EXIT_PREEMPTED
+                reason = f"peer_{detail.get('reason', 'exit')}"
+                self._reap(child)
+            else:
+                rc = EXIT_PREEMPTED
+                crash = False
+                # a wedged peer consumes the preemption budget, so a host
+                # that wedges every epoch eventually aborts the pod
+                preempt = kind == "peer_stale"
+                reason = kind
+                self._reap(child)
+            rv.publish_heartbeat("restarting", epoch)
+            if kind == "peer_epoch":
+                rec = detail
+            else:
+                try:
+                    rec = rv.propose_restart(
+                        epoch, reason, crash, preempt, rc=rc,
+                        delay_fn=lambda c: self.backoff.delay(c - 1),
+                    )
+                except BarrierTimeout as e:
+                    ab = rv.abort(f"h{rv.host}: {e}", 1)
+                    return self._finish_abort(ab)
+            if rec["crashes"] > self.max_restarts:
+                # the abort rc comes from the RECORD, not this host's
+                # local view: a bystander that adopted a peer's proposal
+                # must still surface the crashing child's exit code
+                ab = rv.abort(
+                    f"crash budget exhausted "
+                    f"({rec['crashes']} > {self.max_restarts})",
+                    int(rec.get("rc", rc)) if rec.get("crash") else 1,
+                )
+                return self._finish_abort(ab)
+            if rec["preemptions"] > self.max_preemptions:
+                ab = rv.abort(
+                    f"resumable-exit budget exhausted "
+                    f"({rec['preemptions']} > {self.max_preemptions})",
+                    EXIT_PREEMPTED,
+                )
+                return self._finish_abort(ab)
+            self._emit(
+                "pod_restart",
+                epoch=rec["epoch"],
+                reason=rec["reason"],
+                proposer=rec["proposer"],
+                crashes=rec["crashes"],
+                preemptions=rec["preemptions"],
+                delay=rec["delay"],
+            )
+            self._log(
+                f"joining restart epoch {rec['epoch']} "
+                f"(reason={rec['reason']} by h{rec['proposer']}, "
+                f"crashes {rec['crashes']}/{self.max_restarts}, "
+                f"delay {rec['delay']:.1f}s)"
+            )
+            # heartbeat while waiting at the join barrier — throttled to
+            # heartbeat_s (on_wait fires every poll iteration, and an
+            # unthrottled atomic write per poll would load the NAS the
+            # signal_poll_s split exists to protect)
+            last_hb = [-float("inf")]
+
+            def _hb_while_waiting(epoch=epoch):
+                now = self.clock()
+                if now - last_hb[0] >= self.heartbeat_s:
+                    rv.publish_heartbeat("restarting", epoch)
+                    last_hb[0] = now
+
+            try:
+                t0 = self.clock()
+                rv.barrier(
+                    f"e{rec['epoch']}-join", on_wait=_hb_while_waiting,
+                )
+                self._emit(
+                    "coord_barrier",
+                    name=f"e{rec['epoch']}-join",
+                    wait=self.clock() - t0,
+                )
+            except BarrierTimeout as e:
+                # a peer never joined: its supervisor is gone, and a
+                # partial relaunch would just hang — give the pod up
+                ab = rv.abort(f"h{rv.host}: {e}", 1)
+                return self._finish_abort(ab)
+            except PodAborted as e:
+                return self._finish_abort(e.record)
+            if rec["delay"] > 0:
+                self.sleep(rec["delay"])
+            epoch = int(rec["epoch"])
+            restart_index += 1
+            self.restarts = restart_index
+
+
+def supervise_pod_command(
+    argv: list[str],
+    coord_dir: str | os.PathLike,
+    host: int,
+    n_hosts: int,
+    max_restarts: int = 5,
+    env: dict | None = None,
+    **kwargs,
+) -> int:
+    """Pod-mode supervision of ``argv`` (the CLI's ``--supervise --pod``).
+
+    ``coord_dir`` must be one directory every host of the pod sees (the
+    checkpoint/log NAS) and must be FRESH per launch — scope it by job
+    (``/nas/<job>/coord``): the protocol's markers (barriers, epoch
+    ledger, abort) describe one pod lifetime, and stale ones from a
+    previous run would let a lone host sail through the start barrier or
+    replay an old give-up (a stale abort marker is refused loudly).
+    Children additionally get the rendezvous env (``DDL_COORD_*``) so
+    the stall watchdog can publish exit intent and
+    ``checkpoint.resolve_resume`` can run the rank-0 resume agreement,
+    plus ``DDL_RESTART_EPOCH`` for obs metadata."""
+    from ddl_tpu import coord
+
+    base_env = dict(os.environ if env is None else env)
+    rv = coord.Rendezvous(
+        coord_dir, host, n_hosts,
+        timeout_s=float(
+            base_env.get(coord.ENV_TIMEOUT) or coord.DEFAULT_TIMEOUT_S
+        ),
+    )
+    fault_state = _fault_state_path(base_env, f"h{host}")
+
+    def spawn(restart_epoch: int, restart_index: int):
+        child_env = dict(base_env)
+        child_env["DDL_SUPERVISED"] = "1"
+        child_env["DDL_RESTART_COUNT"] = str(restart_index)
+        child_env[coord.ENV_EPOCH] = str(restart_epoch)
+        child_env[coord.ENV_DIR] = str(coord_dir)
+        child_env[coord.ENV_HOSTS] = str(n_hosts)
+        child_env[coord.ENV_HOST] = str(host)
+        child_env.setdefault("DDL_HOST_ID", str(host))
+        child_env.setdefault("DDL_WATCHDOG_ACTION", "exit")
+        _prepare_fault_env(child_env, restart_index, fault_state)
+        return subprocess.Popen(argv, env=child_env)
+
+    kwargs.setdefault("events", _supervisor_events(base_env, host=host))
+    sup = PodSupervisor(
+        spawn, rv, max_restarts=max_restarts, **kwargs
+    )
+    try:
+        return sup.run()
+    finally:
+        if sup.events is not None:
+            sup.events.close()
+        if fault_state is not None:
+            try:
+                os.unlink(fault_state)
+            except OSError:
+                pass

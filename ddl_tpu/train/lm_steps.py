@@ -47,7 +47,25 @@ __all__ = [
     "make_lm_step_fns",
     "make_ring_core",
     "finalize_step_fns",
+    "poison_nan_grads",
 ]
+
+
+def poison_nan_grads(step, grads, nan_step: int | None):
+    """Traced ``nan@grad`` fault injection, shared by the LM and ViT
+    step factories: when ``nan_step`` (from
+    ``faultinject.traced_nan_step()``, consumed at factory-build time)
+    is armed, a ``lax.cond`` on the step counter replaces every gradient
+    leaf with NaN at exactly that step — a real diverged update inside
+    the compiled program.  No-op (and nothing traced in) when unarmed."""
+    if nan_step is None:
+        return grads
+    return jax.lax.cond(
+        step == nan_step,
+        lambda g: jax.tree.map(lambda x: jnp.full_like(x, jnp.nan), g),
+        lambda g: g,
+        grads,
+    )
 
 # The jit-boundary sharding for token batches (inputs AND targets): batch
 # over data x expert (outside MoE layers the expert axis is extra data
@@ -252,6 +270,13 @@ def finalize_step_fns(
     tok_sharding = NamedSharding(mesh, TOKEN_SPEC)
     replicated = NamedSharding(mesh, P())
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    # fault injection, compiled IN: `nan@grad:K` bakes a traced cond on
+    # the step counter into the jitted program, so nan_policy="recover"
+    # is exercised against an actual non-finite update (consumed at
+    # build time — the post-rollback rebuild compiles it out)
+    from ddl_tpu.utils import faultinject
+
+    nan_grad_step = faultinject.traced_nan_step()
 
     def train_step(state, inputs, targets):
         if manual_grad_fn is not None:
@@ -277,6 +302,7 @@ def finalize_step_fns(
             grads, metrics = accumulate_grads(
                 grad_fn, state.params, (inp_c, tgt_c, steps), k
             )
+        grads = poison_nan_grads(state.step, grads, nan_grad_step)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (

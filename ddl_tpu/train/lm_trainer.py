@@ -497,8 +497,13 @@ class LMTrainer(BaseTrainer):
         # label with the true optimizer step (preemption can end a period
         # early), so resume_step and the training stream line up exactly
         step = int(jax.device_get(self.state.step))
+        # the LM data stream is keyed by global step (sample_batch is
+        # pure in step), so step IS the exact-resume cursor; period/
+        # offset ride along for the pod sim's no-dup/no-skip audit
+        cursor = dict(self.data_cursor or {}, step=step)
         path = ckpt.save_snapshot(
-            self.run.checkpoint_dir, self.job_id, step, self.state
+            self.run.checkpoint_dir, self.job_id, step, self.state,
+            cursor=cursor,
         )
         print(f"step {step} | saved snapshot to {path}")
 

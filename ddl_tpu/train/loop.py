@@ -112,6 +112,23 @@ class BaseTrainer:
     # save was gated on improvement): GC never deletes it — keep bounds
     # the cadence retention, not the best-model one.
     best_snapshot_epoch = None
+    # The data-stream position the NEXT snapshot represents, set by the
+    # loop before every save_snapshot call: {"period", "offset"} where
+    # offset is the number of batches this period had consumed when the
+    # state was captured (0 for a period-boundary save, partial for a
+    # preemption save).  Families record it in the snapshot manifest
+    # (checkpoint.save_snapshot(cursor=...)) so an exact resume replays
+    # no batch and skips none (checkpoint.read_cursor).
+    data_cursor = None
+    # Batches of the resume period already consumed by the snapshot being
+    # restored (from its cursor); the family's run_period skips them.
+    _resume_offset = 0
+
+    def consume_resume_offset(self) -> int:
+        """The batch offset the first resumed period starts at; one-shot
+        (subsequent periods start at 0)."""
+        offset, self._resume_offset = self._resume_offset, 0
+        return offset
 
     # ---------------------------------------------------------- overrides
 
@@ -318,6 +335,10 @@ class BaseTrainer:
             if obs is not None:
                 obs.begin_period(period)
             start = perf_counter()
+            # where this period's data stream starts (nonzero only for
+            # the first period after an exact mid-period resume) — a
+            # preemption cursor must record skip + steps, not just steps
+            offset_base = self._resume_offset
             train_metrics, steps = self.run_period(period, guard)
             elapsed = perf_counter() - start
             if period == profile_period:
@@ -397,6 +418,8 @@ class BaseTrainer:
             improved = self._improved(eval_metrics)
             if improved or self.snapshot_due(period):
                 with _phase(obs, "checkpoint", step=idx):
+                    # a boundary save: the period's data is fully consumed
+                    self.data_cursor = {"period": period + 1, "offset": 0}
                     self.save_snapshot(period)
                     if improved:
                         # idx is the snapshot's store key in every
@@ -414,6 +437,13 @@ class BaseTrainer:
                 # the interesting cost of a preempted run — lands in this
                 # period's checkpoint phase total.
                 with _phase(obs, "checkpoint", step=idx):
+                    # a mid-period save: record how far into the period's
+                    # data stream the state got, so the resumed run
+                    # re-enters THIS period at that offset instead of
+                    # skipping the period's remaining batches
+                    self.data_cursor = {
+                        "period": period, "offset": offset_base + steps
+                    }
                     self.save_snapshot(period)
                     self.wait_for_saves()
                     self._gc_snapshots()

@@ -221,6 +221,24 @@ class Trainer(BaseTrainer):
             self.cfg.train.checkpoint_dir, self.job_id, epoch, self.state,
             verify=False,
         )
+        self._apply_cursor(self.job_id, epoch)
+
+    def _apply_cursor(self, job_id: str, epoch: int) -> None:
+        """Exact-resume refinement: if the snapshot's manifest carries a
+        mid-epoch data cursor (a preemption landed partway through the
+        epoch), re-enter THAT epoch at the recorded batch offset instead
+        of skipping its remaining batches — the resumed stream replays
+        no batch and skips none."""
+        cur = ckpt.read_cursor(
+            self.cfg.train.checkpoint_dir, job_id, epoch
+        )
+        if cur and int(cur.get("offset", 0)) > 0:
+            self.epochs_run = int(cur.get("period", self.epochs_run))
+            self._resume_offset = int(cur["offset"])
+            print(
+                f"[resume] data cursor: re-entering epoch "
+                f"{self.epochs_run} at batch {self._resume_offset}"
+            )
 
     # ------------------------------------------------------------------
 
@@ -254,18 +272,26 @@ class Trainer(BaseTrainer):
             desc=str(path),
             hint="pass train.auto_resume=false",
         )
+        self._apply_cursor(self._resume_job, self._resume_epoch)
         print(f"Resuming training from epoch {self.epochs_run}")
 
     def save_snapshot(self, epoch: int) -> None:
+        cursor = self.data_cursor
+        if cursor and cursor.get("offset", 0) >= len(self.train_loader):
+            # preempted exactly at the epoch's end: the stream is fully
+            # consumed, so the cursor is a clean next-epoch start (a
+            # literal offset would resume into an empty remainder)
+            cursor = {"period": int(cursor["period"]) + 1, "offset": 0}
         if self.cfg.train.async_checkpoint:
             if self._snapshot_mgr is None:
                 self._snapshot_mgr = ckpt.SnapshotManager(
                     self.cfg.train.checkpoint_dir, self.job_id
                 )
-            path = self._snapshot_mgr.save(epoch, self.state)
+            path = self._snapshot_mgr.save(epoch, self.state, cursor=cursor)
         else:
             path = ckpt.save_snapshot(
-                self.cfg.train.checkpoint_dir, self.job_id, epoch, self.state
+                self.cfg.train.checkpoint_dir, self.job_id, epoch,
+                self.state, cursor=cursor,
             )
         print(f"Epoch {epoch} | Saved snapshot to {path}")
 
@@ -291,12 +317,18 @@ class Trainer(BaseTrainer):
         in-flight step when a preemption signal has arrived.
         """
         self.train_loader.set_epoch(epoch)
+        # exact resume: skip the batches a preemption snapshot already
+        # consumed this epoch (index-level skip — nothing is loaded and
+        # discarded; one-shot, later epochs start at 0)
+        skip = self.consume_resume_offset()
+        if skip:
+            self.train_loader.set_start_batch(skip)
         losses, preds, targets = [], [], []
         steps = 0
         # event steps are GLOBAL (epoch * steps/epoch + i) so the obs
         # liveness/straggler comparison sees one monotone counter per
         # host, the same unit the LM family's global step gives it
-        step_base = epoch * len(self.train_loader)
+        step_base = epoch * len(self.train_loader) + skip
         it = iter(self.train_loader)
         while True:
             # data_wait = host-side batch production (the loader), h2d =

@@ -167,6 +167,12 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
         return loss, (logits, {"loss": loss, "accuracy": acc})
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    # `nan@grad:K` fault injection, compiled into the jitted step (see
+    # lm_steps.finalize_step_fns — same consume-at-build semantics)
+    from ddl_tpu.train.lm_steps import poison_nan_grads
+    from ddl_tpu.utils import faultinject
+
+    nan_grad_step = faultinject.traced_nan_step()
 
     def train_step(state, images, labels):
         if manual_grad_fn is not None:
@@ -195,6 +201,7 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
             grads, metrics = accumulate_grads(
                 grad_fn, state.params, (img_c, lab_c, steps), k
             )
+        grads = poison_nan_grads(state.step, grads, nan_grad_step)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         return (
             state.replace(

@@ -144,6 +144,7 @@ class ViTTrainer(BaseTrainer):
                 desc=f"job {run.job_id!r} epoch {resume_epoch}",
                 hint="pass --fresh (auto_resume=False)",
             )
+            self._apply_cursor(resume_epoch)
             print(f"resumed; continuing at epoch {self.periods_run}")
 
     def _make_fns(self):
@@ -171,15 +172,36 @@ class ViTTrainer(BaseTrainer):
             self.run.checkpoint_dir, self.run.job_id, epoch, self.state,
             verify=False,
         )
+        self._apply_cursor(epoch)
+
+    def _apply_cursor(self, epoch: int) -> None:
+        """Exact resume: a mid-epoch preemption snapshot re-enters its
+        epoch at the recorded batch offset (same mechanism as the CNN
+        family — see Trainer._apply_cursor)."""
+        cur = ckpt.read_cursor(
+            self.run.checkpoint_dir, self.run.job_id, epoch
+        )
+        if cur and int(cur.get("offset", 0)) > 0:
+            self.periods_run = int(cur.get("period", self.periods_run))
+            self._resume_offset = int(cur["offset"])
+            print(
+                f"[resume] data cursor: re-entering epoch "
+                f"{self.periods_run} at batch {self._resume_offset}"
+            )
 
     # ------------------------------------------------------- loop hooks
 
     def run_period(self, epoch: int, guard=None):
         self.train_loader.set_epoch(epoch)
+        # exact resume: skip batches a preemption snapshot already
+        # consumed this epoch (one-shot index-level skip)
+        skip = self.consume_resume_offset()
+        if skip:
+            self.train_loader.set_start_batch(skip)
         losses, steps = [], 0
         # global event steps (epoch * steps/epoch + i) — one monotone
         # counter per host for the obs liveness/straggler comparison
-        step_base = epoch * len(self.train_loader)
+        step_base = epoch * len(self.train_loader) + skip
         it = iter(self.train_loader)
         while True:
             with _phase(self.obs, "data_wait", step=step_base + steps):
@@ -233,8 +255,14 @@ class ViTTrainer(BaseTrainer):
         )
 
     def save_snapshot(self, epoch: int) -> None:
+        cursor = self.data_cursor
+        if cursor and cursor.get("offset", 0) >= len(self.train_loader):
+            # preempted exactly at the epoch boundary: a clean next-epoch
+            # start, not an empty-remainder resume
+            cursor = {"period": int(cursor["period"]) + 1, "offset": 0}
         path = ckpt.save_snapshot(
-            self.run.checkpoint_dir, self.job_id, epoch, self.state
+            self.run.checkpoint_dir, self.job_id, epoch, self.state,
+            cursor=cursor,
         )
         print(f"epoch {epoch} | saved snapshot to {path}")
 

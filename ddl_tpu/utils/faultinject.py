@@ -8,22 +8,40 @@ point, with no hardware involved:
     DDL_FAULT="preempt@step:12"        preemption signal at global step 12
     DDL_FAULT="crash@step:8"           raise InjectedCrash at step 8
     DDL_FAULT="nan@step:5"             poison the enclosing period's loss
+    DDL_FAULT="nan@grad:5"             non-finite GRADIENT at step 5, inside
+                                       the compiled step (a traced lax.cond
+                                       in the step factories — a real
+                                       diverged update, not a host-side
+                                       poisoned metric)
     DDL_FAULT="stall@step:4:30"        sleep 30s at step 4 (trips watchdog)
     DDL_FAULT="corrupt_ckpt@save:2"    corrupt the 2nd snapshot after commit
     DDL_FAULT="io@save:1:2"            OSError on save attempts 1 and 2
     DDL_FAULT="io@batch:5"             OSError on the 5th loader sample read
 
 Grammar: comma-separated ``kind@site:at[:arg]`` specs.  ``site`` is an
-instrumentation point (``step`` in the training loops, ``save``/
-``restore`` in ``checkpoint.py``, ``batch`` in ``data/loader.py``);
-``at`` is the 0-based coordinate for externally-counted sites (the
-global step) or the 1-based call count for internally-counted ones
-(saves, batch reads); ``arg`` is the stall duration in seconds for
-``stall`` and the repeat count for ``io`` (default 1).  Each spec fires
-exactly ``repeat`` times and then stays quiet, so an auto-resumed
-relaunch of the same process *would* re-fire — which is why relaunch
-tests clear ``DDL_FAULT`` (or use ``activate()``/``deactivate()``) for
-the resumed attempt, exactly like a real preemption not recurring.
+instrumentation point (``step`` in the training loops, ``grad`` inside
+the jitted step factories, ``save``/``restore`` in ``checkpoint.py``,
+``batch`` in ``data/loader.py``); ``at`` is the 0-based coordinate for
+externally-counted sites (the global step) or the 1-based call count for
+internally-counted ones (saves, batch reads); ``arg`` is the stall
+duration in seconds for ``stall`` and the repeat count for ``io``
+(default 1).
+
+**The consume-on-fire rule.**  Each spec fires exactly ``repeat`` times
+and then stays quiet; a fired spec models a one-off event (an eviction
+does not recur).  When ``DDL_FAULT_STATE`` names a file, ``fire()``
+appends the spec's canonical key there at the moment it exhausts —
+*before* the fault acts, so a crash/exit cannot lose the record.  The
+supervisor reads that file on relaunch and rebuilds ``DDL_FAULT`` with
+only the NON-consumed specs, so multi-fault scenarios (a second
+``preempt@step`` beyond the resume point) survive relaunches while
+fired specs do not.  ``nan@grad`` is consumed at step-function BUILD
+time (``traced_nan_step``), not at fire time: the poison is compiled
+into the step, and the post-rollback rebuild (the reduced-LR grace
+recompile) therefore drops it — the replayed steps run clean, exactly
+like a real one-off divergence that a restore-and-re-run absorbs.
+Tests that drive relaunch in-process use ``activate()``/``deactivate()``
+to the same effect; ``DDL_FAULT_PERSIST=1`` pins the full spec instead.
 
 Every hook is a no-op (one ``is None`` check) when no injector is
 active; production code pays nothing.
@@ -46,6 +64,7 @@ __all__ = [
     "deactivate",
     "io_check",
     "poison_loss",
+    "traced_nan_step",
 ]
 
 KINDS = ("preempt", "crash", "nan", "stall", "corrupt_ckpt", "io")
@@ -67,6 +86,13 @@ class FaultSpec:
     @property
     def repeat(self) -> int:
         return int(self.arg) if self.kind == "io" and self.arg else 1
+
+    @property
+    def key(self) -> str:
+        """Canonical spec text — the identity the consume-on-fire state
+        file records and the supervisor's relaunch filter matches on."""
+        base = f"{self.kind}@{self.site}:{self.at}"
+        return base if self.arg is None else f"{base}:{self.arg:g}"
 
     @classmethod
     def parse(cls, text: str) -> "FaultSpec":
@@ -136,8 +162,27 @@ class FaultInjector:
             ):
                 s.fired += 1
                 self.log.append((s.kind, site, at))
+                if s.fired >= s.repeat:
+                    _record_consumed(s)
                 due.append(s)
         return due
+
+
+def _record_consumed(spec: FaultSpec) -> None:
+    """Append an exhausted spec's key to the DDL_FAULT_STATE file (set by
+    the supervisor) so the relaunch env drops exactly the specs that
+    fired.  Called BEFORE the fault acts — a crash/exit cannot lose the
+    record.  Best-effort: state-file I/O failing must not turn a test
+    fault into a different fault."""
+    path = os.environ.get("DDL_FAULT_STATE")
+    if not path:
+        return
+    try:
+        with open(path, "a") as fh:
+            fh.write(spec.key + "\n")
+            fh.flush()
+    except OSError:
+        pass
 
 
 # --------------------------------------------------------------------------
@@ -220,6 +265,28 @@ def io_check(site: str) -> None:
         return
     if inj.fire(site, kinds=("io",)):
         raise OSError(f"injected I/O error at {site}")
+
+
+def traced_nan_step() -> int | None:
+    """Build-time hook for the step-function factories: the step at which
+    the COMPILED train step should poison its gradient (``nan@grad:K``),
+    or None.  The factory bakes a ``lax.cond(state.step == K, ...)`` into
+    the jitted program, so the non-finite value originates inside the
+    compiled update — a real diverged gradient, not a host-side poisoned
+    metric.  Consumed at build time (see the module docstring): the
+    rollback path's step-function rebuild compiles the injection OUT, so
+    the replayed steps run clean."""
+    inj = active()
+    if inj is None:
+        return None
+    for s in inj.specs:
+        if s.kind == "nan" and s.site == "grad" and s.fired < s.repeat:
+            s.fired += 1
+            inj.log.append((s.kind, s.site, s.at))
+            if s.fired >= s.repeat:
+                _record_consumed(s)
+            return s.at
+    return None
 
 
 def corrupt_check(path) -> None:

@@ -133,6 +133,59 @@ def make(train_step):
     assert _lint_tmp(tmp_path, "bench/lm.py", src) == []
 
 
+EXIT_NO_INTENT_SRC = """
+import os
+import sys
+
+def die():
+    os._exit(75)
+
+def die_politely(rv):
+    rv.publish_intent("crash", 1, 0)
+    sys.exit(1)
+
+def hand_off(exit_fn=None):
+    fn = exit_fn or os._exit  # the escape-hatch reference counts too
+    bail = sys.exit  # a bare sys.exit alias is the same escape hatch
+    fn(75)
+"""
+
+
+def test_exit_without_intent_rule_in_coord_paths(tmp_path):
+    # the bare call and the passed-around function objects (os._exit
+    # AND sys.exit) are flagged; the function that publishes intent
+    # first is clean
+    for rel in ("supervisor.py", "coord.py", "obs/watchdog.py"):
+        fs = _lint_tmp(tmp_path, rel, EXIT_NO_INTENT_SRC)
+        assert _rules(fs) == ["exit-without-intent"] * 3, (rel, fs)
+        assert {f.line for f in fs} == {6, 13, 14}
+    # outside the coordination modules the rule does not apply
+    assert _lint_tmp(tmp_path, "bench/lm.py", EXIT_NO_INTENT_SRC) == []
+    # suppression works like every other rule
+    ok = EXIT_NO_INTENT_SRC.replace(
+        "os._exit(75)",
+        "os._exit(75)  # ddl-lint: disable=exit-without-intent",
+    ).replace(
+        "fn = exit_fn or os._exit  # the escape-hatch reference counts too",
+        "fn = exit_fn or os._exit  # ddl-lint: disable=exit-without-intent",
+    ).replace(
+        "bail = sys.exit  # a bare sys.exit alias is the same escape hatch",
+        "bail = sys.exit  # ddl-lint: disable=exit-without-intent",
+    )
+    assert _lint_tmp(tmp_path, "supervisor.py", ok) == []
+
+
+def test_shipped_watchdog_escalation_publishes_intent():
+    """The real watchdog passes the rule because _escalate publishes
+    exit intent before its os._exit — delete that call and the linter
+    must catch it (proven by the fixture test above)."""
+    fs = [
+        f for f in lint_package(PACKAGE)
+        if f.rule == "exit-without-intent"
+    ]
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
 def test_suppression_comment_silences_one_rule(tmp_path):
     src = """
 import jax
@@ -297,9 +350,14 @@ def test_contract_trace_violation():
 
 def test_contract_probes_run_clean():
     """The shipped factories satisfy their own contracts end to end
-    (slow-ish: builds all four probe step families on the CPU mesh)."""
-    from ddl_tpu.analysis.contracts import run_contracts
+    (slow-ish: builds all six probe step families — the four flat/decode
+    ones plus the LM and ViT pipeline compositions — on the CPU
+    mesh)."""
+    from ddl_tpu.analysis.contracts import PROBES, run_contracts
 
+    assert {name for name, _ in PROBES} >= {
+        "lm_pipeline", "vit_pipeline",
+    }, "pipeline factories must be probed too"
     report = run_contracts()
     assert report.findings == [], "\n".join(
         f.format() for f in report.findings

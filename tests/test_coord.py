@@ -1,0 +1,438 @@
+"""Pod-level coordinated recovery (ddl_tpu/coord.py + PodSupervisor).
+
+Unit tier: the rendezvous primitives (barrier, stale-peer ageout,
+split-brain-free restart-epoch proposal under a real race, rank-0
+resume-epoch agreement) and the PodSupervisor protocol driven by
+scripted fake children over one tmpdir "NAS".
+
+End-to-end tier: a 3-process pod sim — real tiny-LM trainer children
+under real pod supervisors sharing one tmpdir — where an injected
+``stall@step`` hang on host 1 makes all three hosts exit and relaunch
+in the same restart epoch, restore the same (rank-0-agreed) snapshot,
+and reach the same final step and identical final weights, with the
+consumed-batch audit proving the resumed stream replayed no batch and
+skipped none (the data cursor).
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from ddl_tpu.coord import (
+    BarrierTimeout,
+    Rendezvous,
+    agreed_resume_epoch,
+    from_env,
+)
+from ddl_tpu.supervisor import EXIT_PREEMPTED, PodSupervisor
+from ddl_tpu.utils.backoff import Backoff
+
+CHILD = Path(__file__).parent / "pod_sim_child.py"
+
+
+def _rv(root, host, n, **kw):
+    kw.setdefault("timeout_s", 10.0)
+    kw.setdefault("poll_s", 0.005)
+    return Rendezvous(root, host, n, **kw)
+
+
+# ---------------------------------------------------------------------------
+# rendezvous primitives
+# ---------------------------------------------------------------------------
+
+
+def test_barrier_completes_when_all_arrive(tmp_path):
+    done = []
+
+    def host(i):
+        rv = _rv(tmp_path, i, 3)
+        rv.barrier("go")
+        done.append(i)
+
+    threads = [threading.Thread(target=host, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(done) == [0, 1, 2]
+
+
+def test_barrier_times_out_when_a_peer_never_arrives(tmp_path):
+    rv = _rv(tmp_path, 0, 2, timeout_s=0.2)
+    with pytest.raises(BarrierTimeout, match="1/2 hosts"):
+        rv.barrier("lonely")
+
+
+def test_stale_peer_ageout_only_for_running_hosts(tmp_path):
+    a, b, c = _rv(tmp_path, 0, 3), _rv(tmp_path, 1, 3), _rv(tmp_path, 2, 3)
+    b.publish_heartbeat("running", 0)
+    c.publish_heartbeat("done", 0)
+    time.sleep(0.15)
+    # b aged out while "running"; c is parked "done" and never stale
+    assert a.stale_peers(0.1) == [1]
+    assert a.stale_peers(10.0) == []
+    b.publish_heartbeat("running", 0)  # a fresh beat clears it
+    assert a.stale_peers(0.1) == []
+
+
+def test_restart_epoch_proposal_is_split_brain_free(tmp_path):
+    """N hosts racing to propose the same restart epoch converge on ONE
+    record: one proposer, one cumulative crash count, one agreed
+    delay."""
+    records = {}
+
+    def propose(i):
+        rv = _rv(tmp_path, i, 4)
+        records[i] = rv.propose_restart(
+            0, reason=f"crash-h{i}", crash=True, preempt=False,
+            delay_fn=lambda c: 1.0 + i,  # would differ per host if raced
+        )
+
+    threads = [threading.Thread(target=propose, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len({r["proposer"] for r in records.values()}) == 1
+    assert len({r["delay"] for r in records.values()}) == 1
+    for r in records.values():
+        assert r["epoch"] == 1
+        assert r["crashes"] == 1  # one restart event, counted once
+    # the ledger rolls counts forward epoch over epoch
+    rv = _rv(tmp_path, 0, 4)
+    rec2 = rv.propose_restart(1, "crash", crash=True, preempt=False)
+    assert rec2["epoch"] == 2 and rec2["crashes"] == 2
+
+
+def test_rank0_resume_agreement_overrides_divergent_views(tmp_path, monkeypatch):
+    """Torn-NAS shape: hosts compute different latest_valid_epoch; every
+    host must restore rank 0's answer."""
+    values = {0: 12, 1: 4}  # host 1's local view lags (torn write)
+    got = {}
+
+    def host(i):
+        rv = _rv(tmp_path, i, 2)
+        got[i] = rv.agree("resume-job-e1", lambda: values[i])
+
+    t1 = threading.Thread(target=host, args=(1,))
+    t1.start()
+    time.sleep(0.05)  # host 1 is already waiting when rank 0 decides
+    host(0)
+    t1.join(timeout=10)
+    assert got == {0: 12, 1: 12}
+
+    # the env-driven wrapper used by checkpoint.resolve_resume
+    monkeypatch.setenv("DDL_COORD_DIR", str(tmp_path))
+    monkeypatch.setenv("DDL_COORD_HOSTS", "2")
+    monkeypatch.setenv("DDL_COORD_HOST", "0")
+    monkeypatch.setenv("DDL_RESTART_EPOCH", "2")
+    assert from_env().host == 0
+    assert agreed_resume_epoch("job", lambda: 7) == 7
+    monkeypatch.setenv("DDL_COORD_HOST", "1")
+    assert agreed_resume_epoch("job", lambda: 3) == 7  # rank 0's answer
+    monkeypatch.delenv("DDL_COORD_DIR")
+    assert from_env() is None
+    assert agreed_resume_epoch("job", lambda: 5) == 5  # non-pod fallback
+
+
+def test_abort_is_pod_wide_and_first_writer_wins(tmp_path):
+    a, b = _rv(tmp_path, 0, 2), _rv(tmp_path, 1, 2)
+    rec = a.abort("crash budget exhausted", 9)
+    assert b.aborted()["rc"] == 9
+    # a later abort keeps the original story
+    assert b.abort("something else", 3)["reason"] == "crash budget exhausted"
+    assert rec["host"] == 0
+
+
+# ---------------------------------------------------------------------------
+# PodSupervisor protocol (scripted fake children, threads as hosts)
+# ---------------------------------------------------------------------------
+
+
+class FakeChild:
+    """Scripted child: exits ``rc`` after ``delay`` seconds, or hangs
+    forever (rc=None) until terminated."""
+
+    def __init__(self, rc=None, delay=0.05):
+        self.rc = rc
+        self.delay = delay
+        self.t0 = time.monotonic()
+        self.killed = False
+
+    def poll(self):
+        if self.killed:
+            return -15
+        if self.rc is None:
+            return None
+        return self.rc if time.monotonic() - self.t0 >= self.delay else None
+
+    def terminate(self):
+        self.killed = True
+
+    kill = terminate
+
+    def wait(self, timeout=None):
+        return self.poll()
+
+
+def _run_pod(tmp_path, scripts, n_hosts=None, events=None, **sup_kwargs):
+    """Run one PodSupervisor per host in threads; ``scripts[i]`` is the
+    list of children host i spawns, in order.  Returns {host: exit}."""
+    n_hosts = n_hosts if n_hosts is not None else len(scripts)
+    sup_kwargs.setdefault("backoff", Backoff(base=0.01, jitter=0.0))
+    results = {}
+    sups = {}
+
+    def host(i):
+        rv = _rv(tmp_path, i, n_hosts)
+        it = iter(scripts[i])
+        sup = PodSupervisor(
+            lambda epoch, idx: next(it), rv,
+            poll_s=0.005, heartbeat_s=0.02, stale_after_s=30.0,
+            log=lambda m: None,
+            events=(events or {}).get(i),
+            **sup_kwargs,
+        )
+        sups[i] = sup
+        results[i] = sup.run()
+
+    threads = [
+        threading.Thread(target=host, args=(i,)) for i in range(len(scripts))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "pod deadlocked"
+    return results
+
+
+def test_pod_completes_when_all_children_succeed(tmp_path):
+    results = _run_pod(tmp_path, [[FakeChild(rc=0)], [FakeChild(rc=0)]])
+    assert results == {0: 0, 1: 0}
+    assert _rv(tmp_path, 0, 2).current_epoch() == 0  # no restart proposed
+
+
+def test_one_crash_restarts_every_host_in_the_same_epoch(tmp_path):
+    """Host 1 crashes; host 0's healthy child (hanging mid-'collective')
+    is killed and both hosts relaunch together in restart epoch 1."""
+    h0 = [FakeChild(rc=None), FakeChild(rc=0)]
+    results = _run_pod(tmp_path, [h0, [FakeChild(rc=1), FakeChild(rc=0)]])
+    assert results == {0: 0, 1: 0}
+    assert h0[0].killed  # the healthy child was killed, not abandoned
+    rv = _rv(tmp_path, 0, 2)
+    assert rv.current_epoch() == 1
+    rec = rv.epoch_record(1)
+    assert rec["crashes"] == 1 and rec["reason"].endswith("crash")
+
+
+def test_completed_host_rejoins_a_restart_proposed_while_it_waits(tmp_path):
+    """Host 0 finishes its run; host 1 then crashes.  Host 0 must leave
+    the done barrier and retrain — the resumed collective needs every
+    host."""
+    h0 = [FakeChild(rc=0, delay=0.01), FakeChild(rc=0)]
+    h1 = [FakeChild(rc=1, delay=0.3), FakeChild(rc=0)]
+    results = _run_pod(tmp_path, [h0, h1])
+    assert results == {0: 0, 1: 0}
+    assert _rv(tmp_path, 0, 2).current_epoch() == 1
+
+
+def test_resumable_exits_do_not_consume_the_crash_budget(tmp_path):
+    h0 = [FakeChild(rc=EXIT_PREEMPTED, delay=0.01), FakeChild(rc=0)]
+    h1 = [FakeChild(rc=None), FakeChild(rc=0)]
+    results = _run_pod(tmp_path, [h0, h1], max_restarts=0)
+    assert results == {0: 0, 1: 0}  # survives despite a zero crash budget
+    rec = _rv(tmp_path, 0, 2).epoch_record(1)
+    assert rec["crashes"] == 0 and rec["preemptions"] == 1
+    assert rec["delay"] == 0.0  # preemptions relaunch without backoff
+
+
+def test_crash_budget_exhaustion_aborts_the_whole_pod(tmp_path):
+    h0 = [FakeChild(rc=None), FakeChild(rc=None)]
+    h1 = [FakeChild(rc=7, delay=0.01), FakeChild(rc=7, delay=0.01)]
+    results = _run_pod(tmp_path, [h0, h1], max_restarts=1)
+    # both hosts exit with the crashing host's code, not just the crasher
+    assert results == {0: 7, 1: 7}
+    ab = _rv(tmp_path, 0, 2).aborted()
+    assert ab is not None and "crash budget" in ab["reason"]
+
+
+def test_stale_peer_triggers_escalation_not_eternal_hang(tmp_path):
+    """Host 1's supervisor dies silently (no heartbeat, child hangs).
+    Host 0 must detect the aged-out heartbeat, attempt a coordinated
+    restart, and — when the dead peer never joins the barrier — abort
+    rather than hang forever."""
+    rv1 = _rv(tmp_path, 1, 2)
+    rv1.arrive("start")  # host 1 made the start barrier...
+    rv1.publish_heartbeat("running", 0)  # ...beat once, then died
+
+    rv0 = _rv(tmp_path, 0, 2, timeout_s=0.5)
+    child = FakeChild(rc=None)
+    sup = PodSupervisor(
+        lambda epoch, idx: child, rv0,
+        poll_s=0.005, heartbeat_s=0.02, stale_after_s=0.1,
+        backoff=Backoff(base=0.01, jitter=0.0), log=lambda m: None,
+    )
+    rc = sup.run()
+    assert rc != 0
+    assert child.killed
+    ab = rv0.aborted()
+    assert ab is not None and "join" in ab["reason"]
+
+
+def test_pod_supervisor_emits_coordination_events(tmp_path):
+    from ddl_tpu.obs import EventWriter, read_events
+
+    w0 = EventWriter(tmp_path / "logs", "podjob", host=0)
+    results = _run_pod(
+        tmp_path / "nas",
+        [[FakeChild(rc=None), FakeChild(rc=0)],
+         [FakeChild(rc=1, delay=0.01), FakeChild(rc=0)]],
+        events={0: w0},
+    )
+    assert results == {0: 0, 1: 0}
+    w0.close()
+    events = read_events(w0.path)
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "supervisor_start"
+    assert "coord_barrier" in kinds and "pod_restart" in kinds
+    restart = next(e for e in events if e["kind"] == "pod_restart")
+    # either host may win the proposal race; the classification must
+    # still be the crash (reason "crash" from the crasher itself or
+    # "peer_crash" from the bystander that saw its intent)
+    assert restart["epoch"] == 1 and restart["reason"].endswith("crash")
+    assert kinds[-1] == "supervisor_done"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the 3-host pod sim (real trainers, real supervisors)
+# ---------------------------------------------------------------------------
+
+
+def _read_consumed(sim: Path, host: int) -> list[tuple[int, int]]:
+    out = []
+    for line in (sim / f"consumed_h{host}.log").read_text().splitlines():
+        e, s = line.split()
+        out.append((int(e), int(s)))
+    return out
+
+
+def _warm_compile_cache(sim_env: dict, tmp_path: Path) -> None:
+    """One plain 1-step child run to seed the persistent XLA cache, so
+    generation-0 children compile in far less than the watchdog
+    deadline."""
+    env = dict(sim_env, DDL_SIM_DIR=str(tmp_path / "warmup"),
+               DDL_SIM_STEPS="1", DDL_SIM_PACE="0")
+    (tmp_path / "warmup").mkdir()
+    subprocess.run(
+        [sys.executable, str(CHILD)], env=env, check=True, timeout=240,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+
+
+def test_three_host_pod_sim_stall_escalation_and_exact_resume(tmp_path):
+    """The acceptance scenario end to end: stall@step on host 1 → its
+    watchdog escalates (exit-intent + resumable exit) → ALL THREE hosts
+    kill their trainers and relaunch in the same restart epoch → every
+    host restores the rank-0-agreed snapshot → identical final step and
+    identical final weights on every host, and each host's final
+    incarnation consumed exactly the batches from the restored cursor to
+    the end — none duplicated, none skipped."""
+    from ddl_tpu import checkpoint as ckpt
+    from ddl_tpu.supervisor import supervise_pod_command
+
+    sim = tmp_path / "sim"
+    nas = tmp_path / "nas"
+    sim.mkdir()
+    nas.mkdir()
+    steps = 10
+    base_env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DDL_FAULT",
+                     "DDL_FAULT_STATE", "DDL_WATCHDOG_S", "DDL_COORD_DIR",
+                     "DDL_COORD_HOSTS", "DDL_COORD_HOST", "DDL_HOST_ID",
+                     "DDL_RESTART_EPOCH", "DDL_SUPERVISED",
+                     "DDL_OBS_STEP_SPANS")
+    }
+    base_env.update(
+        DDL_SIM_DIR=str(sim),
+        DDL_SIM_STEPS=str(steps),
+        DDL_SIM_PACE="0.8",
+        DDL_JOB_ID="podsim",
+        DDL_LOG_DIR=str(sim / "suplogs"),
+        DDL_WATCHDOG_S="4",
+        DDL_TEST_COMPILE_CACHE=os.environ.get(
+            "DDL_TEST_COMPILE_CACHE", "/tmp/ddl_tpu_test_xla_cache"
+        ),
+    )
+    _warm_compile_cache(base_env, tmp_path)
+
+    results = {}
+
+    def host(i):
+        env = dict(base_env)
+        if i == 1:
+            # stall EARLY so the coordinated kill lands mid-run on the
+            # healthy hosts (a late kill can let a graceful SIGTERM
+            # snapshot complete the whole run — also legal, but the
+            # interesting audit is a nonempty resume tail)
+            env["DDL_FAULT"] = "stall@step:2:300"  # the hang
+        results[i] = supervise_pod_command(
+            [sys.executable, str(CHILD)], nas, i, 3,
+            env=env, max_restarts=3,
+            backoff=Backoff(base=0.01, jitter=0.0),
+            poll_s=0.05, heartbeat_s=0.2, stale_after_s=60.0,
+            log=lambda m: None,
+        )
+
+    threads = [threading.Thread(target=host, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "pod sim deadlocked"
+    assert results == {0: 0, 1: 0, 2: 0}, results
+
+    rv = _rv(nas, 0, 3)
+    # exactly one coordinated restart, triggered by the stalled host
+    assert rv.current_epoch() == 1, rv.current_epoch()
+    rec = rv.epoch_record(1)
+    assert rec["crashes"] == 0  # a hang is resumable, not a crash
+
+    # every host completed IN RESTART EPOCH 1, at the same final step,
+    # with bit-identical weights
+    finals = []
+    for i in range(3):
+        last = (sim / f"final_h{i}.log").read_text().splitlines()[-1]
+        e, step, digest = last.split()
+        finals.append((int(e), int(step), digest))
+    assert all(e == 1 for e, _, _ in finals), finals
+    assert all(s == steps for _, s, _ in finals), finals
+    assert len({d for _, _, d in finals}) == 1, finals
+
+    # exact resume: host 0 published the agreed snapshot through the
+    # rendezvous (read the marker directly — rank 0's agree() would
+    # recompute); its manifest cursor is the resume step, and every
+    # host's final incarnation consumed exactly [cursor .. steps)
+    import json
+
+    agreed = json.loads(
+        (nas / "agree" / "resume-podsim-e1.json").read_text()
+    )["value"]
+    assert agreed is not None
+    cursor = ckpt.read_cursor(sim / "ckpt", "podsim", agreed)
+    assert cursor is not None and cursor["step"] == agreed
+    for i in range(3):
+        # the epoch-1 incarnation consumed exactly [agreed, steps) —
+        # empty iff the agreed snapshot already held the completed run
+        # (a graceful coordinated-kill snapshot landed at the last step)
+        tail = [s for e, s in _read_consumed(sim, i) if e == 1]
+        assert tail == list(range(agreed, steps)), (
+            f"h{i} replayed or skipped batches: {tail} "
+            f"(agreed resume {agreed})"
+        )

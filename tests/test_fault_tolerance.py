@@ -360,8 +360,9 @@ def test_supervisor_preemptions_do_not_consume_crash_budget():
 
 
 def test_supervise_command_subprocess_crash_then_success(tmp_path):
-    """The real subprocess runner: child crashes once (tracked in a state
-    file), then completes; the supervisor env contract is visible."""
+    """The real subprocess runner: child crashes once (an injected fault
+    it CONSUMES), then completes; the supervisor env contract is
+    visible, and the fired fault does not recur on relaunch."""
     import sys
 
     from ddl_tpu.supervisor import supervise_command
@@ -369,22 +370,30 @@ def test_supervise_command_subprocess_crash_then_success(tmp_path):
     marker = tmp_path / "attempts"
     prog = (
         "import os, pathlib, sys\n"
+        "sys.path.insert(0, os.getcwd())\n"
         f"m = pathlib.Path({str(marker)!r})\n"
         "n = int(m.read_text()) if m.exists() else 0\n"
         "m.write_text(str(n + 1))\n"
         "assert os.environ['DDL_SUPERVISED'] == '1'\n"
         "assert os.environ['DDL_RESTART_COUNT'] == str(n)\n"
         "assert os.environ['DDL_WATCHDOG_ACTION'] == 'exit'\n"
-        # injected faults are one-off events: present on the first
-        # attempt, dropped from relaunch envs
+        # consume-on-fire: the spec is present on the first attempt,
+        # fires (recorded via DDL_FAULT_STATE), and is dropped from the
+        # relaunch env because it fired — not because relaunch wipes all
         "assert ('DDL_FAULT' in os.environ) == (n == 0)\n"
-        "sys.exit(1 if n == 0 else 0)\n"
+        "from ddl_tpu.utils import faultinject\n"
+        "try:\n"
+        "    faultinject.check_step(1)\n"
+        "except faultinject.InjectedCrash:\n"
+        "    sys.exit(1)\n"
+        "sys.exit(0)\n"
     )
     env = dict(os.environ)
     env["DDL_FAULT"] = "crash@step:1"
     env["DDL_LOG_DIR"] = str(tmp_path / "logs")
     env["DDL_JOB_ID"] = "supcmd"
     env.pop("DDL_FAULT_PERSIST", None)
+    env.pop("DDL_FAULT_STATE", None)
     rc = supervise_command(
         [sys.executable, "-c", prog], max_restarts=2, env=env,
         backoff=Backoff(base=0.01, jitter=0.0), log=lambda m: None,
@@ -400,6 +409,62 @@ def test_supervise_command_subprocess_crash_then_success(tmp_path):
     assert kinds[0] == "supervisor_start"
     assert "supervisor_relaunch" in kinds
     assert kinds[-1] == "supervisor_done"
+
+
+def test_relaunch_preserves_non_consumed_fault_specs(tmp_path):
+    """Multi-fault scenario: only the spec that FIRED is dropped on
+    relaunch; the not-yet-fired one (a second fault beyond the resume
+    point) survives and fires in the next attempt."""
+    import sys
+
+    from ddl_tpu.supervisor import supervise_command
+
+    seen = tmp_path / "seen_faults"
+    prog = (
+        "import os, pathlib, sys\n"
+        "sys.path.insert(0, os.getcwd())\n"
+        f"s = pathlib.Path({str(seen)!r})\n"
+        "with s.open('a') as fh:\n"
+        "    fh.write(os.environ.get('DDL_FAULT', '<none>') + '\\n')\n"
+        "from ddl_tpu.utils import faultinject\n"
+        "try:\n"
+        "    for step in range(8):\n"
+        "        faultinject.check_step(step)\n"
+        "except faultinject.InjectedCrash:\n"
+        "    sys.exit(1)\n"
+        "sys.exit(0)\n"
+    )
+    env = dict(os.environ)
+    # two crashes at different steps: each attempt consumes exactly one
+    env["DDL_FAULT"] = "crash@step:2,crash@step:5"
+    env.pop("DDL_FAULT_PERSIST", None)
+    env.pop("DDL_FAULT_STATE", None)
+    env["DDL_LOG_DIR"] = str(tmp_path / "logs")
+    rc = supervise_command(
+        [sys.executable, "-c", prog], max_restarts=3, env=env,
+        backoff=Backoff(base=0.01, jitter=0.0), log=lambda m: None,
+    )
+    assert rc == 0
+    attempts = seen.read_text().splitlines()
+    assert attempts == [
+        "crash@step:2,crash@step:5",  # both armed
+        "crash@step:5",               # first consumed, second preserved
+        "<none>",                     # all consumed
+    ]
+
+
+def test_surviving_faults_filter_matches_duplicates_one_for_one(tmp_path):
+    from ddl_tpu.supervisor import _surviving_faults
+
+    state = tmp_path / "state"
+    state.write_text("io@save:1\n")
+    # two identical specs, one fired: exactly one survives
+    assert _surviving_faults("io@save:1, io@save:1", state) == "io@save:1"
+    # missing state file = nothing fired (a child that crashed before
+    # its fault must not disarm it)
+    assert _surviving_faults(
+        "crash@step:3", tmp_path / "nope"
+    ) == "crash@step:3"
 
 
 def test_injected_preempt_supervised_relaunch_resumes(tmp_path):
@@ -608,6 +673,139 @@ def test_nan_policy_bounded_rollbacks():
     with pytest.raises(RuntimeError, match="persisted through 2 rollback"):
         t.train()
     assert t.rollback_calls == 2
+
+
+def test_traced_nan_step_consume_at_build():
+    """`nan@grad` is consumed when a factory builds: the first build gets
+    the step, the rebuild (the post-rollback grace recompile) gets None —
+    so replayed steps run clean."""
+    faultinject.activate("nan@grad:7")
+    assert faultinject.traced_nan_step() == 7
+    assert faultinject.traced_nan_step() is None
+    # the host-side step hook never sees grad-site specs
+    faultinject.activate("nan@grad:0")
+    faultinject.check_step(0)
+    assert faultinject.active().nan_pending is False
+
+
+def test_nan_grad_injected_inside_compiled_step_recovers(tmp_path):
+    """The ROADMAP item made real: a non-finite value injected into the
+    GRADIENT inside the jitted step (traced lax.cond on the step
+    counter).  The poisoned update corrupts the params, the next window's
+    loss goes NaN, and nan_policy="recover" rolls back to the last good
+    snapshot; the grace rebuild compiles the injection out, so the
+    replay completes with finite weights."""
+    import jax
+
+    faultinject.activate("nan@grad:5")
+    t = _tiny_lm(
+        tmp_path, "lm-nan-grad", steps=8, save_every=4, log_dir=None,
+        log_every=1, nan_policy="recover", nan_max_consecutive=1,
+        nan_grace_scale=0.1, nan_grace_periods=1,
+    )
+    t.train()
+    assert int(t.state.step) == 8
+    assert t.recovery.rollbacks == 1
+    assert t.update_scale == 1.0
+    leaves = jax.tree.leaves(jax.device_get(t.state.params))
+    assert all(np.isfinite(np.asarray(x)).all() for x in leaves)
+
+
+# ---------------------------------------------------------------------------
+# exact-resume data cursor
+# ---------------------------------------------------------------------------
+
+
+def test_cursor_recorded_in_snapshot_manifest(tmp_path):
+    path = ckpt.save_snapshot(
+        tmp_path, "job", 0, {"w": np.ones((4,))},
+        cursor={"period": 2, "offset": 3},
+    )
+    assert ckpt.verify_snapshot(path)[0]
+    assert ckpt.read_cursor(tmp_path, "job", 0) == {
+        "period": 2, "offset": 3,
+    }
+    # cursor-less and legacy (manifest-less) snapshots: None, not a crash
+    ckpt.save_snapshot(tmp_path, "job", 1, {"w": np.ones((4,))})
+    assert ckpt.read_cursor(tmp_path, "job", 1) is None
+    (ckpt.snapshot_path(tmp_path, "job", 1) / ckpt.MANIFEST_NAME).unlink()
+    assert ckpt.read_cursor(tmp_path, "job", 1) is None
+
+
+def test_loader_start_batch_skips_exactly_and_is_one_shot():
+    from ddl_tpu.data.loader import DataLoader
+    from ddl_tpu.data.sampler import ShardedEpochSampler
+
+    class _Seq:
+        labels = list(range(12))
+
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return np.full((2, 2, 3), i, np.uint8), i
+
+    loader = DataLoader(
+        _Seq(), 3, sampler=ShardedEpochSampler(12, shuffle=False),
+        num_workers=0,
+    )
+    loader.set_start_batch(2)
+    labels = [list(lb) for _, lb in loader]
+    assert labels == [[6, 7, 8], [9, 10, 11]]  # first 2 batches skipped
+    labels = [list(lb) for _, lb in loader]
+    assert len(labels) == 4  # one-shot: the next epoch is full again
+
+
+def test_cnn_mid_epoch_preempt_resumes_at_exact_batch(tmp_path):
+    """Acceptance-grade exact resume for the epoch family: preempt
+    mid-epoch -> the snapshot manifest carries {period, offset} -> the
+    resumed run re-enters THAT epoch at THAT batch and consumes exactly
+    the remaining batches (no replay, no skip)."""
+    from ddl_tpu.config import preset
+    from ddl_tpu.train import Trainer
+
+    os.environ["DDL_JOB_ID"] = "cursor-exact"
+    try:
+        def make_cfg():
+            return preset("single", **{
+                "data.image_size": "32", "data.global_batch_size": "8",
+                "data.eval_batch_size": "8",
+                "data.synthetic_num_train": "48",
+                "data.synthetic_num_test": "16", "data.num_workers": "0",
+                "model.growth_rate": "4", "model.block_config": "[2,2]",
+                "model.num_init_features": "8", "model.bn_size": "2",
+                "train.max_epochs": "3", "train.save_best_qwk": "false",
+                "train.log_dir": str(tmp_path / "logs"),
+                "train.checkpoint_dir": str(tmp_path / "ckpt"),
+            })
+
+        # 6 batches/epoch; preempt at global step 8 = epoch 1, 3 batches in
+        faultinject.activate("preempt@step:8")
+        t = Trainer(make_cfg())
+        t.train()
+        assert t.preempted
+        assert ckpt.read_cursor(tmp_path / "ckpt", "cursor-exact", 1) == {
+            "period": 1, "offset": 3,
+        }
+
+        faultinject.deactivate()
+        t2 = Trainer(make_cfg())
+        assert t2.epochs_run == 1 and t2._resume_offset == 3
+        consumed = []
+        orig = t2.run_period
+
+        def spy(epoch, guard=None):
+            m, steps = orig(epoch, guard)
+            consumed.append((epoch, steps))
+            return m, steps
+
+        t2.run_period = spy
+        t2.train()
+        # epoch 1's remaining 3 batches, then a full epoch 2 — nothing
+        # replayed, nothing skipped
+        assert consumed == [(1, 3), (2, 6)]
+    finally:
+        os.environ.pop("DDL_JOB_ID", None)
 
 
 def test_nan_rollback_lm_end_to_end(tmp_path):

@@ -124,6 +124,61 @@ def test_event_writer_span_nesting(tmp_path):
     assert custom["step"] == 3 and custom["foo"] == 1.5 and custom["run"] == "r1"
 
 
+def test_step_span_sampler_one_in_n(tmp_path, monkeypatch):
+    """`emit_step_spans` as an integer N emits phase spans for 1-in-N
+    steps only; period totals still accumulate every step."""
+    from ddl_tpu.obs import EventWriter, StepTrace, read_events
+
+    w = EventWriter(tmp_path, "job", host=0)
+    trace = StepTrace(w, emit_step_spans=4)
+    trace.begin_period(0)
+    for step in range(10):
+        with trace.phase("step", step=step):
+            pass
+    # period-boundary phases are ONE write per period (and the
+    # preemption checkpoint span is incident-review gold): never thinned,
+    # even though the loop tags them with the boundary step
+    with trace.phase("checkpoint", step=7):
+        pass
+    trace.end_period(0, 0, elapsed=1.0, steps=10)
+    w.close()
+    events = read_events(w.path)
+    spans = [e for e in events if e["kind"] == "span"]
+    assert [e["step"] for e in spans if e["name"] == "step"] == [0, 4, 8]
+    assert [e["step"] for e in spans if e["name"] == "checkpoint"] == [7]
+    (period,) = [e for e in events if e["kind"] == "period"]
+    assert period["steps"] == 10  # totals cover every step regardless
+
+    # bool settings keep their round-6 meaning; env parses integers
+    assert StepTrace(w, emit_step_spans=False).emit_step_spans == 0
+    assert StepTrace(w, emit_step_spans=True).emit_step_spans == 1
+    monkeypatch.setenv("DDL_OBS_STEP_SPANS", "100")
+    t = StepTrace.create(tmp_path, "job2", "lm", host=0)
+    assert t.emit_step_spans == 100
+    t.writer.close()
+    monkeypatch.setenv("DDL_OBS_STEP_SPANS", "off")
+    t = StepTrace.create(tmp_path, "job3", "lm", host=0)
+    assert t.emit_step_spans == 0
+    t.writer.close()
+
+
+def test_event_writer_stamps_pod_restart_epoch(tmp_path, monkeypatch):
+    from ddl_tpu.obs import EventWriter, read_events
+
+    monkeypatch.setenv("DDL_RESTART_EPOCH", "3")
+    w = EventWriter(tmp_path, "job-re", host=0)
+    w.emit("heartbeat")
+    w.close()
+    (e,) = read_events(w.path)
+    assert e["repoch"] == 3
+    monkeypatch.delenv("DDL_RESTART_EPOCH")
+    w = EventWriter(tmp_path, "job-re2", host=0)
+    w.emit("heartbeat")
+    w.close()
+    (e,) = read_events(w.path)
+    assert "repoch" not in e  # no noise outside pod mode
+
+
 def test_watchdog_stall_dumps_stacks(tmp_path):
     from ddl_tpu.obs import EventWriter, Watchdog, read_events
 

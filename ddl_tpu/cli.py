@@ -39,6 +39,14 @@ trainer writes (``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
     python -m ddl_tpu.cli obs diff <job_a> <job_b>
     python -m ddl_tpu.cli obs baseline <job_id> --out FILE
     python -m ddl_tpu.cli obs diff <job_id> --baseline FILE [--fail-slowdown 0.5]
+    python -m ddl_tpu.cli obs pod <job_id> [--log-dir DIR] [--json]
+
+(``summarize`` includes decode p50/p95/p99 latency/queue-delay/TTFT when
+the run served requests; ``pod`` merges ALL hosts' streams into the
+straggler/skew table, barrier-wait attribution, and unified incident
+timeline; with ``DDL_OBS_PROFILE=1`` anomalies additionally arm a
+rate-limited ``jax.profiler`` capture whose per-op digest lands in the
+stream — ``ddl_tpu/obs/profiler.py``.)
 
 Static analysis (``ddl_tpu/analysis/``): AST anti-pattern rules plus the
 sharding-contract probes, gated by the committed ``LINT_BASELINE.json``:

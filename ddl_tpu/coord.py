@@ -68,6 +68,8 @@ __all__ = [
     "BarrierTimeout",
     "PodAborted",
     "Rendezvous",
+    "acquire_launch",
+    "active_launch_root",
     "from_env",
     "publish_exit_intent_from_env",
 ]
@@ -99,11 +101,16 @@ class PodAborted(RuntimeError):
 def _write_json(path: Path, payload: dict) -> None:
     """Atomic marker write: a reader sees the old file or the new one,
     never a torn line (tmp + rename, the write_manifest pattern).  The
-    tmp name carries the pid so two writers racing on the same marker
-    (possible only for barriers, which are idempotent) don't clobber
-    each other's tmp."""
+    tmp name carries pid AND thread id so two writers racing on the
+    same marker (idempotent ones: barriers, finished) never clobber
+    each other's tmp — the in-process pod tests run N "hosts" as
+    threads of one pid, where pid alone collides."""
+    import threading
+
     path.parent.mkdir(parents=True, exist_ok=True)
-    tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+    tmp = path.with_name(
+        f".{path.name}.{os.getpid()}.{threading.get_ident()}.tmp"
+    )
     tmp.write_text(json.dumps(payload))
     os.replace(tmp, path)
 
@@ -374,6 +381,26 @@ class Rendezvous:
                 )
             self.sleep(self.poll_s)
 
+    # ------------------------------------------------------------ finished
+
+    def mark_finished(self, rc: int, reason: str = "complete") -> dict:
+        """Stamp this launch as over (clean completion).  First writer
+        wins, like ``abort``; ``acquire_launch`` treats a launch with
+        either marker as closed, so a lone relaunched host can never
+        join a completed run's stale barriers."""
+        path = self.root / "finished.json"
+        existing = _read_json(path)
+        if existing is not None:
+            return existing
+        record = {
+            "ts": self.clock(),
+            "host": self.host,
+            "reason": reason,
+            "rc": int(rc),
+        }
+        _write_json(path, record)
+        return record
+
     # --------------------------------------------------------------- abort
 
     def abort(self, reason: str, rc: int) -> dict:
@@ -394,6 +421,84 @@ class Rendezvous:
 
     def aborted(self) -> dict | None:
         return _read_json(self.root / "abort.json")
+
+
+# ---------------------------------------------------------------------------
+# run-scoped rendezvous state (launch-token subdirs)
+# ---------------------------------------------------------------------------
+
+
+def _launch_closed(root: Path) -> bool:
+    return (root / "finished.json").is_file() or (root / "abort.json").is_file()
+
+
+def acquire_launch(
+    pod_dir: str | os.PathLike, token: str | None = None
+) -> Path:
+    """The rendezvous root for THIS launch: a token subdir under
+    ``<pod_dir>/launches/``, so one ``--pod`` directory can serve
+    successive launches without stale markers crossing between them.
+
+    The failure this closes (ROADMAP): the protocol's markers describe
+    one pod lifetime, and with everything at the pod root a lone host
+    relaunched after a COMPLETED run would sail through the previous
+    run's fully-arrived start barrier and hang alone at its first
+    collective.  Scoped, that host opens a *new* launch subdir (the old
+    one carries ``finished.json``/``abort.json``), waits at a fresh
+    start barrier its absent peers never arrive at, and aborts loudly.
+
+    With an explicit ``token`` (the ``DDL_LAUNCH_TOKEN`` env —
+    a scheduler incarnation id the operator guarantees is shared across
+    hosts and fresh per launch) the subdir is exactly that token.
+    Otherwise hosts agree leaderlessly: join the highest-numbered launch
+    that is not yet closed, else atomically ``mkdir`` the next number —
+    losers of the create race re-read and join the winner's."""
+    launches = Path(pod_dir) / "launches"
+    launches.mkdir(parents=True, exist_ok=True)
+    if token:
+        d = launches / f"t-{token}"
+        if _launch_closed(d):
+            # same staleness the numbered path defuses: a host relaunched
+            # with the finished run's token must not re-enter its barriers
+            raise RuntimeError(
+                f"launch token {token!r} names a finished/aborted launch "
+                f"({d}) — DDL_LAUNCH_TOKEN must be fresh per launch; "
+                "refusing to rejoin a closed run's rendezvous state"
+            )
+        d.mkdir(exist_ok=True)
+        return d
+    while True:
+        nums = sorted(
+            int(p.name[1:]) for p in launches.glob("L*")
+            if p.name[1:].isdigit()
+        )
+        cur = nums[-1] if nums else 0
+        if cur:
+            d = launches / f"L{cur:04d}"
+            if not _launch_closed(d):
+                return d
+        nxt = launches / f"L{cur + 1:04d}"
+        try:
+            nxt.mkdir()
+        except FileExistsError:
+            continue  # lost the create race: re-read, join the winner's
+        _write_json(
+            nxt / "launch.json",
+            {"ts": time.time(), "creator_pid": os.getpid()},
+        )
+        return nxt
+
+
+def active_launch_root(pod_dir: str | os.PathLike) -> Path | None:
+    """The newest launch subdir of a ``--pod`` directory (for
+    inspection/tests), or None when nothing ever launched there."""
+    launches = Path(pod_dir) / "launches"
+    if not launches.is_dir():
+        return None
+    dirs = [p for p in launches.iterdir() if p.is_dir()]
+    # newest by creation order (mtime), so numbered and token launches
+    # rank together
+    return max(dirs, key=lambda p: p.stat().st_mtime, default=None)
 
 
 # ---------------------------------------------------------------------------

@@ -191,12 +191,22 @@ def make_lm_generator(
     ``obs`` (an ``obs.events.EventWriter``) turns on per-request
     telemetry: each ``run()`` emits a ``decode_request`` span with
     ``dispatch``/``wait`` child spans and one ``decode`` event carrying
-    request tokens/s.  Prefill and the per-token scan are ONE fused XLA
-    program, so there is no host boundary to time individual decode
-    steps at — the dispatch/wait split is the finest host-visible
-    attribution; per-step device time lives in the profiler trace
-    (``bench/profile_decode.py``).  The fence it needs makes the request
+    prompt/output lengths, total latency, queueing delay,
+    time-to-first-token, and tokens/s — the per-request fields
+    ``obs summarize`` folds into serving-side p50/p95/p99
+    (``obs/serving.py``).  Without obs, prefill and the per-token scan
+    are ONE fused XLA program (no per-token dispatch from Python); with
+    obs the program is split at the first sampled token — prefill+first
+    token, then the remaining scan — so TTFT is a real fence on the
+    first token rather than an estimate.  The split is sampling-exact
+    (same RNG split sequence), costs one extra dispatch per request, and
+    the second program is dispatched before the first is fenced, so the
+    device pipeline stays full.  The fences make the request
     synchronous, which serving callers are anyway.
+
+    ``run(..., submitted_at=perf_counter_value)`` lets a serving harness
+    timestamp enqueue: the gap to dispatch is emitted as ``queue_delay``
+    (0.0 for callers that dispatch inline).
     """
     if max_len is None:
         max_len = prompt_len + max_new
@@ -244,27 +254,17 @@ def make_lm_generator(
         )
     model = LMDecode(cfg, rolling=rolling, attn_core=attn_core)
 
-    def generate(params, prompt, rng):
-        caches = init_kv_cache(
-            cfg, batch, max_len, rolling=rolling, quant=kv_quant
-        )
+    def sample(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if top_k is not None:
+            kth = lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(
+            rng, logits / jnp.float32(temperature), axis=-1
+        ).astype(jnp.int32)
 
-        with nn.logical_axis_rules(rules):
-            logits, caches = model.apply(
-                {"params": params}, prompt, caches, 0, last_only=True
-            )
-        last = logits[:, -1]
-
-        def sample(logits, rng):
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            if top_k is not None:
-                kth = lax.top_k(logits, top_k)[0][..., -1:]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            return jax.random.categorical(
-                rng, logits / jnp.float32(temperature), axis=-1
-            ).astype(jnp.int32)
-
+    def make_step(params):
         def step(carry, i):
             last, caches, rng = carry
             rng, sub = jax.random.split(rng)
@@ -275,10 +275,34 @@ def make_lm_generator(
                 )
             return (logits[:, 0], caches, rng), tok
 
-        (_, _, _), toks = lax.scan(
-            step, (last, caches, rng), jnp.arange(max_new)
+        return step
+
+    def _prefill(params, prompt, rng):
+        """Prompt forward + the FIRST sampled token applied to the cache
+        — everything TTFT covers."""
+        caches = init_kv_cache(
+            cfg, batch, max_len, rolling=rolling, quant=kv_quant
         )
-        return toks.T  # (B, max_new)
+        with nn.logical_axis_rules(rules):
+            logits, caches = model.apply(
+                {"params": params}, prompt, caches, 0, last_only=True
+            )
+        last = logits[:, -1]
+        (last, caches, rng), tok0 = make_step(params)((last, caches, rng), 0)
+        return tok0, last, caches, rng
+
+    def _rest(params, tok0, last, caches, rng):
+        """Decode steps 1..max_new-1 — the same RNG split sequence as
+        one fused prefill+scan program, so the two-program split is
+        token-identical to the fused path."""
+        (_, _, _), toks = lax.scan(
+            make_step(params), (last, caches, rng), jnp.arange(1, max_new)
+        )
+        return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+    def generate(params, prompt, rng):
+        tok0, last, caches, rng = _prefill(params, prompt, rng)
+        return _rest(params, tok0, last, caches, rng)
 
     tok_sharding = NamedSharding(mesh, DECODE_TOKEN_SPEC)
 
@@ -287,10 +311,16 @@ def make_lm_generator(
         in_shardings=(None, tok_sharding, None),
         out_shardings=tok_sharding,
     )
+    # the TTFT-splittable pair, compiled only when obs telemetry runs
+    jitted_prefill = jax.jit(
+        _prefill,
+        in_shardings=(None, tok_sharding, None),
+    )
+    jitted_rest = jax.jit(_rest, out_shardings=tok_sharding)
 
     warmed = False
 
-    def run(params, prompt, rng=None):
+    def run(params, prompt, rng=None, submitted_at=None):
         nonlocal warmed
         if rng is None:
             rng = jax.random.key(0)
@@ -302,18 +332,33 @@ def make_lm_generator(
         from ddl_tpu.utils.timing import fence
 
         # the first request pays the XLA compile; flag it so summaries
-        # can exclude it from steady-state tokens/s (the same warmup
+        # can exclude it from steady-state percentiles (the same warmup
         # discipline as bench/analysis.comm_time_summary)
         warm, warmed = warmed, True
         t0 = perf_counter()
+        # queueing delay: enqueue -> dispatch, when the serving harness
+        # timestamps enqueue (perf_counter base); inline callers have no
+        # queue, which 0.0 states honestly
+        queue_delay = (
+            max(0.0, t0 - submitted_at) if submitted_at is not None else 0.0
+        )
         with obs.span(
             "decode_request", prompt_len=prompt_len, max_new=max_new,
             batch=batch,
         ):
             with obs.span("dispatch"):
                 with jax.set_mesh(mesh):
-                    toks = jitted(params, prompt, rng)
+                    # both programs dispatch back to back — the tail is
+                    # queued behind prefill on the device, so fencing the
+                    # first token below doesn't drain the pipeline
+                    tok0, last, caches, rng2 = jitted_prefill(
+                        params, prompt, rng
+                    )
+                    toks = jitted_rest(params, tok0, last, caches, rng2)
             with obs.span("wait"):
+                with obs.span("first_token"):
+                    fence(tok0)
+                ttft = perf_counter() - t0
                 fence(toks)
         dur = perf_counter() - t0
         obs.emit(
@@ -322,7 +367,13 @@ def make_lm_generator(
             new_tokens=max_new,
             batch=batch,
             dur=dur,
+            queue_delay=queue_delay,
+            ttft=ttft,
             tok_per_s=batch * max_new / dur if dur > 0 else None,
+            decode_tok_per_s=(
+                batch * (max_new - 1) / (dur - ttft)
+                if max_new > 1 and dur > ttft else None
+            ),
             warm=warm,
         )
         return toks

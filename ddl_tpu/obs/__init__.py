@@ -13,6 +13,13 @@ detectors (``obs/anomaly.py``), and a run-inspection CLI
 The CSVs keep the reference schema and stay the cross-run aggregation
 surface (``bench/analysis.py``); the event stream adds what they cannot
 express — nesting, per-host liveness, and sub-period attribution.
+
+The diagnosis layer on top (PR 5): anomaly-triggered ``jax.profiler``
+capture windows with per-op digests (``obs/profiler.py``), serving-side
+latency percentiles over the decode path's per-request events
+(``obs/serving.py``), and the pod-wide cross-host view — straggler/skew
+table, barrier-wait attribution, unified incident timeline
+(``obs/pod.py``, ``ddl_tpu obs pod``).
 """
 
 from ddl_tpu.obs.anomaly import (
@@ -22,6 +29,8 @@ from ddl_tpu.obs.anomaly import (
     ThroughputRegressionDetector,
 )
 from ddl_tpu.obs.events import EventWriter, events_path, read_events
+from ddl_tpu.obs.profiler import TraceCapturer
+from ddl_tpu.obs.serving import QuantileAccumulator, ServingStats
 from ddl_tpu.obs.steptrace import PHASES, StepTrace
 from ddl_tpu.obs.watchdog import Watchdog
 
@@ -31,8 +40,11 @@ __all__ = [
     "HBMGrowthDetector",
     "LossSpikeDetector",
     "PHASES",
+    "QuantileAccumulator",
+    "ServingStats",
     "StepTrace",
     "ThroughputRegressionDetector",
+    "TraceCapturer",
     "Watchdog",
     "events_path",
     "read_events",

@@ -82,8 +82,20 @@ class ThroughputRegressionDetector:
         self.values: deque[float] = deque(maxlen=window)
         self.drop = drop
         self.min_points = min_points
+        self.suppressed = 0
 
-    def observe(self, steps_per_sec: float) -> dict | None:
+    def observe(
+        self, steps_per_sec: float, suppress: bool = False
+    ) -> dict | None:
+        """``suppress=True`` marks a period with a KNOWN throughput
+        excursion — a recompile landed in it (steptrace counts XLA
+        backend compiles per period) — so a compile stall neither raises
+        a false anomaly (and burns a profile capture on it) nor drags
+        the trailing baseline down and masks the next real regression:
+        the period is judged not at all and admitted not at all."""
+        if suppress:
+            self.suppressed += 1
+            return None
         sps = float(steps_per_sec)
         out = None
         if len(self.values) >= self.min_points and np.isfinite(sps):
@@ -129,8 +141,13 @@ class AnomalyMonitor:
     """Feed per-period signals; anomalies stream as events and pile up
     for the end-of-run summary."""
 
-    def __init__(self, writer=None, **detector_kwargs) -> None:
+    def __init__(self, writer=None, capturer=None, **detector_kwargs) -> None:
         self.writer = writer
+        # an obs.profiler.TraceCapturer (or None): every anomaly this
+        # monitor surfaces — rolling-detector firings AND externally
+        # recorded ones (nonfinite_loss) — arms a rate-limited
+        # profile-on-anomaly trace window over the next steps
+        self.capturer = capturer
         self.loss = LossSpikeDetector(
             **detector_kwargs.get("loss_spike", {})
         )
@@ -146,14 +163,21 @@ class AnomalyMonitor:
         loss: float | None = None,
         steps_per_sec: float | None = None,
         hbm_bytes: float | None = None,
+        compiles: int = 0,
     ) -> list[dict]:
+        """``compiles`` is the period's XLA backend-compile count (from
+        ``StepTrace``): a period that recompiled has a known, explained
+        throughput excursion, so regression detection is suppressed for
+        it instead of burning a profile capture on a compile stall."""
         found = []
         if loss is not None:
             a = self.loss.observe(loss)
             if a:
                 found.append(a)
         if steps_per_sec is not None:
-            a = self.throughput.observe(steps_per_sec)
+            a = self.throughput.observe(
+                steps_per_sec, suppress=compiles > 0
+            )
             if a:
                 found.append(a)
         a = self.hbm.observe(hbm_bytes)
@@ -164,6 +188,8 @@ class AnomalyMonitor:
             self.anomalies.append(a)
             if self.writer is not None:
                 self.writer.emit("anomaly", step=idx, **a)
+            if self.capturer is not None:
+                self.capturer.trigger(a["type"], step=idx)
         return found
 
     def record(self, idx: int, type: str, **fields) -> dict:
@@ -174,6 +200,8 @@ class AnomalyMonitor:
         self.anomalies.append(a)
         if self.writer is not None:
             self.writer.emit("anomaly", step=idx, **a)
+        if self.capturer is not None:
+            self.capturer.trigger(type, step=idx)
         return a
 
     def summary_lines(self) -> list[str]:

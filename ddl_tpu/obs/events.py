@@ -57,6 +57,9 @@ EVENT_KINDS = (
     "heartbeat", "stall", "watchdog_exit",
     # anomaly.py detectors + loop recovery
     "anomaly", "rollback",
+    # profiler.py anomaly-triggered jax.profiler windows (trace dir +
+    # per-op device-time digest; also the ok=False disable markers)
+    "profile_capture",
     # loop.py data-path retries
     "io_retry",
     # infer/decode.py per-request serving telemetry

@@ -1,0 +1,315 @@
+"""Pod-wide observability: merge every host's event stream into one view.
+
+PR 4's pod supervision made an N-host job write N per-host JSONL streams
+(trainer children plus their supervisors, all under the same
+``by_job_id/<job>/``) with no pod-level view; straggler and skew
+diagnosis is exactly the cross-host correlation a per-host summary
+cannot show (the 100k-GPU collective-communication study in PAPERS.md
+makes the same point at fleet scale: one slow participant sets the speed
+of every collective).  ``ddl_tpu obs pod <job>`` renders three such
+views from the merged streams:
+
+* **per-host skew table** — per-(restart-epoch, period) phase
+  breakdowns aligned across hosts: each host's steps/s and mean
+  ``step``/``data_wait`` seconds per period against the pod median,
+  with the straggler (the host whose compute+input time is furthest
+  above median) called out.  On an SPMD pod every host runs the same
+  program, so a host sitting above median in ``step`` time is either a
+  slow chip or a victim of its own input pipeline (``data_wait``
+  separates the two).
+* **barrier-wait attribution** — per-host waits from ``coord_barrier``
+  events (the pod supervisors emit one per barrier join): who arrives
+  late, who waits, and how much restart wall-clock the rendezvous
+  itself costs.
+* **unified timeline** — restarts, anomalies, stalls, and profile
+  captures from every host on one wall clock, grouped by restart epoch
+  (``repoch``), so "host 2 stalled, the pod restarted, loss spiked on
+  resume, a trace was captured" reads as one story.
+
+Pure stdlib over the event files, like ``obs/report.py`` — runs
+anywhere the log directory is mounted, no JAX.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+from collections import defaultdict
+
+from ddl_tpu.obs.events import read_events
+
+__all__ = [
+    "load_pod",
+    "pod_summary",
+    "render_pod_summary",
+]
+
+# kinds worth a line on the cross-host timeline (lifecycle + incidents;
+# spans/heartbeats/periods are volume, not narrative)
+TIMELINE_KINDS = (
+    "run_start", "run_end", "supervisor_start", "supervisor_relaunch",
+    "supervisor_done", "pod_restart", "peer_stale", "coord_barrier",
+    "anomaly", "stall", "watchdog_exit", "rollback", "profile_capture",
+)
+
+# a host this far above the pod median in per-period step+data_wait time
+# is flagged as the straggler
+STRAGGLER_RATIO = 1.15
+
+
+def load_pod(log_dir: str | os.PathLike, job_id: str) -> dict[int, list[dict]]:
+    """Every host's events for a job, keyed by host id (from the file
+    name, which is authoritative — the events' ``host`` field matches it
+    by construction)."""
+    from ddl_tpu.obs.report import _job_dir
+
+    streams: dict[int, list[dict]] = {}
+    for f in sorted(_job_dir(log_dir, job_id).glob("events-h*.jsonl")):
+        try:
+            host = int(f.stem.split("-h")[-1])
+        except ValueError:
+            continue
+        streams[host] = read_events(f)
+    return streams
+
+
+def _median(values: list[float]) -> float | None:
+    return statistics.median(values) if values else None
+
+
+def pod_summary(streams: dict[int, list[dict]]) -> dict:
+    """Aggregate per-host streams into the pod view ``render_pod_summary``
+    prints.  Only periods every host reported (same ``(repoch, period)``
+    key) enter the skew comparison — hosts die and resume at different
+    wall-clock points, and comparing a host's clean period against
+    another's preemption-truncated one would manufacture skew."""
+    # -- per-host period tables keyed by (repoch, period) ----------------
+    period_by_host: dict[int, dict[tuple, dict]] = {}
+    hosts: dict[int, dict] = {}
+    for host, events in streams.items():
+        rec = hosts.setdefault(host, {
+            "periods": 0, "steps": 0.0, "elapsed": 0.0,
+            "stalls": 0, "anomalies": 0, "captures": 0, "restarts": 0,
+            "last_step": None,
+        })
+        table = period_by_host.setdefault(host, {})
+        for e in events:
+            kind = e.get("kind")
+            if kind == "period":
+                key = (e.get("repoch", 0), e.get("period"))
+                table[key] = e
+                rec["periods"] += 1
+                rec["steps"] += e.get("steps", 0)
+                rec["elapsed"] += e.get("elapsed", 0.0)
+            elif kind == "stall":
+                rec["stalls"] += 1
+            elif kind == "anomaly":
+                rec["anomalies"] += 1
+            elif kind == "profile_capture" and e.get("ok"):
+                rec["captures"] += 1
+            elif kind in ("supervisor_relaunch", "pod_restart"):
+                rec["restarts"] += 1
+            step = e.get("step")
+            if step is not None and kind in ("span", "heartbeat", "stall"):
+                rec["last_step"] = (
+                    step if rec["last_step"] is None
+                    else max(rec["last_step"], step)
+                )
+
+    shared = None
+    for table in period_by_host.values():
+        keys = set(table)
+        shared = keys if shared is None else shared & keys
+    shared = shared or set()
+
+    # -- skew rows over the shared periods -------------------------------
+    skew: dict[int, dict] = {}
+    for host, table in period_by_host.items():
+        rows = [table[k] for k in shared]
+        if not rows:
+            skew[host] = {
+                "steps_per_sec": None, "step_s": None, "data_wait_s": None,
+                "busy_s": None,
+            }
+            continue
+        n = len(rows)
+        step_s = sum(
+            (r.get("phases") or {}).get("step", 0.0) for r in rows
+        ) / n
+        wait_s = sum(
+            (r.get("phases") or {}).get("data_wait", 0.0) for r in rows
+        ) / n
+        sps = [r["steps_per_sec"] for r in rows if r.get("steps_per_sec")]
+        skew[host] = {
+            "steps_per_sec": sum(sps) / len(sps) if sps else None,
+            "step_s": step_s,
+            "data_wait_s": wait_s,
+            "busy_s": step_s + wait_s,
+        }
+
+    busies = [s["busy_s"] for s in skew.values() if s["busy_s"] is not None]
+    median_busy = _median(busies)
+    straggler = None
+    if median_busy and len(busies) > 1:
+        worst_host = max(
+            (h for h, s in skew.items() if s["busy_s"] is not None),
+            key=lambda h: skew[h]["busy_s"],
+        )
+        worst = skew[worst_host]["busy_s"]
+        if worst > STRAGGLER_RATIO * median_busy:
+            straggler = {
+                "host": worst_host,
+                "busy_s": worst,
+                "median_busy_s": median_busy,
+                "ratio": worst / median_busy,
+            }
+
+    # -- barrier-wait attribution ----------------------------------------
+    barriers: dict[str, dict[int, float]] = defaultdict(dict)
+    for host, events in streams.items():
+        for e in events:
+            if e.get("kind") != "coord_barrier":
+                continue
+            name = e.get("name", "?")
+            barriers[name][host] = (
+                barriers[name].get(host, 0.0) + e.get("wait", 0.0)
+            )
+
+    # -- unified timeline -------------------------------------------------
+    # stamp the stream's host over the event field: the file-name host is
+    # authoritative (load_pod), and sim-pod children each believe they are
+    # host 0 while their streams are per-host
+    timeline = sorted(
+        (
+            {**e, "host": host}
+            for host, events in streams.items() for e in events
+            if e.get("kind") in TIMELINE_KINDS
+        ),
+        key=lambda e: e.get("ts", 0.0),
+    )
+
+    return {
+        "hosts": hosts,
+        "shared_periods": len(shared),
+        "repochs": sorted({
+            e.get("repoch", 0)
+            for events in streams.values() for e in events
+        }),
+        "skew": skew,
+        "median_busy_s": median_busy,
+        "straggler": straggler,
+        "barriers": {k: dict(v) for k, v in barriers.items()},
+        "timeline": timeline,
+    }
+
+
+def _fmt(v, spec=".3f", width=9) -> str:
+    return f"{v:>{width}{spec}}" if v is not None else f"{'n/a':>{width}}"
+
+
+def _timeline_label(e: dict) -> str:
+    kind = e.get("kind")
+    if kind == "anomaly":
+        return f"anomaly:{e.get('type')}"
+    if kind == "coord_barrier":
+        return f"barrier:{e.get('name')} wait={e.get('wait', 0):.1f}s"
+    if kind == "profile_capture":
+        d = e.get("digest") or {}
+        top = d.get("top_op")
+        return (
+            f"profile_capture:{e.get('trigger')}"
+            + (f" top_op={top}" if top else "")
+            + ("" if e.get("ok") else " FAILED")
+        )
+    if kind == "supervisor_relaunch":
+        return f"relaunch:{e.get('reason')}"
+    if kind == "pod_restart":
+        return (
+            f"pod_restart:{e.get('reason')} -> epoch {e.get('epoch')} "
+            f"(proposer h{e.get('proposer')})"
+        )
+    if kind == "stall":
+        return f"stall age={e.get('age', 0):.1f}s"
+    return kind
+
+
+def render_pod_summary(s: dict, job_id: str = "", tail: int = 40) -> str:
+    lines = [f"== pod view{f' — {job_id}' if job_id else ''} =="]
+    lines.append(
+        f"hosts: {len(s['hosts'])} | restart epochs: "
+        f"{len(s['repochs'])} | shared periods compared: "
+        f"{s['shared_periods']}"
+    )
+
+    lines.append("-- per-host skew (means over shared periods) --")
+    lines.append(
+        f"{'host':<6} {'steps/s':>9} {'step_s':>9} {'data_w_s':>9} "
+        f"{'vs median':>10} {'stalls':>7} {'anom':>5} {'restarts':>9}"
+    )
+    med = s.get("median_busy_s")
+    for host in sorted(s["skew"]):
+        sk = s["skew"][host]
+        rec = s["hosts"].get(host, {})
+        vs = (
+            f"{'x' + format(sk['busy_s'] / med, '.2f'):>10}"
+            if med and sk["busy_s"] is not None else f"{'n/a':>10}"
+        )
+        flag = (
+            "  <-- straggler"
+            if s["straggler"] and s["straggler"]["host"] == host else ""
+        )
+        lines.append(
+            f"h{host:<5} {_fmt(sk['steps_per_sec'], '.2f')} "
+            f"{_fmt(sk['step_s'])} {_fmt(sk['data_wait_s'])} "
+            f"{vs:>10} {rec.get('stalls', 0):>7} "
+            f"{rec.get('anomalies', 0):>5} {rec.get('restarts', 0):>9}"
+            f"{flag}"
+        )
+    if s["straggler"]:
+        st = s["straggler"]
+        lines.append(
+            f"straggler: h{st['host']} at {st['busy_s']:.3f}s/period "
+            f"step+data_wait vs pod median {st['median_busy_s']:.3f}s "
+            f"(x{st['ratio']:.2f})"
+        )
+    elif len(s["hosts"]) > 1 and med is not None:
+        lines.append(
+            f"no straggler: worst host within {STRAGGLER_RATIO:.2f}x of "
+            "the pod median"
+        )
+    elif len(s["hosts"]) > 1:
+        lines.append(
+            "skew not comparable: no (restart epoch, period) reported by "
+            "every host"
+        )
+
+    if s["barriers"]:
+        lines.append("-- barrier waits (s, summed per host) --")
+        hosts = sorted(s["hosts"])
+        lines.append(
+            f"{'barrier':<16} " + " ".join(f"h{h:<7}" for h in hosts)
+        )
+        for name in sorted(s["barriers"]):
+            waits = s["barriers"][name]
+            lines.append(
+                f"{name:<16} " + " ".join(
+                    f"{waits.get(h, 0.0):<8.2f}" for h in hosts
+                )
+            )
+
+    events = s["timeline"]
+    if events:
+        t0 = events[0].get("ts", 0.0)
+        shown = events[-tail:]
+        lines.append(
+            f"-- timeline ({len(events)} events"
+            + (f", last {len(shown)}" if len(shown) < len(events) else "")
+            + ") --"
+        )
+        for e in shown:
+            lines.append(
+                f"  +{e.get('ts', 0.0) - t0:8.2f}s h{e.get('host', 0)} "
+                f"e{e.get('repoch', 0)} step={e.get('step')} "
+                f"{_timeline_label(e)}"
+            )
+    return "\n".join(lines)

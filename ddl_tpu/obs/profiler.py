@@ -1,0 +1,318 @@
+"""Anomaly-triggered ``jax.profiler`` capture (profile-on-anomaly).
+
+The one-shot ``profile_dir`` hook in ``train/loop.py`` traces a chosen
+post-warmup period — useful for planned benchmarking, useless for the
+incident that happens at step 48 000 of an unattended run.  This module
+closes the ROADMAP follow-on: when an anomaly detector fires (loss
+spike, throughput regression, HBM growth — ``obs/anomaly.py``) or the
+stall watchdog is about to escalate, a ``TraceCapturer`` arms a one-shot
+``jax.profiler`` trace window over the NEXT few steps and emits a
+``profile_capture`` event carrying the trace directory, the trigger, and
+a per-op device-time digest (``bench/xprof.op_digest``) — so the
+regression is explainable from the event stream alone, without opening
+TensorBoard.
+
+Rate limiting is the design center, because anomalies cluster exactly
+when tracing is most expensive: at most ``max_captures`` per run, a
+``cooldown_s`` between captures, and triggers arriving while a window is
+armed/active (or cooling down) are *counted* — the next capture's event
+reports how many it absorbed — but never extend or restart a window.
+Every profiler interaction is best-effort: a broken profiler build (or a
+trace already running via the ``profile_dir`` hook) disables the
+capturer for the run instead of taking the trainer down.
+
+Opt-in via env (documented in README):
+
+    DDL_OBS_PROFILE=1           enable (default off)
+    DDL_OBS_PROFILE_STEPS=N     steps per trace window      (default 2)
+    DDL_OBS_PROFILE_MAX=K       captures per run            (default 2)
+    DDL_OBS_PROFILE_COOLDOWN_S  seconds between captures    (default 300)
+    DDL_OBS_PROFILE_DIR=DIR     trace root (default: ``xprof/`` beside
+                                the host's event file)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["TraceCapturer", "capturer_from_env"]
+
+
+class TraceCapturer:
+    """Arm-on-anomaly, capture-on-next-steps ``jax.profiler`` windows.
+
+    The training loop drives it with ``on_step(step)`` at each step
+    boundary (wired through ``StepTrace.phase("step")``); detectors call
+    ``trigger(reason, ...)``; paths with no upcoming step boundary (the
+    watchdog's hung-step escalation) use ``capture_now``.  ``tracer_start``
+    / ``tracer_stop`` / ``digest_fn`` are injectable for tests; the
+    defaults are ``jax.profiler.start_trace`` / ``stop_trace`` /
+    ``bench.xprof.op_digest``.
+    """
+
+    def __init__(
+        self,
+        writer,
+        trace_root: str | os.PathLike,
+        steps: int = 2,
+        max_captures: int = 2,
+        cooldown_s: float = 300.0,
+        clock=time.monotonic,
+        tracer_start=None,
+        tracer_stop=None,
+        digest_fn=None,
+    ) -> None:
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        self.writer = writer
+        self.trace_root = str(trace_root)
+        self.steps = int(steps)
+        self.max_captures = int(max_captures)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._start = tracer_start
+        self._stop = tracer_stop
+        self._digest = digest_fn
+        self.captures = 0
+        self.suppressed = 0  # triggers absorbed since the last capture
+        self.disabled = False  # tripped by a profiler failure
+        self._armed: dict | None = None  # pending trigger context
+        self._active: dict | None = None  # in-flight window
+        self._last_capture_t: float | None = None
+        # trigger/on_step run on the trainer thread, capture_now on the
+        # watchdog thread; reentrant because capture_now finishes its own
+        # window while holding it
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------- triggers
+
+    def _ready(self) -> bool:
+        if self.disabled or self.captures >= self.max_captures:
+            return False
+        if self._armed is not None or self._active is not None:
+            return False
+        if (
+            self._last_capture_t is not None
+            and self.clock() - self._last_capture_t < self.cooldown_s
+        ):
+            return False
+        return True
+
+    def trigger(self, reason: str, step=None, **fields) -> bool:
+        """Arm a capture window for the next steps.  Returns True when
+        armed; a refused trigger (cap reached, cooldown, already armed or
+        tracing) is counted into ``suppressed`` instead.  Non-blocking:
+        a synchronous watchdog capture holding the lock (possibly wedged
+        in the profiler along with the device) must never stall the
+        trainer thread — the trigger is absorbed instead."""
+        if not self._lock.acquire(blocking=False):
+            if not self.disabled:
+                self.suppressed += 1
+            return False
+        try:
+            if not self._ready():
+                if not self.disabled:
+                    self.suppressed += 1
+                return False
+            self._armed = {"trigger": reason, "trigger_step": step, **fields}
+            return True
+        finally:
+            self._lock.release()
+
+    # ----------------------------------------------------------- step hooks
+
+    def _trace_dir(self, tag: str) -> str | None:
+        """Create and return this capture's trace directory, or None
+        (capturer disabled) when the root is unwritable — diagnostics
+        must never take the trainer (or the watchdog thread) down."""
+        d = os.path.join(
+            self.trace_root, f"{self.captures:02d}-{tag}"
+        )
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            self.disabled = True
+            self.writer.emit(
+                "profile_capture", ok=False, error=str(e), disabled=True
+            )
+            return None
+        return d
+
+    def _start_trace(self, trace_dir: str) -> bool:
+        try:
+            if self._start is not None:
+                self._start(trace_dir)
+            else:
+                import jax
+
+                jax.profiler.start_trace(trace_dir)
+            return True
+        # deliberately broad: a profiler failure (already tracing via the
+        # profile_dir hook, missing backend support) must cost the run
+        # its diagnostics, never its training
+        except Exception as e:  # ddl-lint: disable=broad-except
+            self.disabled = True
+            self.writer.emit(
+                "profile_capture", ok=False, error=str(e), disabled=True
+            )
+            return False
+
+    def _finish_trace(self, step=None) -> None:
+        ctx = self._active
+        self._active = None
+        try:
+            if self._stop is not None:
+                self._stop()
+            else:
+                import jax
+
+                jax.profiler.stop_trace()
+        except Exception as e:  # ddl-lint: disable=broad-except
+            self.disabled = True
+            self.writer.emit(
+                "profile_capture", ok=False, error=str(e), disabled=True,
+                **{k: v for k, v in ctx.items() if k != "deadline_step"},
+            )
+            return
+        self.captures += 1
+        self._last_capture_t = self.clock()
+        digest = None
+        try:
+            if self._digest is not None:
+                digest = self._digest(ctx["trace_dir"])
+            else:
+                from ddl_tpu.bench.xprof import op_digest
+
+                digest = op_digest(ctx["trace_dir"])
+        except Exception as e:  # ddl-lint: disable=broad-except
+            digest = {"error": str(e)}
+        self.writer.emit(
+            "profile_capture",
+            step=step if step is not None else ctx.get("trigger_step"),
+            ok=True,
+            trace_dir=ctx["trace_dir"],
+            steps=ctx.get("steps"),
+            suppressed=self.suppressed,
+            digest=digest,
+            **{
+                k: v for k, v in ctx.items()
+                if k not in ("trace_dir", "steps", "deadline_step")
+            },
+        )
+        self.suppressed = 0
+
+    def on_step(self, step: int) -> None:
+        """Step-boundary hook (called at the start of each training
+        step): starts an armed window, closes an active one after
+        ``steps`` steps have run under it.  Non-blocking like
+        ``trigger`` — skipping a boundary while the watchdog holds the
+        lock just delays the window close by a step."""
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            self._on_step_locked(step)
+        finally:
+            self._lock.release()
+
+    def _on_step_locked(self, step: int) -> None:
+        if self._active is not None:
+            deadline = self._active.get("deadline_step")
+            # deadline None: a synchronous capture_now window (no step
+            # budget) is in flight on the watchdog thread
+            if deadline is not None and step >= deadline:
+                self._finish_trace(step=step)
+            return
+        if self._armed is None:
+            return
+        ctx = self._armed
+        self._armed = None
+        trace_dir = self._trace_dir(
+            f"{ctx['trigger']}-s{step if step is not None else 0}"
+        )
+        if trace_dir is None or not self._start_trace(trace_dir):
+            return
+        self._active = {
+            **ctx,
+            "trace_dir": trace_dir,
+            "steps": self.steps,
+            "first_step": step,
+            "deadline_step": (step or 0) + self.steps,
+        }
+
+    def finish(self) -> None:
+        """End-of-run hook: close a window the run ended inside of, and
+        drop a trigger still armed (it fired on the final step; no
+        boundary will come, and it must not leak into a later ``train()``
+        segment's first step with this run's attribution)."""
+        with self._lock:
+            if self._active is not None:
+                self._finish_trace()
+            if self._armed is not None:
+                self._armed = None
+                self.suppressed += 1
+
+    # ---------------------------------------------------- synchronous path
+
+    def capture_now(
+        self, reason: str, window_s: float = 0.5, step=None, **fields
+    ) -> bool:
+        """Trace the next ``window_s`` seconds synchronously — for
+        callers with no upcoming step boundary to ride (the watchdog's
+        hung-step path captures what the wedged device is doing right
+        before escalation).  Same rate limits as ``trigger``; never
+        raises (it runs on the watchdog thread, ahead of ``os._exit``).
+        Holds the lock across the window: the trainer thread is wedged
+        anyway (that is why the watchdog fired), and blocking a late
+        ``on_step`` for ``window_s`` beats racing it."""
+        with self._lock:
+            if not self._ready():
+                if not self.disabled:
+                    self.suppressed += 1
+                return False
+            trace_dir = self._trace_dir(f"{reason}-now")
+            if trace_dir is None or not self._start_trace(trace_dir):
+                return False
+            self._active = {
+                "trigger": reason, "trigger_step": step,
+                "trace_dir": trace_dir, "steps": None, "deadline_step": None,
+                **fields,
+            }
+            try:
+                time.sleep(window_s)
+            finally:
+                self._finish_trace(step=step)
+            return True
+
+
+def capturer_from_env(writer, default_root, env=os.environ):
+    """Build the env-configured ``TraceCapturer`` for a trainer, or None
+    when profile-on-anomaly is off (the default: tracing costs real step
+    time, so arming it is the operator's call).
+
+    A ``DDL_OBS_PROFILE_DIR`` override is scoped per host like the
+    default root — supervisors propagate env to every host of a pod, and
+    an SPMD-wide anomaly fires on all of them at the same step, which
+    would otherwise interleave trace files in one directory (and hand
+    ``op_digest`` another host's xplane).  A restart epoch additionally
+    gets its own subdir: relaunched incarnations reset the capture
+    counter, so ``00-<trigger>-sN`` names can repeat across them."""
+    flag = (env.get("DDL_OBS_PROFILE") or "").lower()
+    if flag in ("", "0", "false", "off"):
+        return None
+    root = env.get("DDL_OBS_PROFILE_DIR")
+    root = (
+        os.path.join(root, f"h{writer.host:03d}") if root
+        else str(default_root)
+    )
+    repoch = env.get("DDL_RESTART_EPOCH")
+    if repoch and repoch != "0":
+        root = os.path.join(root, f"r{repoch}")
+    return TraceCapturer(
+        writer,
+        root,
+        steps=int(env.get("DDL_OBS_PROFILE_STEPS") or 2),
+        max_captures=int(env.get("DDL_OBS_PROFILE_MAX") or 2),
+        cooldown_s=float(env.get("DDL_OBS_PROFILE_COOLDOWN_S") or 300.0),
+    )

@@ -3,14 +3,23 @@
 ``python -m ddl_tpu.cli obs <command>``:
 
     summarize <job_id>          throughput trend, phase breakdown table,
-                                anomalies, stalls, peak HBM, per-host
-                                liveness
+                                decode p50/p95/p99 (latency, queue delay,
+                                TTFT, tok/s — obs/serving.py), profile
+                                captures, anomalies, stalls, peak HBM,
+                                per-host liveness
     tail <job_id> [-n N]        last N events, rendered one per line
     diff <job_a> <job_b>        phase/throughput comparison of two runs
     baseline <job_id> --out F   store one run's summary as a JSON baseline
     diff <job> --baseline F     compare a run against a stored baseline;
                                 --fail-slowdown 0.5 exits nonzero on a
-                                >50% steps/s regression (the CI gate)
+                                >50% steps/s regression — and, when both
+                                runs carry decode percentiles, on a p95
+                                latency inflation past the same fraction
+                                (the CI gate)
+    pod <job_id>                pod-wide view over ALL hosts' streams
+                                (obs/pod.py): per-host skew/straggler
+                                table, barrier-wait attribution, unified
+                                restart/anomaly/capture timeline
 
 Pure stdlib + the event files — no JAX import, so it runs anywhere the
 NAS/log directory is mounted (the reference's analysis had the same
@@ -112,19 +121,25 @@ def summarize_run(events: list[dict]) -> dict:
         else:
             rec.pop("_period_step")
 
-    decodes = [e for e in events if e.get("kind") == "decode"]
-    decode = None
-    if decodes:
-        # steady-state rate: warm requests only (the first request per
-        # generator pays the XLA compile), unless nothing warm exists
-        warm = [e for e in decodes if e.get("warm")] or decodes
-        rates = [e["tok_per_s"] for e in warm if e.get("tok_per_s")]
-        decode = {
-            "requests": len(decodes),
-            "tokens": sum(e.get("new_tokens", 0) * e.get("batch", 1)
-                          for e in decodes),
-            "mean_tok_per_s": sum(rates) / len(rates) if rates else None,
-        }
+    # serving-side percentiles (obs/serving.py): latency / queue delay /
+    # TTFT / tok_per_s distributions over warm per-request decode events
+    from ddl_tpu.obs.serving import ServingStats
+
+    decode = ServingStats.from_events(events).summary()
+    if decode is not None and decode["mean_tok_per_s"] is None:
+        # no warm request at all (single-request smokes): fall back to
+        # the cold rates so the legacy mean stays populated
+        rates = [
+            e["tok_per_s"] for e in events
+            if e.get("kind") == "decode" and e.get("tok_per_s")
+        ]
+        decode["mean_tok_per_s"] = (
+            sum(rates) / len(rates) if rates else None
+        )
+
+    captures = [
+        e for e in events if e.get("kind") == "profile_capture"
+    ]
 
     hbm = [e["hbm_peak_bytes"] for e in periods if e.get("hbm_peak_bytes")]
     return {
@@ -141,6 +156,7 @@ def summarize_run(events: list[dict]) -> dict:
         "peak_hbm_bytes": max(hbm) if hbm else None,
         "hosts": hosts,
         "decode": decode,
+        "profile_captures": captures,
     }
 
 
@@ -175,9 +191,47 @@ def render_summary(s: dict, job_id: str = "") -> str:
             f"{d['mean_tok_per_s']:.1f} tok/s"
             if d["mean_tok_per_s"] else "n/a"
         )
+        cold = ""
+        if d.get("cold"):
+            # all-cold runs fall back to the cold rates for the mean, so
+            # "excluded" would mislabel exactly what produced the number
+            cold = (
+                f" ({d['cold']} cold, compile included)"
+                if d["cold"] >= d["requests"]
+                else f" ({d['cold']} cold excluded)"
+            )
         lines.append(
-            f"decode: {d['requests']} requests, {d['tokens']} tokens, {rate}"
+            f"decode: {d['requests']} requests, {d['tokens']} tokens, "
+            f"{rate}{cold}"
         )
+        if d.get("percentiles"):
+            from ddl_tpu.obs.serving import render_percentiles
+
+            lines.append("-- decode percentiles (warm requests) --")
+            lines.extend(render_percentiles(d["percentiles"]))
+    captures = s.get("profile_captures") or []
+    if captures:
+        lines.append(f"-- profile captures ({len(captures)}) --")
+        for c in captures:
+            if not c.get("ok"):
+                lines.append(
+                    f"  [failed] {c.get('trigger', '?')}: {c.get('error')}"
+                )
+                continue
+            digest = c.get("digest") or {}
+            top = ", ".join(
+                f"{k} {v:.1f}ms"
+                for k, v in list(digest.get("ops", {}).items())[:3]
+            )
+            lines.append(
+                f"  [{c.get('trigger')}] step {c.get('step')}: "
+                f"{c.get('trace_dir')}"
+                + (f" | {top}" if top else "")
+                + (
+                    f" | {c['suppressed']} trigger(s) absorbed"
+                    if c.get("suppressed") else ""
+                )
+            )
     lines.append(f"-- anomalies ({len(s['anomalies'])}) --")
     for a in s["anomalies"]:
         base = (
@@ -237,7 +291,29 @@ def diff_runs(sa: dict, sb: dict, job_a: str, job_b: str) -> str:
         f"stalls: {len(sa['stalls'])} vs {len(sb['stalls'])} | "
         f"compiles: {sa['compiles']} vs {sb['compiles']}"
     )
+    pa, pb = _decode_percentiles(sa), _decode_percentiles(sb)
+    if pa and pb:
+        lines.append(
+            f"{'decode':<14} {job_a[:14]:>14} {job_b[:14]:>14} {'delta':>8}"
+        )
+        for metric in sorted(set(pa) & set(pb)):
+            for q in ("p50", "p95", "p99"):
+                a, b = pa[metric].get(q), pb[metric].get(q)
+                if a is None or b is None:
+                    continue
+                delta = f"{(b - a) / a:+.0%}" if a else "new"
+                lines.append(
+                    f"{metric + ':' + q:<14} {a:>14.4g} {b:>14.4g} "
+                    f"{delta:>8}"
+                )
     return "\n".join(lines)
+
+
+def _decode_percentiles(s: dict) -> dict | None:
+    """A summary's decode percentile block (None when the run — or a
+    stored pre-percentile baseline — has none)."""
+    d = s.get("decode")
+    return d.get("percentiles") if d else None
 
 
 def _render_event(e: dict) -> str:
@@ -297,6 +373,20 @@ def main(argv=None) -> None:
     )
     p_base.add_argument("job_id")
     p_base.add_argument("--out", default="obs_baseline.json")
+    p_pod = sub.add_parser(
+        "pod", parents=[common],
+        help="pod-wide view over all hosts' streams: skew/straggler "
+        "table, barrier waits, unified timeline (obs/pod.py)",
+    )
+    p_pod.add_argument("job_id")
+    p_pod.add_argument(
+        "--timeline", type=int, default=40, metavar="N",
+        help="show at most the last N timeline events (default 40)",
+    )
+    p_pod.add_argument(
+        "--json", action="store_true",
+        help="emit the pod summary as JSON instead of the rendered view",
+    )
     args = ap.parse_args(argv)
 
     if args.command == "summarize":
@@ -326,24 +416,47 @@ def main(argv=None) -> None:
             raise SystemExit("obs diff needs a second job id or --baseline")
         print(diff_runs(sa, sb, name_a, name_b))
         if args.fail_slowdown is not None:
+            frac = args.fail_slowdown
             ra, rb = _rate(sa), _rate(sb)
-            if not ra or not rb:
-                # a run that emitted no period events must not pass the
-                # gate by default — that is the shape of a crashed smoke
+            pa, pb = _decode_percentiles(sa), _decode_percentiles(sb)
+            lat_gate = (
+                pa and pb
+                and pa.get("latency_s", {}).get("p95") is not None
+                and pb.get("latency_s", {}).get("p95") is not None
+            )
+            if not (ra and rb) and not lat_gate:
+                # a run that emitted neither period events nor decode
+                # percentiles must not pass the gate by default — that
+                # is the shape of a crashed smoke
                 raise SystemExit(
                     f"FAIL: cannot compute steps/s "
-                    f"({name_a}: {ra}, {name_b}: {rb}) — no period "
-                    "events? the regression gate needs both rates"
+                    f"({name_a}: {ra}, {name_b}: {rb}) and no decode "
+                    "percentiles on both sides — the regression gate "
+                    "needs at least one comparable signal"
                 )
-            if rb < (1.0 - args.fail_slowdown) * ra:
+            if ra and rb and rb < (1.0 - frac) * ra:
                 raise SystemExit(
                     f"FAIL: {name_b} at {rb:.2f} steps/s is more than "
-                    f"{args.fail_slowdown:.0%} below {name_a} "
-                    f"({ra:.2f} steps/s)"
+                    f"{frac:.0%} below {name_a} ({ra:.2f} steps/s)"
                 )
+            if lat_gate:
+                la = pa["latency_s"]["p95"]
+                lb = pb["latency_s"]["p95"]
+                if lb > (1.0 + frac) * la:
+                    raise SystemExit(
+                        f"FAIL: {name_b} decode p95 latency {lb:.4g}s is "
+                        f"more than {frac:.0%} above {name_a} "
+                        f"({la:.4g}s)"
+                    )
             print(
-                f"OK: throughput within the {args.fail_slowdown:.0%} "
-                "regression gate"
+                f"OK: within the {frac:.0%} regression gate ("
+                + " and ".join(
+                    g for g, on in (
+                        ("steps/s", ra and rb),
+                        ("decode p95 latency", lat_gate),
+                    ) if on
+                )
+                + ")"
             )
     elif args.command == "baseline":
         events = load_run(args.log_dir, args.job_id)
@@ -354,6 +467,22 @@ def main(argv=None) -> None:
         payload = {"job_id": args.job_id, "summary": summarize_run(events)}
         Path(args.out).write_text(json.dumps(payload, indent=1))
         print(f"wrote baseline for {args.job_id!r} to {args.out}")
+    elif args.command == "pod":
+        from ddl_tpu.obs.pod import load_pod, pod_summary, render_pod_summary
+
+        streams = load_pod(args.log_dir, args.job_id)
+        if not streams:
+            raise SystemExit(
+                f"no events for job {args.job_id!r} under {args.log_dir} "
+                f"(looked for {_job_dir(args.log_dir, args.job_id)}/events-h*.jsonl)"
+            )
+        summary = pod_summary(streams)
+        if args.json:
+            print(json.dumps(summary, default=str))
+        else:
+            print(
+                render_pod_summary(summary, args.job_id, tail=args.timeline)
+            )
 
 
 if __name__ == "__main__":

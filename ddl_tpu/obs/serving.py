@@ -1,0 +1,175 @@
+"""Serving-side latency statistics over per-request ``decode`` events.
+
+Serving comparisons are made on tail latency — the Gemma-on-TPU serving
+study (PAPERS.md) reports p50/p95/p99, never means — because the mean of
+a latency distribution hides exactly the requests users notice.  This
+module turns the decode path's per-request events (``infer/decode.py``:
+duration, queueing delay, time-to-first-token, tokens/s, prompt/output
+lengths) into streaming percentiles for ``obs summarize`` and the
+``obs diff --fail-slowdown`` regression gate.
+
+``QuantileAccumulator`` is a bounded-memory reservoir (Vitter's
+algorithm R, deterministic seed): exact quantiles while the stream fits
+the reservoir (every CI run), a uniform sample of the stream beyond it —
+so a week-long serving run's event file can be summarized without
+holding every request in memory.  Quantile interpolation matches
+``numpy.quantile``'s default (linear), which is what the unit tests pin
+it against.
+"""
+
+from __future__ import annotations
+
+import random
+
+__all__ = ["QuantileAccumulator", "ServingStats", "PERCENTILES"]
+
+PERCENTILES = (0.5, 0.95, 0.99)
+
+# decode-event field -> summary metric name; values are seconds except
+# the rate row.
+METRICS = (
+    ("dur", "latency_s"),
+    ("queue_delay", "queue_delay_s"),
+    ("ttft", "ttft_s"),
+    ("tok_per_s", "tok_per_s"),
+)
+
+
+class QuantileAccumulator:
+    """Streaming quantiles over a bounded reservoir.
+
+    ``add`` is O(1); ``quantile`` sorts the reservoir on demand (cached
+    between adds).  While ``count <= capacity`` the reservoir IS the
+    stream and quantiles are exact; beyond that it is a uniform random
+    sample (algorithm R) with a deterministic seed, so summaries are
+    reproducible run to run."""
+
+    def __init__(self, capacity: int = 4096, seed: int = 0) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._rng = random.Random(seed)
+        self._values: list[float] = []
+        self._sorted: list[float] | None = None
+        self.count = 0
+        self.total = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+    def add(self, x: float) -> None:
+        x = float(x)
+        self.count += 1
+        self.total += x
+        self.min = x if self.min is None else min(self.min, x)
+        self.max = x if self.max is None else max(self.max, x)
+        self._sorted = None
+        if len(self._values) < self.capacity:
+            self._values.append(x)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.capacity:
+                self._values[j] = x
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.count if self.count else None
+
+    def quantile(self, q: float) -> float | None:
+        """Linear-interpolated quantile of the reservoir (numpy's
+        default method), None on an empty stream."""
+        if not self._values:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        if self._sorted is None:
+            self._sorted = sorted(self._values)
+        v = self._sorted
+        pos = q * (len(v) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(v) - 1)
+        frac = pos - lo
+        return v[lo] * (1.0 - frac) + v[hi] * frac
+
+    def summary(self, percentiles=PERCENTILES) -> dict:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            **{
+                f"p{int(q * 100)}": self.quantile(q) for q in percentiles
+            },
+        }
+
+
+class ServingStats:
+    """Aggregate per-request ``decode`` events into the percentile block
+    ``obs summarize`` renders and ``obs diff`` gates on.
+
+    Cold requests (``warm`` false — the first request per generator pays
+    the XLA compile) are excluded from every distribution and reported
+    as a count: a p99 that is really "the compile happened" explains
+    nothing."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.acc = {name: QuantileAccumulator(capacity) for _, name in METRICS}
+        self.requests = 0
+        self.cold = 0
+        self.tokens = 0
+        self.prompt_tokens = 0
+
+    def observe(self, event: dict) -> None:
+        self.requests += 1
+        self.tokens += int(
+            event.get("new_tokens", 0) * event.get("batch", 1)
+        )
+        self.prompt_tokens += int(
+            event.get("prompt_len", 0) * event.get("batch", 1)
+        )
+        if not event.get("warm"):
+            self.cold += 1
+            return
+        for field, name in METRICS:
+            v = event.get(field)
+            if v is not None:
+                self.acc[name].add(v)
+
+    @classmethod
+    def from_events(cls, events: list[dict], capacity: int = 4096):
+        stats = cls(capacity)
+        for e in events:
+            if e.get("kind") == "decode":
+                stats.observe(e)
+        return stats
+
+    def summary(self) -> dict | None:
+        """The ``decode`` section of a run summary, or None when the run
+        had no decode requests at all."""
+        if not self.requests:
+            return None
+        rates = self.acc["tok_per_s"]
+        return {
+            "requests": self.requests,
+            "cold": self.cold,
+            "tokens": self.tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "mean_tok_per_s": rates.mean,
+            "percentiles": {
+                name: self.acc[name].summary()
+                for _field, name in METRICS
+                if self.acc[name].count
+            },
+        }
+
+
+def render_percentiles(p: dict) -> list[str]:
+    """The ``-- decode percentiles --`` table lines for a summary's
+    ``decode.percentiles`` block (stored-baseline dicts included)."""
+    lines = [f"{'metric':<14} {'p50':>9} {'p95':>9} {'p99':>9} {'mean':>9}"]
+    for name, s in p.items():
+        row = [f"{name:<14}"]
+        for key in ("p50", "p95", "p99", "mean"):
+            v = s.get(key)
+            row.append(f"{v:>9.4g}" if v is not None else f"{'n/a':>9}")
+        lines.append(" ".join(row))
+    return lines

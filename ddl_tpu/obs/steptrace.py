@@ -102,9 +102,17 @@ class StepTrace:
         writer: EventWriter,
         anomaly: AnomalyMonitor | None = None,
         emit_step_spans: bool | int = True,
+        capturer=None,
     ) -> None:
         self.writer = writer
-        self.anomaly = anomaly if anomaly is not None else AnomalyMonitor(writer)
+        # profile-on-anomaly (obs/profiler.TraceCapturer, or None): armed
+        # by the anomaly monitor, driven at step boundaries by phase()
+        self.capturer = capturer
+        if anomaly is None:
+            anomaly = AnomalyMonitor(writer, capturer=capturer)
+        elif capturer is not None and anomaly.capturer is None:
+            anomaly.capturer = capturer
+        self.anomaly = anomaly
         # span emission policy: False/0 = no per-step spans, True/1 =
         # every step, N > 1 = a 1-in-N sampler (steps where step % N == 0
         # emit their phase spans) — per-step visibility at 1/N of the
@@ -136,7 +144,12 @@ class StepTrace:
         samples 1-in-N steps — the operator dial for runs where two
         flushed JSONL writes per step onto a NAS is real overhead
         (10k-step periods); period events (phase totals, throughput,
-        anomalies) keep flowing either way."""
+        anomalies) keep flowing either way.
+
+        Profile-on-anomaly rides the same wiring: with ``DDL_OBS_PROFILE``
+        set (``obs/profiler.py``), anomaly firings arm a rate-limited
+        ``jax.profiler`` window over the next steps, and the resulting
+        ``profile_capture`` event lands in this writer's stream."""
         if emit_step_spans is None:
             env = os.environ.get("DDL_OBS_STEP_SPANS", "").lower()
             if env in ("0", "false", "off"):
@@ -147,7 +160,13 @@ class StepTrace:
                 emit_step_spans = 1
         writer = EventWriter(log_dir, job_id, host=host, **writer_kwargs)
         writer.emit("run_start", family=family, job_id=job_id)
-        return cls(writer, emit_step_spans=emit_step_spans)
+        from ddl_tpu.obs.profiler import capturer_from_env
+
+        capturer = capturer_from_env(
+            writer,
+            writer.path.parent / "xprof" / f"h{writer.host:03d}",
+        )
+        return cls(writer, emit_step_spans=emit_step_spans, capturer=capturer)
 
     def _span_due(self, name: str, step: int | None) -> bool:
         """The 1-in-N step-span sampler.  Only per-step phases are
@@ -163,6 +182,14 @@ class StepTrace:
 
     @contextmanager
     def phase(self, name: str, step: int | None = None, **fields):
+        if (
+            name == "step"
+            and step is not None
+            and self.capturer is not None
+        ):
+            # step boundary: start an armed profile window / close one
+            # whose step budget is spent (obs/profiler.TraceCapturer)
+            self.capturer.on_step(step)
         t0 = time.perf_counter()
         try:
             if self._span_due(name, step):
@@ -220,6 +247,7 @@ class StepTrace:
             raw = metrics.get("loss")
             loss = float(raw) if raw is not None else None
         steps_per_sec = steps / elapsed if elapsed > 0 else 0.0
+        compiles = self._compiles.count - self._period_compiles
         self.writer.emit(
             "period",
             step=idx,
@@ -229,7 +257,7 @@ class StepTrace:
             steps_per_sec=steps_per_sec,
             phases=phases,
             loss=loss,
-            compiles=self._compiles.count - self._period_compiles,
+            compiles=compiles,
             hbm_bytes_in_use=mem["bytes_in_use"] if mem else None,
             hbm_peak_bytes=mem["peak_bytes_in_use"] if mem else None,
         )
@@ -238,6 +266,7 @@ class StepTrace:
             loss=loss,
             steps_per_sec=steps_per_sec,
             hbm_bytes=mem["bytes_in_use"] if mem else None,
+            compiles=compiles,
         )
         self._period = None
         return phases
@@ -247,6 +276,10 @@ class StepTrace:
         and anomaly count, print what the detectors caught, close the
         stream.  Returns the anomaly list."""
         anomalies = self.anomaly.anomalies
+        if self.capturer is not None:
+            # close a profile window the run ended inside of (its
+            # profile_capture event must precede run_end/close)
+            self.capturer.finish()
         self.writer.emit(
             "run_end",
             phases=dict(self.run_totals),
@@ -261,6 +294,6 @@ class StepTrace:
         # reset per-run state so a second train() on the same trainer
         # reports its own segment, not cumulative double-counted totals
         self.run_totals = defaultdict(float)
-        self.anomaly = AnomalyMonitor(self.writer)
+        self.anomaly = AnomalyMonitor(self.writer, capturer=self.capturer)
         self._needs_run_start = True
         return anomalies

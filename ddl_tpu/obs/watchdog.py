@@ -56,6 +56,7 @@ class Watchdog:
         interval_s: float | None = None,
         on_stall: str = "dump",
         exit_fn=None,
+        capturer=None,
     ) -> None:
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
@@ -75,6 +76,11 @@ class Watchdog:
         # device wait and will never unwind an exception.
         self.on_stall = on_stall
         self._exit_fn = exit_fn
+        # profile-on-anomaly capturer (obs/profiler.TraceCapturer, or
+        # None): a hung step captures a short synchronous trace window —
+        # what the wedged device is actually executing — before the
+        # stall is escalated; rate-limited and never allowed to raise
+        self.capturer = capturer
         self.writer = writer
         self.deadline_s = float(deadline_s)
         # poll fast enough that a stall is caught within ~1.25 deadlines
@@ -136,6 +142,21 @@ class Watchdog:
                         action=self.on_stall,
                         stacks=thread_stacks(),
                     )
+                    if self.capturer is not None:
+                        # no step boundary will ever come on a wedged
+                        # host: capture a short synchronous window NOW,
+                        # before any escalation ends the process.  On a
+                        # side thread with a bounded join — stop_trace
+                        # can *block* (not raise) on a wedged device, and
+                        # the exit-75 relaunch must not wait on it
+                        cap = threading.Thread(
+                            target=self.capturer.capture_now,
+                            args=("hung_step",),
+                            kwargs={"step": step, "age": age},
+                            daemon=True,
+                        )
+                        cap.start()
+                        cap.join(timeout=10.0)
                     if self.on_stall == "exit":
                         self._escalate(step, age)
             else:

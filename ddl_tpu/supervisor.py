@@ -69,6 +69,7 @@ from __future__ import annotations
 import collections
 import os
 import subprocess
+import sys
 import time
 from pathlib import Path
 from typing import Callable
@@ -572,6 +573,11 @@ class PodSupervisor:
                 kind, detail = self._wait_done(epoch)
                 if kind == "done":
                     self._log("pod complete")
+                    # close the launch: coord.acquire_launch refuses to
+                    # re-admit hosts into a finished launch's markers, so
+                    # a lone relaunch opens a fresh subdir instead of
+                    # sailing through this run's start barrier
+                    rv.mark_finished(0)
                     self._emit("supervisor_done", rc=0, gave_up=False)
                     return 0
             if kind == "abort":
@@ -698,20 +704,37 @@ def supervise_pod_command(
     """Pod-mode supervision of ``argv`` (the CLI's ``--supervise --pod``).
 
     ``coord_dir`` must be one directory every host of the pod sees (the
-    checkpoint/log NAS) and must be FRESH per launch — scope it by job
-    (``/nas/<job>/coord``): the protocol's markers (barriers, epoch
-    ledger, abort) describe one pod lifetime, and stale ones from a
-    previous run would let a lone host sail through the start barrier or
-    replay an old give-up (a stale abort marker is refused loudly).
-    Children additionally get the rendezvous env (``DDL_COORD_*``) so
-    the stall watchdog can publish exit intent and
-    ``checkpoint.resolve_resume`` can run the rank-0 resume agreement,
-    plus ``DDL_RESTART_EPOCH`` for obs metadata."""
+    checkpoint/log NAS), scoped by job (``/nas/<job>/coord``).  The
+    rendezvous state itself is run-scoped below it:
+    ``coord.acquire_launch`` places each launch's markers (barriers,
+    epoch ledger, abort) in their own ``launches/`` subdir — joined by
+    token when the operator/scheduler provides ``DDL_LAUNCH_TOKEN``
+    (same value on every host, fresh per launch), else agreed
+    leaderlessly by atomic create — so a completed previous run's
+    markers can never admit a lone relaunched host into a pod that
+    isn't there (it opens a fresh launch, times out at its start
+    barrier, and aborts loudly).  An *unfinished* previous launch is
+    still joined as-is — relaunching into a crashed pod's directory
+    remains "use a fresh --pod dir" territory, and its stale abort
+    marker is refused loudly.  Children additionally get the rendezvous
+    env (``DDL_COORD_*``, pointing at the launch subdir) so the stall
+    watchdog can publish exit intent and ``checkpoint.resolve_resume``
+    can run the rank-0 resume agreement, plus ``DDL_RESTART_EPOCH`` for
+    obs metadata."""
     from ddl_tpu import coord
 
     base_env = dict(os.environ if env is None else env)
+    try:
+        launch_root = coord.acquire_launch(
+            coord_dir, token=base_env.get("DDL_LAUNCH_TOKEN")
+        )
+    except RuntimeError as e:
+        # stale DDL_LAUNCH_TOKEN naming a closed launch: an operator
+        # error, not a crash — report it without a traceback
+        print(f"[pod-supervisor h{host}] {e}", file=sys.stderr)
+        return 1
     rv = coord.Rendezvous(
-        coord_dir, host, n_hosts,
+        launch_root, host, n_hosts,
         timeout_s=float(
             base_env.get(coord.ENV_TIMEOUT) or coord.DEFAULT_TIMEOUT_S
         ),
@@ -723,7 +746,7 @@ def supervise_pod_command(
         child_env["DDL_SUPERVISED"] = "1"
         child_env["DDL_RESTART_COUNT"] = str(restart_index)
         child_env[coord.ENV_EPOCH] = str(restart_epoch)
-        child_env[coord.ENV_DIR] = str(coord_dir)
+        child_env[coord.ENV_DIR] = str(launch_root)
         child_env[coord.ENV_HOSTS] = str(n_hosts)
         child_env[coord.ENV_HOST] = str(host)
         child_env.setdefault("DDL_HOST_ID", str(host))

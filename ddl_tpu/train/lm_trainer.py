@@ -134,7 +134,7 @@ class LMTrainer(BaseTrainer):
             if run.log_dir
             else None
         )
-        self._init_obs(run.log_dir, run.job_id, "lm", proc)
+        self._init_obs(run.log_dir, run.job_id, "lm")
         self.halt_on_nan = run.halt_on_nan
         from ddl_tpu.train.recovery import make_policy
 

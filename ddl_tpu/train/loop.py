@@ -223,14 +223,21 @@ class BaseTrainer:
                 "io_retry", error=str(exc), attempt=attempt
             )
 
-    def _init_obs(self, log_dir, job_id: str, family: str, host: int) -> None:
+    def _init_obs(self, log_dir, job_id: str, family: str) -> None:
         """Shared trainer wiring for the structured event stream (every
         host writes its own file; obs/events.py).  No-op without a log
-        dir, so the obs story tracks the CSV one."""
+        dir, so the obs story tracks the CSV one.
+
+        File attribution goes through ``launch.host_id`` — the launcher
+        env (``DDL_HOST_ID``/``DDL_PROCESS_ID``) wins over the JAX
+        process index.  Identical on a real multihost pod, but sim-pod
+        children are each JAX process 0 and must not merge into one
+        stream (``obs pod`` attributes skew by stream)."""
         if log_dir:
+            from ddl_tpu.launch import host_id
             from ddl_tpu.obs import StepTrace
 
-            self.obs = StepTrace.create(log_dir, job_id, family, host=host)
+            self.obs = StepTrace.create(log_dir, job_id, family, host=host_id())
 
     @property
     def best_label(self) -> str:
@@ -308,7 +315,8 @@ class BaseTrainer:
                 # resumable -> relaunch, instead of hanging forever
                 action = os.environ.get("DDL_WATCHDOG_ACTION", "dump")
                 watchdog = Watchdog(
-                    obs.writer, deadline, on_stall=action
+                    obs.writer, deadline, on_stall=action,
+                    capturer=obs.capturer,
                 ).start()
                 obs.watchdog = watchdog
         try:

@@ -160,7 +160,7 @@ class Trainer(BaseTrainer):
             model_start_job_id=self._resume_job,
         )
         self.is_logging_process = proc == 0
-        self._init_obs(cfg.train.log_dir, self.job_id, "cnn", proc)
+        self._init_obs(cfg.train.log_dir, self.job_id, "cnn")
         self.epochs_run = 0
         # shared-loop knobs (train/loop.BaseTrainer)
         self.num_periods = cfg.train.max_epochs

@@ -116,7 +116,7 @@ class ViTTrainer(BaseTrainer):
             if run.log_dir
             else None
         )
-        self._init_obs(run.log_dir, run.job_id, "vit", proc)
+        self._init_obs(run.log_dir, run.job_id, "vit")
         self.num_periods = run.epochs
         self.halt_on_nan = run.halt_on_nan
         from ddl_tpu.train.recovery import make_policy

@@ -8,6 +8,12 @@ point, with no hardware involved:
     DDL_FAULT="preempt@step:12"        preemption signal at global step 12
     DDL_FAULT="crash@step:8"           raise InjectedCrash at step 8
     DDL_FAULT="nan@step:5"             poison the enclosing period's loss
+    DDL_FAULT="spike@step:5"           multiply the enclosing period's loss
+                                       by arg (default 1e3) — a FINITE
+                                       divergence, the shape the rolling
+                                       loss-spike detector (and the
+                                       profile-on-anomaly capture it
+                                       arms) exists to catch
     DDL_FAULT="nan@grad:5"             non-finite GRADIENT at step 5, inside
                                        the compiled step (a traced lax.cond
                                        in the step factories — a real
@@ -67,7 +73,7 @@ __all__ = [
     "traced_nan_step",
 ]
 
-KINDS = ("preempt", "crash", "nan", "stall", "corrupt_ckpt", "io")
+KINDS = ("preempt", "crash", "nan", "spike", "stall", "corrupt_ckpt", "io")
 
 
 class InjectedCrash(RuntimeError):
@@ -130,6 +136,7 @@ class FaultInjector:
         self.specs = specs
         self.counts: dict[str, int] = {}
         self.nan_pending = False
+        self.spike_scale = None  # pending finite loss-spike multiplier
         self.log: list[tuple[str, str, int]] = []  # (kind, site, coord)
 
     @classmethod
@@ -231,7 +238,7 @@ def check_step(step: int, guard=None) -> None:
     if inj is None:
         return
     for f in inj.fire(
-        "step", at=step, kinds=("preempt", "crash", "stall", "nan")
+        "step", at=step, kinds=("preempt", "crash", "stall", "nan", "spike")
     ):
         if f.kind == "preempt":
             if guard is not None:
@@ -242,17 +249,26 @@ def check_step(step: int, guard=None) -> None:
             time.sleep(f.arg if f.arg else 30.0)
         elif f.kind == "nan":
             inj.nan_pending = True
+        elif f.kind == "spike":
+            inj.spike_scale = f.arg if f.arg else 1e3
 
 
 def poison_loss(metrics: dict) -> dict:
     """Period-end hook (``train/loop.py``): if a ``nan`` fault fired this
     period, replace the loss with NaN so the recovery policy sees exactly
-    what a diverged step produces."""
+    what a diverged step produces; a ``spike`` fault instead multiplies
+    it by the spec's arg — a finite excursion for the loss-spike
+    detector's trigger path."""
     inj = active()
     if inj is not None and inj.nan_pending:
         inj.nan_pending = False
         metrics = dict(metrics)
         metrics["loss"] = float("nan")
+    elif inj is not None and inj.spike_scale is not None:
+        scale, inj.spike_scale = inj.spike_scale, None
+        metrics = dict(metrics)
+        if metrics.get("loss") is not None:
+            metrics["loss"] = float(metrics["loss"]) * scale
     return metrics
 
 

@@ -59,9 +59,14 @@ def main() -> None:
                     "also streams weight-only int8 matmul kernels — the "
                     "HBM-traffic levers for the bandwidth-bound decode")
     ap.add_argument("--obs-log-dir", default=None,
-                    help="emit per-request decode telemetry (tokens/s, "
-                    "dispatch/wait spans) into this log dir's event "
-                    "stream; inspect with `ddl_tpu obs summarize`")
+                    help="emit per-request decode telemetry (lengths, "
+                    "latency, queue delay, TTFT, tokens/s; dispatch/wait "
+                    "spans) into this log dir's event stream; inspect "
+                    "with `ddl_tpu obs summarize` (p50/p95/p99 table)")
+    ap.add_argument("--requests", type=int, default=1,
+                    help="decode the prompt batch this many times (the "
+                    "first request pays the XLA compile and is flagged "
+                    "cold; >= 4 gives the obs percentiles a warm sample)")
     ap.add_argument("--cpu-devices", type=int, default=0)
     args = ap.parse_args()
 
@@ -165,6 +170,13 @@ def main() -> None:
         np.random.default_rng(args.seed), args.batch, args.prompt_len
     )
 
+    from time import perf_counter
+
+    for _ in range(max(0, args.requests - 1)):
+        # warm serving requests for the percentile accumulators; the
+        # submit timestamp exercises the queue-delay field
+        gen(state.params, jnp.asarray(prompts),
+            jax.random.key(args.seed), submitted_at=perf_counter())
     toks = np.asarray(gen(state.params, jnp.asarray(prompts),
                           jax.random.key(args.seed)))
     # score the continuations under the true chain: fraction of steps that

@@ -90,6 +90,9 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--seq-len", type=int, default=256)
     ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--log-every", type=int, default=10,
+                    help="console/CSV/obs period cadence in steps (1 = "
+                    "per-step periods, the finest anomaly-detector feed)")
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--warmup", type=int, default=0,
                     help="linear LR warmup steps")
@@ -201,6 +204,7 @@ def main() -> None:
         batch=args.batch,
         seq_len=args.seq_len,
         steps=args.steps,
+        log_every=args.log_every,
         num_microbatches=args.microbatches,
         accum_steps=args.accum,
         pipeline_schedule=args.pipeline_schedule,

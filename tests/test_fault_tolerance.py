@@ -897,7 +897,7 @@ def test_obs_diff_against_stored_baseline(tmp_path, capsys):
     obs_main(["diff", "fast", "--log-dir", str(logs),
               "--baseline", str(base), "--fail-slowdown", "0.5"])
     out = capsys.readouterr().out
-    assert "OK: throughput within" in out
+    assert "OK: within the" in out and "steps/s" in out
 
     # regression beyond the gate fails loudly
     with pytest.raises(SystemExit, match="FAIL"):

@@ -1,0 +1,706 @@
+"""The PR-5 diagnosis layer: anomaly-triggered profile capture
+(``obs/profiler.py``), serving-side percentiles (``obs/serving.py``),
+and the pod-wide cross-host view (``obs/pod.py`` / ``obs pod``).
+
+Unit tier is stdlib-only (fake tracers/clocks, synthetic event
+streams); the e2e at the bottom drives a real CPU-JAX training run
+where an injected loss spike produces a real ``jax.profiler`` trace
+directory and a ``profile_capture`` event with an op digest.
+"""
+
+import json
+import sys
+
+import numpy as np
+import pytest
+
+
+def _run_main(module, argv):
+    old = sys.argv
+    sys.argv = [module.__name__] + argv
+    try:
+        module.main()
+    finally:
+        sys.argv = old
+
+
+# ---------------------------------------------------------------------------
+# quantile accumulator
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_accumulator_exact_matches_numpy():
+    from ddl_tpu.obs.serving import QuantileAccumulator
+
+    rng = np.random.default_rng(0)
+    for stream in (
+        rng.exponential(2.0, size=500),
+        rng.normal(10.0, 3.0, size=37),
+        np.array([4.2]),
+        np.arange(100.0),
+    ):
+        acc = QuantileAccumulator(capacity=1000)
+        for x in stream:
+            acc.add(float(x))
+        for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+            assert acc.quantile(q) == pytest.approx(
+                float(np.quantile(stream, q)), rel=1e-12, abs=1e-12
+            ), (q, len(stream))
+        assert acc.mean == pytest.approx(float(stream.mean()))
+        assert acc.min == float(stream.min())
+        assert acc.max == float(stream.max())
+        assert acc.count == len(stream)
+
+
+def test_quantile_accumulator_reservoir_beyond_capacity():
+    """Past capacity the reservoir is a uniform sample: bounded memory,
+    quantiles within a few percent of exact on a smooth stream, exact
+    count/mean/min/max either way."""
+    from ddl_tpu.obs.serving import QuantileAccumulator
+
+    rng = np.random.default_rng(1)
+    stream = rng.exponential(1.0, size=50_000)
+    acc = QuantileAccumulator(capacity=2048)
+    for x in stream:
+        acc.add(float(x))
+    assert acc.count == 50_000
+    assert len(acc._values) == 2048
+    assert acc.mean == pytest.approx(float(stream.mean()))
+    for q in (0.5, 0.95):
+        exact = float(np.quantile(stream, q))
+        assert acc.quantile(q) == pytest.approx(exact, rel=0.1), q
+    # deterministic: the same stream gives the same reservoir
+    acc2 = QuantileAccumulator(capacity=2048)
+    for x in stream:
+        acc2.add(float(x))
+    assert acc.quantile(0.95) == acc2.quantile(0.95)
+
+
+def test_quantile_accumulator_validation():
+    from ddl_tpu.obs.serving import QuantileAccumulator
+
+    with pytest.raises(ValueError):
+        QuantileAccumulator(capacity=0)
+    acc = QuantileAccumulator()
+    assert acc.quantile(0.5) is None  # empty stream
+    acc.add(1.0)
+    with pytest.raises(ValueError):
+        acc.quantile(1.5)
+
+
+# ---------------------------------------------------------------------------
+# serving stats over decode events
+# ---------------------------------------------------------------------------
+
+
+def _decode_event(dur, warm=True, **over):
+    e = {
+        "kind": "decode", "prompt_len": 8, "new_tokens": 16, "batch": 2,
+        "dur": dur, "queue_delay": dur / 10, "ttft": dur / 4,
+        "tok_per_s": 32 / dur, "warm": warm,
+    }
+    e.update(over)
+    return e
+
+
+def test_serving_stats_percentiles_exclude_cold():
+    from ddl_tpu.obs.serving import ServingStats
+
+    events = [_decode_event(50.0, warm=False)]  # the compile request
+    events += [_decode_event(d) for d in (1.0, 2.0, 3.0, 4.0)]
+    s = ServingStats.from_events(events).summary()
+    assert s["requests"] == 5 and s["cold"] == 1
+    assert s["tokens"] == 5 * 32 and s["prompt_tokens"] == 5 * 16
+    lat = s["percentiles"]["latency_s"]
+    assert lat["count"] == 4
+    assert lat["p50"] == pytest.approx(2.5)  # the 50s cold outlier excluded
+    assert lat["max"] == 4.0
+    assert s["percentiles"]["queue_delay_s"]["p50"] == pytest.approx(0.25)
+    assert s["percentiles"]["ttft_s"]["p99"] <= 1.0
+    assert s["mean_tok_per_s"] == pytest.approx(
+        float(np.mean([32 / d for d in (1.0, 2.0, 3.0, 4.0)]))
+    )
+
+
+def test_summarize_and_render_decode_percentiles(tmp_path, capsys):
+    """`obs summarize` renders the p50/p95/p99 table from a stream of
+    enriched decode events; `obs diff --fail-slowdown` gates on p95
+    latency when both sides carry percentiles."""
+    from ddl_tpu import cli
+    from ddl_tpu.obs import EventWriter
+
+    def write_job(job, durs):
+        w = EventWriter(tmp_path, job, host=0)
+        w.emit("decode", **{
+            k: v for k, v in _decode_event(30.0, warm=False).items()
+            if k != "kind"
+        })
+        for d in durs:
+            w.emit("decode", **{
+                k: v for k, v in _decode_event(d).items() if k != "kind"
+            })
+        w.close()
+
+    write_job("fast", [1.0, 1.1, 1.2, 1.3])
+    write_job("slow", [2.6, 2.7, 2.8, 2.9])
+
+    cli.main(["obs", "summarize", "fast", "--log-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "decode percentiles" in out
+    for metric in ("latency_s", "queue_delay_s", "ttft_s", "tok_per_s"):
+        assert metric in out
+    assert "p50" in out and "p95" in out and "p99" in out
+    assert "1 cold excluded" in out
+
+    # two-job diff renders the percentile delta rows and the gate trips
+    # on the >100% latency inflation
+    with pytest.raises(SystemExit, match="p95 latency"):
+        cli.main([
+            "obs", "diff", "fast", "slow", "--log-dir", str(tmp_path),
+            "--fail-slowdown", "0.5",
+        ])
+    out = capsys.readouterr().out
+    assert "latency_s:p95" in out
+
+    # within tolerance passes and says which gates ran
+    cli.main([
+        "obs", "diff", "fast", "fast", "--log-dir", str(tmp_path),
+        "--fail-slowdown", "0.5",
+    ])
+    out = capsys.readouterr().out
+    assert "OK" in out and "decode p95 latency" in out
+
+    # a stored baseline round-trips the percentile fields
+    cli.main([
+        "obs", "baseline", "fast", "--log-dir", str(tmp_path),
+        "--out", str(tmp_path / "base.json"),
+    ])
+    capsys.readouterr()
+    stored = json.loads((tmp_path / "base.json").read_text())
+    assert stored["summary"]["decode"]["percentiles"]["latency_s"]["p95"]
+    with pytest.raises(SystemExit, match="p95 latency"):
+        cli.main([
+            "obs", "diff", "slow", "--log-dir", str(tmp_path),
+            "--baseline", str(tmp_path / "base.json"),
+            "--fail-slowdown", "0.5",
+        ])
+
+
+# ---------------------------------------------------------------------------
+# trace capturer (fake tracer + clock: no JAX)
+# ---------------------------------------------------------------------------
+
+
+class _FakeTracer:
+    def __init__(self):
+        self.started = []
+        self.stopped = 0
+        self.active = False
+
+    def start(self, d):
+        assert not self.active, "double start_trace"
+        self.active = True
+        self.started.append(d)
+
+    def stop(self):
+        assert self.active, "stop without start"
+        self.active = False
+        self.stopped += 1
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _capturer(writer, tmp_path, **kw):
+    from ddl_tpu.obs.profiler import TraceCapturer
+
+    tracer = _FakeTracer()
+    clock = _Clock()
+    cap = TraceCapturer(
+        writer, tmp_path / "xprof", clock=clock,
+        tracer_start=tracer.start, tracer_stop=tracer.stop,
+        digest_fn=lambda d: {"total_ms": 1.0, "ops": {"dot": 1.0}},
+        **kw,
+    )
+    return cap, tracer, clock
+
+
+def test_capturer_window_cooldown_and_cap(tmp_path):
+    from ddl_tpu.obs import EventWriter, read_events
+
+    w = EventWriter(tmp_path, "job", host=0)
+    cap, tracer, clock = _capturer(
+        w, tmp_path, steps=2, max_captures=2, cooldown_s=100.0
+    )
+    # no trigger: steps pass untraced
+    cap.on_step(0)
+    assert not tracer.started
+
+    assert cap.trigger("loss_spike", step=0) is True
+    # triggers while armed/active are absorbed, not re-armed
+    assert cap.trigger("loss_spike", step=0) is False
+    cap.on_step(1)  # arms -> starts
+    assert len(tracer.started) == 1 and tracer.active
+    assert cap.trigger("hbm_growth", step=1) is False
+    cap.on_step(2)  # 1 step in window: still tracing
+    assert tracer.active
+    cap.on_step(3)  # window of 2 complete: stop + emit
+    assert not tracer.active and cap.captures == 1
+
+    # cooldown: a fresh trigger inside it is absorbed...
+    clock.t = 50.0
+    assert cap.trigger("throughput_regression", step=4) is False
+    # ...and admitted after it
+    clock.t = 150.0
+    assert cap.trigger("throughput_regression", step=5) is True
+    cap.on_step(6)
+    cap.on_step(8)  # deadline passed (6 + 2): closes
+    assert cap.captures == 2
+
+    # K-cap: no third capture this run
+    clock.t = 1000.0
+    assert cap.trigger("loss_spike", step=9) is False
+    cap.on_step(10)
+    assert len(tracer.started) == 2
+
+    w.close()
+    events = read_events(w.path)
+    captures = [e for e in events if e["kind"] == "profile_capture"]
+    assert len(captures) == 2
+    first, second = captures
+    assert first["ok"] and first["trigger"] == "loss_spike"
+    assert first["trace_dir"] == tracer.started[0]
+    assert first["digest"]["ops"] == {"dot": 1.0}
+    assert first["steps"] == 2 and first["first_step"] == 1
+    # the three absorbed triggers are accounted on the next capture
+    assert first["suppressed"] == 2  # armed-dup + active-dup
+    assert second["suppressed"] == 1  # the cooldown-absorbed one
+
+
+def test_capturer_finish_closes_open_window(tmp_path):
+    from ddl_tpu.obs import EventWriter, read_events
+
+    w = EventWriter(tmp_path, "job2", host=0)
+    cap, tracer, _clock = _capturer(w, tmp_path, steps=5)
+    cap.trigger("loss_spike", step=3)
+    cap.on_step(4)
+    assert tracer.active
+    cap.finish()  # run ended inside the window
+    assert not tracer.active and cap.captures == 1
+    w.close()
+    (c,) = [e for e in read_events(w.path) if e["kind"] == "profile_capture"]
+    assert c["ok"] and c["trigger"] == "loss_spike"
+
+
+def test_capturer_capture_now_and_failure_disables(tmp_path):
+    from ddl_tpu.obs import EventWriter, read_events
+    from ddl_tpu.obs.profiler import TraceCapturer
+
+    w = EventWriter(tmp_path, "job3", host=0)
+    cap, tracer, _clock = _capturer(w, tmp_path, steps=2)
+    assert cap.capture_now("hung_step", window_s=0.0, step=7) is True
+    assert cap.captures == 1 and not tracer.active
+
+    # a tracer that raises must disable the capturer, never propagate
+    # (the watchdog thread calls this right before os._exit)
+    def boom(d):
+        raise RuntimeError("profiler unavailable")
+
+    w2 = EventWriter(tmp_path, "job4", host=0)
+    cap2 = TraceCapturer(
+        w2, tmp_path / "xprof2", tracer_start=boom, tracer_stop=lambda: None
+    )
+    assert cap2.capture_now("hung_step") is False
+    assert cap2.disabled
+    assert cap2.trigger("loss_spike") is False  # stays off
+    w2.close()
+    (e,) = [
+        ev for ev in read_events(w2.path) if ev["kind"] == "profile_capture"
+    ]
+    assert e["ok"] is False and e["disabled"] is True
+    w.close()
+
+
+def test_watchdog_stall_captures_before_escalation(tmp_path):
+    """A hung step has no upcoming step boundary: the watchdog calls
+    the capturer's synchronous path when the stall fires, so the trace
+    (what the wedged device is executing) exists before any
+    escalation ends the process."""
+    import time as _time
+
+    from ddl_tpu.obs import EventWriter, Watchdog, read_events
+
+    w = EventWriter(tmp_path, "wd-job", host=0)
+    cap, tracer, _clock = _capturer(w, tmp_path, steps=2)
+    with Watchdog(w, deadline_s=0.15, interval_s=0.03, capturer=cap) as wd:
+        wd.beat(5)
+        _time.sleep(0.6)  # the stalled "step"
+    w.close()
+    events = read_events(w.path)
+    assert [e for e in events if e["kind"] == "stall"]
+    (c,) = [e for e in events if e["kind"] == "profile_capture"]
+    assert c["ok"] and c["trigger"] == "hung_step" and c["step"] == 5
+    assert len(tracer.started) == 1 and not tracer.active
+
+
+def test_capturer_step_hook_tolerates_sync_window(tmp_path):
+    """Regression: a capture_now window (deadline_step None) in flight on
+    the watchdog thread must not crash a concurrent trainer-thread
+    on_step with a TypeError — and the non-blocking paths absorb rather
+    than stall when the lock is held."""
+    import threading
+
+    from ddl_tpu.obs import EventWriter
+
+    w = EventWriter(tmp_path, "job-race", host=0)
+    cap, tracer, _clock = _capturer(w, tmp_path, steps=2)
+    cap._active = {"trigger": "hung_step", "trigger_step": 3,
+                   "trace_dir": str(tmp_path), "steps": None,
+                   "deadline_step": None}
+    cap.on_step(4)  # previously: '>=' between int and None
+    assert cap._active is not None  # sync window untouched
+    cap._active = None
+
+    # lock held elsewhere: trigger/on_step return immediately
+    with cap._lock:
+        done = []
+
+        def worker():
+            assert cap.trigger("loss_spike", step=1) is False
+            cap.on_step(2)
+            done.append(True)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(timeout=5.0)
+        assert done, "trainer-thread hooks blocked on the capturer lock"
+    assert cap.suppressed == 1
+    w.close()
+
+
+def test_capturer_finish_drops_stale_armed_trigger(tmp_path):
+    """A trigger armed on the final step must not leak a capture (with
+    the old run's attribution) into a later train() segment."""
+    from ddl_tpu.obs import EventWriter
+
+    w = EventWriter(tmp_path, "job-stale", host=0)
+    cap, tracer, _clock = _capturer(w, tmp_path, steps=2)
+    assert cap.trigger("loss_spike", step=9) is True
+    cap.finish()  # run ended before any step boundary
+    assert cap._armed is None and cap.suppressed == 1
+    cap.on_step(0)  # second segment: nothing starts
+    assert not tracer.started
+    w.close()
+
+
+def test_capturer_from_env_scopes_override_dir(tmp_path, monkeypatch):
+    """DDL_OBS_PROFILE_DIR is pod-shared (supervisors propagate env):
+    the capturer scopes it per host, and relaunched incarnations
+    (restart epoch > 0) get their own subdir because the capture
+    counter resets per process."""
+    import os as _os
+
+    from ddl_tpu.obs import EventWriter
+    from ddl_tpu.obs.profiler import capturer_from_env
+
+    w = EventWriter(tmp_path, "job-env", host=2)
+    env = {"DDL_OBS_PROFILE": "1", "DDL_OBS_PROFILE_DIR": str(tmp_path / "nas")}
+    cap = capturer_from_env(w, tmp_path / "default", env=env)
+    assert cap.trace_root == _os.path.join(str(tmp_path / "nas"), "h002")
+
+    env["DDL_RESTART_EPOCH"] = "1"
+    cap = capturer_from_env(w, tmp_path / "default", env=env)
+    assert cap.trace_root == _os.path.join(
+        str(tmp_path / "nas"), "h002", "r1"
+    )
+
+    # no override: the per-host default root is used as-is (epoch 0)
+    del env["DDL_OBS_PROFILE_DIR"]
+    env["DDL_RESTART_EPOCH"] = "0"
+    cap = capturer_from_env(w, tmp_path / "default", env=env)
+    assert cap.trace_root == str(tmp_path / "default")
+    w.close()
+
+
+def test_anomaly_monitor_arms_capturer(tmp_path):
+    from ddl_tpu.obs import AnomalyMonitor, EventWriter
+
+    w = EventWriter(tmp_path, "job5", host=0)
+    cap, tracer, _clock = _capturer(w, tmp_path, steps=1)
+    mon = AnomalyMonitor(w, capturer=cap)
+    for i in range(8):
+        mon.observe_period(i, loss=1.0)
+    mon.observe_period(8, loss=9.0)  # spike -> trigger
+    cap.on_step(9)
+    cap.on_step(10)
+    assert cap.captures == 1
+    # record() (externally-detected anomalies) arms too
+    mon2 = AnomalyMonitor(w, capturer=cap)
+    mon2.record(3, "nonfinite_loss", value=float("nan"))
+    assert cap.suppressed >= 1 or cap._armed is not None
+    w.close()
+
+
+def test_throughput_suppressed_after_recompile():
+    """A period that recompiled is neither judged nor admitted to the
+    trailing window: a known compile stall must not fire the detector
+    (or burn a profile capture), and its depressed steps/s must not
+    drag the baseline."""
+    from ddl_tpu.obs import AnomalyMonitor, ThroughputRegressionDetector
+
+    det = ThroughputRegressionDetector(window=10, drop=0.3, min_points=5)
+    for _ in range(8):
+        assert det.observe(100.0) is None
+    # the compile-stalled period would trip the detector...
+    assert det.observe(10.0, suppress=True) is None
+    assert det.suppressed == 1
+    # ...and did not contaminate the baseline for the next real one
+    a = det.observe(10.0)
+    assert a and a["baseline"] == pytest.approx(100.0)
+
+    # monitor plumbing: compiles > 0 suppresses only the throughput leg
+    mon = AnomalyMonitor()
+    for i in range(8):
+        mon.observe_period(i, loss=1.0, steps_per_sec=100.0)
+    found = mon.observe_period(8, loss=9.0, steps_per_sec=10.0, compiles=1)
+    assert {a["type"] for a in found} == {"loss_spike"}
+    found = mon.observe_period(9, loss=1.0, steps_per_sec=10.0)
+    assert {a["type"] for a in found} == {"throughput_regression"}
+
+
+# ---------------------------------------------------------------------------
+# pod-wide aggregation (synthetic 3-host streams)
+# ---------------------------------------------------------------------------
+
+
+def _write_host_stream(
+    log_dir, job, host, periods=4, step_s=0.10, wait_s=0.02
+):
+    """One host's synthetic stream: period events with a phase breakdown
+    plus a barrier event and one anomaly on host 0."""
+    from ddl_tpu.obs import EventWriter
+
+    w = EventWriter(log_dir, job, host=host, run_id=f"r{host}")
+    for p in range(periods):
+        steps = 10
+        elapsed = (step_s + wait_s) * steps + 0.01
+        w.emit(
+            "period", step=p, period=p, steps=steps, elapsed=elapsed,
+            steps_per_sec=steps / elapsed,
+            phases={
+                "step": step_s * steps, "data_wait": wait_s * steps,
+                "fence": 0.001,
+            },
+        )
+    w.emit("coord_barrier", name="start", wait=0.5 * (host + 1))
+    if host == 0:
+        w.emit("anomaly", step=2, type="loss_spike", value=9.9)
+        w.emit(
+            "profile_capture", step=2, ok=True, trigger="loss_spike",
+            trace_dir="/tmp/x", digest={"ops": {"dot": 1.0}, "top_op": "dot.3"},
+        )
+    w.close()
+
+
+def test_obs_pod_straggler_and_barriers(tmp_path, capsys):
+    from ddl_tpu import cli
+    from ddl_tpu.obs.pod import load_pod, pod_summary, render_pod_summary
+
+    job = "pod-job"
+    # host 1 is the injected straggler: 2x step time, extra data_wait
+    _write_host_stream(tmp_path, job, 0)
+    _write_host_stream(tmp_path, job, 1, step_s=0.20, wait_s=0.05)
+    _write_host_stream(tmp_path, job, 2)
+
+    streams = load_pod(tmp_path, job)
+    assert sorted(streams) == [0, 1, 2]
+    s = pod_summary(streams)
+    assert s["shared_periods"] == 4
+    assert s["straggler"] is not None and s["straggler"]["host"] == 1
+    assert s["straggler"]["ratio"] > 1.5
+    assert s["skew"][1]["step_s"] == pytest.approx(2.0, rel=0.01)
+    # barrier attribution: per-host waits recorded under the name
+    assert s["barriers"]["start"][2] == pytest.approx(1.5)
+    assert s["hosts"][0]["anomalies"] == 1
+    assert s["hosts"][0]["captures"] == 1
+
+    text = render_pod_summary(s, job)
+    assert "<-- straggler" in text
+    straggler_line = next(
+        ln for ln in text.splitlines() if "<-- straggler" in ln
+    )
+    assert straggler_line.startswith("h1")
+    assert "barrier waits" in text
+    assert "profile_capture:loss_spike" in text  # on the timeline
+
+    # the CLI end of it
+    cli.main(["obs", "pod", job, "--log-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "straggler: h1" in out
+    assert "timeline" in out
+    cli.main(["obs", "pod", job, "--log-dir", str(tmp_path), "--json"])
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["straggler"]["host"] == 1
+
+    with pytest.raises(SystemExit, match="no events"):
+        cli.main(["obs", "pod", "nosuch", "--log-dir", str(tmp_path)])
+
+
+def test_obs_pod_no_straggler_on_balanced_pod(tmp_path):
+    from ddl_tpu.obs.pod import load_pod, pod_summary, render_pod_summary
+
+    job = "balanced"
+    for h in range(3):
+        _write_host_stream(tmp_path, job, h)
+    s = pod_summary(load_pod(tmp_path, job))
+    assert s["straggler"] is None
+    assert "no straggler" in render_pod_summary(s, job)
+
+
+# ---------------------------------------------------------------------------
+# run-scoped rendezvous (launch-token subdirs)
+# ---------------------------------------------------------------------------
+
+
+def test_acquire_launch_scopes_and_refuses_closed(tmp_path):
+    from ddl_tpu.coord import Rendezvous, acquire_launch, active_launch_root
+
+    # first launch: all hosts of a fresh pod join the same subdir
+    a0 = acquire_launch(tmp_path)
+    a1 = acquire_launch(tmp_path)
+    assert a0 == a1 == tmp_path / "launches" / "L0001"
+
+    # a completed launch is closed: the next acquire opens a NEW subdir
+    # (a lone relaunched host cannot rejoin the finished run's barriers)
+    rv = Rendezvous(a0, 0, 2, timeout_s=1.0)
+    rv.arrive("start")  # the stale marker the scoping defuses
+    rv.mark_finished(0)
+    b0 = acquire_launch(tmp_path)
+    assert b0 == tmp_path / "launches" / "L0002"
+    assert not (b0 / "barriers").exists()  # fresh marker space
+
+    # an aborted launch counts as closed too
+    rv2 = Rendezvous(b0, 0, 2, timeout_s=1.0)
+    rv2.abort("boom", 1)
+    assert acquire_launch(tmp_path) == tmp_path / "launches" / "L0003"
+
+    # an UNfinished launch is joined as-is (crashed-pod relaunch keeps
+    # its documented fresh-dir semantics)
+    assert acquire_launch(tmp_path) == tmp_path / "launches" / "L0003"
+
+    # explicit operator token pins the subdir
+    t = acquire_launch(tmp_path, token="job-incarnation-7")
+    assert t == tmp_path / "launches" / "t-job-incarnation-7"
+    assert acquire_launch(tmp_path, token="job-incarnation-7") == t
+
+    # a stale token naming a CLOSED launch is refused loudly — a lone
+    # host relaunched with the finished run's DDL_LAUNCH_TOKEN must not
+    # re-enter its fully-arrived barriers
+    Rendezvous(t, 0, 2, timeout_s=1.0).mark_finished(0)
+    with pytest.raises(RuntimeError, match="finished/aborted"):
+        acquire_launch(tmp_path, token="job-incarnation-7")
+
+    assert active_launch_root(tmp_path) is not None
+    assert active_launch_root(tmp_path / "nothing") is None
+
+
+def test_mark_finished_first_writer_wins(tmp_path):
+    from ddl_tpu.coord import Rendezvous
+
+    rv0 = Rendezvous(tmp_path, 0, 2, timeout_s=1.0)
+    rv1 = Rendezvous(tmp_path, 1, 2, timeout_s=1.0)
+    first = rv0.mark_finished(0)
+    second = rv1.mark_finished(3, reason="late")
+    assert second == first and first["host"] == 0 and first["rc"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fault injection: the finite loss-spike kind
+# ---------------------------------------------------------------------------
+
+
+def test_spike_fault_poisons_loss_finitely():
+    from ddl_tpu.utils import faultinject
+
+    inj = faultinject.activate("spike@step:3:100")
+    try:
+        for step in range(3):
+            faultinject.check_step(step)
+        assert faultinject.poison_loss({"loss": 2.0})["loss"] == 2.0
+        faultinject.check_step(3)
+        poisoned = faultinject.poison_loss({"loss": 2.0})
+        assert poisoned["loss"] == pytest.approx(200.0)
+        assert np.isfinite(poisoned["loss"])
+        # consumed: later periods run clean
+        faultinject.check_step(4)
+        assert faultinject.poison_loss({"loss": 2.0})["loss"] == 2.0
+        assert inj.log == [("spike", "step", 3)]
+    finally:
+        faultinject.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# e2e: injected loss spike -> one rate-limited jax.profiler capture
+# ---------------------------------------------------------------------------
+
+
+def test_spike_triggers_one_profile_capture_e2e(tmp_path, monkeypatch):
+    """The acceptance scenario on CPU JAX: a DDL_FAULT-induced loss
+    spike fires the loss-spike detector, which arms the capturer; the
+    next steps run under a REAL ``jax.profiler`` trace; exactly one
+    ``profile_capture`` event lands, carrying an existing trace dir and
+    an xprof op digest."""
+    import examples.train_lm as train_lm
+
+    from ddl_tpu.obs import read_events
+    from ddl_tpu.obs.events import events_path
+    from ddl_tpu.utils import faultinject
+
+    log_dir = tmp_path / "logs"
+    monkeypatch.setenv("DDL_FAULT", "spike@step:6")
+    monkeypatch.setenv("DDL_OBS_PROFILE", "1")
+    monkeypatch.setenv("DDL_OBS_PROFILE_STEPS", "2")
+    monkeypatch.setenv("DDL_OBS_PROFILE_MAX", "1")
+    faultinject.deactivate()  # re-read DDL_FAULT in this process
+    try:
+        _run_main(train_lm, [
+            "--steps", "12", "--log-every", "1", "--batch", "4",
+            "--seq-len", "16", "--d-model", "32", "--layers", "2",
+            "--log-dir", str(log_dir), "--job-id", "lm-spike",
+            "--no-halt-on-nan",
+        ])
+    finally:
+        faultinject.deactivate()
+    events = read_events(events_path(log_dir, "lm-spike", 0))
+    spikes = [
+        e for e in events
+        if e["kind"] == "anomaly" and e.get("type") == "loss_spike"
+    ]
+    # the anomaly is stamped with the period's boundary index (the
+    # spiked step 6 lives in the period whose boundary is step 7)
+    assert len(spikes) == 1 and spikes[0]["step"] == 7, spikes
+    captures = [e for e in events if e["kind"] == "profile_capture"]
+    assert len(captures) == 1, captures  # rate-limited to exactly one
+    (cap,) = captures
+    assert cap["ok"] is True and cap["trigger"] == "loss_spike"
+    import glob
+    import os
+
+    assert os.path.isdir(cap["trace_dir"])
+    assert glob.glob(
+        os.path.join(cap["trace_dir"], "**", "*.xplane.pb"), recursive=True
+    ), "no xplane.pb written"
+    digest = cap["digest"]
+    assert digest and "error" not in digest
+    assert digest["ops"], digest  # a non-empty per-op-category breakdown
+    assert digest["total_ms"] > 0
+
+    # `obs summarize` surfaces the capture with its digest
+    from ddl_tpu import cli
+
+    cli.main(["obs", "summarize", "lm-spike", "--log-dir", str(log_dir)])

@@ -300,6 +300,57 @@ def _probe_decode() -> _Probe:
     return probe
 
 
+def _probe_serve_decode() -> _Probe:
+    """The continuous-batching serving engine's batched decode program
+    (serve/engine.py): one token for every lane over the paged KV pool.
+    Validates the serving boundary (pending tokens over 'data') and that
+    the gathered-block-table attention lowers under a data+model mesh —
+    a rule-table edit that breaks the per-lane cache constraints
+    surfaces here before a serve-bench ever runs."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.models.transformer import TransformerLM
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.serve.engine import make_serve_step_fns
+
+    probe = _Probe(make_serve_step_fns)
+    cfg = _tiny_lm_cfg()
+    fns = make_serve_step_fns(
+        cfg, LMMeshSpec(data=2, model=2),
+        block_size=8, num_blocks=16, max_batch=4,
+    )
+    _check_boundary(probe, fns.contract, fns.mesh)
+    params = nn.meta.unbox(
+        jax.eval_shape(
+            lambda r: TransformerLM(cfg, None).init(
+                r, jnp.zeros((2, 8), jnp.int32)
+            )["params"],
+            jax.random.key(0),
+        )
+    )
+    pools = jax.eval_shape(fns.init_pools)
+    tables = jax.ShapeDtypeStruct((4, fns.max_blocks_per_seq), jnp.int32)
+    lengths = jax.ShapeDtypeStruct((4,), jnp.int32)
+    pending = jax.ShapeDtypeStruct((4,), jnp.int32)
+    rngs = jax.ShapeDtypeStruct((4, 2), jnp.uint32)
+    decode, _ = fns.decode_for(4, fns.max_blocks_per_seq)
+    _lower(
+        probe, decode, params, pools, tables, lengths, pending, rngs,
+        what="serve continuous-batch decode chunk",
+    )
+    _lower(
+        probe, fns.prefill_for(8), params, pools,
+        jax.ShapeDtypeStruct((1, 8), jnp.int32),
+        jax.ShapeDtypeStruct((1,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+        what="serve bucketed prefill",
+    )
+    return probe
+
+
 def _probe_lm_pipeline() -> _Probe:
     """The pipeline-parallel LM step factory (parallel/lm_pipeline.py):
     same contract surface as the flat path (it shares
@@ -365,6 +416,7 @@ PROBES = (
     ("lm_flat", _probe_lm),
     ("vit_flat", _probe_vit),
     ("lm_decode", _probe_decode),
+    ("serve_decode", _probe_serve_decode),
     ("lm_pipeline", _probe_lm_pipeline),
     ("vit_pipeline", _probe_vit_pipeline),
 )

@@ -53,6 +53,22 @@ sharding-contract probes, gated by the committed ``LINT_BASELINE.json``:
 
     python -m ddl_tpu.cli lint [--json] [--baseline LINT_BASELINE.json]
         [--update-baseline] [--no-contracts] [paths...]
+
+Serving (``ddl_tpu/serve/``): the continuous-batching engine — paged
+block KV pool, admit/retire scheduler over a static decode batch,
+admission control with shed policies — benchmarked by firing N
+synthetic concurrent clients and rendering the percentile report
+(p50/p95/p99 latency / queue delay / TTFT / tok/s, aggregate tokens/s
+per chip, shed/compile counts):
+
+    python -m ddl_tpu.cli serve-bench --cpu-devices 1 --clients 8 \
+        --prompt-len 8:24 --max-new 16:32 --block-size 8 --num-blocks 64 \
+        [--policy shed_oldest] [--int8 kv] [--compare-sequential] \
+        [--obs-log-dir DIR --job-id J]   # events -> `obs summarize J`,
+                                         # gated by `obs diff --baseline
+                                         # BASELINE_OBS.json --fail-slowdown F`
+    python examples/serve_lm.py ...      # same engine over a training
+                                         # snapshot (--checkpoint-dir/--step)
 """
 
 from __future__ import annotations
@@ -79,6 +95,12 @@ def main(argv=None) -> None:
         from ddl_tpu.analysis.cli import main as lint_main
 
         raise SystemExit(lint_main(argv[1:]))
+    if argv and argv[0] == "serve-bench":
+        # continuous-batching serving benchmark (serve/bench.py); JAX
+        # init is deferred until after its --cpu-devices handling
+        from ddl_tpu.serve.bench import main as serve_main
+
+        return serve_main(argv[1:])
     if argv and argv[0] == "train":
         argv = argv[1:]
 

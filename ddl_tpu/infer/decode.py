@@ -75,7 +75,10 @@ class LMDecode(nn.Module):
     attn_core: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, caches, offset, last_only: bool = False):
+    def __call__(
+        self, tokens, caches, offset, last_only: bool = False,
+        last_index=None,
+    ):
         cfg = self.cfg
         x = make_embed(cfg)(tokens)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
@@ -85,7 +88,16 @@ class LMDecode(nn.Module):
                 x, caches[i], offset, rolling=self.rolling
             )
             new_caches.append(c)
-        if last_only:  # prefill only needs the next-token logits
+        if last_index is not None:
+            # right-padded prefill (serve/engine.py bucketing): the
+            # next-token logits live at the TRUE prompt end, not at -1.
+            # Slicing before the head keeps the norm+head computation the
+            # (B, 1, D) shape last_only compiles, so a padded prefill's
+            # logits stay bit-identical to the unpadded single-request
+            # program's (a full-width head + post-hoc index fuses
+            # differently and drifts enough to flip near-tie argmaxes)
+            x = lax.dynamic_slice_in_dim(x, last_index, 1, axis=1)
+        elif last_only:  # prefill only needs the next-token logits
             x = x[:, -1:]
         return apply_final_norm_and_head(cfg, x), tuple(new_caches)
 

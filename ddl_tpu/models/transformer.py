@@ -274,17 +274,21 @@ def remat_block(cfg) -> type:
 
 
 def _rope(x, theta: float, positions=None):
-    """Rotary embeddings. x: (B, T, H, D); ``positions`` (T,) overrides the
-    default global positions 0..T-1 (incremental decode passes
-    ``offset + arange(T)``)."""
+    """Rotary embeddings. x: (B, T, H, D); ``positions`` overrides the
+    default global positions 0..T-1 — (T,) shared across the batch
+    (incremental decode passes ``offset + arange(T)``) or (B, T)
+    per-row (the serving engine's continuous decode batch, where each
+    lane sits at its own sequence offset)."""
     _, t, _, d = x.shape
     half = d // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     if positions is None:
         positions = jnp.arange(t, dtype=jnp.float32)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (T, half)
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., T, half)
+    if angles.ndim == 2:  # shared row broadcasts over the batch
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 

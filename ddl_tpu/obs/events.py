@@ -64,6 +64,11 @@ EVENT_KINDS = (
     "io_retry",
     # infer/decode.py per-request serving telemetry
     "decode",
+    # serve/ continuous-batching engine: admission/shed decisions, lane
+    # retirement, and block-pool occupancy snapshots (per-request latency
+    # still flows through "decode" so one percentile pipeline serves
+    # both the one-shot and the continuous-batching paths)
+    "serve_admit", "serve_shed", "serve_retire", "kv_pool_stats",
     # supervisor.py restart lifecycle
     "supervisor_start", "supervisor_relaunch", "supervisor_done",
     # pod-level coordinated recovery (coord.py + PodSupervisor)

@@ -77,12 +77,18 @@ def _median(values: list[float]) -> float | None:
     return statistics.median(values) if values else None
 
 
-def pod_summary(streams: dict[int, list[dict]]) -> dict:
+def pod_summary(streams: dict[int, list[dict]], serving=None) -> dict:
     """Aggregate per-host streams into the pod view ``render_pod_summary``
     prints.  Only periods every host reported (same ``(repoch, period)``
     key) enter the skew comparison — hosts die and resume at different
     wall-clock points, and comparing a host's clean period against
-    another's preemption-truncated one would manufacture skew."""
+    another's preemption-truncated one would manufacture skew.
+
+    ``serving`` is an optional pre-built serving summary dict
+    (``ServingStats.summary()``) — the CLI passes the incremental
+    tail-cursor accumulators (``obs/cursor.py``) so the pod view of a
+    serving job shows pod-wide request counts and aggregate tokens/s
+    without re-parsing every stream per invocation."""
     # -- per-host period tables keyed by (repoch, period) ----------------
     period_by_host: dict[int, dict[tuple, dict]] = {}
     hosts: dict[int, dict] = {}
@@ -200,6 +206,7 @@ def pod_summary(streams: dict[int, list[dict]]) -> dict:
         "straggler": straggler,
         "barriers": {k: dict(v) for k, v in barriers.items()},
         "timeline": timeline,
+        "serving": serving,
     }
 
 
@@ -281,6 +288,18 @@ def render_pod_summary(s: dict, job_id: str = "", tail: int = 40) -> str:
         lines.append(
             "skew not comparable: no (restart epoch, period) reported by "
             "every host"
+        )
+
+    sv = s.get("serving")
+    if sv:
+        agg = (
+            f", {sv['agg_tok_per_s']:.1f} tok/s warm-span aggregate "
+            f"({sv['agg_tok_per_s_per_chip']:.1f}/chip)"
+            if sv.get("agg_tok_per_s") is not None else ""
+        )
+        lines.append(
+            f"serving: {sv['requests']} requests, {sv['tokens']} "
+            f"tokens{agg}"
         )
 
     if s["barriers"]:

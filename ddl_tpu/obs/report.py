@@ -13,9 +13,10 @@
     diff <job> --baseline F     compare a run against a stored baseline;
                                 --fail-slowdown 0.5 exits nonzero on a
                                 >50% steps/s regression — and, when both
-                                runs carry decode percentiles, on a p95
-                                latency inflation past the same fraction
-                                (the CI gate)
+                                runs carry the serving signals, on a
+                                decode p95 latency or p99 TTFT inflation
+                                or an aggregate tokens/s/chip drop past
+                                the same fraction (the CI gate)
     pod <job_id>                pod-wide view over ALL hosts' streams
                                 (obs/pod.py): per-host skew/straggler
                                 table, barrier-wait attribution, unified
@@ -60,8 +61,13 @@ def load_run(log_dir: str | os.PathLike, job_id: str) -> list[dict]:
     return events
 
 
-def summarize_run(events: list[dict]) -> dict:
-    """Aggregate one run's events into the summary dict the CLI renders."""
+def summarize_run(events: list[dict], decode_stats=None) -> dict:
+    """Aggregate one run's events into the summary dict the CLI renders.
+
+    ``decode_stats`` is an optional pre-built ``ServingStats`` (the CLI
+    passes the incremental tail-cursor accumulators — ``obs/cursor.py`` —
+    so long-running serving jobs don't re-parse every stream per
+    invocation); None folds the decode events in ``events``."""
     phases: dict[str, float] = defaultdict(float)
     # Run-level totals come from ONE representative host: every host
     # emits its own period events for the same global periods, so
@@ -125,13 +131,17 @@ def summarize_run(events: list[dict]) -> dict:
     # TTFT / tok_per_s distributions over warm per-request decode events
     from ddl_tpu.obs.serving import ServingStats
 
-    decode = ServingStats.from_events(events).summary()
+    if decode_stats is None:
+        decode_stats = ServingStats.from_events(events)
+    decode = decode_stats.summary()
     if decode is not None and decode["mean_tok_per_s"] is None:
         # no warm request at all (single-request smokes): fall back to
-        # the cold rates so the legacy mean stays populated
+        # the cold rates so the legacy mean stays populated.  A rate of
+        # exactly 0.0 is present, not missing (falsy-drop bug class)
         rates = [
             e["tok_per_s"] for e in events
-            if e.get("kind") == "decode" and e.get("tok_per_s")
+            if e.get("kind") == "decode"
+            and e.get("tok_per_s") is not None
         ]
         decode["mean_tok_per_s"] = (
             sum(rates) / len(rates) if rates else None
@@ -189,7 +199,7 @@ def render_summary(s: dict, job_id: str = "") -> str:
         d = s["decode"]
         rate = (
             f"{d['mean_tok_per_s']:.1f} tok/s"
-            if d["mean_tok_per_s"] else "n/a"
+            if d["mean_tok_per_s"] is not None else "n/a"
         )
         cold = ""
         if d.get("cold"):
@@ -204,6 +214,14 @@ def render_summary(s: dict, job_id: str = "") -> str:
             f"decode: {d['requests']} requests, {d['tokens']} tokens, "
             f"{rate}{cold}"
         )
+        if d.get("agg_tok_per_s") is not None:
+            chips = d.get("chips", 1)
+            lines.append(
+                f"serving aggregate: {d['agg_tok_per_s']:.1f} tok/s over "
+                f"the warm span "
+                f"({d['agg_tok_per_s_per_chip']:.1f} tok/s/chip on "
+                f"{chips} chip(s))"
+            )
         if d.get("percentiles"):
             from ddl_tpu.obs.serving import render_percentiles
 
@@ -396,7 +414,19 @@ def main(argv=None) -> None:
                 f"no events for job {args.job_id!r} under {args.log_dir} "
                 f"(looked for {_job_dir(args.log_dir, args.job_id)}/events-h*.jsonl)"
             )
-        print(render_summary(summarize_run(events), args.job_id))
+        # decode percentiles come from the incremental tail-cursor cache
+        # (obs/cursor.py): the reservoir accumulators fold only bytes
+        # appended since the last summarize and persist in the sidecar.
+        # NOTE the phase/step sections above still come from load_run's
+        # full parse — making the whole summary incremental is a ROADMAP
+        # follow-on; today the cursor buys persistent percentile state,
+        # not a faster summarize
+        from ddl_tpu.obs.cursor import incremental_serving_stats
+
+        stats = incremental_serving_stats(args.log_dir, args.job_id)
+        print(render_summary(
+            summarize_run(events, decode_stats=stats), args.job_id
+        ))
     elif args.command == "tail":
         events = load_run(args.log_dir, args.job_id)
         for e in events[-args.n:]:
@@ -419,12 +449,24 @@ def main(argv=None) -> None:
             frac = args.fail_slowdown
             ra, rb = _rate(sa), _rate(sb)
             pa, pb = _decode_percentiles(sa), _decode_percentiles(sb)
+            da, db = sa.get("decode") or {}, sb.get("decode") or {}
+
+            def _pct(p, metric, q):
+                return (p or {}).get(metric, {}).get(q)
+
             lat_gate = (
-                pa and pb
-                and pa.get("latency_s", {}).get("p95") is not None
-                and pb.get("latency_s", {}).get("p95") is not None
+                _pct(pa, "latency_s", "p95") is not None
+                and _pct(pb, "latency_s", "p95") is not None
             )
-            if not (ra and rb) and not lat_gate:
+            ttft_gate = (
+                _pct(pa, "ttft_s", "p99") is not None
+                and _pct(pb, "ttft_s", "p99") is not None
+            )
+            agg_gate = (
+                da.get("agg_tok_per_s_per_chip") is not None
+                and db.get("agg_tok_per_s_per_chip") is not None
+            )
+            if not (ra and rb) and not (lat_gate or ttft_gate or agg_gate):
                 # a run that emitted neither period events nor decode
                 # percentiles must not pass the gate by default — that
                 # is the shape of a crashed smoke
@@ -440,13 +482,30 @@ def main(argv=None) -> None:
                     f"{frac:.0%} below {name_a} ({ra:.2f} steps/s)"
                 )
             if lat_gate:
-                la = pa["latency_s"]["p95"]
-                lb = pb["latency_s"]["p95"]
+                la = _pct(pa, "latency_s", "p95")
+                lb = _pct(pb, "latency_s", "p95")
                 if lb > (1.0 + frac) * la:
                     raise SystemExit(
                         f"FAIL: {name_b} decode p95 latency {lb:.4g}s is "
                         f"more than {frac:.0%} above {name_a} "
                         f"({la:.4g}s)"
+                    )
+            if ttft_gate:
+                ta = _pct(pa, "ttft_s", "p99")
+                tb = _pct(pb, "ttft_s", "p99")
+                if tb > (1.0 + frac) * ta:
+                    raise SystemExit(
+                        f"FAIL: {name_b} p99 TTFT {tb:.4g}s is more "
+                        f"than {frac:.0%} above {name_a} ({ta:.4g}s)"
+                    )
+            if agg_gate:
+                ga = da["agg_tok_per_s_per_chip"]
+                gb = db["agg_tok_per_s_per_chip"]
+                if gb < (1.0 - frac) * ga:
+                    raise SystemExit(
+                        f"FAIL: {name_b} serving aggregate "
+                        f"{gb:.4g} tok/s/chip is more than {frac:.0%} "
+                        f"below {name_a} ({ga:.4g} tok/s/chip)"
                     )
             print(
                 f"OK: within the {frac:.0%} regression gate ("
@@ -454,6 +513,8 @@ def main(argv=None) -> None:
                     g for g, on in (
                         ("steps/s", ra and rb),
                         ("decode p95 latency", lat_gate),
+                        ("p99 TTFT", ttft_gate),
+                        ("agg tok/s/chip", agg_gate),
                     ) if on
                 )
                 + ")"
@@ -476,7 +537,12 @@ def main(argv=None) -> None:
                 f"no events for job {args.job_id!r} under {args.log_dir} "
                 f"(looked for {_job_dir(args.log_dir, args.job_id)}/events-h*.jsonl)"
             )
-        summary = pod_summary(streams)
+        from ddl_tpu.obs.cursor import incremental_serving_stats
+
+        serving = incremental_serving_stats(
+            args.log_dir, args.job_id
+        ).summary()
+        summary = pod_summary(streams, serving=serving)
         if args.json:
             print(json.dumps(summary, default=str))
         else:

@@ -56,6 +56,35 @@ class QuantileAccumulator:
         self.min: float | None = None
         self.max: float | None = None
 
+    def state_dict(self) -> dict:
+        """JSON-serializable snapshot (the tail-cursor cache persists
+        accumulators between ``obs summarize`` invocations —
+        ``obs/cursor.py``).  Includes the reservoir RNG state so a
+        restored accumulator samples the stream tail exactly as the
+        uninterrupted one would."""
+        st = self._rng.getstate()
+        return {
+            "capacity": self.capacity,
+            "values": self._values,
+            "count": self.count,
+            "total": self.total,
+            "min": self.min,
+            "max": self.max,
+            "rng": [st[0], list(st[1]), st[2]],
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "QuantileAccumulator":
+        acc = cls(capacity=state["capacity"])
+        acc._values = [float(v) for v in state["values"]]
+        acc.count = int(state["count"])
+        acc.total = float(state["total"])
+        acc.min = state["min"]
+        acc.max = state["max"]
+        v, internal, gauss = state["rng"]
+        acc._rng.setstate((v, tuple(internal), gauss))
+        return acc
+
     def add(self, x: float) -> None:
         x = float(x)
         self.count += 1
@@ -117,6 +146,18 @@ class ServingStats:
         self.cold = 0
         self.tokens = 0
         self.prompt_tokens = 0
+        # warm-span aggregate throughput: warm output tokens over the
+        # wall-clock span [earliest warm request start, latest warm
+        # completion] — the system-level tokens/s number the Gemma-on-TPU
+        # serving comparison reports per chip, next to the per-request
+        # percentiles (which can look healthy while the batch is empty).
+        # Spans are PER ENGINE LABEL (event "engine" field; the one-shot
+        # generator has none): a CI job stream holds a decode smoke AND
+        # a serve-bench smoke minutes apart, and one global span would
+        # be >99% idle gap — a gate on that number moves with test
+        # ordering, not serving performance
+        self.spans: dict[str, list] = {}  # label -> [tokens, start, end]
+        self.chips = 0
 
     def observe(self, event: dict) -> None:
         self.requests += 1
@@ -126,13 +167,61 @@ class ServingStats:
         self.prompt_tokens += int(
             event.get("prompt_len", 0) * event.get("batch", 1)
         )
+        chips = event.get("chips")
+        if chips:
+            self.chips = max(self.chips, int(chips))
         if not event.get("warm"):
             self.cold += 1
             return
         for field, name in METRICS:
             v = event.get(field)
+            # 0.0 is a real measurement (inline dispatch has zero queue
+            # delay; a clock-granularity TTFT can floor to 0.0) — only
+            # absence drops the sample.  Treating falsy as missing is the
+            # bug class the regression test pins (test_serve.py).
             if v is not None:
                 self.acc[name].add(v)
+        tok = int(event.get("new_tokens", 0) * event.get("batch", 1))
+        ts = event.get("ts")
+        if ts is not None:
+            start = ts - (event.get("dur") or 0.0)
+            span = self.spans.get(str(event.get("engine") or "decode"))
+            if span is None:
+                self.spans[str(event.get("engine") or "decode")] = [
+                    tok, start, ts,
+                ]
+            else:
+                span[0] += tok
+                span[1] = min(span[1], start)
+                span[2] = max(span[2], ts)
+
+    def state_dict(self) -> dict:
+        return {
+            "acc": {name: a.state_dict() for name, a in self.acc.items()},
+            "requests": self.requests,
+            "cold": self.cold,
+            "tokens": self.tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "spans": self.spans,
+            "chips": self.chips,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "ServingStats":
+        stats = cls()
+        stats.acc = {
+            name: QuantileAccumulator.from_state(s)
+            for name, s in state["acc"].items()
+        }
+        stats.requests = int(state["requests"])
+        stats.cold = int(state["cold"])
+        stats.tokens = int(state["tokens"])
+        stats.prompt_tokens = int(state["prompt_tokens"])
+        stats.spans = {
+            k: [v[0], v[1], v[2]] for k, v in state["spans"].items()
+        }
+        stats.chips = int(state["chips"])
+        return stats
 
     @classmethod
     def from_events(cls, events: list[dict], capacity: int = 4096):
@@ -148,12 +237,24 @@ class ServingStats:
         if not self.requests:
             return None
         rates = self.acc["tok_per_s"]
+        # per-engine spans summed: idle gaps BETWEEN engines' activity
+        # windows (decode smoke ... serve-bench smoke) don't count as
+        # serving time; gaps within one engine's window still do
+        span = sum(max(0.0, s[2] - s[1]) for s in self.spans.values())
+        tokens_in_spans = sum(s[0] for s in self.spans.values())
+        agg = tokens_in_spans / span if span > 0 else None
+        chips = self.chips or 1
         return {
             "requests": self.requests,
             "cold": self.cold,
             "tokens": self.tokens,
             "prompt_tokens": self.prompt_tokens,
             "mean_tok_per_s": rates.mean,
+            "agg_tok_per_s": agg,
+            "chips": chips,
+            "agg_tok_per_s_per_chip": (
+                agg / chips if agg is not None else None
+            ),
             "percentiles": {
                 name: self.acc[name].summary()
                 for _field, name in METRICS

@@ -19,9 +19,12 @@ __all__ = ["dense_attention"]
 def dense_attention(q, k, v, causal: bool = False, mask=None, window: int = 0):
     """Full softmax attention. q: (B, Tq, H, D), k/v: (B, Tk, Hkv, D) ->
     (B, Tq, H, D).  ``mask`` is an explicit (Tq, Tk) bool mask (True =
-    attend) for cross-length cases like KV-cache decode; ``causal`` builds
-    the square tril mask, banded to the last ``window`` positions when
-    ``window > 0`` (sliding-window attention).
+    attend) for cross-length cases like KV-cache decode — or (B, Tq, Tk)
+    when every batch row has its own visibility, e.g. the serving
+    engine's continuous decode batch where each lane sits at a different
+    sequence length (``ddl_tpu/serve/``); ``causal`` builds the square
+    tril mask, banded to the last ``window`` positions when ``window > 0``
+    (sliding-window attention).
 
     Grouped-query attention: when ``Hkv < H`` (``H % Hkv == 0``), each K/V
     head serves a group of ``H/Hkv`` query heads.  The grouping is done by
@@ -49,7 +52,9 @@ def dense_attention(q, k, v, causal: bool = False, mask=None, window: int = 0):
     if hkv == h:
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / scale
         if mask is not None:
-            scores = jnp.where(mask[None, None], scores, -1e30)
+            # (Tq, Tk) shared across batch, or (B, Tq, Tk) per-lane
+            m = mask[None, None] if mask.ndim == 2 else mask[:, None]
+            scores = jnp.where(m, scores, -1e30)
         probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     if h % hkv:
@@ -58,6 +63,10 @@ def dense_attention(q, k, v, causal: bool = False, mask=None, window: int = 0):
     qg = q.reshape(b, tq, hkv, g, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) / scale
     if mask is not None:
-        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        m = (
+            mask[None, None, None] if mask.ndim == 2
+            else mask[:, None, None]
+        )
+        scores = jnp.where(m, scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
     return jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, tq, h, d)

@@ -148,6 +148,21 @@ def _interpret_default() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+def _bias_spec(bias, b: int, bl: int) -> pl.BlockSpec:
+    """BlockSpec for the additive mask: one shared (1, L) row broadcast
+    to every batch program, or a (B, L) per-lane bias tiled along the
+    batch grid dimension (the serving engine's continuous decode batch,
+    where each lane's visible length differs)."""
+    if bias.shape[0] == 1:
+        return pl.BlockSpec((1, bl), lambda i, j: (0, j))
+    if bias.shape[0] != b:
+        raise ValueError(
+            f"bias batch dim {bias.shape[0]} must be 1 (shared) or match "
+            f"the query batch {b} (per-lane)"
+        )
+    return pl.BlockSpec((1, bl), lambda i, j: (i, j))
+
+
 def pick_block_l(L: int, fused: int) -> int | None:
     """Legal sequence tile for a cache of L rows and ``fused`` feature
     width, or None when the kernel cannot tile this shape.
@@ -222,7 +237,10 @@ def _block_l(
 def decode_attention(q, ck, cv, bias, *, hkv: int, block_l=None,
                      interpret=None):
     """q: (B, 1, H, D); ck/cv: (B, L, Hkv*Dh) bf16 fused cache;
-    bias: (1, L) f32 additive mask.  Returns (B, 1, H, D)."""
+    bias: (1, L) f32 additive mask shared across the batch, or (B, L)
+    per-lane — continuous-batching decode (``ddl_tpu/serve/``) attends a
+    gathered block-table cache where every lane sits at its own length,
+    so each batch row carries its own mask.  Returns (B, 1, H, D)."""
     b, _, h, d = q.shape
     L = ck.shape[1]
     if interpret is None:
@@ -235,7 +253,7 @@ def decode_attention(q, ck, cv, bias, *, hkv: int, block_l=None,
             pl.BlockSpec((1, h, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, bl, hkv * d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, bl, hkv * d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, bl), lambda i, j: (0, j)),
+            _bias_spec(bias, b, bl),
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda i, j: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
@@ -260,7 +278,8 @@ def quant_decode_attention(q, ck, ks, cv, vs, bias, *, hkv: int,
     """q: (B, 1, H, D); ck/cv: (B, L, Hkv*Dh) int8 fused cache;
     ks/vs: (B, Hkv, L) f32 per-(token, head) scales (L minor, so the
     kernel reads an aligned (block_l,) lane vector per head);
-    bias: (1, L) f32 additive mask."""
+    bias: (1, L) f32 additive mask, or (B, L) per-lane (see
+    ``decode_attention``)."""
     b, _, h, d = q.shape
     L = ck.shape[1]
     if interpret is None:
@@ -275,7 +294,7 @@ def quant_decode_attention(q, ck, ks, cv, vs, bias, *, hkv: int,
             pl.BlockSpec((1, bl, hkv * d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, hkv, bl), lambda i, j: (i, 0, j)),
             pl.BlockSpec((1, hkv, bl), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, bl), lambda i, j: (0, j)),
+            _bias_spec(bias, b, bl),
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda i, j: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),

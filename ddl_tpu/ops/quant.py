@@ -178,7 +178,10 @@ def kv_slice(cache, start, span: int):
 def kv_attend(q, cache, mask, use_kernel: bool = False):
     """Cached decode attention over a (fused-storage) bf16 tuple or
     QuantKV cache.  q: (B, Tq, H, Dh); mask: (Tq, L) bool (True =
-    attend).
+    attend), or (B, Tq, L) when every batch row has its own visibility —
+    the serving engine's continuous decode batch gathers each lane's
+    block table into row b of the cache, so lane lengths differ
+    (``ddl_tpu/serve/kv_pool.py``).
 
     ``use_kernel=True`` (single-device T=1 over the full cache) runs the
     Pallas one-pass kernel (``ops/decode_attention.py``): default-layout
@@ -196,7 +199,10 @@ def kv_attend(q, cache, mask, use_kernel: bool = False):
         L = (cache.kq if isinstance(cache, QuantKV) else cache[0]).shape[1]
         # cache lengths with no alignment-legal tile keep the einsum path
         if pick_block_l(L, fused) is not None:
-            bias = jnp.where(mask[:1], 0.0, -1e30).astype(jnp.float32)
+            # (Tq, L) -> shared (1, L) bias row; (B, Tq, L) -> per-lane
+            # (B, L) bias (the kernels tile either along the batch grid)
+            mrow = mask[:1] if mask.ndim == 2 else mask[:, 0]
+            bias = jnp.where(mrow, 0.0, -1e30).astype(jnp.float32)
             if isinstance(cache, QuantKV):
                 hkv = fused // d
                 return quant_decode_attention(
@@ -223,6 +229,8 @@ def quant_dense_attention(q, kq, ks, vq, vs, mask):
     """Softmax attention reading an int8 K/V cache without dequantizing it.
 
     q: (B, Tq, H, D); kq/vq: (B, L, Hkv, D) int8; ks/vs: (B, Hkv, L).
+    ``mask`` is (Tq, L) shared across the batch or (B, Tq, L) per-lane
+    (serving engine decode batches, ``ddl_tpu/serve/``).
     Because each key/value row has ONE scale, ``q·(kq*s) = (q·kq)*s`` — the
     key scales multiply the (B, Hkv, G, Tq, L) scores and the value scales
     fold into the softmax probs, so the only full-size int8 operands feed
@@ -242,7 +250,8 @@ def quant_dense_attention(q, kq, ks, vq, vs, mask):
     scores = scores.astype(jnp.float32) * (
         ksb / jnp.sqrt(jnp.float32(d))
     )
-    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    m = mask[None, None, None] if mask.ndim == 2 else mask[:, None, None]
+    scores = jnp.where(m, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     vsb = vs[:, :, None, None, :]
     pv = (probs * vsb).astype(q.dtype)

@@ -1,0 +1,39 @@
+"""Continuous-batching serving engine with a paged KV cache.
+
+The training side of this framework scales by sharding one step over a
+mesh; the serving side scales by keeping the decode batch full.  This
+package is the layer between the model and concurrent users:
+
+* ``kv_pool``   — block-granular KV slots: fixed device pools per layer
+                  (``init_kv_cache``'s fused layouts chopped along the
+                  sequence dim), a host-side ``BlockAllocator`` with
+                  allocate/free/defrag, per-request block tables.
+* ``scheduler`` — the continuous batch: lanes, admit/retire, worst-case
+                  block reservation (admitted requests always finish).
+* ``admission`` — bounded queue + shed policies (reject-new /
+                  shed-oldest) with ``serve_shed`` obs events.
+* ``engine``    — the two XLA programs (bucketed single-request
+                  prefill+first-token; one static-shape batched decode
+                  step over gathered block tables) and the serving loop.
+* ``bench``     — ``ddl_tpu serve-bench``: N synthetic concurrent
+                  clients, percentile report, sequential baseline.
+
+Grounded in the Gemma-on-TPU serving comparison (PAPERS.md): batched
+TPU serving throughput is won or lost in the scheduler and KV-cache
+management, not the matmuls.
+"""
+
+from ddl_tpu.serve.admission import AdmissionController
+from ddl_tpu.serve.engine import ServeEngine, make_serve_step_fns
+from ddl_tpu.serve.kv_pool import BlockAllocator, init_kv_pool
+from ddl_tpu.serve.scheduler import ContinuousScheduler, Request
+
+__all__ = [
+    "AdmissionController",
+    "BlockAllocator",
+    "ContinuousScheduler",
+    "Request",
+    "ServeEngine",
+    "init_kv_pool",
+    "make_serve_step_fns",
+]

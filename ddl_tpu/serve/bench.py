@@ -1,0 +1,343 @@
+"""``ddl_tpu serve-bench``: synthetic concurrent clients -> percentile report.
+
+Fires N clients at the continuous-batching engine with configurable
+prompt/output-length distributions and a deterministic arrival process,
+then renders the serving report: p50/p95/p99 latency / queue delay /
+TTFT / per-request tokens/s (the ``obs/serving.py`` accumulators — the
+same table ``obs summarize`` shows), aggregate tokens/s (and per chip),
+admission/shed counts, pool occupancy, and compile counts.
+
+``--compare-sequential`` replays the same requests one-at-a-time
+through ``infer.decode.make_lm_generator`` at equal per-request
+settings — the one-request-at-a-time baseline continuous batching
+exists to beat; the report prints the throughput ratio.
+
+With ``--obs-log-dir/--job-id`` every request lands in the job's event
+stream, so ``obs summarize <job>`` renders the percentiles and
+``obs diff <job> --baseline BASELINE_OBS.json --fail-slowdown F`` gates
+p95 latency, p99 TTFT and aggregate tokens/s against the committed
+baseline (the CI flow in the verify skill).
+
+Examples::
+
+    python -m ddl_tpu.cli serve-bench --cpu-devices 1 --clients 8 \
+        --prompt-len 8:24 --max-new 16:32 --block-size 8 --num-blocks 64
+    python examples/serve_lm.py --checkpoint-dir /tmp/ck --step 200 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from time import perf_counter
+
+__all__ = ["main"]
+
+
+def _parse_range(s: str, name: str) -> tuple[int, int]:
+    """"8" -> (8, 8); "8:24" -> (8, 24) inclusive uniform range."""
+    parts = s.split(":")
+    try:
+        if len(parts) == 1:
+            lo = hi = int(parts[0])
+        elif len(parts) == 2:
+            lo, hi = int(parts[0]), int(parts[1])
+        else:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"--{name} must be an int or lo:hi range, got {s!r}"
+        )
+    if lo < 1 or hi < lo:
+        raise SystemExit(f"--{name} range {s!r} is empty or non-positive")
+    return lo, hi
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        prog="ddl_tpu serve-bench", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--clients", type=int, default=8,
+                    help="number of synthetic client requests")
+    ap.add_argument("--prompt-len", default="8:16", metavar="N|LO:HI",
+                    help="prompt length distribution (uniform)")
+    ap.add_argument("--max-new", default="16", metavar="N|LO:HI",
+                    help="output length distribution (uniform)")
+    ap.add_argument("--arrival-s", type=float, default=0.0,
+                    help="mean client interarrival seconds (exponential; "
+                    "0 = all arrive at t0, the closed-burst worst case)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=None)
+    # engine envelope
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--num-blocks", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--policy", default="reject",
+                    choices=["reject", "shed_oldest"])
+    ap.add_argument("--min-free-blocks", type=int, default=0,
+                    help="pool watermark: keep this many blocks free "
+                    "after every admission")
+    ap.add_argument("--steps-per-dispatch", type=int, default=8,
+                    help="max decode steps fused into one dispatch "
+                    "(bounds admission latency; 1 = step-at-a-time)")
+    ap.add_argument("--int8", default="none", choices=["none", "kv", "kv+w"],
+                    help="int8 serving quantization (ops/quant.py)")
+    # model / mesh
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=0)
+    ap.add_argument("--attn-window", type=int, default=0)
+    ap.add_argument("--data", type=int, default=1)
+    ap.add_argument("--model", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=1)
+    ap.add_argument("--cpu-devices", type=int, default=0)
+    # weights
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="serve a training snapshot (any layout); "
+                    "omitted = random-init weights (smoke mode)")
+    ap.add_argument("--job-id", default="serve-bench")
+    ap.add_argument("--step", type=int, default=None,
+                    help="snapshot step (required with --checkpoint-dir)")
+    # obs / report
+    ap.add_argument("--obs-log-dir", default=None,
+                    help="emit decode/serve_*/kv_pool_stats events into "
+                    "this log dir (inspect with `ddl_tpu obs summarize`)")
+    ap.add_argument("--compare-sequential", action="store_true",
+                    help="also run the one-request-at-a-time baseline "
+                    "and report the throughput ratio")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the compile warmup request (percentiles "
+                    "then include cold compiles)")
+    args = ap.parse_args(argv)
+
+    if args.cpu_devices:
+        from ddl_tpu.launch import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
+    import jax
+    import numpy as np
+
+    from ddl_tpu.models.transformer import LMConfig, TransformerLM
+    from ddl_tpu.obs.serving import ServingStats, render_percentiles
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.serve.engine import ServeEngine
+
+    p_lo, p_hi = _parse_range(args.prompt_len, "prompt-len")
+    n_lo, n_hi = _parse_range(args.max_new, "max-new")
+
+    cfg = LMConfig(
+        vocab_size=256,
+        d_model=args.d_model,
+        n_layers=args.layers,
+        n_heads=args.heads,
+        n_kv_heads=args.kv_heads,
+        head_dim=args.d_model // args.heads,
+        d_ff=4 * args.d_model,
+        attn_window=args.attn_window,
+        compute_dtype=(
+            "bfloat16" if jax.default_backend() != "cpu" else "float32"
+        ),
+    )
+    spec = LMMeshSpec(data=args.data, seq=args.seq, model=args.model)
+
+    if args.checkpoint_dir:
+        if args.step is None:
+            raise SystemExit("--checkpoint-dir requires --step")
+        params = _load_params(cfg, spec, args)
+    else:
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        params = nn.meta.unbox(
+            TransformerLM(cfg, None).init(
+                jax.random.key(args.seed), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        )
+    if args.int8 == "kv+w":
+        from ddl_tpu.ops.quant import quantize_lm_params
+
+        params = quantize_lm_params(params)
+
+    obs = None
+    if args.obs_log_dir:
+        from ddl_tpu.obs import EventWriter
+
+        obs = EventWriter(args.obs_log_dir, args.job_id)
+
+    engine = ServeEngine(
+        cfg, params, spec,
+        block_size=args.block_size, num_blocks=args.num_blocks,
+        max_batch=args.max_batch, temperature=args.temperature,
+        top_k=args.top_k, kv_quant=args.int8 != "none",
+        max_queue=args.max_queue, policy=args.policy,
+        min_free_blocks=args.min_free_blocks,
+        max_steps_per_dispatch=args.steps_per_dispatch, obs=obs,
+    )
+
+    # deterministic synthetic clients
+    rng = np.random.default_rng(args.seed)
+    clients = []
+    arrival = 0.0
+    for i in range(args.clients):
+        if args.arrival_s:
+            arrival += rng.exponential(args.arrival_s)
+        clients.append({
+            "id": f"c{i:04d}",
+            "prompt": rng.integers(0, cfg.vocab_size, rng.integers(
+                p_lo, p_hi + 1)).astype(np.int32),
+            "max_new": int(rng.integers(n_lo, n_hi + 1)),
+            "arrival": arrival,
+        })
+
+    if not args.no_warmup:
+        # pay every reachable compile before the clock starts (the
+        # sequential baseline warms all ITS programs too — equal footing)
+        pre = engine.precompile(p_hi, n_hi)
+        print(
+            f"precompiled: {pre['prefill']} prefill bucket(s), "
+            f"{pre['decode']} decode program(s)"
+        )
+
+    t_start = perf_counter()
+    pending = list(clients)
+    while pending or engine.busy:
+        now = perf_counter() - t_start
+        while pending and pending[0]["arrival"] <= now:
+            c = pending.pop(0)
+            engine.submit(
+                c["prompt"], c["max_new"], request_id=c["id"],
+                submitted_at=t_start + c["arrival"],
+                rng_seed=args.seed,
+            )
+        progressed = engine.step()
+        if not progressed and pending:
+            time.sleep(
+                max(0.0, min(0.01, pending[0]["arrival"] - now))
+            )
+    wall = perf_counter() - t_start
+
+    # ---- report ---------------------------------------------------------
+    results = engine.results
+    out_tokens = sum(len(v) for v in results.values())
+    agg = out_tokens / wall if wall > 0 else 0.0
+    chips = engine.fns.mesh.size
+    st = engine.stats
+    print("== serve-bench report ==")
+    print(
+        f"clients: {args.clients} | completed: {st['completed']} | "
+        f"shed: {st['shed']} | queue policy: {args.policy}"
+    )
+    print(
+        f"engine: block_size={args.block_size} num_blocks={args.num_blocks} "
+        f"max_batch={args.max_batch} int8={args.int8} | peak lanes "
+        f"{engine.scheduler.peak_lanes}, peak blocks {st['peak_blocks']}"
+        f"/{args.num_blocks}"
+    )
+    print(
+        f"compiles: prefill buckets {sorted(engine._compiled_buckets)} "
+        f"({st['prefill_compiles']}), decode {st['decode_compiles']} | "
+        f"decode steps: {st['decode_steps']}"
+    )
+    print(
+        f"aggregate: {agg:.1f} tok/s over {wall:.2f}s "
+        f"({agg / chips:.1f} tok/s/chip on {chips} chip(s))"
+    )
+    # the engine keeps the canonical per-request records in memory
+    # (identical content to the emitted decode events), so the
+    # percentile table renders with or without an event stream
+    stats = ServingStats.from_events(engine.request_log)
+    summary = stats.summary()
+    if summary and summary.get("percentiles"):
+        print("-- percentiles (warm requests) --")
+        for line in render_percentiles(summary["percentiles"]):
+            print(line)
+    if summary and summary.get("agg_tok_per_s") is not None:
+        print(
+            f"warm-span aggregate: {summary['agg_tok_per_s']:.1f} tok/s "
+            f"({summary['agg_tok_per_s_per_chip']:.1f} tok/s/chip)"
+        )
+
+    if args.compare_sequential:
+        seq_rate = _sequential_baseline(cfg, spec, params, clients, args)
+        ratio = agg / seq_rate if seq_rate else float("inf")
+        print(
+            f"sequential baseline: {seq_rate:.1f} tok/s -> continuous "
+            f"batching x{ratio:.2f}"
+        )
+
+
+def _sequential_baseline(cfg, spec, params, clients, args) -> float:
+    """One-request-at-a-time throughput at equal per-request settings:
+    ``make_lm_generator`` per distinct (prompt_len, max_new), warmed,
+    then all requests played back to back."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddl_tpu.infer.decode import make_lm_generator
+    from ddl_tpu.utils.timing import fence
+
+    gens = {}
+    for c in clients:
+        key = (len(c["prompt"]), c["max_new"])
+        if key not in gens:
+            gens[key] = make_lm_generator(
+                cfg, spec, prompt_len=key[0], max_new=key[1], batch=1,
+                temperature=args.temperature, top_k=args.top_k,
+                kv_quant=args.int8 != "none",
+            )
+    # pay every compile before timing (same discipline as engine warmup)
+    for (p, _n), gen in gens.items():
+        fence(gen(
+            params, jnp.zeros((1, p), jnp.int32),
+            jax.random.PRNGKey(args.seed),
+        ))
+    t0 = perf_counter()
+    total = 0
+    for c in clients:
+        gen = gens[(len(c["prompt"]), c["max_new"])]
+        toks = gen(
+            params, jnp.asarray(c["prompt"][None, :]),
+            jax.random.PRNGKey(args.seed),
+        )
+        fence(toks)
+        total += int(np.asarray(toks).size)
+    dur = perf_counter() - t0
+    return total / dur if dur > 0 else 0.0
+
+
+def _load_params(cfg, spec, args):
+    """Restore a training snapshot's params (any layout), mirroring
+    examples/generate_lm.py."""
+    import optax
+
+    from ddl_tpu.checkpoint import load_snapshot, snapshot_metadata
+    from ddl_tpu.parallel.lm_pipeline import (
+        abstract_lm_state,
+        convert_lm_state,
+        saved_pipe_stages,
+        saved_virtual_stages,
+    )
+    from ddl_tpu.parallel.sharding import build_lm_mesh
+
+    mesh = build_lm_mesh(spec)
+    md = snapshot_metadata(args.checkpoint_dir, args.job_id, args.step)
+    pipe = saved_pipe_stages(md["state"]["params"])
+    virtual = saved_virtual_stages(md["state"]["params"])
+    state, _ = load_snapshot(
+        args.checkpoint_dir, args.job_id, args.step,
+        abstract_lm_state(
+            cfg, optax.adam(1e-3), pipe, mesh=mesh, virtual=virtual
+        ),
+    )
+    if pipe > 1:
+        state = convert_lm_state(state)
+    return state.params
+
+
+if __name__ == "__main__":
+    main()

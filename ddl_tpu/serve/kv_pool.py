@@ -1,0 +1,349 @@
+"""Paged/block KV cache: a fixed pool of block-granular KV slots.
+
+One-shot decode (``infer/decode.py``) allocates a contiguous
+``(B, max_len, Hkv*Dh)`` cache per generator — the right shape for a
+single fused program, the wrong shape for serving: a continuous batch
+admits and retires requests at different lengths every iteration, so a
+contiguous per-request allocation either reserves worst-case capacity
+for everyone (the memory waste the vLLM paper measured at 60-80%) or
+copies caches around on every admit.  The paged layout breaks the cache
+into fixed-size blocks:
+
+* device side, per layer: ``k``/``v`` pools of shape
+  ``(num_blocks, block_size, Hkv*Dh)`` — the SAME fused feature-minor
+  storage as ``infer/decode.init_kv_cache`` (``ops/quant.kv_fuse``:
+  in-place single-row writes), just chopped along the sequence dim into
+  block rows.  The int8 path reuses ``ops.quant.QuantKV`` exactly:
+  int8 pools plus ``(num_blocks, Hkv, block_size)`` f32 scale pools.
+* host side: ``BlockAllocator`` — a free list over block ids with
+  allocate/free/defrag and the occupancy stats the admission policy
+  watches (``serve/admission.py``); each in-flight request holds a
+  **block table** (list of block ids), and the decode step gathers each
+  lane's table into a contiguous per-lane view (``pool_gather``) that
+  feeds the unmodified cached-attention cores (``ops.quant.kv_attend``
+  — einsum or the Pallas one-pass kernel with a per-lane bias row).
+
+Sharding: the pool's block dim is the sequence dim chopped up, so it
+carries the ``act_seq`` logical axis (context-parallel serving shards
+the pool over ``seq``); the fused feature dim keeps ``act_heads``
+(tensor-parallel decode).  Validated by the ``serve_decode`` contract
+probe (``analysis/contracts.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ddl_tpu.ops.quant import QuantKV, quantize_q8
+
+__all__ = [
+    "BlockAllocator",
+    "PoolExhausted",
+    "blocks_for",
+    "cache_write_token",
+    "init_kv_pool",
+    "pool_gather",
+    "pool_write_prefill",
+    "pool_write_token",
+    "apply_block_permutation",
+]
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``tokens`` cache rows (ceil division)."""
+    if tokens <= 0:
+        raise ValueError(f"tokens must be > 0, got {tokens}")
+    return -(-tokens // block_size)
+
+
+class PoolExhausted(RuntimeError):
+    """Raised by ``BlockAllocator.alloc`` when the pool cannot satisfy a
+    request — the scheduler checks ``can_alloc`` first, so reaching this
+    from the engine is a bookkeeping bug, not an overload condition."""
+
+
+class BlockAllocator:
+    """Host-side free list over the pool's block ids.
+
+    Lowest-id-first allocation keeps live blocks packed toward the front
+    of the pool (gathers touch a compact prefix; ``defrag`` restores the
+    property when interleaved retire/admit churn breaks it).  Invariants
+    (pinned by tests/test_serve.py): a block is never handed out twice,
+    never freed twice, and ``free + in_use == num_blocks`` always.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError(
+                f"need num_blocks >= 1 and block_size >= 1, got "
+                f"{num_blocks}, {block_size}"
+            )
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._free = list(range(num_blocks))  # kept ascending
+        self._used: set[int] = set()
+        self.high_water = 0  # max blocks ever simultaneously in use
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._used)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n < 1:
+            raise ValueError(f"alloc needs n >= 1, got {n}")
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} blocks, {len(self._free)} free of "
+                f"{self.num_blocks}"
+            )
+        ids, self._free = self._free[:n], self._free[n:]
+        self._used.update(ids)
+        self.high_water = max(self.high_water, len(self._used))
+        return ids
+
+    def free(self, ids) -> None:
+        ids = list(ids)
+        bad = [i for i in ids if i not in self._used]
+        if bad:
+            raise ValueError(
+                f"freeing blocks not currently allocated: {sorted(bad)}"
+            )
+        self._used.difference_update(ids)
+        self._free = sorted(self._free + ids)
+
+    def fragmentation(self) -> float:
+        """Fraction of the live span that is holes: 1 - used/(max_used+1).
+        0.0 when live blocks are packed at the front (or the pool is
+        empty) — the quantity ``defrag`` drives back to zero."""
+        if not self._used:
+            return 0.0
+        span = max(self._used) + 1
+        return 1.0 - len(self._used) / span
+
+    def compaction_plan(self) -> dict[int, int] | None:
+        """old-id -> new-id mapping that packs live blocks to the lowest
+        ids (preserving relative order), or None when already packed.
+        The caller must apply it to the device pools AND every request's
+        block table (``apply_block_permutation``), then ``commit_plan``.
+        """
+        live = sorted(self._used)
+        plan = {old: new for new, old in enumerate(live) if old != new}
+        return plan or None
+
+    def commit_plan(self, plan: dict[int, int]) -> None:
+        """Adopt a compaction plan: live blocks occupy [0, used)."""
+        self._used = {plan.get(i, i) for i in self._used}
+        self._free = sorted(set(range(self.num_blocks)) - self._used)
+
+    def stats(self) -> dict:
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "free": self.free_blocks,
+            "used": self.used_blocks,
+            "high_water": self.high_water,
+            "fragmentation": round(self.fragmentation(), 4),
+        }
+
+
+def init_kv_pool(
+    cfg, num_blocks: int, block_size: int, dtype=None, quant: bool = False,
+) -> tuple:
+    """Per-layer zeroed block pools — ``init_kv_cache``'s layouts with the
+    sequence dim chopped into ``num_blocks`` rows of ``block_size``.
+
+    Plain: ``(k, v)`` of shape (num_blocks, block_size, Hkv*Dh).
+    ``quant=True``: ``QuantKV`` leaves — int8 pools + (num_blocks, Hkv,
+    block_size) f32 scales, the same per-(token, head) granularity as
+    the contiguous int8 cache, so ``ops.quant.kv_attend`` reads a
+    gathered pool without knowing it was paged."""
+    if quant and dtype is not None:
+        raise ValueError(
+            "quant=True fixes the pool layout (int8 + f32 scales); "
+            "dtype cannot be combined with it"
+        )
+    dtype = dtype or cfg.dtype
+    shape = (num_blocks, block_size, cfg.kv_heads * cfg.head_dim)
+    if quant:
+        q = jnp.zeros(shape, jnp.int8)
+        s = jnp.zeros((num_blocks, cfg.kv_heads, block_size), jnp.float32)
+        return tuple(QuantKV(q, s, q, s) for _ in range(cfg.n_layers))
+    zero = jnp.zeros(shape, dtype)
+    return tuple((zero, zero) for _ in range(cfg.n_layers))
+
+
+def pool_write_prefill(pool_layer, cache_layer, block_ids):
+    """Scatter one request's contiguous prefill cache into its blocks.
+
+    ``cache_layer`` is a (1, Pb, fused) single-request cache (bf16 tuple
+    or QuantKV) fresh out of ``infer.decode.LMDecode`` prefill;
+    ``block_ids`` (Pb / block_size,) int32 — entries >= num_blocks are
+    dropped (bucket padding beyond the request's reservation).  Rows
+    past the true prompt length carry pad-token K/V; they are always
+    overwritten by ``pool_write_token`` before the length mask ever
+    exposes them."""
+    nb = (
+        pool_layer.kq if isinstance(pool_layer, QuantKV) else pool_layer[0]
+    ).shape[0]
+    del nb  # shape-checked by the scatter itself; kept for readability
+    if isinstance(pool_layer, QuantKV):
+        bs = pool_layer.kq.shape[1]
+        hkv = pool_layer.ks.shape[1]
+        n = block_ids.shape[0]
+
+        def rows(x):  # (1, Pb, fused) -> (n, bs, fused)
+            return x[0].reshape(n, bs, x.shape[-1])
+
+        def scales(s):  # (1, Hkv, Pb) -> (n, Hkv, bs)
+            return s[0].reshape(hkv, n, bs).transpose(1, 0, 2)
+
+        return QuantKV(
+            pool_layer.kq.at[block_ids].set(
+                rows(cache_layer.kq), mode="drop"
+            ),
+            pool_layer.ks.at[block_ids].set(
+                scales(cache_layer.ks), mode="drop"
+            ),
+            pool_layer.vq.at[block_ids].set(
+                rows(cache_layer.vq), mode="drop"
+            ),
+            pool_layer.vs.at[block_ids].set(
+                scales(cache_layer.vs), mode="drop"
+            ),
+        )
+    pk, pv = pool_layer
+    ck, cv = cache_layer
+    bs = pk.shape[1]
+    n = block_ids.shape[0]
+    rows = lambda x: x[0].reshape(n, bs, x.shape[-1])
+    return (
+        pk.at[block_ids].set(rows(ck).astype(pk.dtype), mode="drop"),
+        pv.at[block_ids].set(rows(cv).astype(pv.dtype), mode="drop"),
+    )
+
+
+def pool_write_token(pool_layer, k, v, blk, slot):
+    """Write one new K/V row per lane into the pool.
+
+    ``k``/``v``: (B, 1, Hkv, Dh) fresh projections; ``blk``/``slot``:
+    (B,) int32 — each lane's target block and in-block row.  Lanes with
+    ``blk >= num_blocks`` (idle lanes) are dropped.  QuantKV pools
+    quantize on the way in, exactly like ``ops.quant.kv_write``."""
+    b = k.shape[0]
+    kf = k.reshape(b, -1)  # fused (B, Hkv*Dh)
+    vf = v.reshape(b, -1)
+    if isinstance(pool_layer, QuantKV):
+        kq, ks = quantize_q8(k)
+        vq, vs = quantize_q8(v)
+        kqf = kq.reshape(b, -1)
+        vqf = vq.reshape(b, -1)
+        kss = ks[:, 0, :, 0].astype(pool_layer.ks.dtype)  # (B, Hkv)
+        vss = vs[:, 0, :, 0].astype(pool_layer.vs.dtype)
+        return QuantKV(
+            pool_layer.kq.at[blk, slot].set(kqf, mode="drop"),
+            pool_layer.ks.at[blk, :, slot].set(kss, mode="drop"),
+            pool_layer.vq.at[blk, slot].set(vqf, mode="drop"),
+            pool_layer.vs.at[blk, :, slot].set(vss, mode="drop"),
+        )
+    pk, pv = pool_layer
+    return (
+        pk.at[blk, slot].set(kf.astype(pk.dtype), mode="drop"),
+        pv.at[blk, slot].set(vf.astype(pv.dtype), mode="drop"),
+    )
+
+
+def cache_write_token(cache_layer, k, v, pos):
+    """Write one new K/V row per lane into a GATHERED contiguous cache.
+
+    ``cache_layer``: (B, L, fused) tuple / QuantKV straight out of
+    ``pool_gather``; ``pos``: (B,) int32, each lane's row (its current
+    length).  The decode chunk gathers each lane's table ONCE per
+    dispatch and then appends rows here — a (B, fused) scatter per step
+    instead of re-gathering the whole (B, L, fused) view per layer per
+    step.  Row ``pos[b]`` of lane b's gathered view is exactly position
+    ``(blk, slot)`` of the pool (`pos = table_index * block_size +
+    slot`), so attention over this cache is bit-identical to attention
+    over a fresh gather."""
+    b = k.shape[0]
+    lanes = jnp.arange(b)
+    kf = k.reshape(b, -1)
+    vf = v.reshape(b, -1)
+    if isinstance(cache_layer, QuantKV):
+        kq, ks = quantize_q8(k)
+        vq, vs = quantize_q8(v)
+        return QuantKV(
+            cache_layer.kq.at[lanes, pos].set(kq.reshape(b, -1)),
+            cache_layer.ks.at[lanes, :, pos].set(
+                ks[:, 0, :, 0].astype(cache_layer.ks.dtype)
+            ),
+            cache_layer.vq.at[lanes, pos].set(vq.reshape(b, -1)),
+            cache_layer.vs.at[lanes, :, pos].set(
+                vs[:, 0, :, 0].astype(cache_layer.vs.dtype)
+            ),
+        )
+    ck, cv = cache_layer
+    return (
+        ck.at[lanes, pos].set(kf.astype(ck.dtype)),
+        cv.at[lanes, pos].set(vf.astype(cv.dtype)),
+    )
+
+
+def pool_gather(pool_layer, tables):
+    """Gather each lane's block table into a contiguous per-lane cache.
+
+    ``tables``: (B, max_blocks) int32 — idle entries use an
+    out-of-range id and clip to the last block; the caller's length mask
+    never exposes those rows.  Returns the (B, L, fused) tuple / QuantKV
+    layout ``ops.quant.kv_attend`` expects, L = max_blocks * block_size.
+    """
+    b, nmax = tables.shape
+    # mode="clip", NOT the jnp.take default "fill": out-of-range ids
+    # would otherwise gather NaN rows, and a masked NaN still poisons
+    # the softmax output through 0 * NaN on the value side
+    if isinstance(pool_layer, QuantKV):
+        bs = pool_layer.kq.shape[1]
+        hkv = pool_layer.ks.shape[1]
+
+        def rows(x):  # (B, nmax, bs, fused) -> (B, L, fused)
+            g = jnp.take(x, tables, axis=0, mode="clip")
+            return g.reshape(b, nmax * bs, x.shape[-1])
+
+        def scales(s):  # (B, nmax, Hkv, bs) -> (B, Hkv, L)
+            g = jnp.take(s, tables, axis=0, mode="clip")
+            return g.transpose(0, 2, 1, 3).reshape(b, hkv, nmax * bs)
+
+        return QuantKV(
+            rows(pool_layer.kq), scales(pool_layer.ks),
+            rows(pool_layer.vq), scales(pool_layer.vs),
+        )
+    pk, pv = pool_layer
+    bs = pk.shape[1]
+    rows = lambda x: jnp.take(x, tables, axis=0, mode="clip").reshape(
+        b, nmax * bs, x.shape[-1]
+    )
+    return (rows(pk), rows(pv))
+
+
+def apply_block_permutation(pools, plan: dict[int, int], num_blocks: int):
+    """Move pool rows per a compaction plan (device-side half of
+    ``BlockAllocator.compaction_plan``): new row j reads old row
+    ``inverse(j)``; rows not mentioned keep their id."""
+    inv = list(range(num_blocks))
+    for old, new in plan.items():
+        inv[new] = old
+    perm = jnp.asarray(inv, jnp.int32)
+    take = lambda x: jnp.take(x, perm, axis=0)
+
+    def one(layer):
+        if isinstance(layer, QuantKV):
+            return QuantKV(*(take(a) for a in layer))
+        return tuple(take(a) for a in layer)
+
+    return tuple(one(layer) for layer in pools)

@@ -1,0 +1,185 @@
+"""Continuous-batching scheduler: lanes, block tables, admit/retire.
+
+The PR-5 two-program TTFT split (``infer/decode.py``: prefill+first-token
+then the decode tail) was an observability trick on a single request;
+this module promotes that split to the serving architecture.  The decode
+batch is ``max_batch`` **lanes**; every engine iteration:
+
+1. finished lanes retire — their pool blocks go back to the allocator
+   and the lane frees up (``retire``),
+2. queued prompts are admitted into free lanes while the pool can hold
+   their worst-case footprint (``try_admit`` — prefill runs per request
+   as its own program, so a long prompt never stalls in-flight decodes
+   behind a monolithic batch rebuild),
+3. one batched decode step advances ALL active lanes together.
+
+Admission reserves ``blocks_for(prompt + max_new)`` up front: a request
+that is admitted can always run to completion — the scheduler never
+needs to preempt a lane mid-flight to reclaim memory, which keeps the
+retire path trivial and the shed policy (``serve/admission.py``) the
+only place requests are dropped.
+
+Pure host-side bookkeeping (no JAX import): the engine
+(``serve/engine.py``) owns the device arrays, this module owns which
+lane/block holds what.  That split is what makes admission order,
+retire-and-recycle, and shed determinism unit-testable in microseconds
+(tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ddl_tpu.serve.kv_pool import BlockAllocator, blocks_for
+
+__all__ = ["Request", "LaneState", "ContinuousScheduler"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One client prompt.  ``prompt`` is a 1-D int32 token array (numpy
+    — nothing here touches devices); ``submitted_at`` is a
+    ``perf_counter`` timestamp so queueing delay is measurable."""
+
+    id: str
+    prompt: Any
+    max_new: int
+    submitted_at: float | None = None
+    rng_seed: int = 0
+
+    def __post_init__(self):
+        if self.max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {self.max_new}")
+        if len(self.prompt) < 1:
+            raise ValueError("empty prompt")
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    def total_tokens(self) -> int:
+        # cache rows the request can ever hold: the prompt plus every
+        # generated token except the last (sampled, never forwarded)
+        return self.prompt_len + self.max_new - 1
+
+
+@dataclasses.dataclass
+class LaneState:
+    """One in-flight request bound to a decode-batch lane."""
+
+    lane: int
+    request: Request
+    block_ids: list[int]
+    length: int  # cache rows written so far
+    pending_tok: int  # sampled, not yet forwarded
+    outputs: list[int]  # sampled tokens, outputs[0] = the TTFT token
+    admitted_at: float = 0.0
+    ttft_s: float | None = None
+    cold: bool = False  # paid an XLA compile (excluded from percentiles)
+    finished_at: float | None = None
+
+    @property
+    def done(self) -> bool:
+        return len(self.outputs) >= self.request.max_new
+
+
+class ContinuousScheduler:
+    """Lane + block bookkeeping for the continuous batch.
+
+    ``min_free_blocks`` is the pool watermark: admission keeps at least
+    that many blocks free AFTER the reservation — headroom the operator
+    sets so a burst of admissions cannot starve the pool to exactly
+    zero (admission control's second watermark, next to queue depth).
+    """
+
+    def __init__(
+        self,
+        allocator: BlockAllocator,
+        max_batch: int,
+        max_blocks_per_seq: int,
+        min_free_blocks: int = 0,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.allocator = allocator
+        self.max_batch = int(max_batch)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.min_free_blocks = int(min_free_blocks)
+        self.lanes: list[Optional[LaneState]] = [None] * max_batch
+        self.peak_lanes = 0
+
+    # -- capacity queries -------------------------------------------------
+    def blocks_needed(self, req: Request) -> int:
+        return blocks_for(req.total_tokens(), self.allocator.block_size)
+
+    def fits_ever(self, req: Request) -> bool:
+        """False when the request exceeds the engine's static envelope —
+        it must be rejected outright, no amount of waiting helps: wider
+        than a block table, or a footprint the pool can never cover
+        once the ``min_free_blocks`` watermark is held back (queueing
+        such a request would park it at the head forever and livelock
+        the drain loop behind it)."""
+        need = self.blocks_needed(req)
+        return (
+            need <= self.max_blocks_per_seq
+            and need + self.min_free_blocks <= self.allocator.num_blocks
+        )
+
+    def free_lane(self) -> int | None:
+        for i, lane in enumerate(self.lanes):
+            if lane is None:
+                return i
+        return None
+
+    def can_admit(self, req: Request) -> bool:
+        return (
+            self.free_lane() is not None
+            and self.allocator.can_alloc(
+                self.blocks_needed(req) + self.min_free_blocks
+            )
+        )
+
+    # -- state transitions ------------------------------------------------
+    def try_admit(self, req: Request) -> LaneState | None:
+        """Bind ``req`` to a free lane and reserve its whole block
+        footprint; None when a lane or the watermark says wait."""
+        if not self.fits_ever(req):
+            raise ValueError(
+                f"request {req.id!r} needs {self.blocks_needed(req)} "
+                f"blocks > max_blocks_per_seq={self.max_blocks_per_seq}"
+            )
+        lane = self.free_lane()
+        if lane is None or not self.can_admit(req):
+            return None
+        ids = self.allocator.alloc(self.blocks_needed(req))
+        state = LaneState(
+            lane=lane, request=req, block_ids=ids,
+            length=req.prompt_len, pending_tok=0, outputs=[],
+        )
+        self.lanes[lane] = state
+        self.peak_lanes = max(
+            self.peak_lanes, sum(l is not None for l in self.lanes)
+        )
+        return state
+
+    def retire(self, lane: int) -> LaneState:
+        """Unbind a lane and recycle its blocks."""
+        state = self.lanes[lane]
+        if state is None:
+            raise ValueError(f"lane {lane} is not active")
+        self.allocator.free(state.block_ids)
+        self.lanes[lane] = None
+        return state
+
+    def active(self) -> list[LaneState]:
+        return [l for l in self.lanes if l is not None]
+
+    def finished(self) -> list[LaneState]:
+        return [l for l in self.lanes if l is not None and l.done]
+
+    def remap_blocks(self, plan: dict[int, int]) -> None:
+        """Rewrite every live block table per a compaction plan (the
+        host half of ``kv_pool.apply_block_permutation``)."""
+        for state in self.active():
+            state.block_ids = [plan.get(i, i) for i in state.block_ids]

@@ -1,0 +1,49 @@
+"""Serve a transformer-LM training snapshot with continuous batching.
+
+Companion to train_lm.py / generate_lm.py: point it at the same
+--checkpoint-dir/--job-id and model flags, and the continuous-batching
+engine (``ddl_tpu/serve/``) serves the saved weights to N concurrent
+synthetic clients — paged KV pool, bucketed prefill, admission control —
+and renders the serving percentile report (p50/p95/p99 latency / queue
+delay / TTFT / tokens-per-s, aggregate tokens/s/chip):
+
+    python examples/train_lm.py --cpu-devices 8 --steps 200 \
+        --checkpoint-dir /tmp/ck --save-every 100
+    python examples/serve_lm.py --cpu-devices 1 --checkpoint-dir /tmp/ck \
+        --job-id lm --step 200 --clients 16 --prompt-len 8:24 \
+        --max-new 32:64
+
+Where generate_lm.py decodes ONE fused batch per invocation (the
+one-request-at-a-time baseline), this drives the serving loop: prompts
+are admitted into the in-flight decode batch as lanes free up, finished
+sequences retire and recycle their KV blocks, and overload is shed at
+the front door.  `--compare-sequential` reports the throughput ratio
+against generate_lm.py-style sequential decodes at equal settings.
+
+This is ``ddl_tpu serve-bench`` with a checkpoint required — all flags
+are shared (see ``python -m ddl_tpu.cli serve-bench --help``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    from ddl_tpu.serve.bench import main as bench_main
+
+    argv = sys.argv[1:]
+    if "--checkpoint-dir" not in argv and "--help" not in argv:
+        raise SystemExit(
+            "serve_lm.py serves a training snapshot: --checkpoint-dir "
+            "(and --step) are required.  For random-init smoke mode use "
+            "`python -m ddl_tpu.cli serve-bench` directly."
+        )
+    bench_main(argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,9 @@ SLOW_TESTS = (
     "test_parallel.py::test_strategies_learn",
     "test_pipeline_deep.py::",
     "test_preemption.py::test_sigterm_mid_training_checkpoints_and_resumes",
+    "test_serve.py::test_engine_matches_sequential_decode",
+    "test_serve.py::test_engine_matches_sequential_variants",
+    "test_serve.py::test_shed_under_pressure_e2e",
     "test_trainer.py::test_resume_from_snapshot",
     "test_trainer.py::test_trainer_end_to_end",
     "test_transformer.py::TestLearning::test_remat_policy_invariance",

@@ -47,7 +47,13 @@ def main() -> None:
     from ddl_tpu.utils.timing import fence
 
     batch = 30
-    cfg = ModelConfig(compute_dtype="bfloat16")
+    # DDL_BENCH_IMPL enables same-session A/Bs of the dense-block impls
+    # (packed default; "fused" = the round-6 Pallas block) without
+    # editing the bench — the knob the gate/PERF.md protocol names.
+    cfg = ModelConfig(
+        compute_dtype="bfloat16",
+        dense_block_impl=os.environ.get("DDL_BENCH_IMPL", "packed"),
+    )
     stages = build_stages(cfg, num_stages=1)
     tx = make_optimizer(TrainConfig())
     state = create_train_state(stages, tx, jax.random.key(0), image_size=224)
@@ -106,9 +112,19 @@ def main() -> None:
         "value_undifferenced": round(undiff, 4),
     }
     # chip utilization: executed FLOPs from XLA cost analysis / peak bf16
-    from ddl_tpu.bench.mfu import append_mfu
+    from ddl_tpu.bench.mfu import append_mfu, fused_dense_block_train_flops
 
-    append_mfu(out, fns.train, slope, state, images, labels)
+    extra = 0.0
+    if cfg.dense_block_impl == "fused":
+        # cost analysis sees zero FLOPs in a Pallas custom call; restore
+        # the fused blocks' work analytically (model convention)
+        extra = fused_dense_block_train_flops(
+            batch, 224, cfg.block_config, cfg.growth_rate, cfg.bn_size,
+            cfg.num_init_features, cfg.dense_block_fused_blocks,
+        )
+        out["impl"] = cfg.dense_block_impl
+    append_mfu(out, fns.train, slope, state, images, labels,
+               extra_flops=extra)
     print(json.dumps(out))
 
 

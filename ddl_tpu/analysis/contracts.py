@@ -191,7 +191,11 @@ def _tiny_lm_cfg():
     )
 
 
-def _probe_cnn() -> _Probe:
+def _cnn_probe(what: str, check_fused_adam: bool = False,
+               eval_too: bool = False, **cfg_overrides) -> _Probe:
+    """Shared CNN DP probe scaffolding: tiny config + data=2 mesh +
+    boundary/lowering/replication checks; variants differ only in model
+    config overrides and extra checks."""
     import jax
     import jax.numpy as jnp
 
@@ -205,19 +209,50 @@ def _probe_cnn() -> _Probe:
     cfg = ModelConfig(
         growth_rate=4, block_config=(2, 2), num_init_features=8, bn_size=2,
         num_classes=5, split_blocks=(1,), compute_dtype="float32",
-        remat=False,
+        remat=False, **cfg_overrides,
     )
     mesh = build_mesh(MeshSpec(data=2))
     stages = build_stages(cfg, num_stages=1)
-    tx = make_optimizer(TrainConfig())
+    tx = make_optimizer(TrainConfig())  # fused Adam by default
     fns = make_dp_step_fns(stages, tx, mesh, jnp.float32)
     _check_boundary(probe, fns.train.contract, mesh)
+    if check_fused_adam and not fns.train.contract.get(
+        "fused_optimizer_update"
+    ):
+        probe.add(
+            "contract-trace",
+            "fused CNN probe expected the fused Adam apply path "
+            "(make_optimizer default) but the factory fell back to the "
+            "two-pass optax path",
+        )
     state = create_train_state(stages, tx, jax.random.key(0), 16)
     img = jax.ShapeDtypeStruct((8, 16, 16, 3), jnp.uint8)
     lbl = jax.ShapeDtypeStruct((8,), jnp.int32)
-    _lower(probe, fns.train, state, img, lbl, what="CNN DP train step")
+    _lower(probe, fns.train, state, img, lbl, what=f"CNN DP train step{what}")
+    if eval_too:
+        _lower(
+            probe, fns.evaluate, state, img,
+            what=f"CNN DP eval step{what}",
+        )
     _check_params(probe, state.params, mesh, fns.train.contract)
     return probe
+
+
+def _probe_cnn() -> _Probe:
+    return _cnn_probe("")
+
+
+def _probe_cnn_fused() -> _Probe:
+    """The CNN DP step factory with the round-6 fused dense-block impl
+    (Pallas VMEM-resident blocks + custom-VJP backward + fused Adam
+    apply): the composition under test is the pallas_call pair and the
+    single-pass optimizer update lowering inside the jitted SPMD step on
+    a data mesh — a kernel-boundary or custom-VJP shape bug surfaces
+    here before a chip bench ever runs."""
+    return _cnn_probe(
+        " (fused dense blocks)", check_fused_adam=True, eval_too=True,
+        dense_block_impl="fused", dense_block_fused_blocks=(0, 1),
+    )
 
 
 def _probe_lm() -> _Probe:
@@ -413,6 +448,7 @@ def _probe_vit_pipeline() -> _Probe:
 
 PROBES = (
     ("cnn_dp", _probe_cnn),
+    ("cnn_dp_fused", _probe_cnn_fused),
     ("lm_flat", _probe_lm),
     ("vit_flat", _probe_vit),
     ("lm_decode", _probe_decode),

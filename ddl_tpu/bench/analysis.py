@@ -90,25 +90,53 @@ def throughput_per_job(log_dir: Path) -> dict[str, dict[str, float]]:
     return out
 
 
-def phase_breakdown_per_job(log_dir: Path) -> dict[str, dict[str, float]]:
-    """Per-job step-phase totals (seconds) from the structured event
+def obs_summaries_per_job(log_dir: Path) -> dict[str, dict]:
+    """One ``summarize_run`` pass per job over the structured event
     streams (``ddl_tpu/obs/``) that trainers write beside the CSVs —
-    the sub-period attribution the reference's CSV schema cannot carry.
-    Jobs without an event stream (reference-framework runs, pre-obs
-    logs) are simply absent."""
+    shared by the phase-breakdown and profile-digest sections so the
+    event corpus is parsed once per report.  Jobs without an event
+    stream (reference-framework runs, pre-obs logs) are simply absent."""
     from ddl_tpu.obs.report import load_run, summarize_run
 
-    out: dict[str, dict[str, float]] = {}
+    out: dict[str, dict] = {}
     by_job = log_dir / "by_job_id"
     if not by_job.is_dir():
         return out
     for job_dir in sorted(by_job.glob("*")):
         events = load_run(log_dir, job_dir.name)
-        if not events:
-            continue
-        summary = summarize_run(events)
-        if summary["phases"]:
-            out[job_dir.name] = summary["phases"]
+        if events:
+            out[job_dir.name] = summarize_run(events)
+    return out
+
+
+def phase_breakdown_per_job(
+    log_dir: Path, summaries: dict[str, dict] | None = None
+) -> dict[str, dict[str, float]]:
+    """Per-job step-phase totals (seconds) — the sub-period attribution
+    the reference's CSV schema cannot carry."""
+    if summaries is None:
+        summaries = obs_summaries_per_job(log_dir)
+    return {
+        job: s["phases"] for job, s in summaries.items() if s["phases"]
+    }
+
+
+def profile_digests_per_job(
+    log_dir: Path, summaries: dict[str, dict] | None = None
+) -> dict[str, list[dict]]:
+    """Per-job anomaly-triggered profile captures with their stored
+    per-op digests (``profile_capture`` events, ``obs/profiler.py``) —
+    the perf-PR evidence channel surfaced in the offline report, so a
+    regression investigation starts from this table instead of a raw
+    trace directory (render any trace in full with ``ddl_tpu bench
+    digest <dir>``)."""
+    if summaries is None:
+        summaries = obs_summaries_per_job(log_dir)
+    out: dict[str, list[dict]] = {}
+    for job, s in summaries.items():
+        captures = s.get("profile_captures") or []
+        if captures:
+            out[job] = captures
     return out
 
 
@@ -148,13 +176,31 @@ def main(argv=None):
     print("== mean throughput per job ==")
     for job, rates in throughput_per_job(log_dir).items():
         print(f"  {job}: " + " ".join(f"{m}={v:.1f}" for m, v in rates.items()))
+    summaries = obs_summaries_per_job(log_dir)
     print("== step-phase breakdown per job (s, from event streams) ==")
-    for job, phases in phase_breakdown_per_job(log_dir).items():
+    for job, phases in phase_breakdown_per_job(log_dir, summaries).items():
         body = " ".join(
             f"{name}={dur:.2f}"
             for name, dur in sorted(phases.items(), key=lambda kv: -kv[1])
         )
         print(f"  {job}: {body}")
+    digests = profile_digests_per_job(log_dir, summaries)
+    if digests:
+        print("== profile captures per job (top op categories, ms) ==")
+        for job, captures in digests.items():
+            for c in captures:
+                if not c.get("ok"):
+                    print(f"  {job} [{c.get('trigger', '?')}]: "
+                          f"capture failed ({c.get('error')})")
+                    continue
+                dig = c.get("digest") or {}
+                ops = "  ".join(
+                    f"{k}={v:.1f}" for k, v in list(
+                        (dig.get("ops") or {}).items()
+                    )[:5]
+                )
+                print(f"  {job} step {c.get('step')} "
+                      f"[{c.get('trigger')}]: {ops or c.get('trace_dir')}")
     print("== communication round-trip per job ==")
     for job, r in comm_time_summary(log_dir).items():
         print(f"  {job}: mean={r['mean_ms']:.3f}ms init={r['init_ms']:.1f}ms n={r['iterations']}")

@@ -104,16 +104,27 @@ def _bench_one(
 
     t_pre, t1, t2 = timed(1), timed(n1), timed(n2)
     ms_per_tok = (t2 - t1) / (n2 - n1) * 1e3
+    slope_fallback = False
     if ms_per_tok <= 0:
         # a host-contention spike in one of the two runs can make the
         # difference negative; one resample of the pair before reporting
         t1, t2 = timed(n1), timed(n2)
         ms_per_tok = (t2 - t1) / (n2 - n1) * 1e3
     if ms_per_tok <= 0:
-        raise RuntimeError(
-            f"host contention: decode slope non-positive after resample "
-            f"({ms_per_tok:.4f} ms/tok) — rerun on a quieter machine"
-        )
+        if jax.devices()[0].platform == "tpu":
+            # a real-chip quote must be slope-honest or not reported
+            raise RuntimeError(
+                f"host contention: decode slope non-positive after "
+                f"resample ({ms_per_tok:.4f} ms/tok) — rerun on a "
+                f"quieter machine"
+            )
+        # CPU harness runs (tier-1's bench smoke): sub-microsecond CPU
+        # walls make the two-length slope pure noise, and a raise here
+        # was a suite-order-dependent flake (PR 6 verify).  Fall back to
+        # the undifferenced long-run quote — deterministic and positive,
+        # fixed dispatch cost included — and say so in the row.
+        ms_per_tok = t2 / n2 * 1e3
+        slope_fallback = True
     kv = cfg.kv_heads
     # windowed rows use the O(window)-memory ring cache (the generator's
     # rolling auto-mode); read the real allocation from init_kv_cache so
@@ -146,6 +157,9 @@ def _bench_one(
         "batch": batch,
         "prefill_ms": round(t_pre * 1e3, 1),
         "decode_ms_per_tok": round(ms_per_tok, 3),
+        # CPU-only: the slope was noise-negative and this row quotes the
+        # undifferenced wall-clock rate instead (never set on TPU rows)
+        **({"slope_fallback": True} if slope_fallback else {}),
         "decode_tok_per_sec": round(batch / (ms_per_tok / 1e3), 1),
         # allocation vs what one decode step actually reads per layer
         "cache_bytes_per_layer": layer_bytes,

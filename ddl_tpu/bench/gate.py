@@ -1,0 +1,281 @@
+"""``ddl_tpu bench`` — the headline perf gate and the op-digest renderer.
+
+Two jobs, both born from round 6's "the headline can never silently
+regress again" rule:
+
+* **MFU / steps-per-sec regression gate** (``ddl_tpu bench
+  --fail-mfu-drop F [--fail-slowdown F]``): compares a headline bench
+  result (run in-process on the chip, or read from a stored JSON line
+  via ``--result``) against the ``headline`` block stored in
+  ``BASELINE.json`` and exits nonzero when steps/sec or MFU dropped by
+  more than the given fraction — the bench-side sibling of ``obs diff
+  --fail-slowdown``.  ``--update-baseline`` stores an intentional new
+  headline.
+
+* **Digest renderer** (``ddl_tpu bench digest <trace_dir|latest>``):
+  renders the ``bench/xprof.op_digest`` top-N per-op-category table for
+  any captured trace — the ROADMAP's "open every perf PR with a digest"
+  rule as one command instead of a Python one-liner.  ``latest``
+  resolves the newest ``*.xplane.pb`` under the usual capture roots
+  (``DDL_OBS_PROFILE_DIR``, ``<log dir>/xprof``, and the
+  ``dn_prof_*``/``lm_prof_*``/``decode_prof_*`` temp dirs the profile
+  benches write).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+__all__ = ["main"]
+
+_HEADLINE_METRIC = "densenet121_train_steps_per_sec_bs30_1chip"
+
+
+# ---------------------------------------------------------------------------
+# digest
+# ---------------------------------------------------------------------------
+
+
+def _latest_trace_dir() -> str | None:
+    """Newest ``*.xplane.pb`` under the known capture roots; returns its
+    directory (op_digest globs recursively from there)."""
+    roots: list[str] = []
+    env_dir = os.environ.get("DDL_OBS_PROFILE_DIR")
+    if env_dir:
+        roots.append(env_dir)
+    log_dir = os.environ.get("DDL_LOG_DIR", "training_logs")
+    roots.extend([os.path.join(log_dir, "xprof"), "xprof"])
+    tmp = tempfile.gettempdir()
+    for prefix in ("dn_prof_", "lm_prof_", "decode_prof_"):
+        roots.extend(glob.glob(os.path.join(tmp, prefix + "*")))
+    newest: tuple[float, str] | None = None
+    for root in roots:
+        for p in glob.glob(
+            os.path.join(root, "**", "*.xplane.pb"), recursive=True
+        ):
+            m = os.path.getmtime(p)
+            if newest is None or m > newest[0]:
+                newest = (m, os.path.dirname(p))
+    return newest[1] if newest else None
+
+
+def _digest(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ddl_tpu bench digest",
+        description="Render the per-op-category device-time digest of a "
+        "captured jax.profiler trace (bench/xprof.op_digest).",
+    )
+    ap.add_argument(
+        "trace", help="trace directory, or 'latest' for the newest "
+        "capture under the standard roots",
+    )
+    ap.add_argument("--top", type=int, default=5,
+                    help="categories to list (default 5)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    trace_dir = args.trace
+    if trace_dir == "latest":
+        trace_dir = _latest_trace_dir()
+        if trace_dir is None:
+            print("bench digest: no *.xplane.pb found under the capture "
+                  "roots (DDL_OBS_PROFILE_DIR, <log dir>/xprof, temp "
+                  "dn_prof_*/lm_prof_*/decode_prof_*)", file=sys.stderr)
+            return 2
+    from ddl_tpu.bench.xprof import op_digest
+
+    try:
+        dig = op_digest(trace_dir, top=args.top)
+    except FileNotFoundError as e:
+        print(f"bench digest: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps({"trace_dir": trace_dir, **dig}))
+        return 0
+    print(f"# digest: {trace_dir}")
+    print(f"# total sync-op time: {dig['total_ms']:.3f} ms "
+          f"(module {dig['module_ms']:.3f} ms)")
+    total = dig["total_ms"] or 1.0
+    for cat, ms in dig["ops"].items():
+        print(f"  {cat:44s} {ms:10.3f} ms  ({100 * ms / total:5.1f}%)")
+    if dig.get("top_op"):
+        print(f"# top op: {dig['top_op']}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# gate
+# ---------------------------------------------------------------------------
+
+
+def _load_result(path: str | None) -> dict:
+    """A headline bench result: the last JSON line of ``--result`` (file
+    or '-') — or a fresh in-process run of the headline bench (real
+    chip)."""
+    if path is None:
+        import io
+        from contextlib import redirect_stdout
+
+        import bench as headline_bench
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            headline_bench.main()
+        text = buf.getvalue()
+        print(text, end="")
+    elif path == "-":
+        text = sys.stdin.read()
+    else:
+        with open(path) as fh:
+            text = fh.read()
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise ValueError("no JSON result line found")
+
+
+def _gate(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ddl_tpu bench",
+        description="Headline-bench regression gate: compare steps/sec "
+        "and MFU against the headline block in BASELINE.json.",
+    )
+    ap.add_argument(
+        "--result", default=None,
+        help="stored bench JSON line (file or '-'); default runs the "
+        "headline bench in-process (needs the real chip)",
+    )
+    ap.add_argument("--baseline", default="BASELINE.json")
+    ap.add_argument(
+        "--fail-mfu-drop", type=float, default=None, metavar="F",
+        help="exit 1 when MFU dropped by more than fraction F",
+    )
+    ap.add_argument(
+        "--fail-slowdown", type=float, default=None, metavar="F",
+        help="exit 1 when steps/sec dropped by more than fraction F",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="store this result as the new headline in the baseline file",
+    )
+    args = ap.parse_args(argv)
+    if (
+        args.fail_mfu_drop is None
+        and args.fail_slowdown is None
+        and not args.update_baseline
+    ):
+        ap.error("nothing to do: pass --fail-mfu-drop/--fail-slowdown "
+                 "and/or --update-baseline (digest: `bench digest ...`)")
+
+    try:
+        result = _load_result(args.result)
+    except (OSError, ValueError, ImportError) as e:
+        # ImportError: the in-process path imports the repo-root bench.py,
+        # which needs cwd=/root/repo like every -m entry point
+        print(f"bench gate: cannot load result: {e}", file=sys.stderr)
+        return 2
+    if result.get("metric") not in (None, _HEADLINE_METRIC):
+        print(f"bench gate: unexpected metric {result.get('metric')!r}",
+              file=sys.stderr)
+        return 2
+
+    with open(args.baseline) as fh:
+        baseline = json.load(fh)
+
+    if args.update_baseline:
+        prev = baseline.get("headline") or {}
+        baseline["headline"] = {
+            "metric": result.get("metric", _HEADLINE_METRIC),
+            "steps_per_sec": result["value"],
+            "mfu": result.get("mfu"),
+            "tflops_per_step": result.get("tflops_per_step"),
+            # provenance survives updates (how/where the number was taken)
+            "source": prev.get(
+                "source", "ddl_tpu bench --update-baseline"
+            ),
+        }
+        if result.get("mfu") is None:
+            # a null stored MFU makes every future --fail-mfu-drop run
+            # FAIL loudly (missing metrics gate closed, below) — say so
+            print(
+                "bench gate: WARNING — result carries no 'mfu' field "
+                "(unknown chip peak?); storing null, which future "
+                "--fail-mfu-drop runs will refuse to gate against",
+                file=sys.stderr,
+            )
+        tmp = args.baseline + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(baseline, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, args.baseline)
+        print(f"bench gate: baseline headline updated -> "
+              f"{baseline['headline']}")
+        if args.fail_mfu_drop is None and args.fail_slowdown is None:
+            return 0
+
+    head = baseline.get("headline")
+    if not head:
+        print(f"bench gate: {args.baseline} has no 'headline' block — "
+              "store one with --update-baseline", file=sys.stderr)
+        return 2
+
+    failures = []
+    rows = []
+
+    def check(name, new, old, frac):
+        if old in (None, 0) or new is None:
+            rows.append((name, new, old, None))
+            if frac is not None:
+                # fail CLOSED: a requested gate with a missing metric is
+                # a failure, not a silent pass — otherwise a result
+                # without an 'mfu' field (unknown chip peak) waves every
+                # MFU regression through
+                failures.append(
+                    f"cannot gate {name}: metric missing "
+                    f"({'baseline' if old in (None, 0) else 'result'} "
+                    f"has no usable value; baseline={old!r}, new={new!r})"
+                )
+            return
+        drop = 1.0 - float(new) / float(old)
+        rows.append((name, new, old, drop))
+        if frac is not None and drop > frac:
+            failures.append(
+                f"{name} dropped {100 * drop:.1f}% "
+                f"({old} -> {new}, limit {100 * frac:.0f}%)"
+            )
+
+    check("steps_per_sec", result.get("value"),
+          head.get("steps_per_sec"), args.fail_slowdown)
+    check("mfu", result.get("mfu"), head.get("mfu"), args.fail_mfu_drop)
+
+    print("== bench gate (vs baseline headline) ==")
+    for name, new, old, drop in rows:
+        d = "n/a" if drop is None else f"{-100 * drop:+.1f}%"
+        print(f"  {name:14s} {old} -> {new}  ({d})")
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    gates = [
+        n for n, f in (("slowdown", args.fail_slowdown),
+                       ("mfu-drop", args.fail_mfu_drop)) if f is not None
+    ]
+    print(f"OK (gates: {', '.join(gates) if gates else 'none'})")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "digest":
+        return _digest(argv[1:])
+    return _gate(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -24,6 +24,7 @@ __all__ = [
     "device_peak_flops",
     "compiled_step_flops",
     "flash_attention_train_flops",
+    "fused_dense_block_train_flops",
     "chunked_ce_extra_flops",
     "mfu",
     "append_mfu",
@@ -122,6 +123,66 @@ def flash_attention_train_flops(
     else:
         n_matmuls = 11 if remat else 9
     return n_matmuls * matmul * n_layers
+
+
+def fused_dense_block_train_flops(
+    batch: int,
+    image_size: int,
+    block_config,
+    growth_rate: int,
+    bn_size: int,
+    num_init_features: int,
+    fused_blocks,
+    accounting: str = "model",
+) -> float:
+    """Analytic train-step FLOPs of the fused dense-block Pallas kernels
+    (``ops/fused_dense_block``) — XLA cost analysis assigns ZERO FLOPs
+    to a Pallas custom call (same probe result as the flash kernel), so
+    ``dense_block_impl="fused"`` bench rows must add the kernels' work
+    back for an honest MFU.  Counts only the blocks in ``fused_blocks``
+    (the others run as XLA ops and are already counted), per layer:
+
+    * ``"model"`` (MFU convention): the theoretical matmuls at the TRUE
+      input width — forward 1x1 + 3x3, backward dW/dx for each = 3 of
+      each; the kernel's zero-padded full-width execution and its
+      backward recompute of the forward intermediates are implementation
+      overhead and do not count.
+    * ``"executed"`` (HFU convention): what the kernels actually run —
+      four full-padded-width 1x1 matmuls (forward, backward recompute,
+      dW1, dhid) and three nine-tap 3x3 sets (forward, dh2, dW2).
+
+    The train forward's batch-stats pass is ordinary XLA and needs no
+    correction."""
+    if accounting not in ("model", "executed"):
+        raise ValueError(
+            f"accounting must be 'model' or 'executed', got {accounting!r}"
+        )
+    from ddl_tpu.ops.fused_dense_block import block_pad
+
+    bn = bn_size * growth_rate
+    f = num_init_features
+    hw = image_size // 4  # stem conv /2 + maxpool /2
+    total = 0.0
+    n_blocks = len(block_config)
+    for b, n_layers in enumerate(block_config):
+        if b in tuple(fused_blocks):
+            s = hw * hw
+            _, p_total = block_pad(f, n_layers, growth_rate)
+            for i in range(n_layers):
+                c_in = f + i * growth_rate
+                conv1 = 2.0 * s * (
+                    c_in if accounting == "model" else p_total
+                ) * bn
+                conv2 = 2.0 * s * 9 * bn * growth_rate
+                if accounting == "model":
+                    total += 3 * conv1 + 3 * conv2
+                else:
+                    total += 4 * conv1 + 3 * conv2
+        f += n_layers * growth_rate
+        if b != n_blocks - 1:
+            f //= 2
+            hw //= 2
+    return batch * total
 
 
 def chunked_ce_extra_flops(

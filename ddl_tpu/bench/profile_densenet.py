@@ -6,7 +6,10 @@ the shared ``bench/xprof`` analysis.  This is the evidence channel for
 PERF.md's "where do the headline milliseconds go" analysis (VERDICT r3
 task 1: profile the headline instead of defending it).  The default
 measures the packed impl (the config default since round 4); pass
-``--impl concat`` to reproduce the textbook-form table in PERF.md.
+``--impl concat`` to reproduce the textbook-form table in PERF.md, or
+``--impl fused`` for the round-6 trainable Pallas-block path (blocks
+per ``ModelConfig.dense_block_fused_blocks``).  The same table renders
+from any stored trace with ``ddl_tpu bench digest <trace_dir|latest>``.
 
 Usage::
 
@@ -64,7 +67,7 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--impl", default="packed",
-                    choices=("concat", "buffer", "packed"))
+                    choices=("concat", "buffer", "packed", "fused"))
     ap.add_argument("--trace-dir", default=None,
                     help="reuse an existing trace instead of capturing")
     args = ap.parse_args()

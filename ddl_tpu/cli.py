@@ -54,6 +54,16 @@ sharding-contract probes, gated by the committed ``LINT_BASELINE.json``:
     python -m ddl_tpu.cli lint [--json] [--baseline LINT_BASELINE.json]
         [--update-baseline] [--no-contracts] [paths...]
 
+Headline perf gate (``ddl_tpu/bench/gate.py``): the MFU / steps-per-sec
+regression gate against ``BASELINE.json``'s stored headline (the bench
+sibling of ``obs diff --fail-slowdown``), and the per-op device-time
+digest renderer behind the "open every perf PR with a digest" rule:
+
+    python -m ddl_tpu.cli bench --fail-mfu-drop 0.1 [--fail-slowdown 0.1]
+        [--result bench_out.json] [--baseline BASELINE.json]
+        [--update-baseline]      # needs the real chip unless --result
+    python -m ddl_tpu.cli bench digest <trace_dir|latest> [--top 5] [--json]
+
 Serving (``ddl_tpu/serve/``): the continuous-batching engine — paged
 block KV pool, admit/retire scheduler over a static decode batch,
 admission control with shed policies — benchmarked by firing N
@@ -95,6 +105,13 @@ def main(argv=None) -> None:
         from ddl_tpu.analysis.cli import main as lint_main
 
         raise SystemExit(lint_main(argv[1:]))
+    if argv and argv[0] == "bench":
+        # headline perf gate + op-digest renderer (bench/gate.py): the
+        # MFU/steps-per-sec regression gate vs BASELINE.json's headline
+        # block, and `bench digest <trace_dir|latest>`
+        from ddl_tpu.bench.gate import main as bench_main
+
+        raise SystemExit(bench_main(argv[1:]))
     if argv and argv[0] == "serve-bench":
         # continuous-batching serving benchmark (serve/bench.py); JAX
         # init is deferred until after its --cpu-devices handling

@@ -62,12 +62,22 @@ class ModelConfig:
     # How dense blocks materialise their concatenative skips: "concat"
     # (textbook jnp.concatenate per layer), "buffer" (memory-efficient:
     # one preallocated per-block feature buffer, layers write their
-    # growth-rate strip in place), or "packed" (TPU-native: lane-aligned
+    # growth-rate strip in place), "packed" (TPU-native: lane-aligned
     # 128-channel feature packs, implicit concat via per-pack 1x1-conv
     # contraction, per-pack batch stats computed once — see
-    # models/densenet.py PackedDenseBlock and PERF.md).  "packed" is the
-    # default: measured +12% on the bs-30 headline step (PERF.md round 4).
+    # models/densenet.py PackedDenseBlock and PERF.md), or "fused"
+    # (Pallas VMEM-resident whole-block kernel with custom-VJP backward
+    # and two-phase train-mode BN, applied per block by
+    # dense_block_fused_blocks with packed everywhere else — see
+    # models/densenet.py FusedDenseBlock, ops/fused_dense_block.py and
+    # PERF.md rounds 5-6).  "packed" is the default: measured +12% on
+    # the bs-30 headline step (PERF.md round 4).
     dense_block_impl: str = "packed"
+    # Which dense blocks (0-indexed) use the fused kernel when
+    # dense_block_impl == "fused".  Default = the round-5 go/no-go list:
+    # blocks 1 and 4 measured 2.9x/8.9x standalone wins; blocks 2 and 3
+    # were a wash and stay packed (PERF.md round 5).
+    dense_block_fused_blocks: Tuple[int, ...] = (0, 3)
     # Optional torchvision state_dict (.pth) to initialise from — the
     # ImageNet-pretrained start the reference uses (single.py:297); a
     # mismatched classifier head is skipped (the head swap, single.py:298-299).
@@ -108,6 +118,13 @@ class TrainConfig:
     # build_optimizer): defaults reproduce its unconfigured Adam exactly.
     weight_decay: float = 0.0  # >0 switches to decoupled AdamW
     grad_clip_norm: float = 0.0  # >0 enables global-norm clipping
+    # Compute the Adam update as ONE fusible expression per leaf
+    # (train/fused_optim.fused_adam: same math and state tree as
+    # optax.adam, so snapshots interoperate; the CNN step factory applies
+    # it in a single pass with no separate updates tree).  Only plain
+    # Adam configs fuse — weight decay / grad clipping keep the optax
+    # chain.
+    fused_adam: bool = True
     lr_schedule: str = "constant"  # "constant" | "cosine"
     warmup_steps: int = 0  # linear 0 -> lr ramp prepended to either schedule
     decay_steps: int = 0  # total steps for cosine (incl. warmup)

@@ -32,6 +32,7 @@ from ddl_tpu.config import ModelConfig
 
 __all__ = [
     "DenseNetStage",
+    "FusedDenseBlock",
     "StageSpec",
     "build_stages",
     "init_stages",
@@ -118,6 +119,22 @@ class _ConvKernel(nn.Module):
         return self.param(
             "kernel", _conv_init,
             (1, 1, self.in_features, self.out_features), jnp.float32,
+        )
+
+
+class _Conv3x3Kernel(nn.Module):
+    """Declares exactly ``nn.Conv``'s 3x3 kernel (same name, shape, init
+    stream) without applying it; the fused block's Pallas kernel runs the
+    conv itself as nine shifted matmuls."""
+
+    in_features: int
+    out_features: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param(
+            "kernel", _conv_init,
+            (3, 3, self.in_features, self.out_features), jnp.float32,
         )
 
 
@@ -281,6 +298,154 @@ class PackedTransition(nn.Module):
         return nn.avg_pool(x, (2, 2), strides=(2, 2))
 
 
+class _FusedLayerDecl(nn.Module):
+    """Declares one dense layer's full param/variable tree (norm1/conv1/
+    norm2/conv2 — bit-identical names, shapes, and init streams to
+    ``DenseLayer``/``PackedDenseLayer``) without applying anything; the
+    fused block folds and runs them through the Pallas kernel."""
+
+    c_in: int
+    bn_features: int
+    growth_rate: int
+
+    @nn.compact
+    def __call__(self):
+        s1, b1, ra1m, ra1v = _BNParams(self.c_in, name="norm1")()
+        k1 = _ConvKernel(self.c_in, self.bn_features, name="conv1")()
+        s2, b2, ra2m, ra2v = _BNParams(self.bn_features, name="norm2")()
+        k2 = _Conv3x3Kernel(
+            self.bn_features, self.growth_rate, name="conv2"
+        )()
+        params = {
+            "norm1": {"scale": s1, "bias": b1},
+            "conv1": {"kernel": k1},
+            "norm2": {"scale": s2, "bias": b2},
+            "conv2": {"kernel": k2},
+        }
+        return params, (ra1m, ra1v), (ra2m, ra2v)
+
+
+def _fused_stats_pass(x, layer_params, growth: int, dtype):
+    """Phase one of the fused block's two-phase train-mode BN: the
+    cross-image batch-statistics pass.
+
+    A per-image kernel cannot reduce across the batch between layers, so
+    the block's statistics are computed ONCE here in plain (traced,
+    differentiable) JAX — a concat-form forward whose only products are
+    the per-layer ``(mean, var)`` pairs: the full-prefix stats each
+    norm1 consumes and the bottleneck stats each norm2 consumes.  Folded
+    into affines (``ops/fused_dense_block.pack_affines``) they are
+    exactly what the kernel consumes, so the kernel stays per-image
+    while BN stays batch-correct; because this pass is ordinary JAX, the
+    gradient through the statistics (the BN batch-correction terms) is
+    exact by the chain rule — the kernel's custom VJP only owns the
+    affine-constant part.
+
+    Returns ``(norm1_stats, norm2_stats, strip_stats)`` where
+    ``strip_stats`` drive the running-average updates exactly as the
+    packed form's pack-creation stats do."""
+    prefix_stats = [_batch_stats(x)]
+    norm1_stats, norm2_stats = [], []
+    feats = x
+    for p in layer_params:
+        mu = jnp.concatenate([s[0] for s in prefix_stats])
+        var = jnp.concatenate([s[1] for s in prefix_stats])
+        norm1_stats.append((mu, var))
+        h = _affine_relu(
+            feats, mu, var, p["norm1"]["scale"], p["norm1"]["bias"], dtype
+        )
+        y1 = jnp.einsum(
+            "bhwc,co->bhwo", h, p["conv1"]["kernel"][0, 0].astype(dtype),
+            preferred_element_type=jnp.float32,
+        )
+        mu2, var2 = _batch_stats(y1)
+        norm2_stats.append((mu2, var2))
+        h2 = _affine_relu(
+            y1, mu2, var2, p["norm2"]["scale"], p["norm2"]["bias"], dtype
+        )
+        strip = jax.lax.conv_general_dilated(
+            h2, p["conv2"]["kernel"].astype(dtype), (1, 1),
+            ((1, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        prefix_stats.append(_batch_stats(strip))
+        feats = jnp.concatenate([feats, strip.astype(feats.dtype)], axis=-1)
+    return norm1_stats, norm2_stats, prefix_stats[1:]
+
+
+class FusedDenseBlock(nn.Module):
+    """Dense block on the VMEM-resident Pallas kernel
+    (``ops/fused_dense_block``), selected per block by
+    ``dense_block_impl="fused"`` + ``dense_block_fused_blocks``.
+
+    Identical parameter/batch-stats tree to the concat/packed forms
+    (checkpoints interoperate, init draws are seed-identical).  Takes
+    and returns a dense (B, H, W, C) tensor.  Eval folds the layers'
+    running stats into the kernel's affines; train runs the two-phase
+    scheme (``_fused_stats_pass`` for batch stats, then the per-image
+    kernel) and updates running averages from the same strip/bottleneck
+    stats the packed form would compute.  The backward is the kernel's
+    ``jax.custom_vjp`` pair; gradients through the batch statistics flow
+    through the stats pass + fold, so train-mode gradients match the
+    packed reference exactly."""
+
+    num_layers: int
+    growth_rate: int
+    bn_size: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        from ddl_tpu.ops.fused_dense_block import (
+            block_pad,
+            fused_dense_block,
+            pack_affines,
+        )
+
+        c0 = x.shape[-1]
+        g = self.growth_rate
+        layer_params, norm1_ra, norm2_ra = [], [], []
+        for i in range(self.num_layers):
+            p, ra1, ra2 = _FusedLayerDecl(
+                c0 + i * g, self.bn_size * g, g,
+                name=f"denselayer{i + 1}",
+            )()
+            layer_params.append(p)
+            norm1_ra.append(ra1)
+            norm2_ra.append(ra2)
+        if train:
+            norm1_stats, norm2_stats, strip_stats = _fused_stats_pass(
+                x, layer_params, g, self.dtype
+            )
+            if not self.is_initializing():
+                for i in range(self.num_layers):
+                    ra1m, ra1v = norm1_ra[i]
+                    ra1m.value = (
+                        _BN_MOMENTUM * ra1m.value
+                        + (1 - _BN_MOMENTUM) * norm1_stats[i][0]
+                    )
+                    ra1v.value = (
+                        _BN_MOMENTUM * ra1v.value
+                        + (1 - _BN_MOMENTUM) * norm1_stats[i][1]
+                    )
+                    ra2m, ra2v = norm2_ra[i]
+                    ra2m.value = (
+                        _BN_MOMENTUM * ra2m.value
+                        + (1 - _BN_MOMENTUM) * norm2_stats[i][0]
+                    )
+                    ra2v.value = (
+                        _BN_MOMENTUM * ra2v.value
+                        + (1 - _BN_MOMENTUM) * norm2_stats[i][1]
+                    )
+        else:
+            norm1_stats = [(m.value, v.value) for m, v in norm1_ra]
+            norm2_stats = [(m.value, v.value) for m, v in norm2_ra]
+        packed = pack_affines(layer_params, norm1_stats, norm2_stats, c0, g)
+        out = fused_dense_block(x.astype(self.dtype), packed, c0=c0, growth=g)
+        pad0, _ = block_pad(c0, self.num_layers, g)
+        return out[..., pad0:pad0 + c0 + self.num_layers * g]
+
+
 def _bn(dtype, name: str):
     return nn.BatchNorm(
         momentum=_BN_MOMENTUM,
@@ -371,12 +536,13 @@ class DenseBlock(nn.Module):
                 )(x, train)
             return x
         if self.impl != "buffer":
-            # "packed" routes to PackedDenseBlock in DenseNetStage before
-            # DenseBlock is ever constructed, but list it: it is a valid
-            # (and the default) config value
+            # "packed"/"fused" route to PackedDenseBlock/FusedDenseBlock
+            # in DenseNetStage before DenseBlock is ever constructed, but
+            # list them: they are valid config values ("packed" the
+            # default)
             raise ValueError(
-                f"dense_block_impl must be 'concat', 'buffer' or 'packed', "
-                f"got {self.impl!r}"
+                f"dense_block_impl must be 'concat', 'buffer', 'packed' "
+                f"or 'fused', got {self.impl!r}"
             )
         b, hgt, wid, c_in = x.shape
         total = c_in + self.num_layers * self.growth_rate
@@ -460,9 +626,24 @@ class DenseNetStage(nn.Module):
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
 
         num_features = _features_entering_block(cfg, self.spec.start_block)
-        packed = cfg.dense_block_impl == "packed"
+        # "fused" rides the packed machinery for transitions and for the
+        # blocks NOT selected by dense_block_fused_blocks (the go/no-go
+        # list from the PERF.md round-5 per-block measurement)
+        packed = cfg.dense_block_impl in ("packed", "fused")
         for b in range(self.spec.start_block, self.spec.end_block):
-            if packed:
+            fused_b = (
+                cfg.dense_block_impl == "fused"
+                and b in tuple(cfg.dense_block_fused_blocks)
+            )
+            if fused_b:
+                x = FusedDenseBlock(
+                    num_layers=cfg.block_config[b],
+                    growth_rate=cfg.growth_rate,
+                    bn_size=cfg.bn_size,
+                    dtype=dtype,
+                    name=f"denseblock{b + 1}",
+                )(x, train)
+            elif packed:
                 packs, stats = _split_packs(x, train)
                 packs, stats = PackedDenseBlock(
                     num_layers=cfg.block_config[b],
@@ -484,6 +665,10 @@ class DenseNetStage(nn.Module):
             if b != num_blocks - 1:
                 num_features //= 2
                 if packed:
+                    if fused_b:
+                        # the fused block returns a dense tensor; split it
+                        # (and its stats, once) for the packed transition
+                        packs, stats = _split_packs(x, train)
                     x = PackedTransition(
                         num_features, dtype, name=f"transition{b + 1}"
                     )(packs, stats, train)
@@ -491,7 +676,7 @@ class DenseNetStage(nn.Module):
                     x = Transition(
                         num_features, dtype, name=f"transition{b + 1}"
                     )(x, train)
-            elif packed:
+            elif packed and not fused_b:
                 # head (or stage boundary) consumes a dense tensor; one
                 # concat per final block, vs one per layer in concat form
                 x = jnp.concatenate(packs, axis=-1)

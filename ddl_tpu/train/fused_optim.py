@@ -1,0 +1,125 @@
+"""Fused Adam: the optimizer update as one fusible expression per leaf.
+
+The round-4 DenseNet op digest puts "elementwise/reduce fusions" (BN
+stats, Adam, loss) at 17.5% of device time.  ``optax.adam``'s update is
+structured as a *chain of tree passes* — ``scale_by_adam`` builds a new
+``mu`` tree, a new ``nu`` tree, a bias-corrected updates tree, then
+``scale`` and ``optax.apply_updates`` each walk the tree again — which
+hands XLA several independent per-leaf HLO chains with materialised
+updates trees between them.  This module computes the whole update —
+new ``mu``, new ``nu``, and the new *parameter* — in ONE ``tree_map``
+pass per leaf (``fused_apply``), so each parameter's update lowers to a
+single fusible elementwise expression reading (g, mu, nu, p) and
+writing (mu', nu', p') with no intermediate updates tensor, and XLA is
+free to fuse it straight onto the last gradient reduction that produced
+``g``.
+
+Drop-in constraints, both load-bearing:
+
+* **State tree is bit-identical to ``optax.adam``'s** (``init``
+  delegates to it): ``(ScaleByAdamState(count, mu, nu), ScaleState)``
+  for a constant lr, ``(..., ScaleByScheduleState(count))`` for a
+  schedule — existing snapshots restore into the fused optimizer and
+  vice versa.
+* **The math is ``optax.adam``'s exactly** (same b1/b2/eps, same
+  ``1 - b**count_inc`` bias correction, ``eps_root=0``), asserted by
+  ``tests/test_optimizer.py`` against optax step by step.
+
+The standard ``update`` endpoint (returns an updates tree, for
+``optax.apply_updates``) is also provided so the transformation works
+anywhere a ``GradientTransformation`` does — ``recovery.scale_tx``, the
+pipeline step factories — while step factories that know about
+``fused_apply`` (``train/steps.py``) take the single-pass path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["FusedAdam", "fused_adam"]
+
+
+class FusedAdam(NamedTuple):
+    """``optax.GradientTransformation`` surface (init/update) plus the
+    single-pass ``fused_apply(grads, state, params) -> (new_params,
+    new_state)`` endpoint step factories fuse into the jitted step."""
+
+    init: Callable[..., Any]
+    update: Callable[..., Any]
+    fused_apply: Callable[..., Any]
+
+
+def fused_adam(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> FusedAdam:
+    """Adam with ``optax.adam``-identical math and state tree, computed
+    in one tree pass.  ``learning_rate`` may be a float or an optax
+    schedule (callable of the step count)."""
+    ref = optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+    schedule = callable(learning_rate)
+
+    def init(params):
+        return ref.init(params)
+
+    def _step(grads, state, params):
+        """One fused pass.  Returns (out, new_state) where ``out`` is the
+        new params tree when ``params`` is given (fused_apply) and the
+        updates tree otherwise (the optax ``update`` endpoint)."""
+        adam_state, lr_state = state
+        count_inc = optax.safe_int32_increment(adam_state.count)
+        if schedule:
+            # scale_by_schedule semantics: scale by f(count), then inc
+            lr_now = learning_rate(lr_state.count)
+            new_lr_state = lr_state._replace(
+                count=optax.safe_int32_increment(lr_state.count)
+            )
+        else:
+            lr_now = learning_rate
+            new_lr_state = lr_state
+        c1 = 1.0 - b1 ** count_inc.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count_inc.astype(jnp.float32)
+
+        def leaf(g, mu, nu, p):
+            mu2 = b1 * mu + (1.0 - b1) * g
+            nu2 = b2 * nu + (1.0 - b2) * (g * g)
+            u = -lr_now * (mu2 / c1) / (jnp.sqrt(nu2 / c2) + eps)
+            return (u if p is None else p + u), mu2, nu2
+
+        g_leaves, treedef = jax.tree.flatten(grads)
+        mu_leaves = jax.tree.leaves(adam_state.mu)
+        nu_leaves = jax.tree.leaves(adam_state.nu)
+        p_leaves = (
+            jax.tree.leaves(params) if params is not None
+            else [None] * len(g_leaves)
+        )
+        trips = [
+            leaf(g, m, n, p)
+            for g, m, n, p in zip(g_leaves, mu_leaves, nu_leaves, p_leaves)
+        ]
+        out = treedef.unflatten([t[0] for t in trips])
+        new_state = (
+            adam_state._replace(
+                count=count_inc,
+                mu=treedef.unflatten([t[1] for t in trips]),
+                nu=treedef.unflatten([t[2] for t in trips]),
+            ),
+            new_lr_state,
+        )
+        return out, new_state
+
+    def update(grads, state, params=None):
+        # optax endpoint: the first tuple element is the updates tree
+        del params  # adam's update does not read params
+        return _step(grads, state, None)
+
+    def fused_apply(grads, state, params):
+        return _step(grads, state, params)
+
+    return FusedAdam(init=init, update=update, fused_apply=fused_apply)

@@ -351,6 +351,7 @@ class BaseTrainer:
             elapsed = perf_counter() - start
             if period == profile_period:
                 jax.profiler.stop_trace()
+                self._print_profile_digest()
             train_metrics = faultinject.poison_loss(train_metrics)
             loss = train_metrics.get("loss")
             idx = self.log_index(period)
@@ -466,6 +467,36 @@ class BaseTrainer:
                 )
                 return
         self.wait_for_saves()
+
+    def _print_profile_digest(self) -> None:
+        """Render the captured period's per-op digest right at the run
+        (the ROADMAP's "open every perf PR with a digest" rule: the
+        trainer's own ``profile_dir`` hook now hands over the top-op
+        table instead of a bare trace directory — same renderer as
+        ``ddl_tpu bench digest``).  Digest failures never cost the run."""
+        if not getattr(self, "is_logging_process", True):
+            return
+        try:
+            from ddl_tpu.bench.xprof import op_digest
+
+            dig = op_digest(self.profile_dir, top=5)
+            ops = "  ".join(
+                f"{k}={v:.1f}ms" for k, v in dig["ops"].items()
+            )
+            print(
+                f"[profile] trace {self.profile_dir}: "
+                f"total {dig['total_ms']:.1f}ms — {ops}"
+            )
+            print(
+                f"[profile] full table: ddl_tpu bench digest "
+                f"{self.profile_dir}"
+            )
+        except Exception as e:  # ddl-lint: disable=broad-except — a
+            # digest render failure (exotic trace layout, missing plane)
+            # must never kill a training run; the trace itself is already
+            # on disk and the message points at it
+            print(f"[profile] digest unavailable ({e}); trace in "
+                  f"{self.profile_dir}")
 
     def _handle_nonfinite(self, period, idx, loss, obs) -> bool:
         """Recovery-policy reaction to a non-finite period loss; returns

@@ -37,6 +37,7 @@ def build_optimizer(
     lr_schedule: str = "constant",
     warmup_steps: int = 0,
     decay_steps: int = 0,
+    fused: bool = False,
 ) -> optax.GradientTransformation:
     """Adam(W) with the standard training-schedule surface the reference
     lacks (it runs ``optim.Adam`` unconfigured, ``single.py:305``):
@@ -48,6 +49,14 @@ def build_optimizer(
     ``lr_schedule``: 'constant' or 'cosine' (requires ``decay_steps`` —
     total steps including warmup); ``warmup_steps`` prepends a 0 -> lr
     linear ramp to either.
+
+    ``fused=True`` swaps plain Adam for ``train/fused_optim.fused_adam``
+    — same math, same state tree (snapshots interoperate), but the whole
+    update collapses to one fusible expression per leaf and step
+    factories that know ``fused_apply`` skip the separate updates tree
+    entirely.  Configs that chain extra transforms (weight decay,
+    gradient clipping) keep the optax chain — those paths are not the
+    headline hot path and correctness beats fusion there.
     """
     if lr_schedule == "cosine":
         if decay_steps <= 0:
@@ -77,6 +86,10 @@ def build_optimizer(
     else:
         raise ValueError(f"unknown lr_schedule {lr_schedule!r}")
 
+    if fused and weight_decay <= 0.0 and grad_clip_norm <= 0.0:
+        from ddl_tpu.train.fused_optim import fused_adam
+
+        return fused_adam(lr, b1=b1, b2=b2, eps=eps)
     if weight_decay > 0.0:
         base = optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
     else:
@@ -88,7 +101,8 @@ def build_optimizer(
 
 def make_optimizer(train_cfg) -> optax.GradientTransformation:
     """Optimizer from a ``TrainConfig`` — defaults are torch's unconfigured
-    Adam (reference ``single.py:305``: lr=1e-3, betas=(0.9,0.999), eps=1e-8)."""
+    Adam (reference ``single.py:305``: lr=1e-3, betas=(0.9,0.999), eps=1e-8),
+    computed fused (``train/fused_optim``) unless ``fused_adam=false``."""
     return build_optimizer(
         train_cfg.learning_rate,
         b1=train_cfg.b1,
@@ -99,6 +113,7 @@ def make_optimizer(train_cfg) -> optax.GradientTransformation:
         lr_schedule=train_cfg.lr_schedule,
         warmup_steps=train_cfg.warmup_steps,
         decay_steps=train_cfg.decay_steps,
+        fused=getattr(train_cfg, "fused_adam", True),
     )
 
 

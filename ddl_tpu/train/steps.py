@@ -53,6 +53,14 @@ def make_dp_step_fns(
     compute_dtype,
     normalizer=normalize_images,
 ) -> StepFns:
+    # Single-pass optimizer application when the transformation offers
+    # it (train/fused_optim.FusedAdam): new params come out of the same
+    # per-leaf expression as the new moments, with no materialised
+    # updates tree between the gradient reduction and the weight write.
+    # The grace-window wrap (recovery.scale_tx) hides fused_apply, so
+    # grace periods transparently take the two-pass optax path.
+    fused_apply = getattr(tx, "fused_apply", None)
+
     def train_step(state: TrainState, images, labels):
         x = normalizer(images, compute_dtype)
 
@@ -65,8 +73,13 @@ def make_dp_step_fns(
         (loss, (logits, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
         )
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if fused_apply is not None:
+            new_params, new_opt = fused_apply(
+                grads, state.opt_state, state.params
+            )
+        else:
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -103,6 +116,8 @@ def make_dp_step_fns(
         "in_specs": {"images": BATCH_SPEC, "labels": BATCH_SPEC},
         "donate_state": True,
         "replicated_params_ok": True,
+        # informational: whether the optimizer applied in one fused pass
+        "fused_optimizer_update": fused_apply is not None,
     }
     return StepFns(train=train, evaluate=evaluate)
 

@@ -1,0 +1,164 @@
+"""`ddl_tpu bench` — the MFU/steps-per-sec regression gate and the
+op-digest renderer (bench/gate.py)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ddl_tpu.bench.gate import main as bench_main
+
+
+@pytest.fixture()
+def baseline(tmp_path):
+    p = tmp_path / "BASELINE.json"
+    p.write_text(json.dumps({
+        "metric": "whatever",
+        "headline": {
+            "metric": "densenet121_train_steps_per_sec_bs30_1chip",
+            "steps_per_sec": 72.589,
+            "mfu": 0.1871,
+        },
+    }))
+    return p
+
+
+def _result(tmp_path, value, mfu):
+    p = tmp_path / "result.json"
+    p.write_text(json.dumps({
+        "metric": "densenet121_train_steps_per_sec_bs30_1chip",
+        "value": value, "unit": "steps/sec", "mfu": mfu,
+    }) + "\n")
+    return p
+
+
+def test_gate_passes_within_tolerance(tmp_path, baseline, capsys):
+    res = _result(tmp_path, 70.0, 0.180)  # ~-3.6% / -3.8%
+    rc = bench_main([
+        "--result", str(res), "--baseline", str(baseline),
+        "--fail-mfu-drop", "0.1", "--fail-slowdown", "0.1",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0 and "OK" in out
+
+
+def test_gate_fails_on_mfu_drop(tmp_path, baseline, capsys):
+    res = _result(tmp_path, 71.0, 0.12)  # MFU -36%
+    rc = bench_main([
+        "--result", str(res), "--baseline", str(baseline),
+        "--fail-mfu-drop", "0.1",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1 and "mfu dropped" in out
+
+
+def test_gate_fails_on_slowdown(tmp_path, baseline, capsys):
+    res = _result(tmp_path, 40.0, 0.187)  # steps/s -45%
+    rc = bench_main([
+        "--result", str(res), "--baseline", str(baseline),
+        "--fail-slowdown", "0.5", "--fail-mfu-drop", "0.1",
+    ])
+    assert rc == 0  # -45% within the 50% gate
+    rc = bench_main([
+        "--result", str(res), "--baseline", str(baseline),
+        "--fail-slowdown", "0.1",
+    ])
+    assert rc == 1
+
+
+def test_gate_update_baseline_round_trip(tmp_path, baseline, capsys):
+    res = _result(tmp_path, 81.5, 0.21)
+    rc = bench_main([
+        "--result", str(res), "--baseline", str(baseline),
+        "--update-baseline",
+    ])
+    assert rc == 0
+    stored = json.loads(baseline.read_text())["headline"]
+    assert stored["steps_per_sec"] == 81.5 and stored["mfu"] == 0.21
+    # the new headline becomes the reference: the old number now fails
+    old = _result(tmp_path, 72.589, 0.1871)
+    rc = bench_main([
+        "--result", str(old), "--baseline", str(baseline),
+        "--fail-slowdown", "0.05",
+    ])
+    assert rc == 1
+
+
+def test_gate_missing_headline_is_usage_error(tmp_path, capsys):
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"metric": "m"}))
+    res = _result(tmp_path, 70.0, 0.18)
+    rc = bench_main([
+        "--result", str(res), "--baseline", str(b),
+        "--fail-mfu-drop", "0.1",
+    ])
+    assert rc == 2
+
+
+def test_digest_renders_cpu_trace(tmp_path, capsys):
+    """`bench digest <dir>` over a real (CPU host-plane) capture: the
+    wire-format reader + host fallback produce a non-empty category
+    table — the same path the PERF.md digest protocol uses."""
+    trace = tmp_path / "trace"
+
+    @jax.jit
+    def f(a, b):
+        return jnp.tanh(a @ b).sum()
+
+    a = jnp.ones((128, 128))
+    f(a, a).block_until_ready()  # compile outside the window
+    jax.profiler.start_trace(str(trace))
+    for _ in range(3):
+        f(a, a).block_until_ready()
+    jax.profiler.stop_trace()
+
+    rc = bench_main(["digest", str(trace), "--top", "5"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "total sync-op time" in out and "ms" in out
+
+    rc = bench_main(["digest", str(trace), "--json"])
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and row["total_ms"] > 0 and row["ops"]
+
+
+def test_digest_missing_trace_is_usage_error(tmp_path, capsys):
+    rc = bench_main(["digest", str(tmp_path / "nope")])
+    assert rc == 2
+
+
+def test_cli_routes_bench_subcommand(tmp_path, baseline, capsys):
+    from ddl_tpu.cli import main as cli_main
+
+    res = _result(tmp_path, 70.0, 0.18)
+    with pytest.raises(SystemExit) as e:
+        cli_main([
+            "bench", "--result", str(res), "--baseline", str(baseline),
+            "--fail-mfu-drop", "0.1",
+        ])
+    assert e.value.code == 0
+
+
+def test_gate_fails_closed_on_missing_metric(tmp_path, baseline, capsys):
+    """A requested gate whose metric is missing (e.g. a result with no
+    'mfu' field because the chip peak was unknown) must FAIL, not
+    silently pass — fail-open here is exactly the silent regression the
+    gate exists to prevent."""
+    p = tmp_path / "result.json"
+    p.write_text(json.dumps({
+        "metric": "densenet121_train_steps_per_sec_bs30_1chip",
+        "value": 70.0, "unit": "steps/sec",  # no mfu
+    }) + "\n")
+    rc = bench_main([
+        "--result", str(p), "--baseline", str(baseline),
+        "--fail-mfu-drop", "0.1",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1 and "cannot gate mfu" in out
+    # without the mfu gate the same result passes on steps/sec alone
+    rc = bench_main([
+        "--result", str(p), "--baseline", str(baseline),
+        "--fail-slowdown", "0.1",
+    ])
+    assert rc == 0

@@ -241,9 +241,11 @@ def test_decode_bench_smoke(capsys):
         sys.argv = argv
     row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     # CPU walls are microseconds, so the two-length slope can come out
-    # negative from noise — assert structure here, timing signs belong to
-    # the real-chip runs (PERF.md)
-    assert np.isfinite(row["decode_tok_per_sec"]) and row["prefill_ms"] > 0
+    # negative from noise; the bench then deterministically falls back to
+    # the undifferenced quote (flagged `slope_fallback`) instead of
+    # raising — the PR-6 "host contention" tier-1 flake.  Real timing
+    # signs belong to the real-chip runs (PERF.md).
+    assert row["decode_tok_per_sec"] > 0 and row["prefill_ms"] > 0
     # the windowed ring allocates O(window); its per-step read spans the
     # same window rows
     assert row["cache_bytes_per_layer"] < row["max_len"] * 2 * 64 * 4

@@ -1,18 +1,33 @@
 """Fused VMEM-resident dense-block kernel (ops/fused_dense_block.py) vs
-the textbook concat DenseBlock — eval-mode forward parity, interpreter
-mode.  (The experiment's chip measurements and go/no-go analysis live in
-PERF.md round 5.)"""
+the textbook concat / packed XLA forms — forward AND gradient parity,
+interpreter mode and under jit.  (The chip measurements and go/no-go
+analysis live in PERF.md rounds 5-6.)"""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ddl_tpu.models.densenet import DenseBlock
+from ddl_tpu.config import ModelConfig
+from ddl_tpu.models.densenet import (
+    DenseBlock,
+    build_stages,
+    forward_stages,
+    init_stages,
+)
 from ddl_tpu.ops.fused_dense_block import (
     block_pad,
+    fused_dense_block,
     fused_dense_block_eval,
     pack_block_params,
 )
+
+
+def _tiny_cfg(**kw):
+    return ModelConfig(
+        growth_rate=4, block_config=(2, 2), num_init_features=8,
+        bn_size=2, num_classes=5, split_blocks=(1,),
+        compute_dtype="float32", remat=False, **kw,
+    )
 
 
 def test_fused_block_matches_concat_eval():
@@ -37,6 +52,203 @@ def test_fused_block_matches_concat_eval():
     got = got[..., pad0:pad0 + c0 + L * growth]
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_fused_block_gradients_match_concat_eval():
+    """The custom-VJP backward kernel against autodiff of the concat
+    reference (eval-mode affines): input gradients match, and the
+    affine/weight gradients match autodiff of the folded-affine
+    formulation — i.e. the kernel's hand-written backward is the true
+    VJP of its own forward."""
+    c0, growth, bn_size, L = 16, 8, 2, 4
+    b, h, w = 2, 6, 5
+    block = DenseBlock(L, growth, bn_size, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(0), (b, h, w, c0))
+    variables = block.init(jax.random.key(1), x, train=False)
+    _, upd = block.apply(variables, x, train=True, mutable=["batch_stats"])
+    variables = {"params": variables["params"], **upd}
+    layers = [variables["params"][f"denselayer{i + 1}"] for i in range(L)]
+    stats = [variables["batch_stats"][f"denselayer{i + 1}"] for i in range(L)]
+    packed = pack_block_params(layers, stats, c0, growth)
+    pad0, _ = block_pad(c0, L, growth)
+
+    def loss_fused(x, pk):
+        o = fused_dense_block(x, pk, c0=c0, growth=growth, interpret=True)
+        return (o[..., pad0:pad0 + c0 + L * growth] ** 2).sum()
+
+    def loss_ref(x):
+        return (block.apply(variables, x, train=False) ** 2).sum()
+
+    def loss_folded(x, pk):
+        """The same folded-affine forward in plain jnp — autodiff
+        reference for the affine/weight gradients."""
+        feats = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (pad0, 0)))
+        p_total = pk["a1"].shape[-1]
+        feats = jnp.pad(
+            feats, ((0, 0), (0, 0), (0, 0), (0, p_total - feats.shape[-1]))
+        )
+        for i in range(L):
+            z1 = feats * pk["a1"][i, 0] + pk["b1"][i, 0]
+            y1 = jnp.einsum(
+                "bhwc,co->bhwo", jnp.maximum(z1, 0.0), pk["w1"][i]
+            )
+            h2 = jnp.maximum(y1 * pk["a2"][i, 0] + pk["b2"][i, 0], 0.0)
+            k = pk["w2"][i].reshape(3, 3, h2.shape[-1], growth)
+            strip = jax.lax.conv_general_dilated(
+                h2, k, (1, 1), ((1, 1), (1, 1)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            feats = jax.lax.dynamic_update_slice(
+                feats, strip, (0, 0, 0, pad0 + c0 + i * growth)
+            )
+        return (feats[..., pad0:pad0 + c0 + L * growth] ** 2).sum()
+
+    gx_f, gp_f = jax.grad(loss_fused, argnums=(0, 1))(x, packed)
+    np.testing.assert_allclose(
+        np.asarray(gx_f), np.asarray(jax.grad(loss_ref)(x)),
+        atol=1e-3, rtol=1e-3,
+    )
+    gx_g, gp_g = jax.grad(loss_folded, argnums=(0, 1))(x, packed)
+    np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_g),
+                               atol=1e-3, rtol=1e-3)
+    for k in ("a1", "b1", "w1", "a2", "b2", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(gp_f[k]), np.asarray(gp_g[k]),
+            atol=1e-3, rtol=1e-3, err_msg=k,
+        )
+
+
+def test_fused_impl_matches_concat_train_grads():
+    """dense_block_impl='fused' through the full model: identical param
+    tree/init, forward, train-mode batch stats, and gradients — the
+    two-phase BN means the gradient THROUGH the batch statistics is
+    included (it flows through the stats pass + fold by autodiff)."""
+    x = jax.random.normal(jax.random.key(2), (2, 16, 16, 3))
+    outs = {}
+    for impl in ("concat", "fused"):
+        cfg = _tiny_cfg(
+            dense_block_impl=impl, dense_block_fused_blocks=(0, 1)
+        )
+        stages = build_stages(cfg, num_stages=1)
+        params, bstats = init_stages(stages, jax.random.key(0), image_size=16)
+
+        def loss(params, bstats, x):
+            logits, ns = forward_stages(stages, params, bstats, x, train=True)
+            return (logits ** 2).sum(), ns
+
+        (val, ns), grads = jax.value_and_grad(loss, has_aux=True)(
+            params, bstats, x
+        )
+        outs[impl] = (val, ns, grads, params)
+    ca = jax.tree.structure(outs["concat"][3])
+    cb = jax.tree.structure(outs["fused"][3])
+    assert ca == cb
+    for a, b in zip(
+        jax.tree.leaves(outs["concat"][3]), jax.tree.leaves(outs["fused"][3])
+    ):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(outs["concat"][0], outs["fused"][0], rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(outs["concat"][1]), jax.tree.leaves(outs["fused"][1])
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(outs["concat"][2]),
+        jax.tree_util.tree_leaves_with_path(outs["fused"][2]),
+    ):
+        np.testing.assert_allclose(
+            a, b, atol=1e-4, rtol=1e-4, err_msg=str(pa)
+        )
+
+
+def test_fused_impl_grads_under_jit():
+    """The same parity with the whole loss+grad jitted (the compiled-mode
+    path CI can exercise: XLA-compiled program around the interpret-mode
+    kernels; Mosaic-compiled runs need the real chip — PERF.md)."""
+    x = jax.random.normal(jax.random.key(3), (2, 16, 16, 3))
+    vals, grads = {}, {}
+    for impl in ("packed", "fused"):
+        cfg = _tiny_cfg(
+            dense_block_impl=impl, dense_block_fused_blocks=(0, 1)
+        )
+        stages = build_stages(cfg, num_stages=1)
+        params, bstats = init_stages(stages, jax.random.key(0), image_size=16)
+
+        @jax.jit
+        def loss_grad(params, bstats, x):
+            def loss(params):
+                logits, _ = forward_stages(
+                    stages, params, bstats, x, train=True
+                )
+                return (logits ** 2).sum()
+
+            return jax.value_and_grad(loss)(params)
+
+        vals[impl], grads[impl] = loss_grad(params, bstats, x)
+    np.testing.assert_allclose(vals["packed"], vals["fused"], rtol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(grads["packed"]), jax.tree.leaves(grads["fused"])
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_train_steps_track_packed_loss_trajectory():
+    """Train a few real steps (normalize + fwd + bwd + fused Adam via the
+    DP step factory) with fused vs packed blocks: the loss trajectories
+    and final params must agree — the end-to-end 'nothing drifts once
+    the optimizer is in the loop' check on CPU interpret mode."""
+    import numpy as _np
+
+    from ddl_tpu.config import TrainConfig
+    from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
+    from ddl_tpu.train.state import create_train_state, make_optimizer
+    from ddl_tpu.train.steps import make_dp_step_fns
+
+    rng = _np.random.default_rng(0)
+    images = jnp.asarray(rng.integers(0, 255, (8, 16, 16, 3)), jnp.uint8)
+    labels = jnp.asarray(rng.integers(0, 5, (8,)), jnp.int32)
+    losses, finals = {}, {}
+    for impl in ("packed", "fused"):
+        cfg = _tiny_cfg(
+            dense_block_impl=impl, dense_block_fused_blocks=(0, 1)
+        )
+        stages = build_stages(cfg, num_stages=1)
+        tx = make_optimizer(TrainConfig())
+        state = create_train_state(stages, tx, jax.random.key(0), 16)
+        mesh = build_mesh(MeshSpec(1, 1))
+        fns = make_dp_step_fns(stages, tx, mesh, jnp.float32)
+        ls = []
+        for _ in range(4):
+            state, loss, _ = fns.train(state, images, labels)
+            ls.append(float(loss))
+        losses[impl] = ls
+        finals[impl] = state.params
+    np.testing.assert_allclose(
+        losses["packed"], losses["fused"], atol=1e-4, rtol=1e-4
+    )
+    for a, b in zip(
+        jax.tree.leaves(finals["packed"]), jax.tree.leaves(finals["fused"])
+    ):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_fused_eval_uses_running_stats():
+    """After a train step mutates running averages, fused eval (running-
+    stat affines, single kernel, no stats pass) matches packed eval."""
+    x = jax.random.normal(jax.random.key(4), (2, 16, 16, 3))
+    outs = {}
+    for impl in ("packed", "fused"):
+        cfg = _tiny_cfg(
+            dense_block_impl=impl, dense_block_fused_blocks=(0, 1)
+        )
+        stages = build_stages(cfg, num_stages=1)
+        params, bstats = init_stages(stages, jax.random.key(0), image_size=16)
+        _, bstats = forward_stages(stages, params, bstats, x, train=True)
+        logits, _ = forward_stages(stages, params, bstats, x, train=False)
+        outs[impl] = np.asarray(logits)
+    np.testing.assert_allclose(
+        outs["packed"], outs["fused"], atol=1e-4, rtol=1e-4
     )
 
 

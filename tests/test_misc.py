@@ -396,3 +396,42 @@ def test_vocab_chunked_ce_extra_flops_restores_scan_trips():
     # model accounting excludes exactly the backward's recompute matmul
     delta = extra - vocab_chunked_ce_extra_flops(b, t, d, v, vb)
     np.testing.assert_allclose(delta, matmul, rtol=1e-12)
+
+
+def test_fused_dense_block_train_flops_closed_form():
+    """The fused-block FLOPs correction (Pallas calls report zero to
+    cost analysis): model convention counts 3x (fwd + dW + dx) of the
+    true-width 1x1 and the nine-tap 3x3 per layer of each FUSED block
+    only; executed adds the padded width and the backward's forward
+    recompute, so executed >= model always."""
+    import pytest
+
+    from ddl_tpu.bench.mfu import fused_dense_block_train_flops
+    from ddl_tpu.ops.fused_dense_block import block_pad
+
+    # one fused block at image 32 -> stem leaves hw=8: two layers
+    batch, g, bn_size, f0 = 2, 4, 2, 8
+    bn, s = bn_size * g, 8 * 8
+    want = 0.0
+    for i in range(2):
+        want += 3 * (2 * s * (f0 + i * g) * bn) + 3 * (2 * s * 9 * bn * g)
+    want *= batch
+    got = fused_dense_block_train_flops(
+        batch, 32, (2, 2), g, bn_size, f0, fused_blocks=(0,)
+    )
+    assert got == want
+    # non-fused blocks contribute nothing (XLA counts them itself)
+    assert fused_dense_block_train_flops(
+        batch, 32, (2, 2), g, bn_size, f0, fused_blocks=()
+    ) == 0.0
+    ex = fused_dense_block_train_flops(
+        batch, 32, (2, 2), g, bn_size, f0, fused_blocks=(0,),
+        accounting="executed",
+    )
+    assert ex > got
+    pad0, p_total = block_pad(f0, 2, g)
+    assert p_total > f0 + 2 * g  # padding is what makes executed larger
+    with pytest.raises(ValueError):
+        fused_dense_block_train_flops(
+            batch, 32, (2, 2), g, bn_size, f0, (0,), accounting="nope"
+        )

@@ -314,12 +314,17 @@ class Rendezvous:
 
     def barrier(
         self, name: str, timeout_s: float | None = None, on_wait=None
-    ) -> None:
-        """Mark arrival and wait until all ``n_hosts`` arrive.  Raises
-        ``BarrierTimeout`` if a peer never shows (its supervisor is gone
-        — the caller aborts the pod instead of hanging the way the
-        collective it replaces would have), and ``PodAborted`` if the
-        give-up marker appears while waiting."""
+    ) -> float:
+        """Mark arrival and wait until all ``n_hosts`` arrive; returns
+        the wall-clock instant THIS host observed the barrier complete.
+        All hosts observe completion within one poll interval of the
+        same true instant, which makes the returned stamp the input to
+        the cross-host clock-skew fit (``obs/fold.estimate_clock_offsets``
+        — per-host offsets are least squares over the shared barriers).
+        Raises ``BarrierTimeout`` if a peer never shows (its supervisor
+        is gone — the caller aborts the pod instead of hanging the way
+        the collective it replaces would have), and ``PodAborted`` if
+        the give-up marker appears while waiting."""
         d = self.root / "barriers" / name
         _write_json(d / f"h{self.host:03d}", {"ts": self.clock()})
         deadline = self.clock() + (
@@ -328,7 +333,7 @@ class Rendezvous:
         while True:
             present = len(list(d.glob("h*")))
             if present >= self.n_hosts:
-                return
+                return self.clock()
             ab = self.aborted()
             if ab is not None:
                 raise PodAborted(ab)

@@ -20,6 +20,14 @@ latency percentiles over the decode path's per-request events
 (``obs/serving.py``), and the pod-wide cross-host view — straggler/skew
 table, barrier-wait attribution, unified incident timeline
 (``obs/pod.py``, ``ddl_tpu obs pod``).
+
+The streaming layer (PR 8): every read path runs through the
+incremental fold engine (``obs/fold.py``) — a resumable reducer over
+appended bytes whose versioned sidecar makes ``summarize``/``pod`` and
+every ``obs watch`` refresh / ``obs export`` scrape O(appended bytes),
+byte-identical to a cold full parse; plus cross-host clock-skew
+estimation from barrier completions, mergeable t-digest serving
+percentiles, and the ``restart_latency`` relaunch-to-first-step metric.
 """
 
 from ddl_tpu.obs.anomaly import (
@@ -29,8 +37,9 @@ from ddl_tpu.obs.anomaly import (
     ThroughputRegressionDetector,
 )
 from ddl_tpu.obs.events import EventWriter, events_path, read_events
+from ddl_tpu.obs.fold import JobFold, StreamFold, estimate_clock_offsets, fold_job
 from ddl_tpu.obs.profiler import TraceCapturer
-from ddl_tpu.obs.serving import QuantileAccumulator, ServingStats
+from ddl_tpu.obs.serving import QuantileAccumulator, ServingStats, TDigest
 from ddl_tpu.obs.steptrace import PHASES, StepTrace
 from ddl_tpu.obs.watchdog import Watchdog
 
@@ -38,14 +47,19 @@ __all__ = [
     "AnomalyMonitor",
     "EventWriter",
     "HBMGrowthDetector",
+    "JobFold",
     "LossSpikeDetector",
     "PHASES",
     "QuantileAccumulator",
     "ServingStats",
     "StepTrace",
+    "StreamFold",
+    "TDigest",
     "ThroughputRegressionDetector",
     "TraceCapturer",
     "Watchdog",
+    "estimate_clock_offsets",
     "events_path",
+    "fold_job",
     "read_events",
 ]

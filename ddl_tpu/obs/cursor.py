@@ -1,97 +1,39 @@
-"""Incremental tail-cursor cache for serving percentile accumulators.
+"""Incremental serving-percentile access (compatibility shim).
 
-``obs summarize`` builds its decode-percentile section by folding every
-per-request ``decode`` event into ``obs/serving.ServingStats``.  Without
-a cache that means re-reading and re-parsing the job's whole JSONL
-streams on every invocation — fine for a CI smoke, pathological for a
-week-long serving run where the same first million events are parsed
-again each time an operator glances at the percentiles (the ROADMAP
-carry-over this module closes).
+PR 6 introduced this module as a serving-only tail-cursor cache: per
+event file a byte cursor plus serialized ``ServingStats`` state in a
+``.serving_cursor.json`` sidecar, so ``obs summarize`` folded only the
+bytes appended since the last invocation.  The pattern — byte cursors,
+torn-line safety, truncation/re-creation guards, serialized reducer
+state — has since been generalized to the WHOLE summary by the
+incremental fold engine (``obs/fold.py``), which maintains the serving
+digests per stream alongside every other aggregate in one
+``.obs_fold.json`` sidecar.
 
-The cache is a small JSON sidecar in the job's log directory
-(``.serving_cursor.json``): per event file a **byte cursor** (how far
-the accumulators have consumed) plus the serialized ``ServingStats``
-state — bounded reservoirs, so the sidecar stays a few hundred KB no
-matter how long the run.  Each load seeks every stream to its cursor,
-folds only the appended tail, advances the cursors, and rewrites the
-sidecar atomically.  Correctness guards:
-
-* only **complete** lines are consumed — a torn final line (writer died
-  or is mid-append) stays before the cursor and is re-read once whole;
-* a file that **shrank** below its cursor (rotation, manual
-  truncation), one **re-created** under the same name (a re-used job
-  id — caught by a fingerprint of the consumed head even when the new
-  file is larger), or a tracked stream that **disappeared** outright:
-  each invalidates the whole cache and triggers a clean rebuild —
-  never a silently double-counted or half-counted stream;
-* a capacity or schema mismatch rebuilds too (``VERSION``).
-
-The cache is an optimization, never a gate: any unreadable/corrupt
-sidecar is discarded and the stats rebuilt from byte 0.
+This module keeps the public entry point: ``incremental_serving_stats``
+now reads through the fold engine (one sidecar, one consumption path —
+the same invocation that makes the phase/step sections incremental) and
+returns the merged job-wide ``ServingStats``.  An old serving-cursor
+sidecar is NOT loaded — the fold needs phase/period/timeline state it
+never held, so the first v3 run re-reads every stream from byte 0 and
+then deletes the superseded file.  (Reservoir-SCHEMA accumulator states
+do still load wherever they persist — ``serving.TDigest.from_state``
+migrates them — which covers externally stored ``ServingStats``
+snapshots, not the discarded sidecar.)
 """
 
 from __future__ import annotations
 
-import hashlib
-import json
 import os
-from pathlib import Path
 
+from ddl_tpu.obs.fold import SIDECAR_NAME, VERSION, fold_job
 from ddl_tpu.obs.serving import ServingStats
 
-__all__ = ["incremental_serving_stats", "CACHE_NAME"]
+__all__ = ["incremental_serving_stats", "CACHE_NAME", "VERSION"]
 
-CACHE_NAME = ".serving_cursor.json"
-VERSION = 2  # v2: head fingerprints + per-engine span state
-
-
-def _load_cache(path: Path, capacity: int) -> dict | None:
-    try:
-        state = json.loads(path.read_text())
-    except (OSError, json.JSONDecodeError):
-        return None
-    if (
-        not isinstance(state, dict)
-        or state.get("version") != VERSION
-        or state.get("capacity") != capacity
-        or not isinstance(state.get("files"), dict)
-    ):
-        return None
-    return state
-
-
-_HEAD_BYTES = 64
-
-
-def _head_sig(path: Path, offset: int) -> str:
-    """Fingerprint of the first ``min(offset, 64)`` bytes — bytes an
-    append-only stream can never rewrite once the cursor passed them, so
-    a mismatch proves the file was deleted and re-created (same name,
-    possibly LARGER than the old cursor — invisible to a size check)."""
-    with open(path, "rb") as f:
-        return hashlib.md5(f.read(min(offset, _HEAD_BYTES))).hexdigest()
-
-
-def _fold_tail(stats: ServingStats, path: Path, offset: int) -> int:
-    """Feed the complete lines appended past ``offset`` into ``stats``;
-    returns the new cursor (end of the last complete line)."""
-    with open(path, "rb") as f:
-        f.seek(offset)
-        chunk = f.read()
-    end = chunk.rfind(b"\n")
-    if end < 0:
-        return offset  # nothing but a torn/partial line so far
-    for line in chunk[: end + 1].splitlines():
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            event = json.loads(line)
-        except json.JSONDecodeError:
-            continue  # torn mid-file line (writer died); skip like read_events
-        if event.get("kind") == "decode":
-            stats.observe(event)
-    return offset + end + 1
+# the sidecar is the fold engine's now; re-exported under the historic
+# name for callers/tests that locate it on disk
+CACHE_NAME = SIDECAR_NAME
 
 
 def incremental_serving_stats(
@@ -102,77 +44,9 @@ def incremental_serving_stats(
 ) -> ServingStats:
     """The job's ``ServingStats`` over all hosts' streams, reading only
     the bytes appended since the last invocation (``cache=True``; the
-    sidecar lives beside the streams so it travels with the log dir).
-    ``cache=False`` rebuilds from scratch and does not touch the sidecar
-    — the reference the cache's own tests compare against."""
-    from ddl_tpu.obs.report import _job_dir
-
-    job = _job_dir(log_dir, job_id)
-    files = sorted(job.glob("events-h*.jsonl"))
-    cache_path = job / CACHE_NAME
-
-    state = _load_cache(cache_path, capacity) if cache else None
-    if state is not None:
-        # rotation/truncation/re-creation guard: a stream now smaller
-        # than its cursor, a consumed head whose bytes changed (deleted
-        # and re-created under the same name — a re-used job id — even
-        # when the new file is LARGER than the old cursor), or a tracked
-        # stream that disappeared outright all mean the accumulated
-        # state describes bytes that no longer exist.  Rebuild rather
-        # than guess.  Cursor-0 files carry no accumulated events, so
-        # they need no head check.
-        present = {f.name for f in files}
-        for f in files:
-            offset = state["files"].get(f.name, 0)
-            if f.stat().st_size < offset or (
-                offset > 0
-                and state.get("heads", {}).get(f.name)
-                != _head_sig(f, offset)
-            ):
-                state = None
-                break
-        if state is not None and not set(state["files"]) <= present:
-            state = None
-    if state is not None:
-        # the restore must never be the crash: a JSON-valid sidecar with
-        # the wrong inner shape (truncated-then-rewritten, hand-edited,
-        # intra-version drift) is "corrupt" per the module contract —
-        # discard and rebuild, don't traceback every summarize forever
-        try:
-            stats = ServingStats.from_state(state["stats"])
-            offsets = {
-                f.name: int(state["files"].get(f.name, 0)) for f in files
-            }
-        except (KeyError, TypeError, ValueError, IndexError):
-            state = None
-    if state is None:
-        stats = ServingStats(capacity)
-        offsets = {f.name: 0 for f in files}
-
-    for f in files:
-        offsets[f.name] = _fold_tail(stats, f, offsets[f.name])
-
-    if cache and files:
-        payload = json.dumps({
-            "version": VERSION,
-            "capacity": capacity,
-            "files": offsets,
-            "heads": {
-                f.name: _head_sig(f, offsets[f.name])
-                for f in files if offsets[f.name] > 0
-            },
-            "stats": stats.state_dict(),
-        })
-        tmp = cache_path.with_name(
-            f"{CACHE_NAME}.tmp{os.getpid()}"
-        )
-        try:
-            tmp.write_text(payload)
-            os.replace(tmp, cache_path)
-        except OSError:
-            # a read-only log mount must not break summarize
-            try:
-                tmp.unlink(missing_ok=True)
-            except OSError:
-                pass
-    return stats
+    fold sidecar lives beside the streams so it travels with the log
+    dir).  ``cache=False`` rebuilds from scratch and does not touch the
+    sidecar — the reference the cache's own tests compare against."""
+    return fold_job(
+        log_dir, job_id, capacity=capacity, cache=cache
+    ).serving()

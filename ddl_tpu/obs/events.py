@@ -73,6 +73,12 @@ EVENT_KINDS = (
     "supervisor_start", "supervisor_relaunch", "supervisor_done",
     # pod-level coordinated recovery (coord.py + PodSupervisor)
     "coord_barrier", "peer_stale", "pod_restart",
+    # relaunch-decision -> child-first-step wall time, emitted by
+    # StepTrace on a relaunched child's first completed step (the
+    # supervisor stamps DDL_RELAUNCH_TS); gateable via `obs diff
+    # --fail-slowdown` — the metric the elastic-restart/compile-cache
+    # ROADMAP direction must move
+    "restart_latency",
 )
 
 # ``type`` values carried by "anomaly" events (AnomalyMonitor.record and

@@ -1,0 +1,356 @@
+"""Machine-readable metrics export: Prometheus text format from the
+fold state.
+
+``ddl_tpu obs export <job_id> [--log-dir DIR] [--prom FILE | --http
+PORT] [--once] [--interval S]`` renders the incremental fold engine's
+state (``obs/fold.py``) as Prometheus text-format gauges/counters — the
+scrape contract between our per-host JSONL streams and the fleet-scale
+monitoring PAPERS.md's 100k-GPU collective study assumes (per-host,
+per-restart-epoch series an external Prometheus/Grafana stack can
+aggregate across jobs, which the human-oriented ``obs
+summarize``/``watch`` views cannot feed).
+
+Three emission modes:
+
+* default: one scrape to stdout (pipe it anywhere);
+* ``--prom FILE``: write the scrape atomically to FILE — with
+  ``--once`` a single shot (the CI smoke), without it a rewrite loop
+  every ``--interval`` seconds (node-exporter textfile-collector
+  style);
+* ``--http PORT``: serve ``GET /metrics`` on PORT, folding the
+  appended bytes per scrape — O(appended bytes) per poll, so a 15 s
+  scrape interval on a week-long run stays cheap.
+
+Series are labeled ``host``/``repoch`` (plus ``phase``/``type``/
+``barrier``/``quantile`` where applicable); counters carry a ``_total``
+suffix per Prometheus naming conventions.  Pure stdlib, no JAX.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["export_command", "prometheus_text"]
+
+_PREFIX = "ddl_obs"
+
+
+def _esc(v) -> str:
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _num(v) -> str:
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Metrics:
+    """Accumulates samples grouped by metric so every metric's # HELP/
+    # TYPE header is emitted once, with samples in deterministic label
+    order."""
+
+    def __init__(self) -> None:
+        self._defs: dict[str, tuple[str, str]] = {}
+        self._samples: dict[str, list[tuple[str, str]]] = {}
+
+    def add(self, name, mtype, help_text, value, **labels) -> None:
+        full = f"{_PREFIX}_{name}"
+        self._defs.setdefault(full, (mtype, help_text))
+        label_s = ",".join(
+            f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+        )
+        self._samples.setdefault(full, []).append((label_s, _num(value)))
+
+    def render(self) -> str:
+        lines = []
+        for full in sorted(self._defs):
+            mtype, help_text = self._defs[full]
+            lines.append(f"# HELP {full} {help_text}")
+            lines.append(f"# TYPE {full} {mtype}")
+            for label_s, value in sorted(self._samples[full]):
+                lines.append(
+                    f"{full}{{{label_s}}} {value}" if label_s
+                    else f"{full} {value}"
+                )
+        return "\n".join(lines) + "\n"
+
+
+def prometheus_text(fold, job_id: str) -> str:
+    """Render a ``JobFold`` as one Prometheus text-format scrape."""
+    from ddl_tpu.obs.fold import estimate_clock_offsets
+    from ddl_tpu.obs.report import summarize_from_fold
+
+    m = _Metrics()
+    job = {"job_id": job_id}
+
+    streams = sorted(
+        (sf for sf in fold.streams.values() if sf.host is not None),
+        key=lambda sf: sf.host,
+    )
+    for sf in streams:
+        host = str(sf.host)
+        m.add(
+            "events_total", "counter",
+            "events consumed from this host's stream", sf.events,
+            host=host, **job,
+        )
+        m.add(
+            "stalls_total", "counter", "stall watchdog firings",
+            sf.pod["stalls"], host=host, **job,
+        )
+        m.add(
+            "restarts_total", "counter",
+            "supervisor relaunches + pod restarts observed",
+            sf.pod["restarts"], host=host, **job,
+        )
+        if sf.pod["last_step"] is not None:
+            m.add(
+                "last_step", "gauge", "newest step seen on this host",
+                sf.pod["last_step"], host=host, **job,
+            )
+        for atype, n in sorted(sf.anomaly_types.items()):
+            m.add(
+                "anomalies_total", "counter",
+                "anomaly detector firings by type", n,
+                host=host, type=atype, **job,
+            )
+        for bname, wait in sorted(sf.barrier_waits.items()):
+            m.add(
+                "barrier_wait_seconds_total", "counter",
+                "seconds spent waiting at coordination barriers", wait,
+                host=host, barrier=bname, **job,
+            )
+        for repoch, br in sorted(sf.by_repoch.items()):
+            rl = {"host": host, "repoch": str(repoch), **job}
+            m.add(
+                "steps_total", "counter",
+                "training steps completed", br["steps"], **rl,
+            )
+            m.add(
+                "elapsed_seconds_total", "counter",
+                "wall-clock seconds across periods", br["elapsed"], **rl,
+            )
+            m.add(
+                "compiles_total", "counter",
+                "XLA backend compiles observed", br["compiles"], **rl,
+            )
+            if br["last_sps"] is not None:
+                m.add(
+                    "steps_per_sec", "gauge",
+                    "latest period throughput", br["last_sps"], **rl,
+                )
+            if br["loss"] is not None:
+                m.add(
+                    "loss", "gauge", "latest period loss", br["loss"],
+                    **rl,
+                )
+            for phase, dur in sorted(br["phases"].items()):
+                m.add(
+                    "phase_seconds_total", "counter",
+                    "per-phase wall-clock seconds", dur,
+                    phase=phase, **rl,
+                )
+        for rep, (_ts, lat) in sorted(
+            sf.restart_latency["by_repoch"].items()
+        ):
+            m.add(
+                "restart_latency_seconds", "gauge",
+                "relaunch-decision to child-first-step wall time",
+                lat, host=host, repoch=str(rep), **job,
+            )
+        admit, shed, retire = (
+            sf.serve["admit"], sf.serve["shed"], sf.serve["retire"],
+        )
+        if admit or shed or retire:
+            m.add(
+                "serve_admitted_total", "counter",
+                "requests admitted into decode lanes", admit,
+                host=host, **job,
+            )
+            m.add(
+                "serve_shed_total", "counter",
+                "requests shed by admission control", shed,
+                host=host, **job,
+            )
+            m.add(
+                "serve_retired_total", "counter",
+                "requests retired complete", retire, host=host, **job,
+            )
+        kv = sf.serve["kv_last"]
+        if kv:
+            for field, metric in (
+                ("free", "kv_free_blocks"),
+                ("used", "kv_used_blocks"),
+                ("num_blocks", "kv_num_blocks"),
+                ("fragmentation", "kv_fragmentation"),
+                ("active_lanes", "serve_active_lanes"),
+                ("queue_depth", "serve_queue_depth"),
+            ):
+                if kv.get(field) is not None:
+                    m.add(
+                        metric, "gauge",
+                        f"latest kv_pool_stats {field}", kv[field],
+                        host=host, **job,
+                    )
+
+    offsets = estimate_clock_offsets({
+        sf.host: sf.barrier_ts for sf in streams
+    })
+    for host, off in sorted((offsets or {}).items()):
+        m.add(
+            "clock_offset_seconds", "gauge",
+            "barrier-fit clock offset vs pod mean (positive = ahead)",
+            off, host=str(host), **job,
+        )
+
+    # -- job-level serving percentiles (per-stream digests merged) -------
+    s = summarize_from_fold(fold)
+    d = s.get("decode")
+    if d:
+        m.add(
+            "decode_requests_total", "counter",
+            "decode requests observed", d["requests"], **job,
+        )
+        m.add(
+            "decode_cold_total", "counter",
+            "compile-affected (percentile-excluded) requests",
+            d["cold"], **job,
+        )
+        m.add(
+            "decode_tokens_total", "counter",
+            "output tokens generated", d["tokens"], **job,
+        )
+        if d.get("agg_tok_per_s_per_chip") is not None:
+            m.add(
+                "serving_agg_tok_per_s_per_chip", "gauge",
+                "warm-span aggregate tokens/s per chip",
+                d["agg_tok_per_s_per_chip"], **job,
+            )
+        # summary metric names -> Prometheus-conventional unit suffixes
+        renames = {
+            "latency_s": "latency_seconds",
+            "queue_delay_s": "queue_delay_seconds",
+            "ttft_s": "ttft_seconds",
+        }
+        for metric, block in sorted((d.get("percentiles") or {}).items()):
+            for q, qs in (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")):
+                if block.get(q) is not None:
+                    m.add(
+                        f"decode_{renames.get(metric, metric)}", "gauge",
+                        "warm-request decode percentile", block[q],
+                        quantile=qs, **job,
+                    )
+    return m.render()
+
+
+def _write_atomic(path: str, text: str) -> None:
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def export_command(
+    log_dir,
+    job_id: str,
+    prom: str | None = None,
+    http_port: int | None = None,
+    once: bool = False,
+    interval: float = 15.0,
+    cache: bool = True,
+    max_scrapes: int | None = None,
+) -> None:
+    """The ``obs export`` entry point (see module docstring).
+    ``max_scrapes`` bounds the --prom rewrite loop (tests)."""
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.report import _job_dir
+
+    if prom is not None and http_port is not None:
+        raise SystemExit("obs export takes --prom or --http, not both")
+
+    def scrape() -> str:
+        return prometheus_text(
+            fold_job(log_dir, job_id, cache=cache), job_id
+        )
+
+    if http_port is not None:
+        _serve_http(scrape, http_port, job_id)
+        return
+
+    fold = fold_job(log_dir, job_id, cache=cache)
+    if not fold.events:
+        raise SystemExit(
+            f"no events for job {job_id!r} under {log_dir} "
+            f"(looked for {_job_dir(log_dir, job_id)}/events-h*.jsonl)"
+        )
+    text = prometheus_text(fold, job_id)
+    if prom is None:
+        print(text, end="")
+        return
+    _write_atomic(prom, text)
+    print(f"wrote {len(text.splitlines())} metric lines to {prom}")
+    if once:
+        return
+    scrapes = 1
+    try:
+        while max_scrapes is None or scrapes < max_scrapes:
+            time.sleep(interval)
+            _write_atomic(prom, scrape())
+            scrapes += 1
+    except KeyboardInterrupt:
+        return
+
+
+def _serve_http(scrape, port: int, job_id: str) -> None:
+    """Blocking /metrics endpoint; each GET folds the appended bytes.
+    Scrapes are serialized: two concurrent folds of the same job would
+    duplicate work (and race on the sidecar rewrite) for no benefit —
+    the second scrape just reuses the first's freshly-advanced state."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    scrape_lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            try:
+                with scrape_lock:
+                    body = scrape().encode()
+            except OSError as e:
+                self.send_response(500)
+                self.end_headers()
+                self.wfile.write(f"scrape failed: {e}\n".encode())
+                return
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+    server = ThreadingHTTPServer(("", port), Handler)
+    print(
+        f"[obs export] serving /metrics for {job_id!r} on :{port} "
+        "(ctrl-c to stop)"
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()

@@ -13,44 +13,41 @@ views from the merged streams:
   breakdowns aligned across hosts: each host's steps/s and mean
   ``step``/``data_wait`` seconds per period against the pod median,
   with the straggler (the host whose compute+input time is furthest
-  above median) called out.  On an SPMD pod every host runs the same
-  program, so a host sitting above median in ``step`` time is either a
-  slow chip or a victim of its own input pipeline (``data_wait``
-  separates the two).
+  above median) called out, plus the host's fitted **clock offset**.
 * **barrier-wait attribution** — per-host waits from ``coord_barrier``
   events (the pod supervisors emit one per barrier join): who arrives
   late, who waits, and how much restart wall-clock the rendezvous
   itself costs.
 * **unified timeline** — restarts, anomalies, stalls, and profile
   captures from every host on one wall clock, grouped by restart epoch
-  (``repoch``), so "host 2 stalled, the pod restarted, loss spiked on
-  resume, a trace was captured" reads as one story.
+  (``repoch``).  Timestamps are **skew-corrected**: cross-host ``ts``
+  ordering used to trust NTP alone; now per-host clock offsets are fit
+  by least squares over the barrier-completion observations every host
+  shares (all hosts observe the same barrier complete within one poll
+  interval — ``obs/fold.estimate_clock_offsets``) and subtracted, so
+  "host 2 stalled, the pod restarted" reads in true order even when a
+  host's clock drifts by seconds.
 
-Pure stdlib over the event files, like ``obs/report.py`` — runs
-anywhere the log directory is mounted, no JAX.
+Reads through the incremental fold engine (``obs/fold.py``) — each
+invocation costs O(appended bytes).  Pure stdlib over the event files,
+like ``obs/report.py`` — runs anywhere the log directory is mounted, no
+JAX.
 """
 
 from __future__ import annotations
 
 import os
 import statistics
-from collections import defaultdict
 
 from ddl_tpu.obs.events import read_events
+from ddl_tpu.obs.fold import TIMELINE_KINDS, estimate_clock_offsets
 
 __all__ = [
     "load_pod",
     "pod_summary",
+    "pod_summary_from_fold",
     "render_pod_summary",
 ]
-
-# kinds worth a line on the cross-host timeline (lifecycle + incidents;
-# spans/heartbeats/periods are volume, not narrative)
-TIMELINE_KINDS = (
-    "run_start", "run_end", "supervisor_start", "supervisor_relaunch",
-    "supervisor_done", "pod_restart", "peer_stale", "coord_barrier",
-    "anomaly", "stall", "watchdog_exit", "rollback", "profile_capture",
-)
 
 # a host this far above the pod median in per-period step+data_wait time
 # is flagged as the straggler
@@ -60,7 +57,8 @@ STRAGGLER_RATIO = 1.15
 def load_pod(log_dir: str | os.PathLike, job_id: str) -> dict[int, list[dict]]:
     """Every host's events for a job, keyed by host id (from the file
     name, which is authoritative — the events' ``host`` field matches it
-    by construction)."""
+    by construction).  Full parse, for callers that want raw events; the
+    CLI goes through ``obs/fold.fold_job``."""
     from ddl_tpu.obs.report import _job_dir
 
     streams: dict[int, list[dict]] = {}
@@ -77,61 +75,37 @@ def _median(values: list[float]) -> float | None:
     return statistics.median(values) if values else None
 
 
-def pod_summary(streams: dict[int, list[dict]], serving=None) -> dict:
-    """Aggregate per-host streams into the pod view ``render_pod_summary``
+def pod_summary_from_fold(fold, serving=None) -> dict:
+    """Aggregate a ``JobFold`` into the pod view ``render_pod_summary``
     prints.  Only periods every host reported (same ``(repoch, period)``
     key) enter the skew comparison — hosts die and resume at different
     wall-clock points, and comparing a host's clean period against
     another's preemption-truncated one would manufacture skew.
 
-    ``serving`` is an optional pre-built serving summary dict
-    (``ServingStats.summary()``) — the CLI passes the incremental
-    tail-cursor accumulators (``obs/cursor.py``) so the pod view of a
-    serving job shows pod-wide request counts and aggregate tokens/s
-    without re-parsing every stream per invocation."""
-    # -- per-host period tables keyed by (repoch, period) ----------------
-    period_by_host: dict[int, dict[tuple, dict]] = {}
-    hosts: dict[int, dict] = {}
-    for host, events in streams.items():
-        rec = hosts.setdefault(host, {
-            "periods": 0, "steps": 0.0, "elapsed": 0.0,
-            "stalls": 0, "anomalies": 0, "captures": 0, "restarts": 0,
-            "last_step": None,
-        })
-        table = period_by_host.setdefault(host, {})
-        for e in events:
-            kind = e.get("kind")
-            if kind == "period":
-                key = (e.get("repoch", 0), e.get("period"))
-                table[key] = e
-                rec["periods"] += 1
-                rec["steps"] += e.get("steps", 0)
-                rec["elapsed"] += e.get("elapsed", 0.0)
-            elif kind == "stall":
-                rec["stalls"] += 1
-            elif kind == "anomaly":
-                rec["anomalies"] += 1
-            elif kind == "profile_capture" and e.get("ok"):
-                rec["captures"] += 1
-            elif kind in ("supervisor_relaunch", "pod_restart"):
-                rec["restarts"] += 1
-            step = e.get("step")
-            if step is not None and kind in ("span", "heartbeat", "stall"):
-                rec["last_step"] = (
-                    step if rec["last_step"] is None
-                    else max(rec["last_step"], step)
-                )
+    ``serving`` overrides the serving summary dict; by default it is
+    built from the fold's own per-stream digests."""
+    streams = {
+        sf.host: (name, sf)
+        for name, sf in sorted(fold.streams.items())
+        if sf.host is not None
+    }
 
+    hosts: dict[int, dict] = {}
+    repochs: set[int] = set()
+    for host, (_name, sf) in streams.items():
+        hosts[host] = dict(sf.pod)
+        repochs |= sf.repochs
+
+    # -- skew rows over the shared (repoch, period) keys -----------------
     shared = None
-    for table in period_by_host.values():
-        keys = set(table)
+    for _host, (_name, sf) in streams.items():
+        keys = set(sf.ptable)
         shared = keys if shared is None else shared & keys
     shared = shared or set()
 
-    # -- skew rows over the shared periods -------------------------------
     skew: dict[int, dict] = {}
-    for host, table in period_by_host.items():
-        rows = [table[k] for k in shared]
+    for host, (_name, sf) in streams.items():
+        rows = [sf.ptable[k] for k in sorted(shared)]
         if not rows:
             skew[host] = {
                 "steps_per_sec": None, "step_s": None, "data_wait_s": None,
@@ -139,13 +113,9 @@ def pod_summary(streams: dict[int, list[dict]], serving=None) -> dict:
             }
             continue
         n = len(rows)
-        step_s = sum(
-            (r.get("phases") or {}).get("step", 0.0) for r in rows
-        ) / n
-        wait_s = sum(
-            (r.get("phases") or {}).get("data_wait", 0.0) for r in rows
-        ) / n
-        sps = [r["steps_per_sec"] for r in rows if r.get("steps_per_sec")]
+        step_s = sum(r[1] for r in rows) / n
+        wait_s = sum(r[2] for r in rows) / n
+        sps = [r[0] for r in rows if r[0]]
         skew[host] = {
             "steps_per_sec": sum(sps) / len(sps) if sps else None,
             "step_s": step_s,
@@ -170,48 +140,75 @@ def pod_summary(streams: dict[int, list[dict]], serving=None) -> dict:
                 "ratio": worst / median_busy,
             }
 
+    # -- clock-skew fit over shared barrier completions ------------------
+    offsets = estimate_clock_offsets({
+        host: sf.barrier_ts for host, (_name, sf) in streams.items()
+    })
+    for host, row in skew.items():
+        row["clock_offset_s"] = (offsets or {}).get(host)
+
     # -- barrier-wait attribution ----------------------------------------
-    barriers: dict[str, dict[int, float]] = defaultdict(dict)
-    for host, events in streams.items():
-        for e in events:
-            if e.get("kind") != "coord_barrier":
-                continue
-            name = e.get("name", "?")
-            barriers[name][host] = (
-                barriers[name].get(host, 0.0) + e.get("wait", 0.0)
+    barriers: dict[str, dict[int, float]] = {}
+    for host, (_name, sf) in streams.items():
+        for bname, wait in sf.barrier_waits.items():
+            barriers.setdefault(bname, {})[host] = (
+                barriers.get(bname, {}).get(host, 0.0) + wait
             )
 
-    # -- unified timeline -------------------------------------------------
-    # stamp the stream's host over the event field: the file-name host is
-    # authoritative (load_pod), and sim-pod children each believe they are
-    # host 0 while their streams are per-host
-    timeline = sorted(
-        (
-            {**e, "host": host}
-            for host, events in streams.items() for e in events
-            if e.get("kind") in TIMELINE_KINDS
-        ),
-        key=lambda e: e.get("ts", 0.0),
+    # -- unified, skew-corrected timeline --------------------------------
+    # stamp the stream's host over the event field (the file-name host is
+    # authoritative: sim-pod children each believe they are host 0) and
+    # subtract the fitted offset so cross-host ordering reflects true
+    # time, not per-host clock drift
+    entries = []
+    for host, (name, sf) in streams.items():
+        off = (offsets or {}).get(host, 0.0) or 0.0
+        for i, e in enumerate(sf.timeline):
+            ts = e.get("ts", 0.0)
+            entries.append((
+                ts - off, name, i,
+                {**e, "host": host, "ts_adj": ts - off},
+            ))
+    entries.sort(key=lambda t: t[:3])
+    timeline = [e for _, _, _, e in entries]
+    timeline_total = sum(
+        sf.totals["timeline"] for _h, (_n, sf) in streams.items()
     )
 
     return {
         "hosts": hosts,
         "shared_periods": len(shared),
-        "repochs": sorted({
-            e.get("repoch", 0)
-            for events in streams.values() for e in events
-        }),
+        "repochs": sorted(repochs),
         "skew": skew,
         "median_busy_s": median_busy,
         "straggler": straggler,
-        "barriers": {k: dict(v) for k, v in barriers.items()},
+        "clock_offsets": offsets,
+        "barriers": barriers,
         "timeline": timeline,
-        "serving": serving,
+        # running count past the per-stream retention cap
+        # (fold.MAX_EVENTS_PER_LIST); `timeline` is the retained tail
+        "timeline_total": timeline_total,
+        "serving": (
+            serving if serving is not None else fold.serving().summary()
+        ),
     }
 
 
+def pod_summary(streams: dict[int, list[dict]], serving=None) -> dict:
+    """Aggregate already-loaded per-host event lists (compatibility path
+    for callers holding raw streams; the CLI folds incrementally)."""
+    from ddl_tpu.obs.fold import JobFold
+
+    return pod_summary_from_fold(
+        JobFold.from_streams(streams), serving=serving
+    )
+
+
 def _fmt(v, spec=".3f", width=9) -> str:
-    return f"{v:>{width}{spec}}" if v is not None else f"{'n/a':>{width}}"
+    return (
+        f"{format(v, spec):>{width}}" if v is not None
+        else f"{'n/a':>{width}}"
+    )
 
 
 def _timeline_label(e: dict) -> str:
@@ -237,6 +234,8 @@ def _timeline_label(e: dict) -> str:
         )
     if kind == "stall":
         return f"stall age={e.get('age', 0):.1f}s"
+    if kind == "restart_latency":
+        return f"restart_latency {e.get('latency', 0):.1f}s"
     return kind
 
 
@@ -248,10 +247,12 @@ def render_pod_summary(s: dict, job_id: str = "", tail: int = 40) -> str:
         f"{s['shared_periods']}"
     )
 
+    offsets = s.get("clock_offsets")
     lines.append("-- per-host skew (means over shared periods) --")
     lines.append(
         f"{'host':<6} {'steps/s':>9} {'step_s':>9} {'data_w_s':>9} "
-        f"{'vs median':>10} {'stalls':>7} {'anom':>5} {'restarts':>9}"
+        f"{'vs median':>10} {'clk_off_s':>10} {'stalls':>7} {'anom':>5} "
+        f"{'restarts':>9}"
     )
     med = s.get("median_busy_s")
     for host in sorted(s["skew"]):
@@ -268,7 +269,8 @@ def render_pod_summary(s: dict, job_id: str = "", tail: int = 40) -> str:
         lines.append(
             f"h{host:<5} {_fmt(sk['steps_per_sec'], '.2f')} "
             f"{_fmt(sk['step_s'])} {_fmt(sk['data_wait_s'])} "
-            f"{vs:>10} {rec.get('stalls', 0):>7} "
+            f"{vs:>10} {_fmt(sk.get('clock_offset_s'), '+.3f', 10)} "
+            f"{rec.get('stalls', 0):>7} "
             f"{rec.get('anomalies', 0):>5} {rec.get('restarts', 0):>9}"
             f"{flag}"
         )
@@ -288,6 +290,12 @@ def render_pod_summary(s: dict, job_id: str = "", tail: int = 40) -> str:
         lines.append(
             "skew not comparable: no (restart epoch, period) reported by "
             "every host"
+        )
+    if offsets:
+        spread = max(offsets.values()) - min(offsets.values())
+        lines.append(
+            f"clock skew: barrier-fit offsets applied to the timeline "
+            f"(spread {spread * 1e3:.1f}ms across {len(offsets)} hosts)"
         )
 
     sv = s.get("serving")
@@ -318,16 +326,19 @@ def render_pod_summary(s: dict, job_id: str = "", tail: int = 40) -> str:
 
     events = s["timeline"]
     if events:
-        t0 = events[0].get("ts", 0.0)
+        t0 = events[0].get("ts_adj", events[0].get("ts", 0.0))
         shown = events[-tail:]
+        total = s.get("timeline_total", len(events))
         lines.append(
-            f"-- timeline ({len(events)} events"
-            + (f", last {len(shown)}" if len(shown) < len(events) else "")
+            f"-- timeline ({total} events"
+            + (f", last {len(shown)}" if len(shown) < total else "")
+            + (", skew-corrected" if offsets else "")
             + ") --"
         )
         for e in shown:
+            ts = e.get("ts_adj", e.get("ts", 0.0))
             lines.append(
-                f"  +{e.get('ts', 0.0) - t0:8.2f}s h{e.get('host', 0)} "
+                f"  +{ts - t0:8.2f}s h{e.get('host', 0)} "
                 f"e{e.get('repoch', 0)} step={e.get('step')} "
                 f"{_timeline_label(e)}"
             )

@@ -5,27 +5,40 @@
     summarize <job_id>          throughput trend, phase breakdown table,
                                 decode p50/p95/p99 (latency, queue delay,
                                 TTFT, tok/s — obs/serving.py), profile
-                                captures, anomalies, stalls, peak HBM,
-                                per-host liveness
+                                captures, anomalies, stalls, restart
+                                latencies, peak HBM, per-host liveness
     tail <job_id> [-n N]        last N events, rendered one per line
     diff <job_a> <job_b>        phase/throughput comparison of two runs
     baseline <job_id> --out F   store one run's summary as a JSON baseline
     diff <job> --baseline F     compare a run against a stored baseline;
                                 --fail-slowdown 0.5 exits nonzero on a
                                 >50% steps/s regression — and, when both
-                                runs carry the serving signals, on a
-                                decode p95 latency or p99 TTFT inflation
-                                or an aggregate tokens/s/chip drop past
-                                the same fraction (the CI gate)
+                                runs carry the signals, on a decode p95
+                                latency / p99 TTFT / restart-latency
+                                inflation or an aggregate tokens/s/chip
+                                drop past the same fraction (the CI gate)
     pod <job_id>                pod-wide view over ALL hosts' streams
                                 (obs/pod.py): per-host skew/straggler
-                                table, barrier-wait attribution, unified
-                                restart/anomaly/capture timeline
+                                table with barrier-fit clock offsets,
+                                barrier-wait attribution, skew-corrected
+                                unified restart/anomaly/capture timeline
+    watch <job_id>              live terminal view, refreshed every
+                                --interval seconds (obs/watch.py);
+                                --once renders a single frame (CI smoke)
+    export <job_id>             Prometheus text-format metrics from the
+                                same fold state (obs/export.py):
+                                --prom FILE writes a scrape file,
+                                --http PORT serves /metrics, --once for
+                                one-shot emission
 
-Pure stdlib + the event files — no JAX import, so it runs anywhere the
-NAS/log directory is mounted (the reference's analysis had the same
-property for its CSVs; ``bench/analysis.py`` keeps that role and calls
-into this module for the event-side sections).
+All commands except ``tail`` read through the incremental fold engine
+(``obs/fold.py``): a resumable reducer whose sidecar makes every
+invocation O(appended bytes) while rendering byte-identically to a cold
+full parse (``--no-cache`` forces the cold path).  Pure stdlib + the
+event files — no JAX import, so it runs anywhere the NAS/log directory
+is mounted (the reference's analysis had the same property for its
+CSVs; ``bench/analysis.py`` keeps that role and calls into this module
+for the event-side sections).
 """
 
 from __future__ import annotations
@@ -33,7 +46,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-from collections import defaultdict
 from pathlib import Path
 
 from ddl_tpu.obs.events import read_events
@@ -43,6 +55,7 @@ __all__ = [
     "load_run",
     "main",
     "render_summary",
+    "summarize_from_fold",
     "summarize_run",
 ]
 
@@ -53,7 +66,9 @@ def _job_dir(log_dir: str | os.PathLike, job_id: str) -> Path:
 
 def load_run(log_dir: str | os.PathLike, job_id: str) -> list[dict]:
     """All hosts' events for a job, ordered by wall clock (cross-host
-    monotonic clocks don't compare; ts is NTP-close)."""
+    monotonic clocks don't compare; ts is NTP-close).  Full parse — the
+    ``tail`` path and external callers that want raw events; the summary
+    paths go through ``obs/fold.fold_job`` instead."""
     events = []
     for f in sorted(_job_dir(log_dir, job_id).glob("events-h*.jsonl")):
         events.extend(read_events(f))
@@ -61,33 +76,69 @@ def load_run(log_dir: str | os.PathLike, job_id: str) -> list[dict]:
     return events
 
 
-def summarize_run(events: list[dict], decode_stats=None) -> dict:
-    """Aggregate one run's events into the summary dict the CLI renders.
+def _merge_sorted(fold, attr: str) -> list[dict]:
+    """Deterministic cross-stream merge of per-stream event lists: sort
+    by (ts, stream name, in-stream position) so cold and resumed folds
+    render identically even under ts ties."""
+    out = []
+    for name in sorted(fold.streams):
+        for i, e in enumerate(getattr(fold.streams[name], attr)):
+            out.append((e.get("ts", 0.0), name, i, e))
+    out.sort(key=lambda t: t[:3])
+    return [e for _, _, _, e in out]
 
-    ``decode_stats`` is an optional pre-built ``ServingStats`` (the CLI
-    passes the incremental tail-cursor accumulators — ``obs/cursor.py`` —
-    so long-running serving jobs don't re-parse every stream per
-    invocation); None folds the decode events in ``events``."""
-    phases: dict[str, float] = defaultdict(float)
+
+def summarize_from_fold(fold) -> dict:
+    """Aggregate a ``JobFold`` into the summary dict the CLI renders
+    (same shape ``obs baseline`` has always stored)."""
+    names = sorted(fold.streams)
+    runs: set[str] = set()
+    for n in names:
+        runs |= fold.streams[n].runs
+
+    # -- representative-host period aggregates ---------------------------
     # Run-level totals come from ONE representative host: every host
     # emits its own period events for the same global periods, so
     # summing across hosts would report N-times-inflated steps/elapsed/
     # phase seconds on exactly the multihost runs this tool targets.
     # (The per-host section below keeps the per-host view.)
-    all_periods = [e for e in events if e.get("kind") == "period"]
-    p_host = min((e.get("host", 0) for e in all_periods), default=0)
-    periods = [e for e in all_periods if e.get("host", 0) == p_host]
-    for e in periods:
-        for name, dur in (e.get("phases") or {}).items():
-            phases[name] += dur
-    if not periods:  # span-only streams (e.g. decode) still break down
-        # top-level spans only: a parent's duration already contains its
-        # children's, so summing every depth would double-count
-        for e in events:
-            if e.get("kind") == "span" and not e.get("depth"):
-                phases[e.get("name", "?")] += e.get("dur", 0.0)
+    phost: dict[int, dict] = {}
+    for n in names:
+        for h, agg in fold.streams[n].phost.items():
+            m = phost.setdefault(h, {
+                "n": 0, "steps": 0, "elapsed": 0.0, "compiles": 0,
+                "hbm": None, "phases": {}, "sps": [],
+            })
+            m["n"] += agg["n"]
+            m["steps"] += agg["steps"]
+            m["elapsed"] += agg["elapsed"]
+            m["compiles"] += agg["compiles"]
+            if agg["hbm"] is not None:
+                m["hbm"] = (
+                    agg["hbm"] if m["hbm"] is None
+                    else max(m["hbm"], agg["hbm"])
+                )
+            for ph, dur in agg["phases"].items():
+                m["phases"][ph] = m["phases"].get(ph, 0.0) + dur
+            m["sps"].extend(agg["sps"])
 
-    sps = [e["steps_per_sec"] for e in periods if e.get("steps_per_sec")]
+    if phost:
+        rep = phost[min(phost)]
+        phases = dict(rep["phases"])
+        periods_n, steps = rep["n"], rep["steps"]
+        elapsed, compiles = rep["elapsed"], rep["compiles"]
+        hbm, sps = rep["hbm"], rep["sps"]
+    else:
+        # span-only streams (e.g. decode) still get a phase breakdown
+        # from top-level spans (a parent's duration already contains its
+        # children's, so deeper spans would double-count)
+        phases = {}
+        for n in names:
+            for ph, dur in fold.streams[n].span_sums.items():
+                phases[ph] = phases.get(ph, 0.0) + dur
+        periods_n = steps = compiles = 0
+        elapsed, hbm, sps = 0.0, None, []
+
     half = len(sps) // 2
     trend = None
     if half >= 1:
@@ -96,78 +147,144 @@ def summarize_run(events: list[dict], decode_stats=None) -> dict:
         trend = {"first_half": first, "second_half": second,
                  "ratio": second / first if first else None}
 
-    # Per-host liveness: span/heartbeat steps are one global monotone
-    # counter per host (every family stamps global steps), so they are
-    # the straggler comparator; period events' step column is the CSV
-    # 'epoch' index (a different unit for the epoch families) and is
-    # used only when a host emitted no finer-grained signal at all —
-    # consistent across hosts, since all run the same configuration.
+    # -- per-host liveness (events' own host field) ----------------------
+    # span/heartbeat steps are one global monotone counter per host, so
+    # they are the straggler comparator; period events' step column is
+    # the CSV 'epoch' index (a different unit for the epoch families)
+    # and is used only when a host emitted no finer-grained signal.
     hosts: dict[int, dict] = {}
-    for e in events:
-        h = e.get("host", 0)
-        rec = hosts.setdefault(
-            h, {"last_step": None, "_period_step": None, "last_ts": None,
-                "stalls": 0}
-        )
-        step = e.get("step")
-        if step is not None:
-            if e.get("kind") in ("span", "heartbeat", "stall"):
-                rec["last_step"] = (
-                    step if rec["last_step"] is None
-                    else max(rec["last_step"], step)
+    for n in names:
+        for h, r in fold.streams[n].hosts.items():
+            m = hosts.setdefault(h, {
+                "last_step": None, "last_ts": None, "stalls": 0,
+                "_pstep": None, "_pstep_ts": None,
+            })
+            if r["last_step"] is not None:
+                m["last_step"] = (
+                    r["last_step"] if m["last_step"] is None
+                    else max(m["last_step"], r["last_step"])
                 )
-            elif e.get("kind") == "period":
-                rec["_period_step"] = step
-        if e.get("kind") == "stall":
-            rec["stalls"] += 1
-        rec["last_ts"] = e.get("ts", rec["last_ts"])
-    for rec in hosts.values():
-        if rec["last_step"] is None:
-            rec["last_step"] = rec.pop("_period_step")
-        else:
-            rec.pop("_period_step")
+            if r["last_ts"] is not None and (
+                m["last_ts"] is None or r["last_ts"] > m["last_ts"]
+            ):
+                m["last_ts"] = r["last_ts"]
+            m["stalls"] += r["stalls"]
+            if r["pstep"] is not None and (
+                m["_pstep_ts"] is None
+                or (r["pstep_ts"] or 0.0) >= m["_pstep_ts"]
+            ):
+                m["_pstep"] = r["pstep"]
+                m["_pstep_ts"] = r["pstep_ts"] or 0.0
+    for m in hosts.values():
+        if m["last_step"] is None:
+            m["last_step"] = m["_pstep"]
+        m.pop("_pstep")
+        m.pop("_pstep_ts")
 
-    # serving-side percentiles (obs/serving.py): latency / queue delay /
-    # TTFT / tok_per_s distributions over warm per-request decode events
-    from ddl_tpu.obs.serving import ServingStats
-
-    if decode_stats is None:
-        decode_stats = ServingStats.from_events(events)
-    decode = decode_stats.summary()
+    # -- serving percentiles (per-stream digests merged) -----------------
+    stats = fold.serving()
+    decode = stats.summary()
     if decode is not None and decode["mean_tok_per_s"] is None:
         # no warm request at all (single-request smokes): fall back to
-        # the cold rates so the legacy mean stays populated.  A rate of
-        # exactly 0.0 is present, not missing (falsy-drop bug class)
-        rates = [
-            e["tok_per_s"] for e in events
-            if e.get("kind") == "decode"
-            and e.get("tok_per_s") is not None
-        ]
+        # the all-request rates so the legacy mean stays populated.  A
+        # rate of exactly 0.0 is present, not missing (falsy-drop bug
+        # class)
         decode["mean_tok_per_s"] = (
-            sum(rates) / len(rates) if rates else None
+            stats.all_rate_sum / stats.all_rate_n
+            if stats.all_rate_n else None
         )
 
-    captures = [
-        e for e in events if e.get("kind") == "profile_capture"
-    ]
+    # -- restart latency (decision -> first step, per restart epoch) -----
+    # running aggregates merged across streams (bounded state however
+    # many restarts a run survives)
+    n = 0
+    total_lat = 0.0
+    mx = last = last_ts = None
+    by_repoch: dict[int, list] = {}
+    for name in names:
+        rl = fold.streams[name].restart_latency
+        if not rl["n"]:
+            continue
+        n += rl["n"]
+        total_lat += rl["sum"]
+        mx = rl["max"] if mx is None else max(mx, rl["max"])
+        if last_ts is None or (rl["last_ts"] or 0.0) >= last_ts:
+            last = rl["last"]
+            last_ts = rl["last_ts"] or 0.0
+        for rep, (ts, lat) in rl["by_repoch"].items():
+            prev = by_repoch.get(int(rep))
+            if prev is None or ts >= prev[0]:
+                by_repoch[int(rep)] = [ts, lat]
+    restart_latency = None
+    if n:
+        restart_latency = {
+            "count": n,
+            "mean": total_lat / n,
+            "max": mx,
+            "last": last,
+            "by_repoch": {rep: v[1] for rep, v in by_repoch.items()},
+        }
 
-    hbm = [e["hbm_peak_bytes"] for e in periods if e.get("hbm_peak_bytes")]
+    counts = {
+        key: sum(fold.streams[nm].totals[key] for nm in names)
+        for key in ("anomalies", "stalls", "captures")
+    }
+
     return {
-        "runs": sorted({e.get("run") for e in events if e.get("run")}),
-        "events": len(events),
-        "periods": len(periods),
-        "steps": sum(e.get("steps", 0) for e in periods),
-        "elapsed": sum(e.get("elapsed", 0.0) for e in periods),
-        "compiles": sum(e.get("compiles", 0) for e in periods),
-        "phases": dict(phases),
+        "runs": sorted(runs),
+        "events": fold.events,
+        "periods": periods_n,
+        "steps": steps,
+        "elapsed": elapsed,
+        "compiles": compiles,
+        "phases": phases,
         "throughput_trend": trend,
-        "anomalies": [e for e in events if e.get("kind") == "anomaly"],
-        "stalls": [e for e in events if e.get("kind") == "stall"],
-        "peak_hbm_bytes": max(hbm) if hbm else None,
+        "anomalies": _merge_sorted(fold, "anomalies"),
+        "stalls": _merge_sorted(fold, "stalls"),
+        # totals keep counting past the per-stream retention cap
+        # (fold.MAX_EVENTS_PER_LIST); the lists above are the retained
+        # tails
+        "counts": counts,
+        "peak_hbm_bytes": hbm,
         "hosts": hosts,
         "decode": decode,
-        "profile_captures": captures,
+        "profile_captures": _merge_sorted(fold, "captures"),
+        "restart_latency": restart_latency,
     }
+
+
+def summarize_run(events: list[dict], decode_stats=None) -> dict:
+    """Aggregate an already-loaded event list (compatibility path for
+    callers holding raw events — ``bench/analysis.py``, tests).  The CLI
+    reads through ``obs/fold.fold_job`` instead, which produces the same
+    summary in O(appended bytes).  ``decode_stats`` optionally overrides
+    the serving section with a pre-built ``ServingStats``."""
+    from ddl_tpu.obs.fold import JobFold
+
+    fold = JobFold.from_events(events)
+    summary = summarize_from_fold(fold)
+    if decode_stats is not None:
+        decode = decode_stats.summary()
+        if decode is not None and decode["mean_tok_per_s"] is None:
+            decode["mean_tok_per_s"] = (
+                decode_stats.all_rate_sum / decode_stats.all_rate_n
+                if decode_stats.all_rate_n else None
+            )
+        summary["decode"] = decode
+    return summary
+
+
+def _count(s: dict, key: str, list_key: str | None = None) -> int:
+    """An incident total: the running count when the summary carries one
+    (fold-era summaries), else the event list's length (stored baselines
+    from before the retention cap)."""
+    c = (s.get("counts") or {}).get(key)
+    return c if c is not None else len(s.get(list_key or key) or [])
+
+
+def _section_header(label: str, total: int, shown: int) -> str:
+    trunc = f", last {shown} shown" if shown < total else ""
+    return f"-- {label} ({total}{trunc}) --"
 
 
 def render_summary(s: dict, job_id: str = "") -> str:
@@ -187,6 +304,12 @@ def render_summary(s: dict, job_id: str = "") -> str:
         )
     if s["peak_hbm_bytes"]:
         lines.append(f"peak HBM: {s['peak_hbm_bytes'] / 1e9:.2f} GB")
+    rl = s.get("restart_latency")
+    if rl:
+        lines.append(
+            f"restart latency: {rl['count']} restart(s), last "
+            f"{rl['last']:.1f}s decision->first-step (max {rl['max']:.1f}s)"
+        )
     if s["phases"]:
         total = sum(s["phases"].values()) or 1.0
         lines.append("-- phase breakdown --")
@@ -229,7 +352,10 @@ def render_summary(s: dict, job_id: str = "") -> str:
             lines.extend(render_percentiles(d["percentiles"]))
     captures = s.get("profile_captures") or []
     if captures:
-        lines.append(f"-- profile captures ({len(captures)}) --")
+        lines.append(_section_header(
+            "profile captures",
+            _count(s, "captures", "profile_captures"), len(captures),
+        ))
         for c in captures:
             if not c.get("ok"):
                 lines.append(
@@ -250,7 +376,9 @@ def render_summary(s: dict, job_id: str = "") -> str:
                     if c.get("suppressed") else ""
                 )
             )
-    lines.append(f"-- anomalies ({len(s['anomalies'])}) --")
+    lines.append(_section_header(
+        "anomalies", _count(s, "anomalies"), len(s["anomalies"]),
+    ))
     for a in s["anomalies"]:
         base = (
             f" vs baseline {a['baseline']:.4g}"
@@ -261,13 +389,16 @@ def render_summary(s: dict, job_id: str = "") -> str:
             f"value {a.get('value', float('nan')):.4g}{base}"
         )
     if s["stalls"]:
-        lines.append(f"-- stalls ({len(s['stalls'])}) --")
+        lines.append(_section_header(
+            "stalls", _count(s, "stalls"), len(s["stalls"]),
+        ))
         for st in s["stalls"]:
+            stacks_n = st.get("stacks_n", len(st.get("stacks") or {}))
             lines.append(
                 f"  host {st.get('host')}: last step {st.get('step')}, "
                 f"{st.get('age', 0):.1f}s past deadline "
                 f"{st.get('deadline', 0):.1f}s "
-                f"({len(st.get('stacks', {}))} thread stacks captured)"
+                f"({stacks_n} thread stacks captured)"
             )
     if len(s["hosts"]) > 1:
         lines.append("-- hosts --")
@@ -305,10 +436,18 @@ def diff_runs(sa: dict, sb: dict, job_a: str, job_b: str) -> str:
         delta = f"{(b - a) / a:+.0%}" if a else "new"
         lines.append(f"{name:<12} {a:>13.3f}s {b:>13.3f}s {delta:>8}")
     lines.append(
-        f"anomalies: {len(sa['anomalies'])} vs {len(sb['anomalies'])} | "
-        f"stalls: {len(sa['stalls'])} vs {len(sb['stalls'])} | "
+        f"anomalies: {_count(sa, 'anomalies')} vs "
+        f"{_count(sb, 'anomalies')} | "
+        f"stalls: {_count(sa, 'stalls')} vs {_count(sb, 'stalls')} | "
         f"compiles: {sa['compiles']} vs {sb['compiles']}"
     )
+    la, lb = _restart_latency(sa), _restart_latency(sb)
+    if la is not None and lb is not None:
+        lines.append(
+            f"restart latency (max): {la:.1f}s vs {lb:.1f}s "
+            f"(x{lb / la:.2f})" if la else
+            f"restart latency (max): {la:.1f}s vs {lb:.1f}s"
+        )
     pa, pb = _decode_percentiles(sa), _decode_percentiles(sb)
     if pa and pb:
         lines.append(
@@ -334,6 +473,13 @@ def _decode_percentiles(s: dict) -> dict | None:
     return d.get("percentiles") if d else None
 
 
+def _restart_latency(s: dict) -> float | None:
+    """A summary's max restart latency (None when the run never
+    restarted, or the baseline predates the field)."""
+    rl = s.get("restart_latency")
+    return rl.get("max") if rl else None
+
+
 def _render_event(e: dict) -> str:
     kind = e.get("kind", "?")
     base = f"[h{e.get('host', 0)}] {kind:<10} step={e.get('step')}"
@@ -349,6 +495,22 @@ def _render_event(e: dict) -> str:
     return f"{base} {body}"
 
 
+def _fold_or_exit(args):
+    from ddl_tpu.obs.fold import fold_job
+
+    fold = fold_job(
+        args.log_dir, getattr(args, "job_id", None) or args.job_a,
+        cache=not args.no_cache,
+    )
+    if not fold.events:
+        job = getattr(args, "job_id", None) or args.job_a
+        raise SystemExit(
+            f"no events for job {job!r} under {args.log_dir} "
+            f"(looked for {_job_dir(args.log_dir, job)}/events-h*.jsonl)"
+        )
+    return fold
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(
         prog="ddl_tpu obs", description=__doc__,
@@ -358,6 +520,11 @@ def main(argv=None) -> None:
     # subcommand too (``obs summarize job --log-dir DIR``)
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument("--log-dir", default="training_logs")
+    common.add_argument(
+        "--no-cache", action="store_true",
+        help="ignore and do not write the incremental fold sidecar "
+        "(cold full parse; the reference the cache must match)",
+    )
     sub = ap.add_subparsers(dest="command", required=True)
     p_sum = sub.add_parser(
         "summarize", parents=[common], help="one run's summary"
@@ -405,34 +572,55 @@ def main(argv=None) -> None:
         "--json", action="store_true",
         help="emit the pod summary as JSON instead of the rendered view",
     )
+    p_watch = sub.add_parser(
+        "watch", parents=[common],
+        help="live terminal view over all hosts' streams, refreshed "
+        "through the incremental fold engine (obs/watch.py)",
+    )
+    p_watch.add_argument("job_id")
+    p_watch.add_argument(
+        "--interval", type=float, default=2.0, metavar="S",
+        help="refresh interval in seconds (default 2)",
+    )
+    p_watch.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (CI smoke / scripting)",
+    )
+    p_exp = sub.add_parser(
+        "export", parents=[common],
+        help="Prometheus text-format metrics from the fold state "
+        "(obs/export.py)",
+    )
+    p_exp.add_argument("job_id")
+    p_exp.add_argument(
+        "--prom", metavar="FILE", default=None,
+        help="write the scrape to FILE (default: stdout)",
+    )
+    p_exp.add_argument(
+        "--http", metavar="PORT", type=int, default=None,
+        help="serve GET /metrics on PORT instead of writing a file",
+    )
+    p_exp.add_argument(
+        "--once", action="store_true",
+        help="emit one scrape and exit (with --prom or stdout)",
+    )
+    p_exp.add_argument(
+        "--interval", type=float, default=15.0, metavar="S",
+        help="rewrite interval for --prom without --once (default 15)",
+    )
     args = ap.parse_args(argv)
 
     if args.command == "summarize":
-        events = load_run(args.log_dir, args.job_id)
-        if not events:
-            raise SystemExit(
-                f"no events for job {args.job_id!r} under {args.log_dir} "
-                f"(looked for {_job_dir(args.log_dir, args.job_id)}/events-h*.jsonl)"
-            )
-        # decode percentiles come from the incremental tail-cursor cache
-        # (obs/cursor.py): the reservoir accumulators fold only bytes
-        # appended since the last summarize and persist in the sidecar.
-        # NOTE the phase/step sections above still come from load_run's
-        # full parse — making the whole summary incremental is a ROADMAP
-        # follow-on; today the cursor buys persistent percentile state,
-        # not a faster summarize
-        from ddl_tpu.obs.cursor import incremental_serving_stats
-
-        stats = incremental_serving_stats(args.log_dir, args.job_id)
-        print(render_summary(
-            summarize_run(events, decode_stats=stats), args.job_id
-        ))
+        fold = _fold_or_exit(args)
+        print(render_summary(summarize_from_fold(fold), args.job_id))
     elif args.command == "tail":
         events = load_run(args.log_dir, args.job_id)
         for e in events[-args.n:]:
             print(_render_event(e))
     elif args.command == "diff":
-        sb = summarize_run(load_run(args.log_dir, args.job_a))
+        from ddl_tpu.obs.fold import fold_job
+
+        sb = summarize_from_fold(_fold_or_exit(args))
         name_b = args.job_a
         if args.baseline:
             stored = json.loads(Path(args.baseline).read_text())
@@ -440,7 +628,9 @@ def main(argv=None) -> None:
             name_a = f"baseline:{stored.get('job_id', '?')}"
         elif args.job_b:
             # two-job diff keeps its original orientation (a vs b)
-            sa, sb = sb, summarize_run(load_run(args.log_dir, args.job_b))
+            sa, sb = sb, summarize_from_fold(fold_job(
+                args.log_dir, args.job_b, cache=not args.no_cache,
+            ))
             name_a, name_b = name_b, args.job_b
         else:
             raise SystemExit("obs diff needs a second job id or --baseline")
@@ -450,6 +640,7 @@ def main(argv=None) -> None:
             ra, rb = _rate(sa), _rate(sb)
             pa, pb = _decode_percentiles(sa), _decode_percentiles(sb)
             da, db = sa.get("decode") or {}, sb.get("decode") or {}
+            la, lb = _restart_latency(sa), _restart_latency(sb)
 
             def _pct(p, metric, q):
                 return (p or {}).get(metric, {}).get(q)
@@ -466,7 +657,10 @@ def main(argv=None) -> None:
                 da.get("agg_tok_per_s_per_chip") is not None
                 and db.get("agg_tok_per_s_per_chip") is not None
             )
-            if not (ra and rb) and not (lat_gate or ttft_gate or agg_gate):
+            restart_gate = la is not None and lb is not None
+            if not (ra and rb) and not (
+                lat_gate or ttft_gate or agg_gate or restart_gate
+            ):
                 # a run that emitted neither period events nor decode
                 # percentiles must not pass the gate by default — that
                 # is the shape of a crashed smoke
@@ -482,13 +676,13 @@ def main(argv=None) -> None:
                     f"{frac:.0%} below {name_a} ({ra:.2f} steps/s)"
                 )
             if lat_gate:
-                la = _pct(pa, "latency_s", "p95")
-                lb = _pct(pb, "latency_s", "p95")
-                if lb > (1.0 + frac) * la:
+                a = _pct(pa, "latency_s", "p95")
+                b = _pct(pb, "latency_s", "p95")
+                if b > (1.0 + frac) * a:
                     raise SystemExit(
-                        f"FAIL: {name_b} decode p95 latency {lb:.4g}s is "
+                        f"FAIL: {name_b} decode p95 latency {b:.4g}s is "
                         f"more than {frac:.0%} above {name_a} "
-                        f"({la:.4g}s)"
+                        f"({a:.4g}s)"
                     )
             if ttft_gate:
                 ta = _pct(pa, "ttft_s", "p99")
@@ -507,6 +701,11 @@ def main(argv=None) -> None:
                         f"{gb:.4g} tok/s/chip is more than {frac:.0%} "
                         f"below {name_a} ({ga:.4g} tok/s/chip)"
                     )
+            if restart_gate and la > 0 and lb > (1.0 + frac) * la:
+                raise SystemExit(
+                    f"FAIL: {name_b} restart latency {lb:.1f}s is more "
+                    f"than {frac:.0%} above {name_a} ({la:.1f}s)"
+                )
             print(
                 f"OK: within the {frac:.0%} regression gate ("
                 + " and ".join(
@@ -515,40 +714,45 @@ def main(argv=None) -> None:
                         ("decode p95 latency", lat_gate),
                         ("p99 TTFT", ttft_gate),
                         ("agg tok/s/chip", agg_gate),
+                        ("restart latency", restart_gate),
                     ) if on
                 )
                 + ")"
             )
     elif args.command == "baseline":
-        events = load_run(args.log_dir, args.job_id)
-        if not events:
-            raise SystemExit(
-                f"no events for job {args.job_id!r} under {args.log_dir}"
-            )
-        payload = {"job_id": args.job_id, "summary": summarize_run(events)}
+        fold = _fold_or_exit(args)
+        payload = {
+            "job_id": args.job_id, "summary": summarize_from_fold(fold),
+        }
         Path(args.out).write_text(json.dumps(payload, indent=1))
         print(f"wrote baseline for {args.job_id!r} to {args.out}")
     elif args.command == "pod":
-        from ddl_tpu.obs.pod import load_pod, pod_summary, render_pod_summary
+        from ddl_tpu.obs.pod import pod_summary_from_fold, render_pod_summary
 
-        streams = load_pod(args.log_dir, args.job_id)
-        if not streams:
-            raise SystemExit(
-                f"no events for job {args.job_id!r} under {args.log_dir} "
-                f"(looked for {_job_dir(args.log_dir, args.job_id)}/events-h*.jsonl)"
-            )
-        from ddl_tpu.obs.cursor import incremental_serving_stats
-
-        serving = incremental_serving_stats(
-            args.log_dir, args.job_id
-        ).summary()
-        summary = pod_summary(streams, serving=serving)
+        fold = _fold_or_exit(args)
+        summary = pod_summary_from_fold(fold)
         if args.json:
             print(json.dumps(summary, default=str))
         else:
             print(
                 render_pod_summary(summary, args.job_id, tail=args.timeline)
             )
+    elif args.command == "watch":
+        from ddl_tpu.obs.watch import watch
+
+        watch(
+            args.log_dir, args.job_id,
+            interval=args.interval, once=args.once,
+            cache=not args.no_cache,
+        )
+    elif args.command == "export":
+        from ddl_tpu.obs.export import export_command
+
+        export_command(
+            args.log_dir, args.job_id,
+            prom=args.prom, http_port=args.http, once=args.once,
+            interval=args.interval, cache=not args.no_cache,
+        )
 
 
 if __name__ == "__main__":

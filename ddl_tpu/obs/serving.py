@@ -8,20 +8,37 @@ duration, queueing delay, time-to-first-token, tokens/s, prompt/output
 lengths) into streaming percentiles for ``obs summarize`` and the
 ``obs diff --fail-slowdown`` regression gate.
 
-``QuantileAccumulator`` is a bounded-memory reservoir (Vitter's
-algorithm R, deterministic seed): exact quantiles while the stream fits
-the reservoir (every CI run), a uniform sample of the stream beyond it —
-so a week-long serving run's event file can be summarized without
-holding every request in memory.  Quantile interpolation matches
-``numpy.quantile``'s default (linear), which is what the unit tests pin
-it against.
+``TDigest`` is the percentile accumulator: a deterministic, *mergeable*
+t-digest.  While the stream fits ``exact_max`` points it stores raw
+singletons and quantiles are exact (``numpy.quantile``'s default linear
+interpolation, which the unit tests pin); beyond that the merging-digest
+compression bounds memory at ~``compression`` centroids with singleton-
+fine tails.  No RNG anywhere — the digest is a pure function of its
+insertion sequence, and ``merge`` sorts the combined centroid set before
+compressing, so merging per-stream digests is independent of operand
+order.  Mergeability is what lets the incremental fold engine
+(``obs/fold.py``) keep one digest PER STREAM and combine them at render
+time: a resumed fold then reproduces a cold fold bit for bit, which a
+shared reservoir (whose sampling depends on the global interleaving of
+streams) cannot.
+
+``QuantileAccumulator`` (the pre-digest bounded reservoir, Vitter's
+algorithm R) is kept for callers that want a uniform *sample* rather
+than a sketch; ``TDigest.from_state`` transparently migrates its
+serialized state, so sidecars written by the reservoir era load into
+digests without losing the accumulated distribution.
 """
 
 from __future__ import annotations
 
 import random
 
-__all__ = ["QuantileAccumulator", "ServingStats", "PERCENTILES"]
+__all__ = [
+    "QuantileAccumulator",
+    "ServingStats",
+    "TDigest",
+    "PERCENTILES",
+]
 
 PERCENTILES = (0.5, 0.95, 0.99)
 
@@ -57,10 +74,8 @@ class QuantileAccumulator:
         self.max: float | None = None
 
     def state_dict(self) -> dict:
-        """JSON-serializable snapshot (the tail-cursor cache persists
-        accumulators between ``obs summarize`` invocations —
-        ``obs/cursor.py``).  Includes the reservoir RNG state so a
-        restored accumulator samples the stream tail exactly as the
+        """JSON-serializable snapshot.  Includes the reservoir RNG state
+        so a restored accumulator samples the stream tail exactly as the
         uninterrupted one would."""
         st = self._rng.getstate()
         return {
@@ -112,12 +127,7 @@ class QuantileAccumulator:
             raise ValueError(f"quantile {q} outside [0, 1]")
         if self._sorted is None:
             self._sorted = sorted(self._values)
-        v = self._sorted
-        pos = q * (len(v) - 1)
-        lo = int(pos)
-        hi = min(lo + 1, len(v) - 1)
-        frac = pos - lo
-        return v[lo] * (1.0 - frac) + v[hi] * frac
+        return _linear_quantile(self._sorted, q)
 
     def summary(self, percentiles=PERCENTILES) -> dict:
         return {
@@ -131,6 +141,229 @@ class QuantileAccumulator:
         }
 
 
+def _linear_quantile(sorted_values: list[float], q: float) -> float:
+    """numpy.quantile's default (linear) interpolation over an already
+    sorted value list."""
+    v = sorted_values
+    pos = q * (len(v) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(v) - 1)
+    frac = pos - lo
+    return v[lo] * (1.0 - frac) + v[hi] * frac
+
+
+# buffered adds between compressions: amortizes the sort without letting
+# the unmerged tail grow past a small constant
+_TDIGEST_BUFFER = 512
+
+
+class TDigest:
+    """Deterministic mergeable t-digest (see module docstring).
+
+    ``exact_max`` is the singleton budget: while total weight stays at
+    or below it nothing is ever merged, quantiles are numpy-exact, and
+    the digest degenerates to a sorted value list (every CI smoke lives
+    here).  Past it, the merging-digest pass bounds the centroid count
+    near ``compression`` with a k1-style size limit (fine tails, coarse
+    middle).  ``count``/``total``/``min``/``max`` always describe the
+    FULL stream, including what compression summarized."""
+
+    def __init__(
+        self, compression: int = 256, exact_max: int = 4096
+    ) -> None:
+        if compression < 8:
+            raise ValueError(
+                f"compression must be >= 8, got {compression}"
+            )
+        if exact_max < 1:
+            raise ValueError(f"exact_max must be >= 1, got {exact_max}")
+        self.compression = int(compression)
+        self.exact_max = int(exact_max)
+        self._means: list[float] = []
+        self._weights: list[float] = []
+        self._buffer: list[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.min: float | None = None
+        self.max: float | None = None
+
+    # ------------------------------------------------------------ ingest
+
+    def add(self, x: float) -> None:
+        x = float(x)
+        self.count += 1
+        self.total += x
+        self.min = x if self.min is None else min(self.min, x)
+        self.max = x if self.max is None else max(self.max, x)
+        self._buffer.append(x)
+        if len(self._buffer) >= _TDIGEST_BUFFER:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._buffer:
+            return
+        pts = sorted(
+            list(zip(self._means, self._weights))
+            + [(x, 1.0) for x in self._buffer]
+        )
+        self._buffer = []
+        weight = sum(w for _, w in pts)
+        if weight <= self.exact_max:
+            # singleton regime: keep every point, quantiles stay exact
+            self._means = [m for m, _ in pts]
+            self._weights = [w for _, w in pts]
+            return
+        self._means, self._weights = self._compress(pts, weight)
+
+    def _compress(self, pts, weight):
+        """One merging-digest pass over mean-sorted points.  A centroid
+        may absorb the next point while its weight stays under the k1
+        size limit ``4*W*q*(1-q)/compression`` at its midpoint quantile
+        — singleton-fine tails, ~compression centroids total.  Pure
+        function of the sorted input: deterministic, order-free."""
+        means: list[float] = []
+        weights: list[float] = []
+        cur_m, cur_w = pts[0]
+        done = 0.0  # weight fully emitted so far
+        for m, w in pts[1:]:
+            q = (done + (cur_w + w) / 2.0) / weight
+            limit = 4.0 * weight * q * (1.0 - q) / self.compression
+            if cur_w + w <= limit:
+                cur_m += (m - cur_m) * (w / (cur_w + w))
+                cur_w += w
+            else:
+                means.append(cur_m)
+                weights.append(cur_w)
+                done += cur_w
+                cur_m, cur_w = m, w
+        means.append(cur_m)
+        weights.append(cur_w)
+        return means, weights
+
+    def merge(self, other: "TDigest") -> None:
+        """Fold ``other``'s distribution into this digest without
+        mutating it.  The combined centroid set is re-sorted before any
+        compression, so ``a.merge(b)`` and ``b.merge(a)`` summarize
+        identically — the property the per-stream fold accumulators rely
+        on when they are combined at render time."""
+        if not other.count:
+            return
+        self.count += other.count
+        self.total += other.total
+        if other.min is not None:
+            self.min = (
+                other.min if self.min is None else min(self.min, other.min)
+            )
+        if other.max is not None:
+            self.max = (
+                other.max if self.max is None else max(self.max, other.max)
+            )
+        pts = sorted(
+            list(zip(self._means, self._weights))
+            + [(x, 1.0) for x in self._buffer]
+            + list(zip(other._means, other._weights))
+            + [(x, 1.0) for x in other._buffer]
+        )
+        self._buffer = []
+        weight = sum(w for _, w in pts)
+        if weight <= self.exact_max:
+            self._means = [m for m, _ in pts]
+            self._weights = [w for _, w in pts]
+        else:
+            self._means, self._weights = self._compress(pts, weight)
+
+    # ------------------------------------------------------------- query
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.count if self.count else None
+
+    def quantile(self, q: float) -> float | None:
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        self._flush()
+        if not self._means:
+            return None
+        if all(w == 1.0 for w in self._weights):
+            # singleton regime: exactly numpy's linear interpolation
+            return _linear_quantile(self._means, q)
+        # compressed regime: interpolate between centroid means at their
+        # cumulative-weight midpoints, clamped to the observed extremes
+        weight = sum(self._weights)
+        target = q * weight
+        cum = 0.0
+        prev_mid = 0.0
+        prev_mean = self.min if self.min is not None else self._means[0]
+        for m, w in zip(self._means, self._weights):
+            mid = cum + w / 2.0
+            if target <= mid:
+                span = mid - prev_mid
+                frac = (target - prev_mid) / span if span > 0 else 0.0
+                return prev_mean + (m - prev_mean) * frac
+            cum += w
+            prev_mid = mid
+            prev_mean = m
+        return self.max if self.max is not None else self._means[-1]
+
+    def summary(self, percentiles=PERCENTILES) -> dict:
+        return {
+            "count": self.count,
+            "mean": self.mean,
+            "min": self.min,
+            "max": self.max,
+            **{
+                f"p{int(q * 100)}": self.quantile(q) for q in percentiles
+            },
+        }
+
+    # ------------------------------------------------------------- state
+
+    def state_dict(self) -> dict:
+        # the unmerged buffer is serialized VERBATIM, not flushed: a
+        # restored digest must hit the same compression boundaries the
+        # uninterrupted one would, or a resumed fold's percentiles drift
+        # from a cold fold's once past the singleton regime
+        return {
+            "compression": self.compression,
+            "exact_max": self.exact_max,
+            "means": self._means,
+            "weights": self._weights,
+            "buffer": self._buffer,
+            "count": self.count,
+            "total": self.total,
+            "min": self.min,
+            "max": self.max,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "TDigest":
+        if "rng" in state or "values" in state:
+            # transparent migration from a QuantileAccumulator (reservoir)
+            # sidecar state: the reservoir's values become singletons and
+            # the full-stream count/total/min/max carry over, so a
+            # pre-digest sidecar keeps its accumulated distribution
+            dig = cls(exact_max=max(int(state["capacity"]), 1))
+            dig._means = sorted(float(v) for v in state["values"])
+            dig._weights = [1.0] * len(dig._means)
+            dig.count = int(state["count"])
+            dig.total = float(state["total"])
+            dig.min = state["min"]
+            dig.max = state["max"]
+            return dig
+        dig = cls(
+            compression=int(state["compression"]),
+            exact_max=int(state["exact_max"]),
+        )
+        dig._means = [float(m) for m in state["means"]]
+        dig._weights = [float(w) for w in state["weights"]]
+        dig._buffer = [float(x) for x in state.get("buffer", [])]
+        dig.count = int(state["count"])
+        dig.total = float(state["total"])
+        dig.min = state["min"]
+        dig.max = state["max"]
+        return dig
+
+
 class ServingStats:
     """Aggregate per-request ``decode`` events into the percentile block
     ``obs summarize`` renders and ``obs diff`` gates on.
@@ -138,26 +371,44 @@ class ServingStats:
     Cold requests (``warm`` false — the first request per generator pays
     the XLA compile) are excluded from every distribution and reported
     as a count: a p99 that is really "the compile happened" explains
-    nothing."""
+    nothing.  ``merge`` combines independently-built stats (the fold
+    engine keeps one per stream); every piece of state is either a sum,
+    a min/max, or a mergeable digest, so merged == fed-as-one-stream."""
 
     def __init__(self, capacity: int = 4096) -> None:
-        self.acc = {name: QuantileAccumulator(capacity) for _, name in METRICS}
+        self.acc = {
+            name: TDigest(exact_max=capacity) for _, name in METRICS
+        }
         self.requests = 0
         self.cold = 0
         self.tokens = 0
         self.prompt_tokens = 0
+        # rate stats over ALL decode events (cold included): the
+        # all-cold-smoke fallback mean in `obs summarize` needs them
+        # without a second pass over the stream
+        self.all_rate_sum = 0.0
+        self.all_rate_n = 0
         # warm-span aggregate throughput: warm output tokens over the
         # wall-clock span [earliest warm request start, latest warm
         # completion] — the system-level tokens/s number the Gemma-on-TPU
         # serving comparison reports per chip, next to the per-request
         # percentiles (which can look healthy while the batch is empty).
-        # Spans are PER ENGINE LABEL (event "engine" field; the one-shot
-        # generator has none): a CI job stream holds a decode smoke AND
-        # a serve-bench smoke minutes apart, and one global span would
-        # be >99% idle gap — a gate on that number moves with test
-        # ordering, not serving performance
+        # Spans are PER ENGINE (the "engine" event field, else the run id
+        # of the emitting process): a CI job stream holds a decode smoke
+        # AND a serve-bench smoke minutes apart — and can hold TWO decode
+        # smokes from different processes — and one shared span would be
+        # >99% idle gap, a number that moves with test ordering, not
+        # serving performance.
         self.spans: dict[str, list] = {}  # label -> [tokens, start, end]
         self.chips = 0
+
+    @staticmethod
+    def _span_label(event: dict) -> str:
+        engine = event.get("engine")
+        if engine:
+            return str(engine)
+        run = event.get("run")
+        return f"run:{run}" if run else "decode"
 
     def observe(self, event: dict) -> None:
         self.requests += 1
@@ -170,6 +421,10 @@ class ServingStats:
         chips = event.get("chips")
         if chips:
             self.chips = max(self.chips, int(chips))
+        rate = event.get("tok_per_s")
+        if rate is not None:
+            self.all_rate_sum += float(rate)
+            self.all_rate_n += 1
         if not event.get("warm"):
             self.cold += 1
             return
@@ -185,15 +440,39 @@ class ServingStats:
         ts = event.get("ts")
         if ts is not None:
             start = ts - (event.get("dur") or 0.0)
-            span = self.spans.get(str(event.get("engine") or "decode"))
+            label = self._span_label(event)
+            span = self.spans.get(label)
             if span is None:
-                self.spans[str(event.get("engine") or "decode")] = [
-                    tok, start, ts,
-                ]
+                self.spans[label] = [tok, start, ts]
             else:
                 span[0] += tok
                 span[1] = min(span[1], start)
                 span[2] = max(span[2], ts)
+
+    def merge(self, other: "ServingStats") -> None:
+        """Fold another stats object in (per-stream fold accumulators
+        merged at render time; see obs/fold.py)."""
+        for name, dig in other.acc.items():
+            mine = self.acc.get(name)
+            if mine is None:
+                self.acc[name] = TDigest.from_state(dig.state_dict())
+            else:
+                mine.merge(dig)
+        self.requests += other.requests
+        self.cold += other.cold
+        self.tokens += other.tokens
+        self.prompt_tokens += other.prompt_tokens
+        self.all_rate_sum += other.all_rate_sum
+        self.all_rate_n += other.all_rate_n
+        self.chips = max(self.chips, other.chips)
+        for label, span in other.spans.items():
+            mine_span = self.spans.get(label)
+            if mine_span is None:
+                self.spans[label] = [span[0], span[1], span[2]]
+            else:
+                mine_span[0] += span[0]
+                mine_span[1] = min(mine_span[1], span[1])
+                mine_span[2] = max(mine_span[2], span[2])
 
     def state_dict(self) -> dict:
         return {
@@ -202,6 +481,8 @@ class ServingStats:
             "cold": self.cold,
             "tokens": self.tokens,
             "prompt_tokens": self.prompt_tokens,
+            "all_rate_sum": self.all_rate_sum,
+            "all_rate_n": self.all_rate_n,
             "spans": self.spans,
             "chips": self.chips,
         }
@@ -210,13 +491,16 @@ class ServingStats:
     def from_state(cls, state: dict) -> "ServingStats":
         stats = cls()
         stats.acc = {
-            name: QuantileAccumulator.from_state(s)
+            name: TDigest.from_state(s)
             for name, s in state["acc"].items()
         }
         stats.requests = int(state["requests"])
         stats.cold = int(state["cold"])
         stats.tokens = int(state["tokens"])
         stats.prompt_tokens = int(state["prompt_tokens"])
+        # reservoir-era sidecars predate the all-rate fields
+        stats.all_rate_sum = float(state.get("all_rate_sum", 0.0))
+        stats.all_rate_n = int(state.get("all_rate_n", 0))
         stats.spans = {
             k: [v[0], v[1], v[2]] for k, v in state["spans"].items()
         }

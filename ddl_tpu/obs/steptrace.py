@@ -45,6 +45,24 @@ PHASES = (
     "logging",
 )
 
+_relaunch_consumed = False
+
+
+def _consume_relaunch_ts() -> float | None:
+    """DDL_RELAUNCH_TS, handed out at most once per process (the first
+    StepTrace built after a supervised relaunch owns the measurement)."""
+    global _relaunch_consumed
+    if _relaunch_consumed:
+        return None
+    raw = os.environ.get("DDL_RELAUNCH_TS")
+    if not raw:
+        return None
+    _relaunch_consumed = True
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
 # Phases that occur once per TRAINING STEP — the only ones the 1-in-N
 # span sampler thins.  eval/checkpoint/logging fire once per period
 # boundary (one write each, and a preemption's blocking checkpoint span
@@ -126,6 +144,14 @@ class StepTrace:
         self._totals: dict[str, float] = defaultdict(float)
         self.run_totals: dict[str, float] = defaultdict(float)
         self._needs_run_start = False  # set by finish() for train() reuse
+        # restart-latency origin: the supervisor's relaunch-decision
+        # wall clock (DDL_RELAUNCH_TS).  The first completed "step"
+        # phase of this process emits one `restart_latency` event
+        # against it — decision -> first step, the whole restart cost
+        # (rendezvous, backoff, snapshot restore, recompile) in one
+        # gateable number.  Consumed once per process, not per
+        # StepTrace: a second train() segment is not a restart.
+        self._relaunch_ts = _consume_relaunch_ts()
 
     @classmethod
     def create(
@@ -191,6 +217,7 @@ class StepTrace:
             # whose step budget is spent (obs/profiler.TraceCapturer)
             self.capturer.on_step(step)
         t0 = time.perf_counter()
+        completed = False
         try:
             if self._span_due(name, step):
                 with self.writer.span(
@@ -199,10 +226,27 @@ class StepTrace:
                     yield
             else:
                 yield
+            completed = True
         finally:
             dur = time.perf_counter() - t0
             self._totals[name] += dur
             self.run_totals[name] += dur
+            if (
+                completed
+                and name == "step"
+                and self._relaunch_ts is not None
+            ):
+                # first COMPLETED step after a supervised relaunch:
+                # stamp decision -> first-step wall time, once.  A step
+                # that raised (crash/preemption mid-compile) must not
+                # consume the measurement — the restart didn't succeed,
+                # and a decision->crash time would pollute the gate.
+                latency = time.time() - self._relaunch_ts
+                origin, self._relaunch_ts = self._relaunch_ts, None
+                self.writer.emit(
+                    "restart_latency", step=step,
+                    latency=latency, decision_ts=origin,
+                )
             if self.watchdog is not None:
                 self.watchdog.beat(step)
 

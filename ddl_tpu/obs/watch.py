@@ -1,0 +1,244 @@
+"""Live terminal view over a running job's event streams.
+
+``ddl_tpu obs watch <job_id> [--log-dir DIR] [--interval S] [--once]``
+tails every host's stream through the incremental fold engine
+(``obs/fold.py``) and redraws one dashboard frame per interval: current
+steps/s and loss per host, the run's phase breakdown, the pod
+skew/straggler table with barrier-wait attribution and barrier-fit
+clock offsets, recent incidents (anomalies / stalls / restarts /
+profile captures), restart latencies, and the serving lane/pool/
+admission counters.  Because each refresh folds only the bytes appended
+since the previous one, watching a week-old job costs the same per tick
+as watching a fresh smoke — the property ``obs summarize``'s old
+full-parse read path could never give a refresh loop.
+
+``--once`` renders a single frame and exits: the scripting/CI surface
+(the verify flow points it at a live smoke), and what the golden-output
+tests pin.  Pure stdlib, no JAX — runs anywhere the log directory is
+mounted.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["build_frame", "watch"]
+
+# ANSI: clear screen + home.  Emitted only between live frames, never in
+# --once mode, so piped/captured output stays clean text.
+_CLEAR = "\x1b[2J\x1b[H"
+
+# how many trailing incident-timeline entries a frame shows
+_INCIDENTS = 8
+
+
+def _fmt(v, spec=".2f", width=9, dash="-") -> str:
+    return (
+        f"{format(v, spec):>{width}}" if v is not None
+        else f"{dash:>{width}}"
+    )
+
+
+def build_frame(fold, job_id: str, now: float | None = None) -> str:
+    """One rendered dashboard frame from a ``JobFold``."""
+    from ddl_tpu.obs.pod import STRAGGLER_RATIO, _timeline_label
+    from ddl_tpu.obs.pod import pod_summary_from_fold
+    from ddl_tpu.obs.report import summarize_from_fold
+
+    now = time.time() if now is None else now
+    s = summarize_from_fold(fold)
+    pod = pod_summary_from_fold(fold, serving=s["decode"])
+
+    lines = [f"== obs watch — {job_id} =="]
+    lines.append(
+        f"hosts: {len(pod['hosts'])} | restart epochs: "
+        f"{len(pod['repochs'])} | events: {s['events']} | periods: "
+        f"{s['periods']} | compiles: {s['compiles']}"
+    )
+
+    # -- per-host current throughput (newest restart epoch wins) ---------
+    lines.append("-- hosts (latest period) --")
+    lines.append(
+        f"{'host':<6} {'steps/s':>9} {'loss':>10} {'step':>8} "
+        f"{'age_s':>7} {'stalls':>7}"
+    )
+    for name in sorted(fold.streams):
+        sf = fold.streams[name]
+        if sf.host is None:
+            continue
+        latest = max(sf.by_repoch) if sf.by_repoch else None
+        br = sf.by_repoch.get(latest) if latest is not None else None
+        last_ts = max(
+            (r["last_ts"] for r in sf.hosts.values()
+             if r.get("last_ts") is not None),
+            default=None,
+        )
+        age = now - last_ts if last_ts is not None else None
+        lines.append(
+            f"h{sf.host:<5} "
+            f"{_fmt(br['last_sps'] if br else None)} "
+            f"{_fmt(br['loss'] if br else None, '.4g', 10)} "
+            f"{_fmt(sf.pod['last_step'], 'd', 8)} "
+            f"{_fmt(age, '.1f', 7)} {sf.pod['stalls']:>7}"
+        )
+
+    if s["phases"]:
+        total = sum(s["phases"].values()) or 1.0
+        lines.append("-- phase breakdown --")
+        for phname, dur in sorted(s["phases"].items(), key=lambda kv: -kv[1]):
+            lines.append(
+                f"{phname:<12} {dur:>10.3f}s {dur / total:>6.1%}"
+            )
+
+    # -- skew / straggler / barrier attribution --------------------------
+    if len(pod["hosts"]) > 1:
+        lines.append(
+            "-- skew (means over shared periods; "
+            f"straggler at >{STRAGGLER_RATIO:.2f}x median) --"
+        )
+        lines.append(
+            f"{'host':<6} {'steps/s':>9} {'step_s':>9} {'data_w_s':>9} "
+            f"{'clk_off_s':>10} {'barrier_w':>10}"
+        )
+        bwaits = {
+            h: sum(w.get(h, 0.0) for w in pod["barriers"].values())
+            for h in pod["hosts"]
+        }
+        for host in sorted(pod["skew"]):
+            sk = pod["skew"][host]
+            flag = (
+                "  <-- straggler"
+                if pod["straggler"] and pod["straggler"]["host"] == host
+                else ""
+            )
+            lines.append(
+                f"h{host:<5} {_fmt(sk['steps_per_sec'])} "
+                f"{_fmt(sk['step_s'], '.3f')} "
+                f"{_fmt(sk['data_wait_s'], '.3f')} "
+                f"{_fmt(sk.get('clock_offset_s'), '+.3f', 10)} "
+                f"{_fmt(bwaits.get(host), '.2f', 10)}{flag}"
+            )
+
+    # -- serving ---------------------------------------------------------
+    d = s["decode"]
+    if d:
+        lines.append("-- serving --")
+        p = d.get("percentiles") or {}
+        lat = p.get("latency_s") or {}
+        ttft = p.get("ttft_s") or {}
+        agg = (
+            f" | agg {d['agg_tok_per_s']:.1f} tok/s "
+            f"({d['agg_tok_per_s_per_chip']:.1f}/chip)"
+            if d.get("agg_tok_per_s") is not None else ""
+        )
+        lines.append(
+            f"requests: {d['requests']} ({d['cold']} cold) | tokens: "
+            f"{d['tokens']}{agg}"
+        )
+        lines.append(
+            f"latency p50/p95/p99: {_p3(lat)} | ttft p50/p95/p99: "
+            f"{_p3(ttft)}"
+        )
+        admit = sum(
+            sf.serve["admit"] for sf in fold.streams.values()
+        )
+        shed = sum(sf.serve["shed"] for sf in fold.streams.values())
+        retire = sum(sf.serve["retire"] for sf in fold.streams.values())
+        kv = None
+        for name in sorted(fold.streams):
+            cand = fold.streams[name].serve["kv_last"]
+            # freshest snapshot wins, not the last stream name: an idle
+            # host's hours-old pool stats must not mask an active one's
+            if cand and (
+                kv is None or cand.get("ts", 0.0) >= kv.get("ts", 0.0)
+            ):
+                kv = cand
+        if admit or shed or retire or kv:
+            pool = (
+                f" | pool {kv.get('free', '?')}/"
+                f"{kv.get('num_blocks', '?')} blocks free, "
+                f"{kv.get('active_lanes', '?')} active lanes, queue "
+                f"{kv.get('queue_depth', '?')}"
+                if kv else ""
+            )
+            lines.append(
+                f"admission: {admit} admitted, {shed} shed, "
+                f"{retire} retired{pool}"
+            )
+
+    rl = s.get("restart_latency")
+    if rl:
+        lines.append(
+            f"restart latency: {rl['count']} restart(s), last "
+            f"{rl['last']:.1f}s decision->first-step"
+        )
+
+    # -- recent incidents -------------------------------------------------
+    incidents = [
+        e for e in pod["timeline"]
+        if e.get("kind") not in ("run_start", "run_end", "coord_barrier")
+    ]
+    lines.append(
+        f"-- incidents ({len(incidents)} total"
+        + (f", last {_INCIDENTS}" if len(incidents) > _INCIDENTS else "")
+        + ") --"
+    )
+    for e in incidents[-_INCIDENTS:]:
+        ts = e.get("ts_adj", e.get("ts", 0.0))
+        lines.append(
+            f"  [{now - ts:7.1f}s ago] h{e.get('host', 0)} "
+            f"e{e.get('repoch', 0)} {_timeline_label(e)}"
+        )
+    if not incidents:
+        lines.append("  (none)")
+    return "\n".join(lines)
+
+
+def _p3(block: dict) -> str:
+    vals = []
+    for q in ("p50", "p95", "p99"):
+        v = block.get(q)
+        vals.append(f"{v:.4g}s" if v is not None else "-")
+    return "/".join(vals)
+
+
+def watch(
+    log_dir,
+    job_id: str,
+    interval: float = 2.0,
+    once: bool = False,
+    cache: bool = True,
+    max_frames: int | None = None,
+) -> None:
+    """The ``obs watch`` loop.  ``once`` renders a single frame;
+    ``max_frames`` bounds the live loop (tests)."""
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.report import _job_dir
+
+    frames = 0
+    try:
+        while True:
+            fold = fold_job(log_dir, job_id, cache=cache)
+            if not fold.events:
+                if once:
+                    raise SystemExit(
+                        f"no events for job {job_id!r} under {log_dir} "
+                        f"(looked for "
+                        f"{_job_dir(log_dir, job_id)}/events-h*.jsonl)"
+                    )
+                print(f"[obs watch] waiting for events from {job_id!r} ...")
+            else:
+                frame = build_frame(fold, job_id)
+                if once:
+                    print(frame)
+                    return
+                print(
+                    _CLEAR + frame
+                    + f"\n(refresh {interval:g}s — ctrl-c to exit)"
+                )
+            frames += 1
+            if max_frames is not None and frames >= max_frames:
+                return
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return

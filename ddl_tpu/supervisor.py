@@ -133,6 +133,14 @@ class Supervisor:
         self.restarts = 0
         self.crashes = 0
         self.preemptions = 0
+        # wall clock of the latest relaunch DECISION (always time.time(),
+        # not the injectable monotonic `clock`: it crosses process
+        # boundaries).  supervise_command stamps it into the relaunched
+        # child's env as DDL_RELAUNCH_TS; the trainer's first completed
+        # step emits a `restart_latency` obs event against it — the
+        # relaunch-to-step metric the elastic-restart ROADMAP direction
+        # gates on (compile-cache wins must show up HERE).
+        self.last_relaunch_ts: float | None = None
         # consecutive resumable exits with no crash in between: the
         # first relaunches immediately (a real eviction), but a STREAK
         # backs off like a crash loop — e.g. a watchdog deadline set
@@ -200,6 +208,7 @@ class Supervisor:
                     + f" (preemption {self.preemptions}, crash budget "
                     f"untouched at {self.crashes}/{self.max_restarts})"
                 )
+                self.last_relaunch_ts = time.time()
                 self._emit(
                     "supervisor_relaunch", reason="preempt", rc=rc,
                     delay=delay,
@@ -221,6 +230,7 @@ class Supervisor:
                 f"[supervisor] crash (exit {rc}); relaunching in "
                 f"{delay:.1f}s (crash {self.crashes}/{self.max_restarts})"
             )
+            self.last_relaunch_ts = time.time()
             self._emit(
                 "supervisor_relaunch", reason="crash", rc=rc, delay=delay,
             )
@@ -333,6 +343,8 @@ def supervise_command(
     base_env = dict(os.environ if env is None else env)
     fault_state = _fault_state_path(base_env, "h0")
 
+    sup_ref: list = []  # filled after construction; attempt closes over it
+
     def attempt(restart_index: int) -> int:
         child_env = dict(base_env)
         child_env["DDL_SUPERVISED"] = "1"
@@ -340,6 +352,14 @@ def supervise_command(
         # escalate the watchdog so a hung collective becomes a relaunch;
         # the operator's explicit setting wins
         child_env.setdefault("DDL_WATCHDOG_ACTION", "exit")
+        # restart-latency accounting: the relaunched child stamps its
+        # first completed step against the relaunch decision's wall
+        # clock (obs `restart_latency` event, emitted by StepTrace); a
+        # stale value inherited from an outer supervisor must not leak
+        # into attempt 0
+        child_env.pop("DDL_RELAUNCH_TS", None)
+        if restart_index > 0 and sup_ref and sup_ref[0].last_relaunch_ts:
+            child_env["DDL_RELAUNCH_TS"] = repr(sup_ref[0].last_relaunch_ts)
         # consume-on-fire: fired specs are one-off events and do not
         # recur on relaunch; unfired specs (a second preempt@step beyond
         # the resume point) are preserved
@@ -348,6 +368,7 @@ def supervise_command(
 
     kwargs.setdefault("events", _supervisor_events(base_env))
     sup = Supervisor(attempt, max_restarts=max_restarts, **kwargs)
+    sup_ref.append(sup)
     try:
         return sup.run()
     finally:
@@ -428,6 +449,12 @@ class PodSupervisor:
         self.log = log
         self.events = events
         self.restarts = 0
+        # wall clock of the latest restart decision (the epoch record's
+        # proposal stamp — one pod-wide instant, so every host's
+        # restart_latency measures against the SAME origin); stamped
+        # into relaunched children as DDL_RELAUNCH_TS by
+        # supervise_pod_command's spawn
+        self.last_relaunch_ts: float | None = None
 
     def _emit(self, kind: str, **fields) -> None:
         if self.events is not None:
@@ -550,8 +577,15 @@ class PodSupervisor:
         rv.publish_heartbeat("booting", epoch)
         try:
             t0 = self.clock()
-            rv.barrier("start")
-            self._emit("coord_barrier", name="start", wait=self.clock() - t0)
+            done_ts = rv.barrier("start")
+            # completed_ts: the wall-clock instant this host OBSERVED the
+            # barrier complete — every host sees it within one poll
+            # interval of the same true instant, which is what the
+            # obs-side clock-skew fit regresses on (obs/fold.py)
+            self._emit(
+                "coord_barrier", name="start", wait=self.clock() - t0,
+                completed_ts=done_ts,
+            )
         except BarrierTimeout as e:
             ab = rv.abort(f"h{rv.host}: start barrier: {e}", 1)
             return self._finish_abort(ab)
@@ -670,13 +704,14 @@ class PodSupervisor:
 
             try:
                 t0 = self.clock()
-                rv.barrier(
+                done_ts = rv.barrier(
                     f"e{rec['epoch']}-join", on_wait=_hb_while_waiting,
                 )
                 self._emit(
                     "coord_barrier",
                     name=f"e{rec['epoch']}-join",
                     wait=self.clock() - t0,
+                    completed_ts=done_ts,
                 )
             except BarrierTimeout as e:
                 # a peer never joined: its supervisor is gone, and a
@@ -687,6 +722,9 @@ class PodSupervisor:
                 return self._finish_abort(e.record)
             if rec["delay"] > 0:
                 self.sleep(rec["delay"])
+            # the restart decision instant: the epoch record's proposal
+            # stamp (rv.clock — wall time), identical on every host
+            self.last_relaunch_ts = float(rec.get("ts") or time.time())
             epoch = int(rec["epoch"])
             restart_index += 1
             self.restarts = restart_index
@@ -741,10 +779,18 @@ def supervise_pod_command(
     )
     fault_state = _fault_state_path(base_env, f"h{host}")
 
+    sup_ref: list = []  # filled after construction; spawn closes over it
+
     def spawn(restart_epoch: int, restart_index: int):
         child_env = dict(base_env)
         child_env["DDL_SUPERVISED"] = "1"
         child_env["DDL_RESTART_COUNT"] = str(restart_index)
+        child_env.pop("DDL_RELAUNCH_TS", None)
+        if restart_index > 0 and sup_ref and sup_ref[0].last_relaunch_ts:
+            # restart-latency origin: the pod-wide restart decision
+            # (epoch-record proposal time) — the child's first completed
+            # step emits `restart_latency` against it
+            child_env["DDL_RELAUNCH_TS"] = repr(sup_ref[0].last_relaunch_ts)
         child_env[coord.ENV_EPOCH] = str(restart_epoch)
         child_env[coord.ENV_DIR] = str(launch_root)
         child_env[coord.ENV_HOSTS] = str(n_hosts)
@@ -758,6 +804,7 @@ def supervise_pod_command(
     sup = PodSupervisor(
         spawn, rv, max_restarts=max_restarts, **kwargs
     )
+    sup_ref.append(sup)
     try:
         return sup.run()
     finally:

@@ -452,3 +452,41 @@ def test_three_host_pod_sim_stall_escalation_and_exact_resume(tmp_path):
             f"h{i} replayed or skipped batches: {tail} "
             f"(agreed resume {agreed})"
         )
+
+    # the live-monitoring surfaces read the pod's shared supervisor
+    # stream dir (three per-host files with barrier completion stamps):
+    # watch renders a populated frame, export scrapes per-host series
+    # including the barrier-fit clock offsets over the shared barriers
+    from ddl_tpu.obs.export import prometheus_text
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.watch import build_frame
+
+    fold = fold_job(sim / "suplogs", "podsim", cache=False)
+    assert len(fold.streams) == 3
+    frame = build_frame(fold, "podsim")
+    assert "pod_restart" in frame
+    assert "clk_off_s" in frame
+    scrape = prometheus_text(fold, "podsim")
+    assert "ddl_obs_barrier_wait_seconds_total{" in scrape
+    assert "ddl_obs_clock_offset_seconds{" in scrape
+    assert scrape.count('ddl_obs_restarts_total{') == 3
+
+    # restart-latency accounting (obs): every relaunched child that
+    # trained in epoch 1 stamped its first completed step against the
+    # pod-wide restart decision (DDL_RELAUNCH_TS from the epoch
+    # record's proposal time) — the relaunch-to-step metric
+    from ddl_tpu.obs.events import read_events
+
+    for i in range(3):
+        if not [s for e, s in _read_consumed(sim, i) if e == 1]:
+            continue  # trained nothing in epoch 1: no first step to stamp
+        evs = read_events(
+            sim / f"logs_h{i}" / "by_job_id" / "podsim"
+            / f"events-h{i:03d}.jsonl"
+        )
+        rls = [e for e in evs if e.get("kind") == "restart_latency"]
+        assert rls, f"h{i} emitted no restart_latency event"
+        assert rls[-1].get("repoch") == 1, rls[-1]
+        assert rls[-1]["latency"] > 0
+        # the decision origin is the epoch record's proposal stamp
+        assert rls[-1]["decision_ts"] == pytest.approx(rec["ts"])

@@ -42,16 +42,25 @@ trainer writes (``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
     python -m ddl_tpu.cli obs pod <job_id> [--log-dir DIR] [--json]
     python -m ddl_tpu.cli obs watch <job_id> [--interval 2] [--once]
     python -m ddl_tpu.cli obs export <job_id> [--prom FILE | --http PORT] [--once]
+    python -m ddl_tpu.cli obs trace <job_id> (--request ID | --slowest-request |
+        --incident N | --step N) [--out trace.json]
+    python -m ddl_tpu.cli obs fleet [log_root] [--json] [--prom FILE]
 
 (``summarize`` includes decode p50/p95/p99 latency/queue-delay/TTFT when
 the run served requests; ``pod`` merges ALL hosts' streams into the
 straggler/skew table — with barrier-fit clock offsets — barrier-wait
 attribution, and the skew-corrected incident timeline; ``watch`` is the
-live refresh-loop view and ``export`` the Prometheus text-format scrape
-surface, both fed by the incremental fold engine (``obs/fold.py``) so
-each refresh/scrape costs O(appended bytes); with ``DDL_OBS_PROFILE=1``
-anomalies additionally arm a rate-limited ``jax.profiler`` capture whose
-per-op digest lands in the stream — ``ddl_tpu/obs/profiler.py``.)
+live view — push mode: it redraws when a stream grows, ``--interval``
+bounds the wait — and ``export`` the Prometheus text-format scrape
+surface incl. cumulative decode latency/TTFT histograms, both fed by
+the incremental fold engine (``obs/fold.py``) so each refresh/scrape
+costs O(appended bytes); ``trace`` emits ONE request/incident/step as
+causally-linked, clock-offset-corrected Chrome trace-event JSON for
+Perfetto (``obs/trace.py``); ``fleet`` rolls up every job under a log
+root — steps/s, MFU, p99 TTFT, restarts, incidents (``obs/fleet.py``);
+with ``DDL_OBS_PROFILE=1`` anomalies additionally arm a rate-limited
+``jax.profiler`` capture whose per-op digest lands in the stream —
+``ddl_tpu/obs/profiler.py``.)
 
 Static analysis (``ddl_tpu/analysis/``): AST anti-pattern rules plus the
 sharding-contract probes, gated by the committed ``LINT_BASELINE.json``:

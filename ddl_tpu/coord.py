@@ -148,6 +148,12 @@ class Rendezvous:
         # processes/hosts, which share NTP time but not a monotonic base
         self.clock = clock
         self.sleep = sleep
+        # wall-clock instant of this host's most recent barrier arrival
+        # (barrier()/arrive()): paired with barrier()'s completion stamp
+        # it bounds the barrier span in ONE clock domain — what the
+        # causal trace (obs/trace.py) renders, instead of mixing a
+        # monotonic wait duration into wall time
+        self.last_arrive_ts: float | None = None
         self.root.mkdir(parents=True, exist_ok=True)
 
     # ------------------------------------------------------------ liveness
@@ -326,7 +332,8 @@ class Rendezvous:
         the collective it replaces would have), and ``PodAborted`` if
         the give-up marker appears while waiting."""
         d = self.root / "barriers" / name
-        _write_json(d / f"h{self.host:03d}", {"ts": self.clock()})
+        self.last_arrive_ts = self.clock()
+        _write_json(d / f"h{self.host:03d}", {"ts": self.last_arrive_ts})
         deadline = self.clock() + (
             self.timeout_s if timeout_s is None else timeout_s
         )
@@ -349,9 +356,10 @@ class Rendezvous:
     def arrive(self, name: str) -> None:
         """Mark arrival at a barrier WITHOUT waiting (callers that must
         keep watching other signals poll ``barrier_complete``)."""
+        self.last_arrive_ts = self.clock()
         _write_json(
             self.root / "barriers" / name / f"h{self.host:03d}",
-            {"ts": self.clock()},
+            {"ts": self.last_arrive_ts},
         )
 
     def barrier_complete(self, name: str) -> bool:

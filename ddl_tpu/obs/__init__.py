@@ -28,6 +28,14 @@ every ``obs watch`` refresh / ``obs export`` scrape O(appended bytes),
 byte-identical to a cold full parse; plus cross-host clock-skew
 estimation from barrier completions, mergeable t-digest serving
 percentiles, and the ``restart_latency`` relaunch-to-first-step metric.
+
+The causal layer (PR 10): ``obs/trace.py`` renders ONE request /
+incident / training step as a clock-offset-corrected, causally-linked
+Chrome trace (``ddl_tpu obs trace``) from native
+``trace_span``/``trace_mark`` events (the serving path) plus spans
+derived from the existing kinds; ``obs/fleet.py`` rolls up every job
+under a log root into one table / combined Prometheus scrape
+(``ddl_tpu obs fleet``).
 """
 
 from ddl_tpu.obs.anomaly import (

@@ -79,6 +79,13 @@ EVENT_KINDS = (
     # --fail-slowdown` — the metric the elastic-restart/compile-cache
     # ROADMAP direction must move
     "restart_latency",
+    # causal tracing (obs/trace.py): a completed span / an instant mark
+    # carrying trace/span/parent ids — emitted natively where causality
+    # is not reconstructable from the aggregate kinds (the serving
+    # request path: admit -> queue -> prefill -> each ridden decode
+    # dispatch -> retire/shed).  Training step and incident traces are
+    # DERIVED from the existing kinds by the trace builder instead.
+    "trace_span", "trace_mark",
 )
 
 # ``type`` values carried by "anomaly" events (AnomalyMonitor.record and

@@ -23,7 +23,14 @@ Three emission modes:
 
 Series are labeled ``host``/``repoch`` (plus ``phase``/``type``/
 ``barrier``/``quantile`` where applicable); counters carry a ``_total``
-suffix per Prometheus naming conventions.  Pure stdlib, no JAX.
+suffix per Prometheus naming conventions.  Decode latency and TTFT are
+additionally rendered as classic cumulative histograms
+(``*_hist_seconds`` with ``_bucket``/``_sum``/``_count``, bounds in
+``LATENCY_BUCKETS``) evaluated from the same mergeable t-digest the
+quantile gauges read — the form external stacks can aggregate across
+jobs and hosts.  ``obs fleet --prom`` reuses ``fill_metrics`` to emit
+MANY jobs into one combined, per-job-labelled scrape.  Pure stdlib, no
+JAX.
 """
 
 from __future__ import annotations
@@ -31,7 +38,12 @@ from __future__ import annotations
 import os
 import time
 
-__all__ = ["export_command", "prometheus_text"]
+__all__ = [
+    "LATENCY_BUCKETS",
+    "export_command",
+    "fill_metrics",
+    "prometheus_text",
+]
 
 _PREFIX = "ddl_obs"
 
@@ -54,11 +66,16 @@ def _num(v) -> str:
 class _Metrics:
     """Accumulates samples grouped by metric so every metric's # HELP/
     # TYPE header is emitted once, with samples in deterministic label
-    order."""
+    order.  One accumulator can hold MANY jobs' series (every sample
+    carries a ``job_id`` label) — the fleet scrape (``obs fleet
+    --prom``) fills it once per job and renders one combined exposition
+    with a single header per family."""
 
     def __init__(self) -> None:
         self._defs: dict[str, tuple[str, str]] = {}
         self._samples: dict[str, list[tuple[str, str]]] = {}
+        self._hist_defs: dict[str, str] = {}
+        self._hist_rows: dict[str, list] = {}
 
     def add(self, name, mtype, help_text, value, **labels) -> None:
         full = f"{_PREFIX}_{name}"
@@ -68,26 +85,82 @@ class _Metrics:
         )
         self._samples.setdefault(full, []).append((label_s, _num(value)))
 
+    def histogram(
+        self, name, help_text, buckets, total, count, **labels
+    ) -> None:
+        """One classic cumulative histogram: ``buckets`` is a list of
+        ``(le_string, cumulative_count)`` in ascending bound order
+        (rendered verbatim — lexicographic sorting would scramble
+        numeric ``le`` bounds), plus the ``_sum``/``_count`` pair."""
+        full = f"{_PREFIX}_{name}"
+        self._hist_defs.setdefault(full, help_text)
+        label_s = ",".join(
+            f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+        )
+        self._hist_rows.setdefault(full, []).append(
+            (label_s, list(buckets), total, count)
+        )
+
     def render(self) -> str:
         lines = []
-        for full in sorted(self._defs):
-            mtype, help_text = self._defs[full]
-            lines.append(f"# HELP {full} {help_text}")
-            lines.append(f"# TYPE {full} {mtype}")
-            for label_s, value in sorted(self._samples[full]):
+        for full in sorted(set(self._defs) | set(self._hist_defs)):
+            if full in self._defs:
+                mtype, help_text = self._defs[full]
+                lines.append(f"# HELP {full} {help_text}")
+                lines.append(f"# TYPE {full} {mtype}")
+                for label_s, value in sorted(self._samples[full]):
+                    lines.append(
+                        f"{full}{{{label_s}}} {value}" if label_s
+                        else f"{full} {value}"
+                    )
+                continue
+            lines.append(f"# HELP {full} {self._hist_defs[full]}")
+            lines.append(f"# TYPE {full} histogram")
+            for label_s, buckets, total, count in sorted(
+                self._hist_rows[full], key=lambda r: r[0]
+            ):
+                for le, cum in buckets:
+                    blabel = (
+                        f'{label_s},le="{le}"' if label_s
+                        else f'le="{le}"'
+                    )
+                    lines.append(f"{full}_bucket{{{blabel}}} {_num(cum)}")
                 lines.append(
-                    f"{full}{{{label_s}}} {value}" if label_s
-                    else f"{full} {value}"
+                    f"{full}_sum{{{label_s}}} {_num(total)}"
+                    if label_s else f"{full}_sum {_num(total)}"
+                )
+                lines.append(
+                    f"{full}_count{{{label_s}}} {_num(count)}"
+                    if label_s else f"{full}_count {_num(count)}"
                 )
         return "\n".join(lines) + "\n"
 
 
+# classic cumulative bucket bounds for the decode latency/TTFT
+# histograms: SLO-shaped seconds from 1ms to 30s (fixed + documented so
+# scrapes from different hosts/jobs aggregate; +Inf is appended)
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
 def prometheus_text(fold, job_id: str) -> str:
     """Render a ``JobFold`` as one Prometheus text-format scrape."""
+    m = _Metrics()
+    fill_metrics(m, fold, job_id)
+    return m.render()
+
+
+def fill_metrics(m: "_Metrics", fold, job_id: str, summary=None) -> None:
+    """Fill ``m`` with one job's series (all labelled ``job_id=``).
+    ``obs export`` renders one job per scrape; ``obs fleet --prom``
+    calls this once per job into a shared accumulator, passing the
+    ``summary`` it already computed for the table so the percentile
+    digest merges and timeline sorts don't run twice per job."""
     from ddl_tpu.obs.fold import estimate_clock_offsets
     from ddl_tpu.obs.report import summarize_from_fold
 
-    m = _Metrics()
     job = {"job_id": job_id}
 
     streams = sorted(
@@ -151,6 +224,12 @@ def prometheus_text(fold, job_id: str) -> str:
                     "loss", "gauge", "latest period loss", br["loss"],
                     **rl,
                 )
+            if br.get("mfu") is not None:
+                m.add(
+                    "mfu", "gauge",
+                    "latest period model FLOPs utilization", br["mfu"],
+                    **rl,
+                )
             for phase, dur in sorted(br["phases"].items()):
                 m.add(
                     "phase_seconds_total", "counter",
@@ -211,7 +290,7 @@ def prometheus_text(fold, job_id: str) -> str:
         )
 
     # -- job-level serving percentiles (per-stream digests merged) -------
-    s = summarize_from_fold(fold)
+    s = summarize_from_fold(fold) if summary is None else summary
     d = s.get("decode")
     if d:
         m.add(
@@ -247,7 +326,30 @@ def prometheus_text(fold, job_id: str) -> str:
                         "warm-request decode percentile", block[q],
                         quantile=qs, **job,
                     )
-    return m.render()
+        # classic cumulative histograms from the same t-digests the
+        # quantile gauges read — external Prometheus stacks can then
+        # aggregate tails ACROSS jobs/hosts (histogram_quantile over
+        # summed buckets), which per-quantile gauges cannot do.  The
+        # family is named *_hist_seconds because the plain *_seconds
+        # name is already a gauge family (one TYPE per family).
+        stats = fold.serving()
+        for field, hname in (
+            ("latency_s", "decode_latency_hist_seconds"),
+            ("ttft_s", "decode_ttft_hist_seconds"),
+        ):
+            dig = stats.acc.get(field)
+            if dig is None or not dig.count:
+                continue
+            buckets = []
+            for le in LATENCY_BUCKETS:
+                buckets.append((repr(le), dig.rank(le) or 0.0))
+            buckets.append(("+Inf", float(dig.count)))
+            m.histogram(
+                hname,
+                "warm-request decode distribution (cumulative buckets "
+                "from the mergeable t-digest)",
+                buckets, dig.total, dig.count, **job,
+            )
 
 
 def _write_atomic(path: str, text: str) -> None:

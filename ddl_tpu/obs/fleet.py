@@ -1,0 +1,234 @@
+"""Multi-job fleet rollup: every job under one log root, one table.
+
+A pod-scale operation runs MANY jobs against one log tree
+(``<root>/by_job_id/<job>/events-h*.jsonl`` — the layout every other
+obs surface already reads); until now each had to be summarized one at
+a time.  ``ddl_tpu obs fleet [log_root]`` folds every job through the
+incremental engine (``obs/fold.py`` — each job costs O(its appended
+bytes), so the rollup is as cheap as the sum of its watches) and
+renders the fleet health table: per-job steps/s, MFU (when the family
+reports it — period events carry ``rates`` since the causal-tracing
+PR), p99 TTFT and aggregate tok/s/chip for serving jobs, restart /
+anomaly / stall counts, and staleness.  ``--json`` is the scripting
+surface; ``--prom FILE`` writes ONE combined Prometheus scrape with
+every job's series (``export.fill_metrics`` per job into a shared
+accumulator — all series are ``job_id``-labelled, so the fleet scrape
+is the per-job series the export surface always promised, across
+jobs).
+
+Pure stdlib over the event files — no JAX — like the rest of the obs
+read path.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+
+__all__ = [
+    "fleet_command",
+    "fleet_prometheus_text",
+    "fleet_summary",
+    "list_jobs",
+    "render_fleet",
+]
+
+
+def list_jobs(log_root: str | os.PathLike) -> list[str]:
+    """Job ids under ``<log_root>/by_job_id`` that carry at least one
+    event stream, sorted for deterministic rollups."""
+    root = Path(log_root) / "by_job_id"
+    if not root.is_dir():
+        return []
+    return sorted(
+        d.name for d in root.iterdir()
+        if d.is_dir() and any(d.glob("events-h*.jsonl"))
+    )
+
+
+def _job_row(fold, summary: dict) -> dict:
+    hosts = {
+        sf.host for sf in fold.streams.values() if sf.host is not None
+    }
+    # one pod-wide restart = one restart, however many hosts observed
+    # it: distinct restart epochs dedupe the per-host pod_restart
+    # copies; single-host supervisor relaunches each count
+    pod_epochs: set = set()
+    relaunches = 0
+    for sf in fold.streams.values():
+        pod_epochs |= sf.pod_restart_epochs
+        relaunches += sf.relaunches
+    restarts = len(pod_epochs) + relaunches
+    counts = summary.get("counts") or {}
+    anomalies = counts.get("anomalies", 0)
+    stalls = counts.get("stalls", 0)
+    # latest MFU across streams: the period event with the newest ts
+    # that carried one wins (deterministic: ties broken by stream name
+    # order via the stable max over sorted streams)
+    mfu = None
+    mfu_ts = None
+    for name in sorted(fold.streams):
+        for br in fold.streams[name].by_repoch.values():
+            if br.get("mfu") is None:
+                continue
+            ts = br.get("last_ts") or 0.0
+            if mfu_ts is None or ts > mfu_ts:
+                mfu, mfu_ts = br["mfu"], ts
+    d = summary.get("decode") or {}
+    p = (d.get("percentiles") or {}).get("ttft_s") or {}
+    elapsed = summary.get("elapsed") or 0.0
+    last_ts = max(
+        (
+            r["last_ts"]
+            for r in summary.get("hosts", {}).values()
+            if r.get("last_ts") is not None
+        ),
+        default=None,
+    )
+    tr = summary.get("trace") or {}
+    return {
+        "hosts": len(hosts),
+        "steps": summary.get("steps", 0),
+        "steps_per_sec": (
+            summary["steps"] / elapsed if elapsed > 0 else None
+        ),
+        "mfu": mfu,
+        "ttft_p99_s": p.get("p99"),
+        "agg_tok_per_s_per_chip": d.get("agg_tok_per_s_per_chip"),
+        "requests": d.get("requests", 0),
+        "restarts": restarts,
+        "anomalies": anomalies,
+        "stalls": stalls,
+        "incidents": restarts + anomalies + stalls,
+        "last_ts": last_ts,
+        "slowest_request": (tr.get("slowest") or {}).get("request"),
+    }
+
+
+def _folds(log_root: str | os.PathLike, cache: bool = True) -> dict:
+    """One ``JobFold`` per non-empty job under ``log_root`` — built
+    once and shared by the table and the prom scrape (folding every
+    stream twice per rollup would double the fleet's read cost)."""
+    from ddl_tpu.obs.fold import fold_job
+
+    out = {}
+    for job in list_jobs(log_root):
+        fold = fold_job(log_root, job, cache=cache)
+        if fold.events:
+            out[job] = fold
+    return out
+
+
+def fleet_summary(log_root: str | os.PathLike, cache: bool = True) -> dict:
+    """``{job_id: row}`` across every job under ``log_root`` (see
+    ``_job_row`` for the row schema)."""
+    folds = _folds(log_root, cache=cache)
+    return {
+        job: _job_row(fold, s)
+        for job, fold, s in _summarized(folds)
+    }
+
+
+def _summarized(folds: dict):
+    """``(job, fold, summary)`` triples — one ``summarize_from_fold``
+    per job, shared by the table row and the prom scrape (the digest
+    merges and timeline sorts are the expensive half of a rollup)."""
+    from ddl_tpu.obs.report import summarize_from_fold
+
+    return [
+        (job, fold, summarize_from_fold(fold))
+        for job, fold in folds.items()
+    ]
+
+
+def _fmt(v, spec=".2f", width=9) -> str:
+    return (
+        f"{format(v, spec):>{width}}" if v is not None
+        else f"{'-':>{width}}"
+    )
+
+
+def render_fleet(
+    summary: dict, log_root: str = "", now: float | None = None
+) -> str:
+    now = time.time() if now is None else now
+    lines = [
+        f"== fleet{f' — {log_root}' if log_root else ''} "
+        f"({len(summary)} job(s)) =="
+    ]
+    lines.append(
+        f"{'job':<20} {'hosts':>5} {'steps':>7} {'steps/s':>8} "
+        f"{'mfu':>6} {'p99_ttft':>9} {'tok/s/chip':>10} {'rstrt':>5} "
+        f"{'anom':>5} {'stall':>5} {'age_s':>8}"
+    )
+    for job in sorted(summary):
+        r = summary[job]
+        age = now - r["last_ts"] if r["last_ts"] is not None else None
+        lines.append(
+            f"{job[:20]:<20} {r['hosts']:>5} {r['steps']:>7} "
+            f"{_fmt(r['steps_per_sec'], '.2f', 8)} "
+            f"{_fmt(r['mfu'], '.3f', 6)} "
+            f"{_fmt(r['ttft_p99_s'], '.4g', 9)} "
+            f"{_fmt(r['agg_tok_per_s_per_chip'], '.1f', 10)} "
+            f"{r['restarts']:>5} {r['anomalies']:>5} {r['stalls']:>5} "
+            f"{_fmt(age, '.0f', 8)}"
+        )
+    return "\n".join(lines)
+
+
+def fleet_prometheus_text(
+    log_root: str | os.PathLike, cache: bool = True
+) -> str:
+    """One combined Prometheus scrape across every job under
+    ``log_root`` — ``export.fill_metrics`` per job into a shared
+    accumulator, one # HELP/# TYPE header per family, every sample
+    ``job_id``-labelled."""
+    return _prom_from_triples(_summarized(_folds(log_root, cache=cache)))
+
+
+def _prom_from_triples(triples) -> str:
+    from ddl_tpu.obs.export import _Metrics, fill_metrics
+
+    m = _Metrics()
+    for job, fold, s in triples:
+        fill_metrics(m, fold, job, summary=s)
+    return m.render()
+
+
+def fleet_command(
+    log_root: str | os.PathLike,
+    as_json: bool = False,
+    prom: str | None = None,
+    cache: bool = True,
+) -> None:
+    folds = _folds(log_root, cache=cache)
+    if not folds:
+        raise SystemExit(
+            f"no jobs with event streams under {log_root} (looked for "
+            f"{Path(log_root) / 'by_job_id'}/*/events-h*.jsonl)"
+        )
+    triples = _summarized(folds)
+    summary = {job: _job_row(fold, s) for job, fold, s in triples}
+    if as_json:
+        import json
+
+        print(json.dumps(summary))
+    else:
+        print(render_fleet(summary, str(log_root)))
+    if prom is not None:
+        import sys
+
+        from ddl_tpu.obs.export import _write_atomic
+
+        # reuse the folds AND summaries already built for the table —
+        # no second read pass, no second digest merge
+        text = _prom_from_triples(triples)
+        _write_atomic(prom, text)
+        # status to stderr: `obs fleet --json --prom F | jq` must keep
+        # reading valid JSON on stdout
+        print(
+            f"wrote {len(text.splitlines())} combined metric lines for "
+            f"{len(summary)} job(s) to {prom}",
+            file=sys.stderr,
+        )

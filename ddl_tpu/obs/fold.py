@@ -58,9 +58,11 @@ __all__ = [
 ]
 
 SIDECAR_NAME = ".obs_fold.json"
-# v1/v2 were the serving-only cursor sidecar (obs/cursor.py); v3 is the
-# whole-summary fold with t-digest serving state
-VERSION = 3
+# v1/v2 were the serving-only cursor sidecar (obs/cursor.py); v3 was the
+# whole-summary fold with t-digest serving state; v4 adds the causal-
+# trace reducer (trace_span/trace_mark counts + slowest-request cell)
+# and per-repoch rate metrics (mfu) — older sidecars rebuild cleanly
+VERSION = 4
 
 # the serving-cursor sidecar this module's cache superseded; removed
 # opportunistically when the fold sidecar is written so a job dir does
@@ -114,7 +116,7 @@ def _new_repoch_agg() -> dict:
     return {
         "periods": 0, "steps": 0, "elapsed": 0.0, "compiles": 0,
         "phases": {}, "last_sps": None, "last_step": None, "loss": None,
-        "last_ts": None,
+        "last_ts": None, "mfu": None,
     }
 
 
@@ -161,6 +163,22 @@ class StreamFold:
             "last_ts": None, "by_repoch": {},  # str(repoch) -> [ts, latency]
         }
         self.serve = {"admit": 0, "shed": 0, "retire": 0, "kv_last": None}
+        # job-level restart accounting: every host of a pod emits its
+        # own pod_restart event for the SAME pod-wide restart, so the
+        # per-stream "restarts" counter (kept for the per-host export/
+        # watch surfaces) over-counts by the pod size when summed.
+        # Distinct restart EPOCHS dedupe across streams; single-host
+        # supervisor relaunches are counted separately (each is real).
+        self.pod_restart_epochs: set[int] = set()
+        self.relaunches = 0
+        # causal-trace reducer (obs/trace.py kinds): span/mark counts
+        # plus a max cell over ROOT request spans — what `obs trace
+        # --slowest-request` selects on without re-reading any stream.
+        # "slowest" is [dur, trace_id, t1]; the (dur, trace_id) tuple
+        # max is deterministic under any resume slicing.
+        self.trace = {
+            "spans": 0, "marks": 0, "requests": 0, "slowest": None,
+        }
         self.serving = ServingStats(capacity)
 
     def _push(self, key: str, item: dict) -> None:
@@ -217,6 +235,10 @@ class StreamFold:
             self._push("captures", dict(e))
         elif kind in ("supervisor_relaunch", "pod_restart"):
             self.pod["restarts"] += 1
+            if kind == "pod_restart":
+                self.pod_restart_epochs.add(int(e.get("epoch", 0) or 0))
+            else:
+                self.relaunches += 1
         elif kind == "coord_barrier":
             name = e.get("name", "?")
             self.barrier_waits[name] = (
@@ -250,6 +272,21 @@ class StreamFold:
             self.serve["retire"] += 1
         elif kind == "kv_pool_stats":
             self.serve["kv_last"] = dict(e)
+        elif kind == "trace_span":
+            tr = self.trace
+            tr["spans"] += 1
+            if e.get("name") == "request" and e.get("trace"):
+                tr["requests"] += 1
+                t0, t1 = e.get("t0"), e.get("t1")
+                if t0 is not None and t1 is not None:
+                    cand = [float(t1) - float(t0), str(e["trace"]), t1]
+                    cur = tr["slowest"]
+                    if cur is None or (cand[0], cand[1]) > (
+                        cur[0], cur[1]
+                    ):
+                        tr["slowest"] = cand
+        elif kind == "trace_mark":
+            self.trace["marks"] += 1
 
         if kind in ("span", "heartbeat", "stall"):
             if step is not None:
@@ -312,6 +349,11 @@ class StreamFold:
             br["loss"] = e.get("loss")
         if ts is not None:
             br["last_ts"] = ts
+        # rate metrics ride the period event (steptrace.end_period
+        # ``rates=``); mfu is the one the fleet rollup tabulates
+        rates = e.get("rates") or {}
+        if rates.get("mfu") is not None:
+            br["mfu"] = rates["mfu"]
 
         if step is not None:
             rec = self.hosts.setdefault(h, _new_host_rec())
@@ -343,6 +385,9 @@ class StreamFold:
             "barrier_ts": self.barrier_ts,
             "restart_latency": self.restart_latency,
             "serve": self.serve,
+            "trace": self.trace,
+            "pod_restart_epochs": sorted(self.pod_restart_epochs),
+            "relaunches": self.relaunches,
             "serving": self.serving.state_dict(),
         }
 
@@ -370,6 +415,11 @@ class StreamFold:
         sf.barrier_ts = dict(state["barrier_ts"])
         sf.restart_latency = dict(state["restart_latency"])
         sf.serve = dict(state["serve"])
+        sf.trace = dict(state["trace"])
+        sf.pod_restart_epochs = {
+            int(r) for r in state["pod_restart_epochs"]
+        }
+        sf.relaunches = int(state["relaunches"])
         sf.serving = ServingStats.from_state(state["serving"])
         return sf
 
@@ -405,6 +455,26 @@ class JobFold:
         for name in sorted(self.streams):
             merged.merge(self.streams[name].serving)
         return merged
+
+    def trace_totals(self) -> dict:
+        """Job-wide causal-trace reduction: span/mark/request counts plus
+        the slowest ROOT request span across every stream — `obs trace
+        --slowest-request`'s selection input.  Deterministic merge: the
+        per-stream cells are (dur, trace_id) maxes."""
+        out = {"spans": 0, "marks": 0, "requests": 0, "slowest": None}
+        for name in sorted(self.streams):
+            tr = self.streams[name].trace
+            out["spans"] += tr["spans"]
+            out["marks"] += tr["marks"]
+            out["requests"] += tr["requests"]
+            cand = tr["slowest"]
+            if cand is not None and (
+                out["slowest"] is None
+                or (cand[0], cand[1])
+                > (out["slowest"][0], out["slowest"][1])
+            ):
+                out["slowest"] = list(cand)
+        return out
 
     # -- in-memory construction (legacy list/stream APIs) -----------------
 

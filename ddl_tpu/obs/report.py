@@ -29,7 +29,21 @@
                                 same fold state (obs/export.py):
                                 --prom FILE writes a scrape file,
                                 --http PORT serves /metrics, --once for
-                                one-shot emission
+                                one-shot emission; decode latency/TTFT
+                                additionally render as classic
+                                cumulative histograms (_bucket/_sum/
+                                _count) next to the quantile gauges
+    trace <job_id>              one request/step/incident as causally-
+                                linked Chrome trace-event JSON, clock-
+                                offset corrected across hosts
+                                (obs/trace.py): --request ID |
+                                --slowest-request | --incident N |
+                                --step N, --out trace.json
+    fleet [log_root]            rollup across ALL jobs under a log
+                                root (obs/fleet.py): per-job steps/s,
+                                MFU, p99 TTFT, restarts, incident
+                                counts as a table / --json / --prom
+                                combined per-job-labelled scrape
 
 All commands except ``tail`` read through the incremental fold engine
 (``obs/fold.py``): a resumable reducer whose sidecar makes every
@@ -230,6 +244,20 @@ def summarize_from_fold(fold) -> dict:
         for key in ("anomalies", "stalls", "captures")
     }
 
+    # -- causal-trace reduction (obs/trace.py kinds) ---------------------
+    tr = fold.trace_totals()
+    trace = None
+    if tr["spans"] or tr["marks"]:
+        trace = {
+            "spans": tr["spans"],
+            "marks": tr["marks"],
+            "requests": tr["requests"],
+            "slowest": (
+                {"request": tr["slowest"][1], "dur": tr["slowest"][0]}
+                if tr["slowest"] is not None else None
+            ),
+        }
+
     return {
         "runs": sorted(runs),
         "events": fold.events,
@@ -250,6 +278,7 @@ def summarize_from_fold(fold) -> dict:
         "decode": decode,
         "profile_captures": _merge_sorted(fold, "captures"),
         "restart_latency": restart_latency,
+        "trace": trace,
     }
 
 
@@ -350,6 +379,15 @@ def render_summary(s: dict, job_id: str = "") -> str:
 
             lines.append("-- decode percentiles (warm requests) --")
             lines.extend(render_percentiles(d["percentiles"]))
+    tr = s.get("trace")
+    if tr and tr.get("slowest"):
+        sl = tr["slowest"]
+        lines.append(
+            f"traced requests: {tr['requests']} | slowest: "
+            f"{sl['request']} ({sl['dur']:.3f}s) — "
+            f"`ddl_tpu obs trace{f' {job_id}' if job_id else ''} "
+            f"--request {sl['request']}`"
+        )
     captures = s.get("profile_captures") or []
     if captures:
         lines.append(_section_header(
@@ -580,7 +618,9 @@ def main(argv=None) -> None:
     p_watch.add_argument("job_id")
     p_watch.add_argument(
         "--interval", type=float, default=2.0, metavar="S",
-        help="refresh interval in seconds (default 2)",
+        help="MAXIMUM seconds between redraws (default 2); the loop "
+        "polls stream sizes/mtimes and redraws as soon as anything "
+        "was appended (push mode)",
     )
     p_watch.add_argument(
         "--once", action="store_true",
@@ -607,6 +647,52 @@ def main(argv=None) -> None:
     p_exp.add_argument(
         "--interval", type=float, default=15.0, metavar="S",
         help="rewrite interval for --prom without --once (default 15)",
+    )
+    p_trace = sub.add_parser(
+        "trace", parents=[common],
+        help="one request/step/incident as causally-linked Chrome "
+        "trace-event JSON (Perfetto-loadable; obs/trace.py)",
+    )
+    p_trace.add_argument("job_id")
+    sel = p_trace.add_mutually_exclusive_group(required=True)
+    sel.add_argument(
+        "--request", metavar="ID",
+        help="trace one serving request by id",
+    )
+    sel.add_argument(
+        "--slowest-request", action="store_true",
+        help="trace the slowest request on record (fold-selected)",
+    )
+    sel.add_argument(
+        "--incident", type=int, metavar="N",
+        help="trace the Nth incident cluster (0 = oldest; stalls/"
+        "anomalies/restarts with their barriers and relaunch spans)",
+    )
+    sel.add_argument(
+        "--step", type=int, metavar="N",
+        help="trace one training step's phase spans across hosts",
+    )
+    p_trace.add_argument(
+        "--out", default="trace.json", metavar="FILE",
+        help="output path for the trace JSON (default trace.json)",
+    )
+    p_fleet = sub.add_parser(
+        "fleet", parents=[common],
+        help="rollup across ALL jobs under a log root: per-job steps/s, "
+        "MFU, p99 TTFT, restarts, incidents (obs/fleet.py)",
+    )
+    p_fleet.add_argument(
+        "log_root", nargs="?", default=None,
+        help="log root holding by_job_id/ (default: --log-dir)",
+    )
+    p_fleet.add_argument(
+        "--json", action="store_true",
+        help="emit the fleet summary as JSON instead of the table",
+    )
+    p_fleet.add_argument(
+        "--prom", metavar="FILE", default=None,
+        help="also write one combined Prometheus scrape with per-job-"
+        "labelled series (the obs export surface, across jobs)",
     )
     args = ap.parse_args(argv)
 
@@ -752,6 +838,24 @@ def main(argv=None) -> None:
             args.log_dir, args.job_id,
             prom=args.prom, http_port=args.http, once=args.once,
             interval=args.interval, cache=not args.no_cache,
+        )
+    elif args.command == "trace":
+        from ddl_tpu.obs.trace import trace_job, write_trace
+
+        trace = trace_job(
+            args.log_dir, args.job_id,
+            request=args.request, slowest=args.slowest_request,
+            incident=args.incident, step=args.step,
+            cache=not args.no_cache,
+        )
+        print(write_trace(trace, args.out))
+    elif args.command == "fleet":
+        from ddl_tpu.obs.fleet import fleet_command
+
+        fleet_command(
+            args.log_root or args.log_dir,
+            as_json=args.json, prom=args.prom,
+            cache=not args.no_cache,
         )
 
 

@@ -305,6 +305,41 @@ class TDigest:
             prev_mean = m
         return self.max if self.max is not None else self._means[-1]
 
+    def rank(self, x: float) -> float | None:
+        """Estimated stream weight at or below ``x`` — the CDF counter
+        behind the Prometheus cumulative-histogram export (``obs export``
+        renders ``_bucket`` series by evaluating this at each bound).
+        Exact while the digest holds singletons (a plain count of values
+        <= x); in the compressed regime it inverts ``quantile``'s
+        midpoint interpolation, so bucket counts stay monotone in ``x``
+        and consistent with the reported quantiles.  None on an empty
+        stream."""
+        self._flush()
+        if not self._means:
+            return None
+        if self.min is not None and x < self.min:
+            return 0.0
+        if self.max is not None and x >= self.max:
+            return float(sum(self._weights))
+        if all(w == 1.0 for w in self._weights):
+            import bisect
+
+            return float(bisect.bisect_right(self._means, x))
+        weight = sum(self._weights)
+        cum = 0.0
+        prev_mid = 0.0
+        prev_mean = self.min if self.min is not None else self._means[0]
+        for m, w in zip(self._means, self._weights):
+            mid = cum + w / 2.0
+            if x < m:
+                span = m - prev_mean
+                frac = (x - prev_mean) / span if span > 0 else 1.0
+                return prev_mid + max(0.0, min(1.0, frac)) * (mid - prev_mid)
+            cum += w
+            prev_mid = mid
+            prev_mean = m
+        return float(weight)
+
     def summary(self, percentiles=PERCENTILES) -> dict:
         return {
             "count": self.count,

@@ -277,9 +277,13 @@ class StepTrace:
         elapsed: float,
         steps: int,
         metrics: dict | None = None,
+        rates: dict | None = None,
     ) -> dict:
         """Emit the per-period summary event and feed the anomaly
-        detectors; returns the phase-total dict."""
+        detectors; returns the phase-total dict.  ``rates`` is the
+        family's ``rate_metrics`` dict (tokens/sec, img/sec, mfu, ...);
+        stamping it into the period event is what lets the fleet rollup
+        (``obs fleet``) tabulate MFU per job without the CSVs."""
         from ddl_tpu.utils.memory import hbm_stats
 
         phases = dict(self._totals)
@@ -304,6 +308,7 @@ class StepTrace:
             compiles=compiles,
             hbm_bytes_in_use=mem["bytes_in_use"] if mem else None,
             hbm_peak_bytes=mem["peak_bytes_in_use"] if mem else None,
+            **({"rates": dict(rates)} if rates else {}),
         )
         self.anomaly.observe_period(
             idx,

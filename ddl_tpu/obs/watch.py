@@ -2,7 +2,11 @@
 
 ``ddl_tpu obs watch <job_id> [--log-dir DIR] [--interval S] [--once]``
 tails every host's stream through the incremental fold engine
-(``obs/fold.py``) and redraws one dashboard frame per interval: current
+(``obs/fold.py``) and redraws one dashboard frame per change — the
+loop polls stream sizes/mtimes between frames and refolds only when
+something was appended, with ``--interval`` as the maximum wait before
+a redraw (push mode; an idle job costs stat calls, not refolds):
+current
 steps/s and loss per host, the run's phase breakdown, the pod
 skew/straggler table with barrier-wait attribution and barrier-fit
 clock offsets, recent incidents (anomalies / stalls / restarts /
@@ -22,7 +26,7 @@ from __future__ import annotations
 
 import time
 
-__all__ = ["build_frame", "watch"]
+__all__ = ["build_frame", "stream_signature", "watch"]
 
 # ANSI: clear screen + home.  Emitted only between live frames, never in
 # --once mode, so piped/captured output stays clean text.
@@ -202,6 +206,24 @@ def _p3(block: dict) -> str:
     return "/".join(vals)
 
 
+def stream_signature(job_dir) -> tuple:
+    """Cheap change detector for a job's event streams: (name, size,
+    mtime_ns) per stream file.  Two stat passes agreeing means nothing
+    was appended — the push-mode watch loop redraws only when this
+    changes, so an idle job costs stat calls, not refolds."""
+    sig = []
+    try:
+        for f in sorted(job_dir.glob("events-h*.jsonl")):
+            try:
+                st = f.stat()
+            except OSError:
+                continue  # rotated away between glob and stat
+            sig.append((f.name, st.st_size, st.st_mtime_ns))
+    except OSError:
+        pass
+    return tuple(sig)
+
+
 def watch(
     log_dir,
     job_id: str,
@@ -209,15 +231,32 @@ def watch(
     once: bool = False,
     cache: bool = True,
     max_frames: int | None = None,
+    poll_s: float | None = None,
 ) -> None:
     """The ``obs watch`` loop.  ``once`` renders a single frame;
-    ``max_frames`` bounds the live loop (tests)."""
+    ``max_frames`` bounds the live loop (tests).
+
+    Push mode: between frames the loop polls the streams' sizes/mtimes
+    (``stream_signature``, every ``poll_s`` — default interval/8 capped
+    at 250ms) and refolds+redraws as soon as anything was appended;
+    ``--interval`` is the MAXIMUM wait before a redraw (the age column
+    must keep moving on an idle job), not a fixed refold period.  A
+    quiet hour of a week-long run therefore costs stat calls per tick,
+    with one cheap refold per interval."""
     from ddl_tpu.obs.fold import fold_job
     from ddl_tpu.obs.report import _job_dir
 
+    job_dir = _job_dir(log_dir, job_id)
+    if poll_s is None:
+        poll_s = min(0.25, max(interval / 8.0, 0.02))
     frames = 0
     try:
         while True:
+            # signature BEFORE the fold: an append landing between the
+            # fold's read and a later stat would otherwise be baked
+            # into the baseline and never trigger a redraw — the next
+            # poll then catches (re-folds) it, at worst double-drawing
+            sig = stream_signature(job_dir)
             fold = fold_job(log_dir, job_id, cache=cache)
             if not fold.events:
                 if once:
@@ -234,11 +273,16 @@ def watch(
                     return
                 print(
                     _CLEAR + frame
-                    + f"\n(refresh {interval:g}s — ctrl-c to exit)"
+                    + f"\n(live — redraw on append, {interval:g}s max; "
+                    "ctrl-c to exit)"
                 )
             frames += 1
             if max_frames is not None and frames >= max_frames:
                 return
-            time.sleep(interval)
+            deadline = time.monotonic() + interval
+            while time.monotonic() < deadline:
+                time.sleep(poll_s)
+                if stream_signature(job_dir) != sig:
+                    break
     except KeyboardInterrupt:
         return

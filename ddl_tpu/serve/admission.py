@@ -49,6 +49,7 @@ class AdmissionController:
         policy: str = "reject",
         obs=None,
         on_shed=None,
+        trace: bool = True,
     ) -> None:
         if policy not in POLICIES:
             raise ValueError(
@@ -60,6 +61,7 @@ class AdmissionController:
         self.policy = policy
         self.obs = obs
         self.on_shed = on_shed
+        self.trace = bool(trace)
         self.queue: deque[Request] = deque()
         self.admitted = 0  # accepted into the queue
         self.shed = 0  # dropped (either policy, any reason)
@@ -74,6 +76,20 @@ class AdmissionController:
                 policy=self.policy,
                 queue_depth=len(self.queue),
             )
+            # terminal causal mark: a shed request's trace ends here,
+            # not at a retire (obs/trace.py renders it as the trace's
+            # final instant)
+            if self.trace:
+                self.obs.emit(
+                    "trace_mark",
+                    trace=req.id,
+                    span=f"{req.id}/shed",
+                    name="shed",
+                    cat="serve",
+                    request_id=req.id,
+                    reason=reason,
+                    policy=self.policy,
+                )
         if self.on_shed is not None:
             self.on_shed(req, reason)
 

@@ -524,6 +524,7 @@ class ServeEngine:
         max_steps_per_dispatch: int = 8,
         defrag_threshold: float | None = None,
         obs=None,
+        trace_requests: bool = True,
         devices=None,
         mesh=None,
     ) -> None:
@@ -536,6 +537,13 @@ class ServeEngine:
         self.cfg = cfg
         self.params = params
         self.obs = obs
+        # per-request causal tracing (obs/trace.py): every request emits
+        # a root span plus queue/prefill/decode-dispatch children into
+        # the obs stream, so `obs trace <job> --request ID` reconstructs
+        # that one request's timeline.  A handful of events per request
+        # on top of the decode/serve_* kinds; operators running at
+        # volumes where that matters turn it off here.
+        self.trace_requests = bool(trace_requests)
         self.defrag_threshold = defrag_threshold
         self.pools = self.fns.init_pools()
         self.allocator = BlockAllocator(num_blocks, block_size)
@@ -545,7 +553,7 @@ class ServeEngine:
         )
         self.admission = AdmissionController(
             max_queue=max_queue, policy=policy, obs=obs,
-            on_shed=self._record_shed,
+            on_shed=self._record_shed, trace=self.trace_requests,
         )
         if max_steps_per_dispatch < 1:
             raise ValueError(
@@ -608,6 +616,23 @@ class ServeEngine:
         return bool(self.scheduler.active()) or bool(self.admission.queue)
 
     # -- engine iteration -------------------------------------------------
+    def _emit_trace_span(
+        self, name: str, t0_pc: float, t1_pc: float, *,
+        trace: str, span: str, parent: str | None, **args,
+    ) -> None:
+        """One completed causal span into the obs stream.  Engine timing
+        runs on ``perf_counter``; trace consumers need wall clock (spans
+        merge across hosts through the clock-offset fit), so both stamps
+        are mapped through the current (wall, perf_counter) pair."""
+        if self.obs is None or not self.trace_requests:
+            return
+        wall, pc = time.time(), perf_counter()
+        self.obs.emit(
+            "trace_span", trace=trace, span=span, parent=parent,
+            name=name, cat="serve",
+            t0=wall - (pc - t0_pc), t1=wall - (pc - t1_pc), **args,
+        )
+
     def _emit_pool_stats(self, **extra) -> None:
         if self.obs is not None:
             self.obs.emit(
@@ -646,6 +671,20 @@ class ServeEngine:
             )
             self.request_log.append(
                 {"kind": "decode", "ts": time.time(), **record}
+            )
+            # the trace ROOT: submit -> retire, parent of the queue/
+            # prefill/decode spans emitted along the way
+            self._emit_trace_span(
+                "request",
+                (
+                    req.submitted_at if req.submitted_at is not None
+                    else state.admitted_at
+                ),
+                end,
+                trace=req.id, span=f"{req.id}/req", parent=None,
+                request_id=req.id, lane=state.lane,
+                prompt_len=req.prompt_len, new_tokens=len(state.outputs),
+                dispatches=len(state.dispatches), outcome="ok",
             )
             if self.obs is not None:
                 self.obs.emit("decode", **record)
@@ -706,6 +745,18 @@ class ServeEngine:
         self.stats["peak_blocks"] = max(
             self.stats["peak_blocks"], self.allocator.used_blocks
         )
+        if req.submitted_at is not None and req.submitted_at < t0:
+            self._emit_trace_span(
+                "queue", req.submitted_at, t0,
+                trace=req.id, span=f"{req.id}/queue",
+                parent=f"{req.id}/req", request_id=req.id,
+            )
+        self._emit_trace_span(
+            "prefill", t0, perf_counter(),
+            trace=req.id, span=f"{req.id}/prefill",
+            parent=f"{req.id}/req", request_id=req.id, lane=state.lane,
+            bucket=bucket, compiled=compiled,
+        )
         if self.obs is not None:
             self.obs.emit(
                 "serve_admit",
@@ -755,6 +806,8 @@ class ServeEngine:
             tables[s.lane, :n] = s.block_ids[:n]
             lengths[s.lane] = s.length
             pending[s.lane] = s.pending_tok
+        seq = self.stats["decode_dispatches"]  # this dispatch's number
+        t0 = perf_counter()
         prog, built = fns.decode_for(k, nmax)
         before = _jit_compiles(prog)
         with jax.set_mesh(fns.mesh):
@@ -779,6 +832,17 @@ class ServeEngine:
             lane_toks = toks[:, s.lane]
             s.pending_tok = int(lane_toks[-1])
             s.outputs.extend(int(t) for t in lane_toks)
+            s.dispatches.append(seq)
+            # one causal span PER RIDING REQUEST, not per dispatch: the
+            # trace of request X must show every batched dispatch X's
+            # tokens came out of, with the co-riders in args
+            self._emit_trace_span(
+                "decode", t0, now,
+                trace=s.request.id, span=f"{s.request.id}/d{seq}",
+                parent=f"{s.request.id}/req",
+                request_id=s.request.id, lane=s.lane, dispatch=seq,
+                steps=k, riders=len(active),
+            )
             if s.done:
                 s.finished_at = now
 
@@ -924,6 +988,15 @@ class ServeEngine:
         fresh-pools signature and once for the committed-pools one (see
         ``precompile``) — a single pass would leave the second compile
         inside the first timed request."""
+        prev_trace, self.trace_requests = self.trace_requests, False
+        try:
+            self._warmup_requests(prompt_len, max_new)
+        finally:
+            # the synthetic request must not become a trace (it would
+            # win --slowest-request on its compile time every smoke)
+            self.trace_requests = prev_trace
+
+    def _warmup_requests(self, prompt_len: int, max_new: int) -> None:
         for _ in range(2):
             outcome = self.submit(
                 np.zeros((prompt_len,), np.int32), max_new,

@@ -78,6 +78,9 @@ class LaneState:
     ttft_s: float | None = None
     cold: bool = False  # paid an XLA compile (excluded from percentiles)
     finished_at: float | None = None
+    # engine dispatch sequence numbers this lane rode — the causal
+    # ledger behind the per-request trace's decode spans (obs/trace.py)
+    dispatches: list = dataclasses.field(default_factory=list)
 
     @property
     def done(self) -> bool:

@@ -211,7 +211,7 @@ class Supervisor:
                 self.last_relaunch_ts = time.time()
                 self._emit(
                     "supervisor_relaunch", reason="preempt", rc=rc,
-                    delay=delay,
+                    delay=delay, decision_ts=self.last_relaunch_ts,
                 )
                 if delay > 0:
                     self.sleep(delay)
@@ -233,6 +233,7 @@ class Supervisor:
             self.last_relaunch_ts = time.time()
             self._emit(
                 "supervisor_relaunch", reason="crash", rc=rc, delay=delay,
+                decision_ts=self.last_relaunch_ts,
             )
             if delay > 0:
                 self.sleep(delay)
@@ -584,7 +585,7 @@ class PodSupervisor:
             # obs-side clock-skew fit regresses on (obs/fold.py)
             self._emit(
                 "coord_barrier", name="start", wait=self.clock() - t0,
-                completed_ts=done_ts,
+                completed_ts=done_ts, arrive_ts=rv.last_arrive_ts,
             )
         except BarrierTimeout as e:
             ab = rv.abort(f"h{rv.host}: start barrier: {e}", 1)
@@ -683,6 +684,10 @@ class PodSupervisor:
                 crashes=rec["crashes"],
                 preemptions=rec["preemptions"],
                 delay=rec["delay"],
+                # the pod-wide decision instant (epoch-record proposal
+                # stamp) — the flow-arrow origin the incident trace
+                # draws to every host's join-barrier span
+                decision_ts=rec.get("ts"),
             )
             self._log(
                 f"joining restart epoch {rec['epoch']} "
@@ -712,6 +717,7 @@ class PodSupervisor:
                     name=f"e{rec['epoch']}-join",
                     wait=self.clock() - t0,
                     completed_ts=done_ts,
+                    arrive_ts=rv.last_arrive_ts,
                 )
             except BarrierTimeout as e:
                 # a peer never joined: its supervisor is gone, and a

@@ -464,11 +464,34 @@ class LMTrainer(BaseTrainer):
         )
 
     def rate_metrics(self, steps: int, elapsed: float) -> dict:
-        return {
-            "tokens_per_sec": (steps / elapsed)
-            * self.run.batch
-            * self.run.seq_len
-        }
+        tok_s = (steps / elapsed) * self.run.batch * self.run.seq_len
+        out = {"tokens_per_sec": tok_s}
+        u = self._mfu_estimate(tok_s)
+        if u is not None:
+            out["mfu"] = u
+        return out
+
+    def _mfu_estimate(self, tokens_per_sec: float) -> float | None:
+        """Steady-state MFU from the 6ND estimate: ``6 * params *
+        tokens/s`` achieved FLOP/s over the pod's peak dense bf16
+        FLOP/s.  The analytic transformer train-step cost (fwd 2ND +
+        bwd 4ND, attention-core excluded) — coarser than the bench's
+        cost-analysis number but free every period, which is what the
+        fleet rollup needs.  None off-TPU (peak unknown) — the metric
+        is meaningless on the CPU sim."""
+        import jax
+
+        from ddl_tpu.bench.mfu import device_peak_flops
+
+        peak = device_peak_flops()
+        if peak is None or tokens_per_sec <= 0:
+            return None
+        if getattr(self, "_param_count", None) is None:
+            self._param_count = sum(
+                x.size for x in jax.tree_util.tree_leaves(self.state.params)
+            )
+        total_peak = peak * max(1, jax.device_count())
+        return 6.0 * self._param_count * tokens_per_sec / total_peak
 
     def evaluate_period(self, period: int) -> dict | None:
         run = self.run

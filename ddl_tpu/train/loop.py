@@ -355,6 +355,10 @@ class BaseTrainer:
             train_metrics = faultinject.poison_loss(train_metrics)
             loss = train_metrics.get("loss")
             idx = self.log_index(period)
+            # one rate_metrics call per period, shared by the CSV rows
+            # and the period obs event (the fleet rollup reads MFU and
+            # the family throughput rates from the event stream)
+            rates = self.rate_metrics(steps, elapsed)
             if loss is not None and not np.isfinite(loss):
                 handled = self._handle_nonfinite(period, idx, loss, obs)
                 if handled:
@@ -363,7 +367,8 @@ class BaseTrainer:
                     # show the excursion, not hide it)
                     if obs is not None:
                         obs.end_period(
-                            period, idx, elapsed, steps, train_metrics
+                            period, idx, elapsed, steps, train_metrics,
+                            rates=rates,
                         )
                     if guard is not None and guard.requested:
                         # preempted mid-recovery: exit inside the grace
@@ -406,9 +411,7 @@ class BaseTrainer:
                         # the reference only logs epoch_time (steps derived
                         # offline).
                         self.logger.log("steps_per_sec", steps / elapsed, idx)
-                        self.logger.log_many(
-                            self.rate_metrics(steps, elapsed), idx
-                        )
+                        self.logger.log_many(rates, idx)
                         # HBM watermark (no reference analog; utils/memory.py)
                         mem = hbm_stats()
                         if mem is not None:
@@ -457,7 +460,10 @@ class BaseTrainer:
                     self.wait_for_saves()
                     self._gc_snapshots()
             if obs is not None:
-                obs.end_period(period, idx, elapsed, steps, train_metrics)
+                obs.end_period(
+                    period, idx, elapsed, steps, train_metrics,
+                    rates=rates,
+                )
             self.periods_run = period + 1
             if preempted:
                 self.preempted = True

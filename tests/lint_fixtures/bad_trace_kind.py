@@ -1,0 +1,20 @@
+"""Known-bad fixture: the causal-trace event kinds.  The REGISTERED
+kinds (``trace_span``/``trace_mark``, obs/events.py) must pass the
+obs-event rule; an unregistered trace-ish kind must still fail — the
+regression this fixture pins is a future trace emitter inventing a kind
+without registering it, which would silently drop that span class from
+every ``obs trace`` output.  Parsed by tests/test_analysis.py — never
+imported."""
+
+
+def emit_trace(writer):
+    writer.emit(
+        "trace_span", trace="r1", span="r1/req", parent=None,
+        name="request", t0=0.0, t1=1.0,
+    )  # registered: fine
+    writer.emit(
+        "trace_mark", trace="r1", span="r1/shed", name="shed",
+    )  # registered: fine
+    writer.emit(
+        "trace_hop", trace="r1", span="r1/hop", name="hop",
+    )  # obs-event-unregistered

@@ -63,6 +63,17 @@ def test_sink_param_flow_reaches_indirect_loss_fn():
     ), "loss fn handed through a helper into value_and_grad must be traced"
 
 
+def test_trace_kind_fixture_registered_vs_not():
+    """The causal-trace kinds are registered; an unregistered trace-ish
+    kind still fails the obs-event rule (LINT_BASELINE.json stays
+    empty, so the gate catches it on the spot)."""
+    fs = _lint_fixture("bad_trace_kind.py")
+    rules = _rules(fs)
+    assert rules.count("obs-event-unregistered") == 1
+    assert len(fs) == 1
+    assert "trace_hop" in fs[0].message
+
+
 def test_bad_misc_fixture_rules():
     fs = _lint_fixture("bad_misc.py")
     rules = _rules(fs)

@@ -125,6 +125,17 @@ def main() -> None:
         out["impl"] = cfg.dense_block_impl
     append_mfu(out, fns.train, slope, state, images, labels,
                extra_flops=extra)
+    # per-device optimizer-state HBM estimate (rule-table-derived Adam
+    # moment bytes, replicated vs ZeRO at the dp=8 reference mesh) —
+    # informational column; the gate/baseline headline ignores it
+    from ddl_tpu.bench.gate import opt_hbm_rows
+
+    (cnn_row,) = opt_hbm_rows(dp=8, families=("cnn",))
+    out["opt_hbm_bytes"] = {
+        "replicated": cnn_row["replicated_bytes"],
+        "zero": cnn_row["zero_bytes"],
+        "dp": cnn_row["dp"],
+    }
     print(json.dumps(out))
 
 

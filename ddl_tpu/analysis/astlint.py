@@ -104,6 +104,18 @@ _STEP_MODULES = frozenset({
     "parallel/lm_pipeline.py",
 })
 
+# Step-factory modules where parameter/batch placement must come from
+# the partition-rule engine (parallel/rules.py): a hand-written
+# PartitionSpec axis literal here bypasses the rule tables the contract
+# probes validate — the exact drift the engine exists to prevent.
+# Derived specs (P(), P(None, *TOKEN_SPEC), axis *variables*) are fine;
+# only hard-coded axis name strings are flagged.
+_RULE_ENGINE_MODULES = frozenset({
+    "train/steps.py",
+    "train/lm_steps.py",
+    "train/vit_steps.py",
+})
+
 # Pod-coordination paths: a process that hard-exits here without first
 # publishing exit intent through the rendezvous strands its peers inside
 # a dead collective until heartbeat ageout — the exact hang the coord
@@ -613,6 +625,40 @@ def _rule_pspec(tree, mod: _Module, rel: str, add) -> None:
                         "throughput loss, never an error")
 
 
+def _rule_pspec_hand_rolled(tree, mod: _Module, rel: str, add) -> None:
+    """In the step-factory modules, flag ``PartitionSpec`` calls that
+    hard-code axis-name strings: placement belongs to the family rule
+    tables (``parallel/rules.py``), and a literal here silently bypasses
+    the table the contract probes validate."""
+    if rel_suffix(rel) not in _RULE_ENGINE_MODULES:
+        return
+    pnames = _pspec_names(tree, mod)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d not in pnames and d != "jax.sharding.PartitionSpec":
+            continue
+        literals = []
+        for arg in node.args:
+            consts = (
+                [arg] if isinstance(arg, ast.Constant)
+                else list(ast.walk(arg)) if isinstance(arg, ast.Tuple)
+                else []
+            )
+            literals.extend(
+                e.value for e in consts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+        if literals:
+            add(node, "pspec-hand-rolled",
+                f"hand-written PartitionSpec axis literal(s) "
+                f"{sorted(set(literals))} in a step-factory module bypass "
+                "the partition-rule engine; use the family rule table / "
+                "named boundary specs from parallel/rules.py (derive "
+                "variants like P(None, *TOKEN_SPEC))")
+
+
 def _rule_donation(tree, mod: _Module, rel: str, add) -> None:
     if rel_suffix(rel) not in _STEP_MODULES:
         return
@@ -717,6 +763,7 @@ def lint_file(
     _rule_compat(tree, rel, add)
     _rule_obs_events(tree, registry, rel, add)
     _rule_pspec(tree, mod, rel, add)
+    _rule_pspec_hand_rolled(tree, mod, rel, add)
     _rule_donation(tree, mod, rel, add)
     _rule_exit_intent(tree, mod, rel, add)
     return sorted(findings)

@@ -14,17 +14,27 @@ TPU required, the same trick the test suite uses) and validated:
   factory attaches to its jitted train/generate function) names only
   real mesh axes, and its batch dimension is actually sharded over
   ``data`` — not silently replicated;
+* the factory's **partition-rule table** (``parallel/rules.py``, carried
+  in the contract as ``rule_table``) resolves every parameter leaf, its
+  specs draw only on real mesh axes, and every ≥``REPLICATION_THRESHOLD``
+  leaf is either sharded or replicated by an *explicit rule* — the rule
+  IS the waiver, there is no hand-maintained waiver list anymore;
 * the jitted program **lowers cleanly** with abstract inputs under the
   contract shardings (unknown axes, divisibility violations, and
   rule-table/spec disagreements all surface here as trace errors);
 * no parameter leaf above ``REPLICATION_THRESHOLD`` elements is fully
   replicated when the mesh has a >1 axis to shard it over (unless the
   factory's contract says replication is by design — CNN DDP, serving
-  replicas);
+  replicas — or the rule table replicates it explicitly);
+* with ``zero_sharding`` the optimizer moments of every eligible large
+  leaf actually carry the ``data`` axis, and the probe reports the
+  measured per-device optimizer-state bytes vs the replicated layout
+  (the ~(dp-1)/dp reduction of PAPERS.md's cross-replica sharding);
 * donation is declared by every train factory (the AST side checks the
   call sites; here the *runtime* is probed — on old jaxlib
   ``compat.py`` strips donation deliberately, which is reported as a
-  waiver note, not a finding).
+  waiver note; when compat retires, ``zero_donation`` asserts the
+  donated buffers actually alias outputs in the compiled ZeRO step).
 
 Probe configs are intentionally tiny (d_model 64, 2 layers) but sized so
 the big kernels cross ``REPLICATION_THRESHOLD`` — a replication
@@ -128,8 +138,29 @@ def _check_boundary(probe: _Probe, contract: dict, mesh) -> None:
             )
 
 
+def _explicit_replications(contract: dict, params) -> dict[str, str]:
+    """``{leaf_path: matched_rule}`` for every leaf the factory's rule
+    table replicates by explicit rule — the declarative successor of the
+    retired ``replicated_ok_leaves`` waiver list."""
+    table = contract.get("rule_table")
+    if table is None:
+        return {}
+    from ddl_tpu.parallel.rules import spec_axes
+
+    out: dict[str, str] = {}
+    for name, _leaf, spec, pattern in table.provenance(params, strict=False):
+        # an explicit rule whose spec names NO axis (P() or all-None —
+        # the FSDP-conditional tables collapse to the latter) is
+        # deliberate replication
+        if pattern is not None and not spec_axes(spec):
+            out[name] = pattern
+    return out
+
+
 def _check_params(probe: _Probe, params, mesh, contract: dict) -> None:
     import jax
+
+    from ddl_tpu.parallel.rules import tree_path_str
 
     if contract["replicated_params_ok"]:
         probe.note(
@@ -137,7 +168,7 @@ def _check_params(probe: _Probe, params, mesh, contract: dict) -> None:
             "(replication check skipped)"
         )
         return
-    waived = contract.get("replicated_ok_leaves", ())
+    explicit = _explicit_replications(contract, params)
     # only non-data axes make replication a bug here: sharding params
     # over 'data' is FSDP, a deliberate opt-in, not a default expectation
     shardable = any(
@@ -151,21 +182,164 @@ def _check_params(probe: _Probe, params, mesh, contract: dict) -> None:
         if size < REPLICATION_THRESHOLD or sharding is None:
             continue
         if sharding.is_fully_replicated:
-            name = jax.tree_util.keystr(path)
-            if any(w in name for w in waived):
+            name = tree_path_str(path)
+            if name in explicit:
                 probe.note(
-                    f"replicated parameter {name} ({size} elements) "
-                    "waived by the factory contract"
+                    f"replicated parameter {name} ({size} elements) is "
+                    f"explicit in the rule table (rule "
+                    f"{explicit[name]!r})"
                 )
                 continue
             probe.add(
                 "contract-replicated",
                 f"parameter {name} ({size} elements) is fully replicated "
                 "on a shardable mesh — a silent per-device memory cost; "
-                "add a logical-axis rule (parallel/sharding.py) or waive "
-                "the leaf in the factory contract "
-                "(replicated_ok_leaves)",
+                "add a rule to the family table (parallel/rules.py — "
+                "an explicit P() rule if replication is intended)",
             )
+
+
+def _check_rule_table(probe: _Probe, contract: dict, abs_params, mesh) -> None:
+    """Validate the factory's partition-rule table directly: every leaf
+    resolves, specs draw only on mesh axes, and every large leaf is
+    sharded or *explicitly* replicated — the checks that used to lean on
+    the hand-spec waiver list."""
+    from ddl_tpu.parallel import rules as prules
+
+    table = contract.get("rule_table")
+    if table is None:
+        probe.add(
+            "contract-rules",
+            "factory contract carries no rule_table: derive the contract "
+            "from the family RuleTable (parallel/rules.py) so the probes "
+            "can validate rules instead of hand-specs",
+        )
+        return
+    mesh_axes = set(mesh.axis_names)
+    for pattern, spec in table.rules:
+        unknown = prules.spec_axes(spec) - mesh_axes
+        if unknown:
+            probe.add(
+                "contract-axis",
+                f"rule ({pattern!r} -> {spec}) in the {table.family!r} "
+                f"table names non-mesh axes {sorted(unknown)} "
+                f"(mesh has {sorted(mesh_axes)})",
+            )
+    try:
+        prov = table.provenance(abs_params)
+    except prules.UnmatchedLeafError as e:
+        probe.add(
+            "contract-rules",
+            f"{table.family!r} rule table does not cover the family's "
+            f"parameter tree: {e}",
+        )
+        return
+    for name, leaf, spec, pattern in prov:
+        size = getattr(leaf, "size", None)
+        if size is None:
+            import math
+
+            shape = getattr(leaf, "shape", ())
+            size = math.prod(shape) if shape else 1
+        if size < REPLICATION_THRESHOLD:
+            continue
+        live = {
+            a for a in prules.spec_axes(spec) if mesh.shape.get(a, 1) > 1
+        }
+        if live:
+            continue
+        if not prules.spec_axes(spec):
+            probe.note(
+                f"{table.family!r} table replicates {name} ({size} "
+                f"elements) by explicit rule {pattern!r}"
+            )
+        else:
+            probe.note(
+                f"{table.family!r} table shards {name} over "
+                f"{sorted(prules.spec_axes(spec))}, all trivial on this "
+                "probe mesh"
+            )
+
+
+def _check_zero_state(probe: _Probe, state, contract: dict, mesh) -> None:
+    """With ``zero_sharding`` declared: every eligible large leaf's
+    moments must actually carry the 'data' axis, and the measured
+    per-device optimizer bytes must show the ~(dp-1)/dp reduction."""
+    import math
+
+    import jax
+
+    from ddl_tpu.parallel import rules as prules
+
+    if not contract.get("zero_sharding"):
+        return
+    from jax.sharding import PartitionSpec as P
+
+    table = contract.get("rule_table")
+    params = state.params
+    specs = (
+        prules.match_partition_rules(table, params, strict=False)
+        if table is not None
+        else jax.tree.map(lambda _: P(), params)
+    )
+    spec_leaves = jax.tree.flatten(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    adam_state = state.opt_state[0]
+    dp = mesh.shape.get("data", 1)
+    actual = replicated = 0.0
+    threshold = contract.get("zero_threshold")
+    if threshold is None:  # not `or`: threshold=0 (shard everything) is valid
+        threshold = prules.ZERO_THRESHOLD
+    for (path, p_leaf), mu_leaf, spec in zip(
+        jax.tree_util.tree_leaves_with_path(params),
+        jax.tree.leaves(adam_state.mu),
+        spec_leaves,
+    ):
+        zspec = prules.zero_shard_spec(
+            spec, tuple(p_leaf.shape), mesh, threshold=threshold
+        )
+        sharding = getattr(mu_leaf, "sharding", None)
+        shard_elems = (
+            math.prod(sharding.shard_shape(mu_leaf.shape))
+            if sharding is not None else mu_leaf.size
+        )
+        # mu + nu, per device; vs the data-replicated layout (the leaf
+        # still shards over non-data axes in both layouts)
+        non_data = prules.spec_num_shards(spec, mesh) if spec else 1
+        actual += 2 * shard_elems * mu_leaf.dtype.itemsize
+        replicated += 2 * mu_leaf.size * mu_leaf.dtype.itemsize / non_data
+        if zspec is None:
+            continue
+        axes = (
+            prules.spec_axes(sharding.spec)
+            if sharding is not None and hasattr(sharding, "spec")
+            else set()
+        )
+        if "data" not in axes:
+            probe.add(
+                "contract-zero",
+                f"zero_sharding is declared but the moments of "
+                f"{prules.tree_path_str(path)} ({p_leaf.size} elements) "
+                "are not sharded over 'data' — the leaf is eligible "
+                f"(zero spec {zspec}) and silently replicated",
+            )
+    if replicated > 0:
+        probe.note(
+            f"zero_sharding: optimizer state {actual / 1024:.0f} KiB/device "
+            f"vs {replicated / 1024:.0f} KiB replicated over data "
+            f"(dp={dp}, reduction x{replicated / max(actual, 1):.2f})"
+        )
+
+
+def _donation_alias_present(compiled_text: str) -> bool:
+    """True when a compiled module's text shows donated input buffers
+    aliasing outputs (XLA ``input_output_alias`` / StableHLO
+    ``tf.aliasing_output`` markers)."""
+    return (
+        "input_output_alias" in compiled_text
+        or "tf.aliasing_output" in compiled_text
+    )
 
 
 def _lower(probe: _Probe, fn, *args, what: str) -> None:
@@ -191,11 +365,11 @@ def _tiny_lm_cfg():
     )
 
 
-def _cnn_probe(what: str, check_fused_adam: bool = False,
-               eval_too: bool = False, **cfg_overrides) -> _Probe:
-    """Shared CNN DP probe scaffolding: tiny config + data=2 mesh +
-    boundary/lowering/replication checks; variants differ only in model
-    config overrides and extra checks."""
+def _cnn_build(zero: bool = False, data: int = 2, **cfg_overrides):
+    """Shared tiny-CNN build: config + mesh + optimizer (ZeRO-wrapped
+    when asked) + step fns + committed state.  ONE definition so every
+    CNN probe — plain, fused, ZeRO, and the donation probe — compiles
+    the same composition and cannot drift."""
     import jax
     import jax.numpy as jnp
 
@@ -205,16 +379,40 @@ def _cnn_probe(what: str, check_fused_adam: bool = False,
     from ddl_tpu.train.state import create_train_state, make_optimizer
     from ddl_tpu.train.steps import make_dp_step_fns
 
-    probe = _Probe(make_dp_step_fns)
     cfg = ModelConfig(
         growth_rate=4, block_config=(2, 2), num_init_features=8, bn_size=2,
         num_classes=5, split_blocks=(1,), compute_dtype="float32",
         remat=False, **cfg_overrides,
     )
-    mesh = build_mesh(MeshSpec(data=2))
+    mesh = build_mesh(MeshSpec(data=data))
     stages = build_stages(cfg, num_stages=1)
     tx = make_optimizer(TrainConfig())  # fused Adam by default
+    if zero:
+        from ddl_tpu.train.fused_optim import with_zero
+
+        # probe models are tiny; a small threshold exercises the sharded
+        # expression on the same leaves a real model shards at 8192
+        tx = with_zero(tx, mesh, threshold=64)
     fns = make_dp_step_fns(stages, tx, mesh, jnp.float32)
+    state = create_train_state(
+        stages, tx, jax.random.key(0), 16, mesh=mesh if zero else None
+    )
+    return fns, state, mesh
+
+
+def _cnn_probe(what: str, check_fused_adam: bool = False,
+               eval_too: bool = False, zero: bool = False, data: int = 2,
+               **cfg_overrides) -> _Probe:
+    """Shared CNN DP probe scaffolding (build via ``_cnn_build``):
+    boundary/lowering/replication checks; variants differ only in model
+    config overrides and extra checks."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.train.steps import make_dp_step_fns
+
+    probe = _Probe(make_dp_step_fns)
+    fns, state, mesh = _cnn_build(zero=zero, data=data, **cfg_overrides)
     _check_boundary(probe, fns.train.contract, mesh)
     if check_fused_adam and not fns.train.contract.get(
         "fused_optimizer_update"
@@ -225,7 +423,6 @@ def _cnn_probe(what: str, check_fused_adam: bool = False,
             "(make_optimizer default) but the factory fell back to the "
             "two-pass optax path",
         )
-    state = create_train_state(stages, tx, jax.random.key(0), 16)
     img = jax.ShapeDtypeStruct((8, 16, 16, 3), jnp.uint8)
     lbl = jax.ShapeDtypeStruct((8,), jnp.int32)
     _lower(probe, fns.train, state, img, lbl, what=f"CNN DP train step{what}")
@@ -235,11 +432,21 @@ def _cnn_probe(what: str, check_fused_adam: bool = False,
             what=f"CNN DP eval step{what}",
         )
     _check_params(probe, state.params, mesh, fns.train.contract)
+    if zero:
+        _check_zero_state(probe, state, fns.train.contract, mesh)
     return probe
 
 
 def _probe_cnn() -> _Probe:
     return _cnn_probe("")
+
+
+def _probe_cnn_zero() -> _Probe:
+    """The CNN DP step with ZeRO-1 weight-update sharding on a data=4
+    mesh: the reduce-scatter/fused-update/all-gather composition must
+    lower, the moments must actually live data-sharded, and the probe
+    reports the measured per-device optimizer-byte reduction."""
+    return _cnn_probe(" (ZeRO)", zero=True, data=4)
 
 
 def _probe_cnn_fused() -> _Probe:
@@ -269,10 +476,92 @@ def _probe_lm() -> _Probe:
     )
     _check_boundary(probe, fns.train.contract, fns.mesh)
     state = fns.init_state()
+    _check_rule_table(probe, fns.train.contract, state.params, fns.mesh)
     tok = jax.ShapeDtypeStruct((8, 32), jax.numpy.int32)
     _lower(probe, fns.train, state, tok, tok, what="LM train step")
     _lower(probe, fns.evaluate, state, tok, tok, what="LM eval step")
     _check_params(probe, state.params, fns.mesh, fns.train.contract)
+    return probe
+
+
+def _probe_lm_zero() -> _Probe:
+    """The LM flat step with ZeRO-1 over a (data=4, model=2) mesh at the
+    REAL 8192-element threshold (the probe model's MLP and vocab kernels
+    cross it): every eligible leaf's moments must carry 'data', the step
+    must lower, and the per-device optimizer bytes must show the
+    ~(dp-1)/dp reduction."""
+    import jax
+
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.fused_optim import fused_adam
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    probe = _Probe(make_lm_step_fns)
+    fns = make_lm_step_fns(
+        _tiny_lm_cfg(), LMMeshSpec(data=4, model=2), fused_adam(1e-3),
+        jax.random.key(0), batch=8, seq_len=32, zero_sharding=True,
+    )
+    _check_boundary(probe, fns.train.contract, fns.mesh)
+    if not fns.train.contract.get("zero_sharding"):
+        probe.add(
+            "contract-zero",
+            "zero_sharding=True was requested but the factory contract "
+            "does not declare it (with_zero wiring lost)",
+        )
+    state = fns.init_state()
+    _check_rule_table(probe, fns.train.contract, state.params, fns.mesh)
+    tok = jax.ShapeDtypeStruct((8, 32), jax.numpy.int32)
+    _lower(probe, fns.train, state, tok, tok, what="LM ZeRO train step")
+    _check_params(probe, state.params, fns.mesh, fns.train.contract)
+    _check_zero_state(probe, state, fns.train.contract, fns.mesh)
+    return probe
+
+
+def _probe_zero_donation() -> _Probe:
+    """Donation effectiveness in the lowered ZeRO step (PR-3 carry-over):
+    on runtimes where compat.py strips jit donation, report the waiver;
+    once compat retires, compile the ZeRO CNN step and assert the
+    donated state buffers actually alias outputs (input_output_alias in
+    the compiled module) — donation that silently stopped aliasing would
+    double state HBM right where ZeRO is trying to save it."""
+    import jax
+
+    from ddl_tpu.train.steps import make_dp_step_fns
+
+    probe = _Probe(make_dp_step_fns)
+    if hasattr(jax.jit, "__wrapped__"):
+        probe.note(
+            "donation-effectiveness waived: compat.py strips jit donation "
+            "on this runtime (old jaxlib mis-aliases donated buffers "
+            "under shard_map); when compat retires, this probe compiles "
+            "the ZeRO step and asserts input_output_alias"
+        )
+        return probe
+    import jax.numpy as jnp
+
+    # the same ZeRO composition cnn_dp_zero validates — one builder,
+    # no drift between the two probes
+    fns, state, _mesh = _cnn_build(zero=True, data=4)
+    img = jax.ShapeDtypeStruct((8, 16, 16, 3), jnp.uint8)
+    lbl = jax.ShapeDtypeStruct((8,), jnp.int32)
+    try:
+        compiled = fns.train.lower(state, img, lbl).compile()
+        text = compiled.as_text()
+    except Exception as e:
+        msg = str(e).splitlines()[0][:200] if str(e) else ""
+        probe.add(
+            "contract-trace",
+            f"ZeRO donation probe failed to compile: {type(e).__name__}: "
+            f"{msg}",
+        )
+        return probe
+    if not _donation_alias_present(text):
+        probe.add(
+            "contract-donation",
+            "the compiled ZeRO train step shows no input_output_alias: "
+            "the donated state is being copied, doubling state HBM "
+            "across the update",
+        )
     return probe
 
 
@@ -296,6 +585,9 @@ def _probe_vit() -> _Probe:
     )
     _check_boundary(probe, fns.train.contract, fns.mesh)
     state = fns.init_state()
+    # the former patch/pos-embedding waivers are explicit rules now —
+    # validated against the table, not a hand list
+    _check_rule_table(probe, fns.train.contract, state.params, fns.mesh)
     img = jax.ShapeDtypeStruct((8, 16, 16, 3), jnp.uint8)
     lbl = jax.ShapeDtypeStruct((8,), jnp.int32)
     _lower(probe, fns.train, state, img, lbl, what="ViT train step")
@@ -449,7 +741,10 @@ def _probe_vit_pipeline() -> _Probe:
 PROBES = (
     ("cnn_dp", _probe_cnn),
     ("cnn_dp_fused", _probe_cnn_fused),
+    ("cnn_dp_zero", _probe_cnn_zero),
     ("lm_flat", _probe_lm),
+    ("lm_zero", _probe_lm_zero),
+    ("zero_donation", _probe_zero_donation),
     ("vit_flat", _probe_vit),
     ("lm_decode", _probe_decode),
     ("serve_decode", _probe_serve_decode),

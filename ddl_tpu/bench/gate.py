@@ -19,7 +19,10 @@ regress again" rule:
   resolves the newest ``*.xplane.pb`` under the usual capture roots
   (``DDL_OBS_PROFILE_DIR``, ``<log dir>/xprof``, and the
   ``dn_prof_*``/``lm_prof_*``/``decode_prof_*`` temp dirs the profile
-  benches write).
+  benches write).  The digest also prints a per-device
+  **optimizer-state HBM** table (rule-table-derived Adam moment bytes
+  per family, replicated vs ZeRO at ``--opt-hbm-dp``) — the capacity
+  axis a device-time trace cannot show.
 """
 
 from __future__ import annotations
@@ -64,6 +67,97 @@ def _latest_trace_dir() -> str | None:
     return newest[1] if newest else None
 
 
+class _AxisShape:
+    """Minimal mesh stand-in for the HBM accounting: the rules engine
+    only reads ``.shape`` (axis sizes), so estimates need no devices."""
+
+    def __init__(self, **axes: int) -> None:
+        self.shape = dict(axes)
+
+
+def opt_hbm_rows(
+    dp: int = 8, tp: int = 1, families: tuple[str, ...] | None = None
+) -> list[dict]:
+    """Per-family per-device optimizer-state HBM estimates from the
+    partition-rule tables (``parallel/rules.optimizer_hbm_bytes``):
+    Adam moments, replicated-over-data vs ZeRO-sharded at ``dp``.
+    Abstract shapes only (eval_shape) — runs anywhere, no chip.
+    ``families`` restricts which model families are built (keys
+    'cnn'/'lm'/'vit'; None = all) — each row's ``family`` field starts
+    with its key."""
+    import jax
+
+    from ddl_tpu.parallel import rules as prules
+
+    mesh = _AxisShape(data=dp, model=tp, expert=1, seq=1, pipe=1)
+    rows: list[dict] = []
+
+    def wanted(key: str) -> bool:
+        return families is None or key in families
+
+    def add(family, table, abs_params):
+        est = prules.optimizer_hbm_bytes(table, abs_params, mesh)
+        rows.append({"family": family, **est})
+
+    if wanted("cnn"):
+        from ddl_tpu.config import ModelConfig
+        from ddl_tpu.models import build_stages
+        from ddl_tpu.models.densenet import init_stages
+
+        stages = build_stages(ModelConfig(), num_stages=1)
+        cnn_params = jax.eval_shape(
+            lambda r: init_stages(stages, r, 224)[0], jax.random.key(0)
+        )
+        add("cnn (densenet121)", prules.cnn_rules(), cnn_params)
+
+    if wanted("lm"):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from ddl_tpu.models.transformer import LMConfig, TransformerLM
+
+        lm_cfg = LMConfig()
+        lm_params = nn.meta.unbox(jax.eval_shape(
+            lambda r: TransformerLM(lm_cfg, None).init(
+                r, jnp.zeros((1, 8), jnp.int32)
+            )["params"],
+            jax.random.key(0),
+        ))
+        add("lm (default cfg)", prules.lm_rules(lm_cfg.fsdp), lm_params)
+
+    if wanted("vit"):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        from ddl_tpu.models.vit import ViT, ViTConfig
+
+        vit_cfg = ViTConfig()
+        vit_params = nn.meta.unbox(jax.eval_shape(
+            lambda r: ViT(vit_cfg).init(
+                r, jnp.zeros((1, vit_cfg.image_size, vit_cfg.image_size, 3),
+                             jnp.float32)
+            )["params"],
+            jax.random.key(0),
+        ))
+        add("vit (default cfg)", prules.vit_rules(vit_cfg.fsdp), vit_params)
+    return rows
+
+
+def _print_opt_hbm(rows: list[dict]) -> None:
+    if not rows:
+        return
+    dp = rows[0]["dp"]
+    print(f"# optimizer-state HBM per device (Adam moments, rule-table "
+          f"estimate, ZeRO dp={dp})")
+    print(f"  {'family':20s} {'replicated':>12s} {'zero':>12s} "
+          f"{'saving':>8s}  sharded-leaves")
+    for r in rows:
+        rep, z = r["replicated_bytes"], r["zero_bytes"]
+        saving = 1.0 - z / rep if rep else 0.0
+        print(f"  {r['family']:20s} {rep / 2**20:10.1f}MB {z / 2**20:10.1f}MB "
+              f"{100 * saving:7.1f}%  {r['zero_sharded_leaves']}/{r['leaves']}")
+
+
 def _digest(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="ddl_tpu bench digest",
@@ -77,6 +171,11 @@ def _digest(argv: list[str]) -> int:
     ap.add_argument("--top", type=int, default=5,
                     help="categories to list (default 5)")
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--opt-hbm-dp", type=int, default=8, metavar="DP",
+        help="data-axis size for the optimizer-state HBM column "
+        "(default 8; 0 disables the section)",
+    )
     args = ap.parse_args(argv)
 
     trace_dir = args.trace
@@ -94,8 +193,11 @@ def _digest(argv: list[str]) -> int:
     except FileNotFoundError as e:
         print(f"bench digest: {e}", file=sys.stderr)
         return 2
+    hbm_rows = opt_hbm_rows(args.opt_hbm_dp) if args.opt_hbm_dp > 0 else []
     if args.as_json:
-        print(json.dumps({"trace_dir": trace_dir, **dig}))
+        print(json.dumps(
+            {"trace_dir": trace_dir, **dig, "opt_hbm": hbm_rows}
+        ))
         return 0
     print(f"# digest: {trace_dir}")
     print(f"# total sync-op time: {dig['total_ms']:.3f} ms "
@@ -105,6 +207,7 @@ def _digest(argv: list[str]) -> int:
         print(f"  {cat:44s} {ms:10.3f} ms  ({100 * ms / total:5.1f}%)")
     if dig.get("top_op"):
         print(f"# top op: {dig['top_op']}")
+    _print_opt_hbm(hbm_rows)
     return 0
 
 

@@ -37,6 +37,8 @@ from ddl_tpu.utils.backoff import Backoff, retry_with_backoff
 __all__ = [
     "save_snapshot",
     "load_snapshot",
+    "state_rule_shardings",
+    "shard_and_gather",
     "snapshot_path",
     "snapshot_metadata",
     "latest_epoch",
@@ -308,12 +310,48 @@ def _head_migration_abstract(saved, abstract):
     return migrated if hits else None
 
 
+def state_rule_shardings(abstract_state: Any, table, mesh) -> Any:
+    """NamedSharding tree for a whole train-state pytree from a
+    partition-rule table (``parallel/rules.RuleTable``).
+
+    The table's regexes match anywhere in the leaf path, so the
+    optimizer moments — whose paths embed the parameter path
+    (``opt_state/0/mu/block0/attn/q/kernel``) — inherit the parameter
+    placement, and non-parameter leaves (step, Adam's count) fall
+    through to replicated (``strict=False``).  This is how a snapshot
+    from ANY topology restores straight into rule placement: hand the
+    result to ``load_snapshot(shardings=...)``."""
+    from ddl_tpu.parallel import rules as prules
+
+    specs = prules.match_partition_rules(table, abstract_state, strict=False)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def shard_and_gather(table, abstract_state: Any, mesh):
+    """Rule-driven ``(shard, gather)`` pair for a state pytree:
+    ``shard(tree)`` device_puts every leaf into the table's placement
+    (optimizer moments included, via path-embedding), ``gather(tree)``
+    pulls every leaf fully to host numpy.  The snapshot-interop bridge:
+    gather a ZeRO-sharded state to compare/save it replicated-style,
+    shard a host-restored one back onto the mesh."""
+    from ddl_tpu.parallel import rules as prules
+
+    specs = prules.match_partition_rules(table, abstract_state, strict=False)
+    return prules.make_shard_and_gather_fns(mesh, specs)
+
+
 def load_snapshot(
     checkpoint_dir: str | os.PathLike,
     job_id: str,
     epoch: int,
     abstract_state: Any,
     verify: bool = True,
+    shardings: Any | None = None,
 ) -> tuple[Any, int]:
     """Restore a snapshot; returns ``(state, epochs_run)`` where training
     resumes at ``epochs_run = saved_epoch + 1`` (reference ``single.py:124``).
@@ -322,7 +360,13 @@ def load_snapshot(
     on load: the kernel and its optimizer moments restore in their saved
     (d_model, vocab) orientation and are transposed into the requested
     tree (with the requested sharding, when the abstract leaf carries
-    one)."""
+    one).
+
+    ``shardings`` (e.g. ``state_rule_shardings(...)``) overrides the
+    abstract tree's placements leaf-by-leaf: Orbax writes GLOBAL arrays,
+    so a replicated-era snapshot restores directly into a ZeRO-sharded
+    layout and vice versa — resharding happens inside the restore, no
+    full-size host copy."""
     path = snapshot_path(checkpoint_dir, job_id, epoch)
     # callers that just picked this epoch via latest_valid_epoch pass
     # verify=False — the manifest CRC pass reads every byte, and doing
@@ -335,6 +379,14 @@ def load_snapshot(
                 f"snapshot at {path} failed its integrity check: {reason}"
             )
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, abstract_state)
+    if shardings is not None:
+        abstract = jax.tree.map(
+            lambda sds, sh: jax.ShapeDtypeStruct(
+                sds.shape, sds.dtype, sharding=sh
+            ),
+            abstract,
+            shardings,
+        )
     with ocp.StandardCheckpointer() as ckptr:
         saved_md = None
         try:

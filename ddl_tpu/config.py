@@ -125,6 +125,14 @@ class TrainConfig:
     # Adam configs fuse — weight decay / grad clipping keep the optax
     # chain.
     fused_adam: bool = True
+    # ZeRO-1 optimizer-state sharding (train/fused_optim.with_zero +
+    # parallel/rules.zero_shard_spec): moments and the weight update for
+    # every >=8192-element leaf live on a 1/dp shard of the 'data' axis
+    # (reduce-scatter grads -> per-shard fused Adam -> all-gather new
+    # params), numerically identical to the replicated path.  Requires
+    # the fused Adam (plain-Adam configs) and a non-pipelined strategy;
+    # a no-op at mesh.data=1.
+    zero_sharding: bool = False
     lr_schedule: str = "constant"  # "constant" | "cosine"
     warmup_steps: int = 0  # linear 0 -> lr ramp prepended to either schedule
     decay_steps: int = 0  # total steps for cosine (incl. warmup)
@@ -190,6 +198,28 @@ class Config:
             raise ValueError(
                 f"unknown nan_policy {self.train.nan_policy!r} "
                 "(want 'halt' or 'recover')"
+            )
+        if self.train.zero_sharding and self.strategy in ("pp", "dp_pp"):
+            raise ValueError(
+                "zero_sharding shards the optimizer update over 'data' "
+                "inside the flat DP step; the pipeline schedules apply "
+                "their optimizer inside a manual shard_map region where "
+                "sharding constraints cannot be planted — use strategy "
+                "'single'/'dp'"
+            )
+        if self.train.zero_sharding and (
+            not self.train.fused_adam
+            or self.train.weight_decay > 0.0
+            or self.train.grad_clip_norm > 0.0
+        ):
+            # weight decay / clipping route make_optimizer to the optax
+            # chain even with fused_adam=true — catch the whole class
+            # here, not deep inside with_zero (and not only at dp>1)
+            raise ValueError(
+                "zero_sharding requires the fused Adam path: "
+                "fused_adam=true and weight_decay=0 and grad_clip_norm=0 "
+                "(the sharded update is planted inside train/fused_optim's "
+                "per-leaf expression; optax chains cannot be ZeRO-sharded)"
             )
         if self.strategy == "single" and self.mesh.num_devices != 1:
             raise ValueError("strategy 'single' requires a (1,1) mesh")

@@ -31,7 +31,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
 
 from ddl_tpu.models.transformer import (
     Block,
@@ -40,6 +40,10 @@ from ddl_tpu.models.transformer import (
     make_embed,
 )
 from ddl_tpu.ops.quant import QuantKV
+# Jit-boundary spec + the family rule table come from the partition-rule
+# engine (parallel/rules.py); re-exported here for the generator's
+# callers.
+from ddl_tpu.parallel.rules import DECODE_TOKEN_SPEC, decode_rules
 from ddl_tpu.parallel.sharding import (
     FLASH_AUTO_MIN_T,
     LMMeshSpec,
@@ -49,12 +53,6 @@ from ddl_tpu.parallel.sharding import (
 )
 
 __all__ = ["LMDecode", "DECODE_TOKEN_SPEC", "init_kv_cache", "make_lm_generator"]
-
-# Jit-boundary sharding for prompt/output token batches: batch over
-# data (tensor-parallel decode shards heads over 'model' *inside* the
-# program via the logical rules).  Named once so the generator and the
-# sharding-contract checker (analysis/contracts.py) agree.
-DECODE_TOKEN_SPEC = P("data")
 
 
 class LMDecode(nn.Module):
@@ -391,14 +389,11 @@ def make_lm_generator(
         return toks
 
     # sharding contract + lowering handles for `ddl_tpu lint`
-    # (analysis/contracts.py): decode has no train state to donate, and
-    # serving replicas intentionally hold full parameter copies when the
-    # mesh has no model axis — replication is checked against the spec
-    run.contract = {
-        "in_specs": {"prompt": DECODE_TOKEN_SPEC},
-        "donate_state": False,
-        "replicated_params_ok": True,
-    }
+    # (analysis/contracts.py), derived from the decode rule table:
+    # decode has no train state to donate, and serving replicas
+    # intentionally hold full parameter copies when the mesh has no
+    # model axis — replication is contractual
+    run.contract = decode_rules().contract()
     run.jitted = jitted
     run.mesh = mesh
     return run

@@ -230,6 +230,13 @@ def fill_metrics(m: "_Metrics", fold, job_id: str, summary=None) -> None:
                     "latest period model FLOPs utilization", br["mfu"],
                     **rl,
                 )
+            if br.get("opt_hbm_bytes") is not None:
+                m.add(
+                    "opt_hbm_bytes", "gauge",
+                    "per-device optimizer-state HBM (live shard shapes; "
+                    "shrinks under ZeRO sharding)",
+                    br["opt_hbm_bytes"], **rl,
+                )
             for phase, dur in sorted(br["phases"].items()):
                 m.add(
                     "phase_seconds_total", "counter",

@@ -59,10 +59,12 @@ __all__ = [
 
 SIDECAR_NAME = ".obs_fold.json"
 # v1/v2 were the serving-only cursor sidecar (obs/cursor.py); v3 was the
-# whole-summary fold with t-digest serving state; v4 adds the causal-
+# whole-summary fold with t-digest serving state; v4 added the causal-
 # trace reducer (trace_span/trace_mark counts + slowest-request cell)
-# and per-repoch rate metrics (mfu) — older sidecars rebuild cleanly
-VERSION = 4
+# and per-repoch rate metrics (mfu); v5 adds the per-device
+# optimizer-state HBM gauge (opt_hbm_bytes, stamped into period rates by
+# the training loop) — older sidecars rebuild cleanly
+VERSION = 5
 
 # the serving-cursor sidecar this module's cache superseded; removed
 # opportunistically when the fold sidecar is written so a job dir does
@@ -116,7 +118,7 @@ def _new_repoch_agg() -> dict:
     return {
         "periods": 0, "steps": 0, "elapsed": 0.0, "compiles": 0,
         "phases": {}, "last_sps": None, "last_step": None, "loss": None,
-        "last_ts": None, "mfu": None,
+        "last_ts": None, "mfu": None, "opt_hbm_bytes": None,
     }
 
 
@@ -354,6 +356,8 @@ class StreamFold:
         rates = e.get("rates") or {}
         if rates.get("mfu") is not None:
             br["mfu"] = rates["mfu"]
+        if rates.get("opt_hbm_bytes") is not None:
+            br["opt_hbm_bytes"] = rates["opt_hbm_bytes"]
 
         if step is not None:
             rec = self.hosts.setdefault(h, _new_host_rec())

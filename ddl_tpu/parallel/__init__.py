@@ -1,5 +1,14 @@
 from ddl_tpu.parallel.mesh import MeshSpec, build_mesh
 from ddl_tpu.parallel.ring_attention import make_ring_self_attention
+from ddl_tpu.parallel.rules import (
+    RuleTable,
+    cnn_rules,
+    decode_rules,
+    lm_rules,
+    match_partition_rules,
+    vit_rules,
+    zero_shard_spec,
+)
 from ddl_tpu.parallel.sharding import LMMeshSpec, build_lm_mesh, lm_logical_rules
 from ddl_tpu.parallel.ulysses import make_ulysses_self_attention
 
@@ -9,6 +18,13 @@ __all__ = [
     "LMMeshSpec",
     "build_lm_mesh",
     "lm_logical_rules",
+    "RuleTable",
+    "match_partition_rules",
+    "cnn_rules",
+    "lm_rules",
+    "vit_rules",
+    "decode_rules",
+    "zero_shard_spec",
     "make_ring_self_attention",
     "make_ulysses_self_attention",
     "make_lm_pipeline_step_fns",

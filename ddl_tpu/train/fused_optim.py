@@ -1,4 +1,5 @@
-"""Fused Adam: the optimizer update as one fusible expression per leaf.
+"""Fused Adam: the optimizer update as one fusible expression per leaf,
+optionally ZeRO-1-sharded over the data axis.
 
 The round-4 DenseNet op digest puts "elementwise/reduce fusions" (BN
 stats, Adam, loss) at 17.5% of device time.  ``optax.adam``'s update is
@@ -14,43 +15,134 @@ writing (mu', nu', p') with no intermediate updates tensor, and XLA is
 free to fuse it straight onto the last gradient reduction that produced
 ``g``.
 
+**ZeRO-1** (``zero=ZeroConfig(...)``, PAPERS.md "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training"): with plain data
+parallelism the moments are replicated over ``data`` — the dominant
+optimizer HBM cost at scale (2x the parameter bytes, times dp copies
+pod-wide).  With a ZeRO config, every parameter leaf at or above
+``threshold`` elements gets its moments and its update computed on a
+``1/dp`` shard (``parallel/rules.zero_shard_spec`` picks the dimension
+from the rule-table-resolved parameter spec): the sharding constraint on
+the incoming gradient turns XLA's gradient all-reduce into a
+**reduce-scatter**, the fused Adam expression runs on the shard, and the
+constraint back to the parameter's own spec **all-gathers** the new
+parameters — all inserted by the SPMD partitioner from the constraints,
+no manual collectives.  The math is element-identical to the replicated
+path (same expression, same reduction operands — asserted to 1e-6 over
+multi-step trajectories by ``tests/test_zero_sharding.py``); only
+placement changes, so snapshots interoperate both ways (Orbax restores
+global arrays into whatever sharding the live state carries).
+
 Drop-in constraints, both load-bearing:
 
 * **State tree is bit-identical to ``optax.adam``'s** (``init``
   delegates to it): ``(ScaleByAdamState(count, mu, nu), ScaleState)``
   for a constant lr, ``(..., ScaleByScheduleState(count))`` for a
   schedule — existing snapshots restore into the fused optimizer and
-  vice versa.
+  vice versa, replicated or ZeRO-sharded.
 * **The math is ``optax.adam``'s exactly** (same b1/b2/eps, same
   ``1 - b**count_inc`` bias correction, ``eps_root=0``), asserted by
   ``tests/test_optimizer.py`` against optax step by step.
 
 The standard ``update`` endpoint (returns an updates tree, for
 ``optax.apply_updates``) is also provided so the transformation works
-anywhere a ``GradientTransformation`` does — ``recovery.scale_tx``, the
-pipeline step factories — while step factories that know about
-``fused_apply`` (``train/steps.py``) take the single-pass path.
+anywhere a ``GradientTransformation`` does — under ZeRO it constrains
+the emitted updates back to the parameter spec, so the two-pass path is
+sharded identically to the fused one.  ``rebuild(**overrides)`` returns
+a re-parameterised twin with the same state tree: ``recovery.scale_tx``
+uses it to enter a grace window (``scale=``) without losing the fused
+path or the ZeRO placement, and the step factories use it to attach a
+``ZeroConfig`` (``with_zero``).
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
-__all__ = ["FusedAdam", "fused_adam"]
+__all__ = ["FusedAdam", "ZeroConfig", "fused_adam", "with_zero"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroConfig:
+    """ZeRO-1 placement for the fused update.
+
+    ``param_specs`` is the rule-table-resolved PartitionSpec pytree for
+    the parameters (None = all-replicated, the CNN DDP family);
+    ``zero_shard_spec`` derives each eligible leaf's moment/update shard
+    from it.  Frozen so a rebuilt optimizer shares it."""
+
+    mesh: Any
+    param_specs: Any = None
+    axis: str = "data"
+    threshold: int | None = None
+
+    def resolved_threshold(self) -> int:
+        from ddl_tpu.parallel.rules import ZERO_THRESHOLD
+
+        return ZERO_THRESHOLD if self.threshold is None else self.threshold
 
 
 class FusedAdam(NamedTuple):
     """``optax.GradientTransformation`` surface (init/update) plus the
     single-pass ``fused_apply(grads, state, params) -> (new_params,
-    new_state)`` endpoint step factories fuse into the jitted step."""
+    new_state)`` endpoint step factories fuse into the jitted step.
+    ``rebuild(scale=..., zero=...)`` re-parameterises without changing
+    the state tree; ``zero`` is the active ``ZeroConfig`` (or None)."""
 
     init: Callable[..., Any]
     update: Callable[..., Any]
     fused_apply: Callable[..., Any]
+    rebuild: Callable[..., "FusedAdam"]
+    zero: ZeroConfig | None
+
+
+def _constrain(x, mesh, spec):
+    """Pin ``x`` to ``spec`` on ``mesh``: a sharding constraint under a
+    trace (the SPMD partitioner turns it into the reduce-scatter /
+    all-gather), a device_put on concrete arrays (eager ``init``)."""
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
+
+
+def _zero_leaf_specs(zero: ZeroConfig, shaped_leaves):
+    """Per-leaf ``(param_spec, zero_spec_or_None)`` aligned with
+    ``shaped_leaves`` (the flattened grads/params — same structure the
+    ``param_specs`` tree was resolved from)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddl_tpu.parallel.rules import zero_shard_spec
+
+    if zero.param_specs is None:
+        pspecs = [P()] * len(shaped_leaves)
+    else:
+        pspecs = jax.tree.flatten(
+            zero.param_specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+        if len(pspecs) != len(shaped_leaves):
+            raise ValueError(
+                f"ZeroConfig.param_specs has {len(pspecs)} leaves but the "
+                f"gradient tree has {len(shaped_leaves)}; the spec tree "
+                "must be resolved from the same parameter tree"
+            )
+    threshold = zero.resolved_threshold()
+    return [
+        (
+            ps,
+            zero_shard_spec(
+                ps, tuple(leaf.shape), zero.mesh, zero.axis, threshold
+            ),
+        )
+        for ps, leaf in zip(pspecs, shaped_leaves)
+    ]
 
 
 def fused_adam(
@@ -58,15 +150,40 @@ def fused_adam(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
+    *,
+    scale: float = 1.0,
+    zero: ZeroConfig | None = None,
 ) -> FusedAdam:
     """Adam with ``optax.adam``-identical math and state tree, computed
     in one tree pass.  ``learning_rate`` may be a float or an optax
-    schedule (callable of the step count)."""
+    schedule (callable of the step count).  ``scale`` multiplies the
+    emitted update (the grace-window dial, ``recovery.scale_tx``);
+    ``zero`` ZeRO-1-shards moments and update (see module docstring)."""
     ref = optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
     schedule = callable(learning_rate)
 
     def init(params):
-        return ref.init(params)
+        state = ref.init(params)
+        if zero is None:
+            return state
+        # place the moments at their ZeRO shard from birth — eagerly
+        # (CNN create_train_state) or as trace constraints (the jitted
+        # LM/ViT create_state); either way tx.init IS the placement.
+        adam_state, lr_state = state
+        zspecs = _zero_leaf_specs(zero, jax.tree.leaves(params))
+
+        def place(tree):
+            leaves, treedef = jax.tree.flatten(tree)
+            placed = [
+                _constrain(m, zero.mesh, zs) if zs is not None else m
+                for m, (_ps, zs) in zip(leaves, zspecs)
+            ]
+            return treedef.unflatten(placed)
+
+        return (
+            adam_state._replace(mu=place(adam_state.mu), nu=place(adam_state.nu)),
+            lr_state,
+        )
 
     def _step(grads, state, params):
         """One fused pass.  Returns (out, new_state) where ``out`` is the
@@ -86,11 +203,29 @@ def fused_adam(
         c1 = 1.0 - b1 ** count_inc.astype(jnp.float32)
         c2 = 1.0 - b2 ** count_inc.astype(jnp.float32)
 
-        def leaf(g, mu, nu, p):
+        def leaf(g, mu, nu, p, pspec=None, zspec=None):
+            if zspec is not None:
+                # reduce-scatter: the constraint on the incoming gradient
+                # makes XLA materialise only this device's 1/dp shard of
+                # the data-axis reduction
+                g = _constrain(g, zero.mesh, zspec)
+                mu = _constrain(mu, zero.mesh, zspec)
+                nu = _constrain(nu, zero.mesh, zspec)
             mu2 = b1 * mu + (1.0 - b1) * g
             nu2 = b2 * nu + (1.0 - b2) * (g * g)
             u = -lr_now * (mu2 / c1) / (jnp.sqrt(nu2 / c2) + eps)
-            return (u if p is None else p + u), mu2, nu2
+            if scale != 1.0:
+                u = scale * u
+            if zspec is None:
+                return (u if p is None else p + u), mu2, nu2
+            if p is None:
+                # updates-tree endpoint: hand back a full update in the
+                # parameter's own placement (all-gather)
+                return _constrain(u, zero.mesh, pspec), mu2, nu2
+            # fused endpoint: add on the shard, then all-gather the new
+            # parameters back to their rule-table placement
+            new_p = _constrain(p, zero.mesh, zspec) + u
+            return _constrain(new_p, zero.mesh, pspec), mu2, nu2
 
         g_leaves, treedef = jax.tree.flatten(grads)
         mu_leaves = jax.tree.leaves(adam_state.mu)
@@ -99,10 +234,19 @@ def fused_adam(
             jax.tree.leaves(params) if params is not None
             else [None] * len(g_leaves)
         )
-        trips = [
-            leaf(g, m, n, p)
-            for g, m, n, p in zip(g_leaves, mu_leaves, nu_leaves, p_leaves)
-        ]
+        if zero is not None:
+            specs = _zero_leaf_specs(zero, g_leaves)
+            trips = [
+                leaf(g, m, n, p, pspec=ps, zspec=zs)
+                for (g, m, n, p), (ps, zs) in zip(
+                    zip(g_leaves, mu_leaves, nu_leaves, p_leaves), specs
+                )
+            ]
+        else:
+            trips = [
+                leaf(g, m, n, p)
+                for g, m, n, p in zip(g_leaves, mu_leaves, nu_leaves, p_leaves)
+            ]
         out = treedef.unflatten([t[0] for t in trips])
         new_state = (
             adam_state._replace(
@@ -122,4 +266,44 @@ def fused_adam(
     def fused_apply(grads, state, params):
         return _step(grads, state, params)
 
-    return FusedAdam(init=init, update=update, fused_apply=fused_apply)
+    def rebuild(**overrides) -> FusedAdam:
+        kw = dict(scale=scale, zero=zero)
+        kw.update(overrides)
+        return fused_adam(learning_rate, b1=b1, b2=b2, eps=eps, **kw)
+
+    return FusedAdam(
+        init=init, update=update, fused_apply=fused_apply,
+        rebuild=rebuild, zero=zero,
+    )
+
+
+def with_zero(
+    tx,
+    mesh,
+    param_specs=None,
+    axis: str = "data",
+    threshold: int | None = None,
+):
+    """``tx`` with ZeRO-1 weight-update sharding attached.
+
+    A no-op on meshes where ``axis`` is trivial (single chip, pp-only)
+    — the replicated path IS the sharded path at dp=1.  Only the fused
+    Adam supports it: optax chains (weight decay, gradient clipping)
+    hide their moments behind opaque tree passes this module cannot
+    constrain, so asking for ZeRO there is a loud error rather than a
+    silent replication."""
+    if getattr(mesh, "shape", {}).get(axis, 1) <= 1:
+        return tx
+    rebuild = getattr(tx, "rebuild", None)
+    if rebuild is None:
+        raise ValueError(
+            "zero_sharding requires the fused Adam optimizer "
+            "(train/fused_optim.fused_adam — the default for plain Adam "
+            "configs; weight_decay/grad_clip_norm configs keep the optax "
+            f"chain and cannot be ZeRO-sharded); got {type(tx).__name__}"
+        )
+    return rebuild(
+        zero=ZeroConfig(
+            mesh=mesh, param_specs=param_specs, axis=axis, threshold=threshold
+        )
+    )

@@ -28,6 +28,14 @@ from ddl_tpu.models.transformer import LMConfig, TransformerLM
 from ddl_tpu.ops.flash_attention import flash_attention
 from ddl_tpu.ops.quant import head_kernel
 from ddl_tpu.parallel.ring_attention import make_ring_self_attention
+# Jit-boundary specs + the family rule table come from the partition-
+# rule engine — this module is lint-banned from hand-writing
+# PartitionSpec axis literals (astlint 'pspec-hand-rolled').
+from ddl_tpu.parallel.rules import (
+    LM_MANUAL_ATTN_SPEC,
+    TOKEN_SPEC,
+    lm_rules,
+)
 from ddl_tpu.parallel.sharding import (
     FLASH_AUTO_MIN_T,  # noqa: F401  (re-exported: measured dispatch bound)
     LMMeshSpec,
@@ -67,12 +75,9 @@ def poison_nan_grads(step, grads, nan_step: int | None):
         grads,
     )
 
-# The jit-boundary sharding for token batches (inputs AND targets): batch
-# over data x expert (outside MoE layers the expert axis is extra data
-# parallelism — the 'batch' logical rule in parallel/sharding.py), sequence
-# over seq.  Named once so the factories, the sharding-contract checker
-# (analysis/contracts.py), and tests all agree by construction.
-TOKEN_SPEC = P(("data", "expert"), "seq")
+# The jit-boundary sharding for token batches (inputs AND targets):
+# batch over data x expert, sequence over seq — defined once in
+# parallel/rules.py (re-exported here for the factories' callers).
 
 
 class LMTrainState(struct.PyTreeNode):
@@ -109,7 +114,7 @@ def make_ring_core(
     return make_ring_self_attention(
         mesh,
         causal=causal,
-        spec=P(("data", "expert"), "seq", "model", None),
+        spec=LM_MANUAL_ATTN_SPEC,
         jit=False,
         use_flash=use_flash,
         window=window,
@@ -240,6 +245,7 @@ def finalize_step_fns(
     rng: jax.Array,
     accum_steps: int = 1,
     manual_grad_fn=None,
+    contract: dict | None = None,
 ) -> LMStepFns:
     """Shared tail for the non-pipelined and pipelined LM paths: wrap a
     ``loss_fn(params, inputs, targets, step=None) -> (loss, (logits,
@@ -263,6 +269,11 @@ def finalize_step_fns(
     schedule, whose interleaved backward cannot be derived by differentiating
     a forward pass).  ``loss_fn`` still drives evaluation.
 
+    ``contract`` (a dict from ``RuleTable.contract``) overrides the
+    default boundary contract — the family factories derive it from
+    their rule table so the contract checker validates rules, not
+    hand-specs.
+
     ``jax.set_mesh`` wraps every call because ``nn.with_logical_constraint``
     lowers to bare-PartitionSpec sharding constraints, which resolve against
     the ambient mesh at trace time.
@@ -270,6 +281,10 @@ def finalize_step_fns(
     tok_sharding = NamedSharding(mesh, TOKEN_SPEC)
     replicated = NamedSharding(mesh, P())
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    # single-pass fused Adam when the transformation offers it (and the
+    # one place ZeRO's reduce-scatter/all-gather constraints live); the
+    # grace-window rebuild (recovery.scale_tx) preserves it
+    fused_apply = getattr(tx, "fused_apply", None)
     # fault injection, compiled IN: `nan@grad:K` bakes a traced cond on
     # the step counter into the jitted program, so nan_policy="recover"
     # is exercised against an actual non-finite update (consumed at
@@ -290,7 +305,8 @@ def finalize_step_fns(
         else:
             k = accum_steps
             b = inputs.shape[0]
-            chunk_sh = NamedSharding(mesh, P(None, ("data", "expert"), "seq"))
+            # the chunked batch is TOKEN_SPEC with a leading scan axis
+            chunk_sh = NamedSharding(mesh, P(None, *TOKEN_SPEC))
             inp_c = jax.lax.with_sharding_constraint(
                 inputs.reshape(k, b // k, *inputs.shape[1:]), chunk_sh
             )
@@ -303,8 +319,13 @@ def finalize_step_fns(
                 grad_fn, state.params, (inp_c, tgt_c, steps), k
             )
         grads = poison_nan_grads(state.step, grads, nan_grad_step)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if fused_apply is not None:
+            new_params, new_opt = fused_apply(
+                grads, state.opt_state, state.params
+            )
+        else:
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         return (
             state.replace(
                 step=state.step + 1, params=new_params, opt_state=new_opt
@@ -341,12 +362,17 @@ def finalize_step_fns(
         )
     )
     # machine-readable sharding contract: what this factory promises at
-    # its jit boundary, validated by `ddl_tpu lint` (analysis/contracts)
-    train.contract = {
-        "in_specs": {"inputs": TOKEN_SPEC, "targets": TOKEN_SPEC},
-        "donate_state": True,
-        "replicated_params_ok": False,
-    }
+    # its jit boundary, validated by `ddl_tpu lint` (analysis/contracts).
+    # Factories pass their rule-table-derived contract (the default
+    # covers pipeline callers); optimizer facts are stamped here where
+    # the transformation is in hand.
+    _zero = getattr(tx, "zero", None)
+    train.contract = dict(
+        contract if contract is not None else lm_rules().contract(),
+        fused_optimizer_update=fused_apply is not None,
+        zero_sharding=_zero is not None,
+        zero_threshold=_zero.resolved_threshold() if _zero is not None else None,
+    )
     return LMStepFns(
         train=train,
         evaluate=evaluate,
@@ -367,8 +393,14 @@ def make_lm_step_fns(
     accum_steps: int = 1,
     pipeline_schedule: str = "gpipe",
     virtual_stages: int = 1,
+    zero_sharding: bool = False,
 ) -> LMStepFns:
     """Build the sharded train state and jitted step functions.
+
+    ``zero_sharding`` attaches ZeRO-1 weight-update sharding to a fused
+    Adam ``tx`` (``train/fused_optim.with_zero`` over the family rule
+    table): large leaves' moments and update live on a 1/dp shard of
+    ``data``.  Requires the flat (non-pipelined) path and a fused Adam.
 
     ``batch`` must divide by ``spec.data`` and ``seq_len`` by ``spec.seq``
     (static SPMD shapes).  The manual attention cores are head-parallel over
@@ -407,6 +439,13 @@ def make_lm_step_fns(
             raise ValueError(
                 "accum_steps > 1 is the non-pipelined path's microbatching; "
                 "with spec.pipe > 1 use num_microbatches instead"
+            )
+        if zero_sharding:
+            raise ValueError(
+                "zero_sharding requires the flat (non-pipelined) step: "
+                "the pipeline schedule applies its optimizer inside a "
+                "manual shard_map region where the ZeRO sharding "
+                "constraints cannot be planted"
             )
         from ddl_tpu.parallel.lm_pipeline import make_lm_pipeline_step_fns
 
@@ -497,7 +536,7 @@ def make_lm_step_fns(
     # batch over data AND expert — the same placement as the 'batch'
     # logical rule, so the manual attention cores see the local batch
     # shard instead of forcing an ep-fold replication at their boundary
-    manual_spec = P(("data", "expert"), "seq", "model", None)
+    manual_spec = LM_MANUAL_ATTN_SPEC
     if cfg.attn_impl == "ring":
         attn_core = make_ring_core(
             mesh, use_flash=bool(cfg.flash), window=cfg.attn_window
@@ -532,8 +571,18 @@ def make_lm_step_fns(
         return model.init(rng, dummy)["params"]
 
     abs_params = jax.eval_shape(init_params, rng)
-    logical_specs = nn.get_partition_spec(abs_params)
-    param_shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, rules)
+    # parameter placement from the family rule table (regex over param
+    # path, parallel/rules.py) — leaf-for-leaf the resolution the
+    # model's logical annotations used to produce, but declarative,
+    # probe-validated, and the base the ZeRO shard derivation reads
+    table = lm_rules(cfg.fsdp)
+    abs_unboxed = nn.meta.unbox(abs_params)
+    param_specs = table.specs(abs_unboxed)
+    param_shardings = table.shardings(abs_unboxed, mesh)
+    if zero_sharding:
+        from ddl_tpu.train.fused_optim import with_zero
+
+        tx = with_zero(tx, mesh, param_specs)
 
     def create_state(rng):
         params = nn.meta.unbox(init_params(rng))
@@ -594,5 +643,6 @@ def make_lm_step_fns(
         return loss, (logits, metrics)
 
     return finalize_step_fns(
-        mesh, tx, loss_fn, create_state, rng, accum_steps=accum_steps
+        mesh, tx, loss_fn, create_state, rng, accum_steps=accum_steps,
+        contract=table.contract(),
     )

@@ -56,6 +56,9 @@ class LMRunConfig:
     accum_steps: int = 1
     pipeline_schedule: str = "gpipe"
     virtual_stages: int = 1
+    # ZeRO-1 optimizer-state sharding over 'data' (requires a fused Adam
+    # tx and the flat step path — see TrainConfig.zero_sharding)
+    zero_sharding: bool = False
     # data: token corpus path (.npy or raw text; encoded on first use) or
     # None for the synthetic Markov-chain byte stream
     corpus: str | None = None
@@ -176,6 +179,7 @@ class LMTrainer(BaseTrainer):
             accum_steps=run.accum_steps,
             pipeline_schedule=run.pipeline_schedule,
             virtual_stages=run.virtual_stages,
+            zero_sharding=run.zero_sharding,
         )
 
     def _rebuild_step_fns(self) -> None:

@@ -136,6 +136,41 @@ class BaseTrainer:
         """Extra per-period throughput metrics (tokens/sec, img/sec, ...)."""
         return {}
 
+    # Measured once per process (placement is static after build); the
+    # loop stamps it into every period event's rates so `obs export`/
+    # `obs fleet` can gauge per-device optimizer-state HBM — the number
+    # ZeRO sharding exists to shrink.
+    _opt_hbm_cache = None
+
+    def opt_state_hbm_bytes(self) -> int | None:
+        """Per-device bytes of this run's live optimizer state: each
+        leaf's actual shard shape (so ZeRO/TP sharding is reflected)
+        times its dtype width.  None when no state is held."""
+        if self._opt_hbm_cache is not None:
+            return self._opt_hbm_cache
+        import math
+
+        opt_state = getattr(getattr(self, "state", None), "opt_state", None)
+        if opt_state is None:
+            return None
+        total = 0
+        for leaf in jax.tree.leaves(opt_state):
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            sharding = getattr(leaf, "sharding", None)
+            try:
+                shard_shape = (
+                    sharding.shard_shape(shape)
+                    if sharding is not None else shape
+                )
+            except (TypeError, ValueError):
+                shard_shape = shape
+            total += math.prod(shard_shape) * dtype.itemsize
+        self._opt_hbm_cache = total
+        return total
+
     def snapshot_due(self, period: int) -> bool:
         """Fixed-cadence snapshots, independent of the best-metric gate."""
         return False
@@ -359,6 +394,9 @@ class BaseTrainer:
             # and the period obs event (the fleet rollup reads MFU and
             # the family throughput rates from the event stream)
             rates = self.rate_metrics(steps, elapsed)
+            opt_hbm = self.opt_state_hbm_bytes()
+            if opt_hbm:
+                rates.setdefault("opt_hbm_bytes", opt_hbm)
             if loss is not None and not np.isfinite(loss):
                 handled = self._handle_nonfinite(period, idx, loss, obs)
                 if handled:

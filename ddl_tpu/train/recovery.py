@@ -107,9 +107,18 @@ class RecoveryPolicy:
 def scale_tx(tx, scale: float):
     """``tx`` with its emitted updates multiplied by ``scale``, keeping
     ``tx``'s state tree bit-identical (snapshot-compatible both ways:
-    ``scale == 1`` wraps are free to skip)."""
+    ``scale == 1`` wraps are free to skip).
+
+    A fused Adam (``train/fused_optim.FusedAdam``) is rebuilt with the
+    scale baked in instead of wrapped — the grace window then keeps both
+    the single-pass ``fused_apply`` path and any attached ZeRO-1
+    placement (a generic wrap would hide them and silently fall back to
+    the two-pass replicated update)."""
     if scale == 1.0:
         return tx
+    rebuild = getattr(tx, "rebuild", None)
+    if rebuild is not None:
+        return rebuild(scale=scale)
     import jax
     import optax
 

@@ -117,13 +117,28 @@ def make_optimizer(train_cfg) -> optax.GradientTransformation:
     )
 
 
-def create_train_state(stages, tx, rng, image_size: int) -> TrainState:
+def create_train_state(stages, tx, rng, image_size: int, mesh=None) -> TrainState:
+    """Fresh CNN train state.  With ``mesh`` the state is *committed*:
+    params/batch_stats/step device_put replicated over the mesh and the
+    optimizer state placed by ``tx.init`` itself — a ZeRO fused Adam
+    (``train/fused_optim.with_zero``) puts each large leaf's moments on
+    their 1/dp data-axis shard, which is exactly the placement the step
+    factory's ``in_shardings=None`` boundary then preserves."""
     from ddl_tpu.models.densenet import init_stages
     import jax.numpy as jnp
 
     params, batch_stats = init_stages(stages, rng, image_size)
+    step = jnp.zeros((), jnp.int32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(mesh, PartitionSpec())
+        params, batch_stats, step = jax.tree.map(
+            lambda x: jax.device_put(x, replicated),
+            (params, batch_stats, step),
+        )
     return TrainState(
-        step=jnp.zeros((), jnp.int32),
+        step=step,
         params=params,
         batch_stats=batch_stats,
         opt_state=tx.init(params),

@@ -28,14 +28,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddl_tpu.models.densenet import forward_stages
 from ddl_tpu.ops import cross_entropy_loss, normalize_images
+# Jit-boundary batch spec + the family rule table come from the
+# partition-rule engine — this module is lint-banned from hand-writing
+# PartitionSpec axis literals (astlint 'pspec-hand-rolled').
+from ddl_tpu.parallel.rules import BATCH_SPEC, cnn_rules
 from ddl_tpu.train.state import TrainState
 
 __all__ = ["StepFns", "BATCH_SPEC", "make_dp_step_fns", "make_grad_stats_fn"]
-
-# Jit-boundary sharding for image/label batches on the (data, pipe)
-# mesh; named once so the factory and the sharding-contract checker
-# (analysis/contracts.py) agree by construction.
-BATCH_SPEC = P("data")
 
 
 class StepFns(NamedTuple):
@@ -57,9 +56,15 @@ def make_dp_step_fns(
     # it (train/fused_optim.FusedAdam): new params come out of the same
     # per-leaf expression as the new moments, with no materialised
     # updates tree between the gradient reduction and the weight write.
-    # The grace-window wrap (recovery.scale_tx) hides fused_apply, so
-    # grace periods transparently take the two-pass optax path.
+    # The grace-window wrap (recovery.scale_tx) rebuilds the fused Adam
+    # with the scale baked in, so grace periods keep this path too.
     fused_apply = getattr(tx, "fused_apply", None)
+    # ZeRO-1 (train/fused_optim.with_zero, attached by the trainer):
+    # moments + update live on a 1/dp shard of each large leaf.  The
+    # state then crosses the jit boundary in its committed (sharded)
+    # placement — a blanket replicated in_sharding would all-gather the
+    # moments right back every step.
+    zero = getattr(tx, "zero", None)
 
     def train_step(state: TrainState, images, labels):
         x = normalizer(images, compute_dtype)
@@ -97,32 +102,39 @@ def make_dp_step_fns(
 
     replicated = NamedSharding(mesh, P())
     batch_sharding = NamedSharding(mesh, BATCH_SPEC)
+    # With ZeRO the state's committed placement (params replicated,
+    # large moments data-sharded — created that way by
+    # state.create_train_state(mesh=...)) is the boundary contract;
+    # None lets it through untouched in AND out.
+    state_in = None if zero is not None else replicated
+    state_out = None if zero is not None else replicated
 
     train = jax.jit(
         train_step,
-        in_shardings=(replicated, batch_sharding, batch_sharding),
-        out_shardings=(replicated, replicated, batch_sharding),
+        in_shardings=(state_in, batch_sharding, batch_sharding),
+        out_shardings=(state_out, replicated, batch_sharding),
         donate_argnums=(0,),
     )
     evaluate = jax.jit(
         eval_step,
-        in_shardings=(replicated, batch_sharding),
+        in_shardings=(state_in, batch_sharding),
         out_shardings=batch_sharding,
     )
-    # sharding contract for `ddl_tpu lint` (analysis/contracts.py): DDP
-    # keeps full parameter replicas by design, so replicated params are
-    # contractual here — the checker skips its replication rule
-    train.contract = {
-        "in_specs": {"images": BATCH_SPEC, "labels": BATCH_SPEC},
-        "donate_state": True,
-        "replicated_params_ok": True,
+    # sharding contract for `ddl_tpu lint` (analysis/contracts.py),
+    # derived from the family rule table: DDP keeps full parameter
+    # replicas by design, so replicated params are contractual here —
+    # the checker skips its replication rule
+    train.contract = cnn_rules().contract(
         # informational: whether the optimizer applied in one fused pass
-        "fused_optimizer_update": fused_apply is not None,
-    }
+        fused_optimizer_update=fused_apply is not None,
+        zero_sharding=zero is not None,
+        zero_threshold=zero.resolved_threshold() if zero is not None else None,
+    )
     return StepFns(train=train, evaluate=evaluate)
 
 
-def make_grad_stats_fn(stages, mesh: Mesh, compute_dtype):
+def make_grad_stats_fn(stages, mesh: Mesh, compute_dtype,
+                       zero_sharding: bool = False):
     """Per-parameter |grad| statistics, computed on-device.
 
     Observability parity with the reference's ``_log_gradient``
@@ -155,9 +167,12 @@ def make_grad_stats_fn(stages, mesh: Mesh, compute_dtype):
         }
 
     replicated = NamedSharding(mesh, P())
-    batch_sharding = NamedSharding(mesh, P("data"))
+    batch_sharding = NamedSharding(mesh, BATCH_SPEC)
+    # under ZeRO the state arrives committed (sharded moments) — do not
+    # force a replicating boundary transfer just to read gradients
+    state_in = None if zero_sharding else replicated
     return jax.jit(
         stats_step,
-        in_shardings=(replicated, batch_sharding, batch_sharding),
+        in_shardings=(state_in, batch_sharding, batch_sharding),
         out_shardings=replicated,
     )

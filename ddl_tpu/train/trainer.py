@@ -72,9 +72,18 @@ class Trainer(BaseTrainer):
         pipelined = cfg.strategy in ("pp", "dp_pp")
         self.stages = build_stages(cfg.model, num_stages=None if pipelined else 1)
         self.tx = make_optimizer(cfg.train)
+        self._zero = False
+        if cfg.train.zero_sharding:
+            from ddl_tpu.train.fused_optim import with_zero
+
+            # CNN DDP params are replicated (cnn_rules: everything P()),
+            # so param_specs=None; with_zero no-ops at mesh data=1
+            self.tx = with_zero(self.tx, self.mesh)
+            self._zero = getattr(self.tx, "zero", None) is not None
         rng = jax.random.key(cfg.train.seed)
         self.state = create_train_state(
-            self.stages, self.tx, rng, cfg.data.image_size
+            self.stages, self.tx, rng, cfg.data.image_size,
+            mesh=self.mesh if self._zero else None,
         )
         if cfg.model.pretrained_path:
             from ddl_tpu.models.convert import load_torch_checkpoint
@@ -91,7 +100,8 @@ class Trainer(BaseTrainer):
             from ddl_tpu.train.steps import make_grad_stats_fn
 
             self.grad_stats_fn = make_grad_stats_fn(
-                self.stages, self.mesh, compute_dtype
+                self.stages, self.mesh, jnp.dtype(cfg.model.compute_dtype),
+                zero_sharding=self._zero,
             )
 
         train_ds, test_ds = datasets if datasets is not None else build_datasets(cfg.data)

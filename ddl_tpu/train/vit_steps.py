@@ -22,6 +22,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddl_tpu.models.vit import ViT, ViTConfig
 from ddl_tpu.ops import normalize_images
 from ddl_tpu.ops.losses import cross_entropy_loss
+# Jit-boundary batch spec + the family rule table come from the
+# partition-rule engine — this module is lint-banned from hand-writing
+# PartitionSpec axis literals (astlint 'pspec-hand-rolled').
+from ddl_tpu.parallel.rules import IMAGE_SPEC, vit_rules
 from ddl_tpu.parallel.sharding import (
     LMMeshSpec,
     build_lm_mesh,
@@ -30,11 +34,6 @@ from ddl_tpu.parallel.sharding import (
 )
 
 __all__ = ["ViTTrainState", "ViTStepFns", "IMAGE_SPEC", "make_vit_step_fns"]
-
-# Jit-boundary sharding for image/label batches: batch over data (the
-# ViT family does not use the expert axis).  Named once so the factory
-# and the sharding-contract checker (analysis/contracts.py) agree.
-IMAGE_SPEC = P("data")
 
 
 class ViTTrainState(struct.PyTreeNode):
@@ -65,6 +64,7 @@ def make_vit_step_fns(
     accum_steps: int = 1,
     pipeline_schedule: str = "gpipe",
     virtual_stages: int = 1,
+    zero_sharding: bool = False,
 ) -> ViTStepFns:
     if spec.seq > 1 or spec.expert > 1:
         raise ValueError(
@@ -81,6 +81,12 @@ def make_vit_step_fns(
             raise ValueError(
                 "accum_steps > 1 is the non-pipelined path's microbatching; "
                 "with spec.pipe > 1 use num_microbatches instead"
+            )
+        if zero_sharding:
+            raise ValueError(
+                "zero_sharding requires the flat (non-pipelined) ViT "
+                "step (the pipeline optimizer runs inside a manual "
+                "shard_map region)"
             )
         return _make_vit_pipeline_step_fns(
             cfg, spec, tx, rng, batch,
@@ -119,8 +125,17 @@ def make_vit_step_fns(
         return model.init(rng, dummy)["params"]
 
     abs_params = jax.eval_shape(init_params, rng)
-    logical = nn.get_partition_spec(abs_params)
-    param_shardings = nn.logical_to_mesh_sharding(logical, mesh, rules)
+    # parameter placement from the family rule table (parallel/rules.py)
+    # — the former patch/pos-embedding contract waivers are explicit
+    # replication rules there
+    table = vit_rules(cfg.fsdp)
+    abs_unboxed = nn.meta.unbox(abs_params)
+    param_specs = table.specs(abs_unboxed)
+    param_shardings = table.shardings(abs_unboxed, mesh)
+    if zero_sharding:
+        from ddl_tpu.train.fused_optim import with_zero
+
+        tx = with_zero(tx, mesh, param_specs)
 
     def create_state(rng):
         params = nn.meta.unbox(init_params(rng))
@@ -145,11 +160,12 @@ def make_vit_step_fns(
             )
 
     return _finalize_vit(mesh, tx, forward, create_state, rng,
-                         accum_steps=accum_steps)
+                         accum_steps=accum_steps, contract=table.contract())
 
 
 def _finalize_vit(mesh, tx, forward, create_state, rng,
-                  accum_steps: int = 1, manual_grad_fn=None) -> ViTStepFns:
+                  accum_steps: int = 1, manual_grad_fn=None,
+                  contract: dict | None = None) -> ViTStepFns:
     """Shared jit tail for the plain and pipelined ViT paths: wraps a
     ``forward(params, images, step=None) -> logits`` (``step`` drives the
     train-mode dropout rng; eval passes nothing) and a
@@ -173,6 +189,8 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
     from ddl_tpu.utils import faultinject
 
     nan_grad_step = faultinject.traced_nan_step()
+    # single-pass fused Adam + ZeRO constraints, as in the LM tail
+    fused_apply = getattr(tx, "fused_apply", None)
 
     def train_step(state, images, labels):
         if manual_grad_fn is not None:
@@ -188,25 +206,31 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
 
             k = accum_steps
             b = images.shape[0]
-            chunk_sh = NamedSharding(
-                mesh, P(None, "data", *([None] * (images.ndim - 1)))
-            )
+            # the chunked batch is IMAGE_SPEC with a leading scan axis
+            # (trailing dims replicate implicitly)
+            chunk_sh = NamedSharding(mesh, P(None, *IMAGE_SPEC))
             img_c = jax.lax.with_sharding_constraint(
                 images.reshape(k, b // k, *images.shape[1:]), chunk_sh
             )
             lab_c = jax.lax.with_sharding_constraint(
-                labels.reshape(k, b // k), NamedSharding(mesh, P(None, "data"))
+                labels.reshape(k, b // k), chunk_sh
             )
             steps = state.step * k + jnp.arange(k)
             grads, metrics = accumulate_grads(
                 grad_fn, state.params, (img_c, lab_c, steps), k
             )
         grads = poison_nan_grads(state.step, grads, nan_grad_step)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        if fused_apply is not None:
+            new_params, new_opt = fused_apply(
+                grads, state.opt_state, state.params
+            )
+        else:
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         return (
             state.replace(
                 step=state.step + 1,
-                params=optax.apply_updates(state.params, updates),
+                params=new_params,
                 opt_state=new_opt,
             ),
             metrics,
@@ -229,16 +253,17 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
         out_shardings=(None, replicated),
         donate_argnums=(0,),
     ))
-    # sharding contract for `ddl_tpu lint` (analysis/contracts.py).  The
-    # patch/position embeddings live on the 'embed' logical axis, which
-    # the rule table deliberately leaves unsharded without FSDP — an
-    # explicit waiver, so their replication is contractual, not silent.
-    train.contract = {
-        "in_specs": {"images": IMAGE_SPEC, "labels": IMAGE_SPEC},
-        "donate_state": True,
-        "replicated_params_ok": False,
-        "replicated_ok_leaves": ("patch_embed", "pos_embed"),
-    }
+    # sharding contract for `ddl_tpu lint` (analysis/contracts.py),
+    # derived from the family rule table — the patch/position embeddings
+    # replicate by explicit rule there (formerly hand-spec waivers), so
+    # the checker reads the table instead of a waiver list.
+    _zero = getattr(tx, "zero", None)
+    train.contract = dict(
+        contract if contract is not None else vit_rules().contract(),
+        fused_optimizer_update=fused_apply is not None,
+        zero_sharding=_zero is not None,
+        zero_threshold=_zero.resolved_threshold() if _zero is not None else None,
+    )
     return ViTStepFns(
         train=train,
         evaluate=_with_mesh(jax.jit(
@@ -341,8 +366,8 @@ def _make_vit_pipeline_step_fns(
     dummy = jnp.zeros((batch, cfg.image_size, cfg.image_size, 3), jnp.float32)
 
     abs_params = jax.eval_shape(lambda r: full_model.init(r, dummy)["params"], rng)
-    logical = nn.get_partition_spec(abs_params)
-    mesh_sharding = nn.logical_to_mesh_sharding(logical, mesh, rules)
+    table = vit_rules(cfg.fsdp)
+    mesh_sharding = table.shardings(nn.meta.unbox(abs_params), mesh)
     block0 = mesh_sharding["block0"]
     stack_dims = (None,) * (1 if V == 1 else 2)
     blocks_sharding = jax.tree.map(
@@ -368,7 +393,9 @@ def _make_vit_pipeline_step_fns(
             opt_state=tx.init(params),
         )
 
-    mb_spec = NamedSharding(mesh, P(None, "data"))
+    # microbatched activations/labels: IMAGE_SPEC behind the leading
+    # microbatch axis
+    mb_spec = NamedSharding(mesh, P(None, *IMAGE_SPEC))
 
     def embed_fn(embed_params, images):
         x = normalize_images(images, cfg.dtype)
@@ -432,7 +459,7 @@ def _make_vit_pipeline_step_fns(
                     x.reshape(M, mb, T, d), mb_spec
                 )
                 lab_mb = jax.lax.with_sharding_constraint(
-                    labels.reshape(M, mb), NamedSharding(mesh, P(None, "data"))
+                    labels.reshape(M, mb), mb_spec
                 )
                 key_args = (
                     (dropout_step_key(rng, step),) if use_dropout else ()
@@ -452,4 +479,5 @@ def _make_vit_pipeline_step_fns(
             return grads, {"loss": met[0] / M, "accuracy": met[1] / M}
 
     return _finalize_vit(mesh, tx, forward, create_state, rng,
-                         manual_grad_fn=manual_grad_fn)
+                         manual_grad_fn=manual_grad_fn,
+                         contract=table.contract())

@@ -40,6 +40,9 @@ class ViTRunConfig:
     accum_steps: int = 1
     pipeline_schedule: str = "gpipe"
     virtual_stages: int = 1
+    # ZeRO-1 optimizer-state sharding over 'data' (requires a fused Adam
+    # tx and the flat step path — see TrainConfig.zero_sharding)
+    zero_sharding: bool = False
     checkpoint_dir: str | None = "checkpoints"
     # keep only the newest K valid snapshots (0 = all); corrupt ones
     # never count toward K — see checkpoint.gc_snapshots
@@ -158,6 +161,7 @@ class ViTTrainer(BaseTrainer):
             accum_steps=run.accum_steps,
             pipeline_schedule=run.pipeline_schedule,
             virtual_stages=run.virtual_stages,
+            zero_sharding=run.zero_sharding,
         )
 
     def _rebuild_step_fns(self) -> None:

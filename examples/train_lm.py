@@ -102,6 +102,11 @@ def main() -> None:
                     help=">0 switches to decoupled AdamW")
     ap.add_argument("--clip-norm", type=float, default=0.0,
                     help=">0 enables global-norm gradient clipping")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1 optimizer-state sharding over 'data': "
+                    "moments + weight update on a 1/dp shard of every "
+                    "large leaf (needs the fused Adam, so plain-Adam "
+                    "configs only; flat step path)")
     ap.add_argument("--d-model", type=int, default=128)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--kv-heads", type=int, default=0,
@@ -199,6 +204,9 @@ def main() -> None:
         lr_schedule="cosine" if args.cosine else "constant",
         warmup_steps=args.warmup,
         decay_steps=args.steps if args.cosine else 0,
+        # ZeRO's sharded update lives inside the fused per-leaf
+        # expression (train/fused_optim); with_zero rejects optax chains
+        fused=args.zero,
     )
     run = LMRunConfig(
         batch=args.batch,
@@ -209,6 +217,7 @@ def main() -> None:
         accum_steps=args.accum,
         pipeline_schedule=args.pipeline_schedule,
         virtual_stages=args.virtual_stages,
+        zero_sharding=args.zero,
         corpus=args.corpus,
         eval_every=args.eval_every,
         eval_frac=args.eval_frac,

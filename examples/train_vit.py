@@ -45,6 +45,12 @@ def main() -> None:
     ap.add_argument("--dropout", type=float, default=0.0,
                     help="residual dropout rate")
     ap.add_argument("--fsdp", action="store_true")
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1 optimizer-state sharding over 'data'. "
+                    "Switches to the plain fused Adam (drops this "
+                    "example's default weight-decay/clip chain — the "
+                    "sharded update lives in the fused per-leaf "
+                    "expression); flat step path only")
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--epochs", type=int, default=3)
     ap.add_argument("--lr", type=float, default=3e-4)
@@ -108,7 +114,11 @@ def main() -> None:
         dropout_rate=args.dropout,
     )
     spec = LMMeshSpec(data=args.data, model=args.model, pipe=args.pipe)
-    tx = build_optimizer(args.lr, weight_decay=0.05, grad_clip_norm=1.0)
+    tx = (
+        build_optimizer(args.lr, fused=True)
+        if args.zero
+        else build_optimizer(args.lr, weight_decay=0.05, grad_clip_norm=1.0)
+    )
     run = ViTRunConfig(
         batch=args.batch,
         epochs=args.epochs,
@@ -116,6 +126,7 @@ def main() -> None:
         accum_steps=args.accum,
         pipeline_schedule=args.pipeline_schedule,
         virtual_stages=args.virtual_stages,
+        zero_sharding=args.zero,
         checkpoint_dir=args.checkpoint_dir or None,
         keep_snapshots=args.keep_snapshots,
         resume_epoch=args.resume_epoch,

@@ -186,6 +186,57 @@ def test_exit_without_intent_rule_in_coord_paths(tmp_path):
     assert _lint_tmp(tmp_path, "supervisor.py", ok) == []
 
 
+PSPEC_HAND_ROLLED_SRC = """
+from jax.sharding import PartitionSpec as P
+
+from ddl_tpu.parallel.rules import TOKEN_SPEC
+
+BAD = P("data")
+ALSO_BAD = P(("data", "expert"), "seq")
+OK_EMPTY = P()
+OK_NONE = P(None, None)
+OK_DERIVED = P(None, *TOKEN_SPEC)
+AXIS = "model"
+OK_VARIABLE = P(AXIS, None)
+"""
+
+
+def test_pspec_hand_rolled_rule_in_step_factories(tmp_path):
+    """Hand-written PartitionSpec axis literals in the step-factory
+    modules bypass the rule engine and are flagged; P(), all-None,
+    star-derived, and axis-variable specs are fine."""
+    for rel in ("train/steps.py", "train/lm_steps.py",
+                "train/vit_steps.py"):
+        fs = _lint_tmp(tmp_path, rel, PSPEC_HAND_ROLLED_SRC)
+        rules = [f.rule for f in fs if f.rule == "pspec-hand-rolled"]
+        assert rules == ["pspec-hand-rolled"] * 2, (rel, fs)
+        assert any("'data'" in f.message for f in fs)
+    # outside the step factories the rule does not apply
+    fs = _lint_tmp(tmp_path, "parallel/rules.py", PSPEC_HAND_ROLLED_SRC)
+    assert [f.rule for f in fs if f.rule == "pspec-hand-rolled"] == []
+    # suppression works like every other rule
+    ok = PSPEC_HAND_ROLLED_SRC.replace(
+        'BAD = P("data")',
+        'BAD = P("data")  # ddl-lint: disable=pspec-hand-rolled',
+    ).replace(
+        'ALSO_BAD = P(("data", "expert"), "seq")',
+        'ALSO_BAD = P(("data", "expert"), "seq")'
+        '  # ddl-lint: disable=pspec-hand-rolled',
+    )
+    fs = _lint_tmp(tmp_path, "train/steps.py", ok)
+    assert [f.rule for f in fs if f.rule == "pspec-hand-rolled"] == []
+
+
+def test_shipped_step_factories_have_no_hand_rolled_pspecs():
+    """The refactored factories draw every axis name from the rule
+    engine — the package must be clean under the new rule."""
+    fs = [
+        f for f in lint_package(PACKAGE)
+        if f.rule == "pspec-hand-rolled"
+    ]
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
 def test_shipped_watchdog_escalation_publishes_intent():
     """The real watchdog passes the rule because _escalate publishes
     exit intent before its os._exit — delete that call and the linter
@@ -336,14 +387,22 @@ def test_contract_replication_violation_and_waiver(small_mesh):
     assert _rules(probe.findings) == ["contract-replicated"]
     assert "big_replicated" in probe.findings[0].message
 
+    # the waiver is an explicit P() rule in the factory's rule table now
+    # (the replicated_ok_leaves hand list is retired)
+    from ddl_tpu.parallel.rules import RuleTable
+
+    table = RuleTable(
+        family="test",
+        rules=(("big_replicated", P()), ("big_sharded", P("model", None))),
+        in_specs={},
+    )
     waived = _probe()
     _check_params(
         waived, params, small_mesh,
-        {"replicated_params_ok": False,
-         "replicated_ok_leaves": ("big_replicated",)},
+        {"replicated_params_ok": False, "rule_table": table},
     )
     assert waived.findings == []
-    assert any("waived" in n for n in waived.notes)
+    assert any("explicit in the rule table" in n for n in waived.notes)
 
 
 def test_contract_trace_violation():

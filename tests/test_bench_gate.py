@@ -118,9 +118,38 @@ def test_digest_renders_cpu_trace(tmp_path, capsys):
     assert rc == 0
     assert "total sync-op time" in out and "ms" in out
 
-    rc = bench_main(["digest", str(trace), "--json"])
+    out_hbm = out  # text mode printed the optimizer-HBM section too
+    assert "optimizer-state HBM per device" in out_hbm
+
+    rc = bench_main(["digest", str(trace), "--json", "--opt-hbm-dp", "4"])
     row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0 and row["total_ms"] > 0 and row["ops"]
+    fams = {r["family"]: r for r in row["opt_hbm"]}
+    assert any(f.startswith("cnn") for f in fams)
+    for r in row["opt_hbm"]:
+        assert r["dp"] == 4
+        assert 0 < r["zero_bytes"] < r["replicated_bytes"]
+
+    # 0 disables the section (fast path for trace-only digests)
+    rc = bench_main(["digest", str(trace), "--opt-hbm-dp", "0"])
+    assert rc == 0
+    assert "optimizer-state HBM" not in capsys.readouterr().out
+
+
+def test_opt_hbm_rows_estimates_scale_with_dp():
+    from ddl_tpu.bench.gate import opt_hbm_rows
+
+    rows4 = {r["family"]: r for r in opt_hbm_rows(dp=4)}
+    rows8 = {r["family"]: r for r in opt_hbm_rows(dp=8)}
+    for fam, r4 in rows4.items():
+        r8 = rows8[fam]
+        # replicated estimate is dp-independent; zero shrinks with dp
+        assert r4["replicated_bytes"] == r8["replicated_bytes"]
+        assert r8["zero_bytes"] < r4["zero_bytes"] < r4["replicated_bytes"]
+        # the saving on eligible leaves is ~(dp-1)/dp: at dp=8 the
+        # whole-model saving must exceed the dp=4 bound of 3/4 only on
+        # the eligible fraction — just assert monotone + sane here
+        assert r4["zero_sharded_leaves"] > 0
 
 
 def test_digest_missing_trace_is_usage_error(tmp_path, capsys):

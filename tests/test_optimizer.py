@@ -158,8 +158,10 @@ def test_fused_adam_schedule_and_update_endpoint():
 def test_build_optimizer_fused_routing():
     """fused=True returns the fused transformation only for plain-Adam
     configs; weight decay / clipping keep the optax chain (and thus no
-    fused_apply), and the grace wrap (scale_tx) hides fused_apply so
-    step factories fall back to the two-pass path during grace."""
+    fused_apply).  The grace wrap (scale_tx) REBUILDS a fused Adam with
+    the scale baked in — fused_apply (and any ZeRO placement) survives
+    the grace window instead of silently falling back to the two-pass
+    replicated path (round 16)."""
     from ddl_tpu.train.recovery import scale_tx
 
     fused = build_optimizer(1e-3, fused=True)
@@ -171,13 +173,21 @@ def test_build_optimizer_fused_routing():
     assert not hasattr(
         build_optimizer(1e-3, fused=True, grad_clip_norm=1.0), "fused_apply"
     )
-    assert not hasattr(scale_tx(fused, 0.5), "fused_apply")
-    # the wrap still works end to end through the update endpoint
+    # a non-fused tx still takes the generic wrap (no fused_apply)
+    assert not hasattr(scale_tx(optax.adam(1e-3), 0.5), "fused_apply")
+    # the scaled rebuild works through BOTH endpoints
     p = _params()
     w = scale_tx(fused, 0.5)
+    assert hasattr(w, "fused_apply")
     s = w.init(p)
     u_half, _ = w.update(_grads(), s, p)
     u_full, _ = fused.update(_grads(), s, p)
     np.testing.assert_allclose(
         np.asarray(u_half["w"]), 0.5 * np.asarray(u_full["w"]), rtol=1e-6
+    )
+    p_half, _ = w.fused_apply(_grads(), s, p)
+    np.testing.assert_allclose(
+        np.asarray(p_half["w"]),
+        np.asarray(p["w"]) + np.asarray(u_half["w"]),
+        rtol=1e-6,
     )

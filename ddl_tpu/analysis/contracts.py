@@ -675,6 +675,19 @@ def _probe_serve_decode() -> _Probe:
         jax.eval_shape(lambda: jax.random.PRNGKey(0)),
         what="serve bucketed prefill",
     )
+    # the round-17 chunk prefill (prefix-cache tails / long-prompt
+    # chunks): masked cached attention at a traced offset over a
+    # gathered pool view must lower under the same sharded mesh
+    chunk, _ = fns.chunk_for(8, fns.max_blocks_per_seq, "final")
+    _lower(
+        probe, chunk, params, pools,
+        jax.ShapeDtypeStruct((1, 8), jnp.int32),
+        jax.ShapeDtypeStruct((fns.max_blocks_per_seq,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+        what="serve chunk prefill",
+    )
     return probe
 
 

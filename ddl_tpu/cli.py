@@ -79,20 +79,35 @@ digest renderer behind the "open every perf PR with a digest" rule:
     python -m ddl_tpu.cli bench digest <trace_dir|latest> [--top 5] [--json]
 
 Serving (``ddl_tpu/serve/``): the continuous-batching engine — paged
-block KV pool, admit/retire scheduler over a static decode batch,
-admission control with shed policies — benchmarked by firing N
-synthetic concurrent clients and rendering the percentile report
-(p50/p95/p99 latency / queue delay / TTFT / tok/s, aggregate tokens/s
-per chip, shed/compile counts):
+block KV pool with refcounted shared-prefix caching (a shared system
+prompt's KV blocks are computed once and shared read-only across
+requests, copy-on-write guarded), chunked prefill (long prompts run as
+bounded chunks interleaved with decode, never stalling admission),
+admit/retire scheduler over a static decode batch, admission control
+with shed policies — benchmarked by firing N synthetic concurrent
+clients and rendering the percentile report (p50/p95/p99 latency /
+queue delay / TTFT / tok/s, prefix-hit rate, prefill tokens computed,
+aggregate tokens/s per chip, shed/compile counts):
 
     python -m ddl_tpu.cli serve-bench --cpu-devices 1 --clients 8 \
         --prompt-len 8:24 --max-new 16:32 --block-size 8 --num-blocks 64 \
+        [--scenario shared-prefix|long-prompt|bursty|mixed] \
+        [--shared-prefix-len 64] [--long-prompt-len 256] \
+        [--prefix-cache on|off] [--prefill-chunk 64] \
         [--policy shed_oldest] [--int8 kv] [--compare-sequential] \
         [--obs-log-dir DIR --job-id J]   # events -> `obs summarize J`,
                                          # gated by `obs diff --baseline
                                          # BASELINE_OBS.json --fail-slowdown F`
     python examples/serve_lm.py ...      # same engine over a training
                                          # snapshot (--checkpoint-dir/--step)
+
+(``--scenario`` selects a parameterized client mix; with
+``--compare-sequential`` the run additionally verifies every completed
+request's tokens are bit-identical to a one-at-a-time
+``make_lm_generator`` replay and exits nonzero on mismatch — the gate
+that prefix caching + chunked prefill change scheduling, never tokens.
+``DDL_OBS_TRACE_SAMPLE=N`` bounds request-trace volume to 1-in-N
+requests, deterministic by request sequence number.)
 """
 
 from __future__ import annotations

@@ -69,6 +69,13 @@ EVENT_KINDS = (
     # still flows through "decode" so one percentile pipeline serves
     # both the one-shot and the continuous-batching paths)
     "serve_admit", "serve_shed", "serve_retire", "kv_pool_stats",
+    # prefix caching (round 17): a request admitted onto cached prompt
+    # blocks (cached_tokens/blocks args), a finished prefill registering
+    # its prompt blocks in the content-keyed index, and the one write a
+    # shared block can see — the copy-on-write block duplication.
+    # serve_admit additionally carries cached_tokens/prefill_tokens and
+    # an optional scenario tag (serve-bench --scenario)
+    "prefix_hit", "prefix_insert", "kv_cow_copy",
     # supervisor.py restart lifecycle
     "supervisor_start", "supervisor_relaunch", "supervisor_done",
     # pod-level coordinated recovery (coord.py + PodSupervisor)

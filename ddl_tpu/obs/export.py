@@ -269,11 +269,31 @@ def fill_metrics(m: "_Metrics", fold, job_id: str, summary=None) -> None:
                 "serve_retired_total", "counter",
                 "requests retired complete", retire, host=host, **job,
             )
+            # prefix-cache economics (round 17): reuse counters + the
+            # cached/computed prompt-token split (hit rate = cached /
+            # (cached + computed), derived at query time)
+            for key, metric, help_text in (
+                ("prefix_hits", "serve_prefix_hits_total",
+                 "admits that reused cached prompt-prefix blocks"),
+                ("prefix_hit_tokens", "serve_prefix_hit_tokens_total",
+                 "prompt tokens served from cached prefix blocks"),
+                ("prefix_inserts", "serve_prefix_inserts_total",
+                 "prompt blocks registered in the prefix index"),
+                ("cow_copies", "serve_kv_cow_copies_total",
+                 "copy-on-write block duplications"),
+                ("prefill_tokens", "serve_prefill_tokens_total",
+                 "prompt tokens actually computed by prefill"),
+            ):
+                m.add(
+                    metric, "counter", help_text,
+                    sf.serve.get(key, 0), host=host, **job,
+                )
         kv = sf.serve["kv_last"]
         if kv:
             for field, metric in (
                 ("free", "kv_free_blocks"),
                 ("used", "kv_used_blocks"),
+                ("cached", "kv_cached_blocks"),
                 ("num_blocks", "kv_num_blocks"),
                 ("fragmentation", "kv_fragmentation"),
                 ("active_lanes", "serve_active_lanes"),

@@ -61,10 +61,11 @@ SIDECAR_NAME = ".obs_fold.json"
 # v1/v2 were the serving-only cursor sidecar (obs/cursor.py); v3 was the
 # whole-summary fold with t-digest serving state; v4 added the causal-
 # trace reducer (trace_span/trace_mark counts + slowest-request cell)
-# and per-repoch rate metrics (mfu); v5 adds the per-device
-# optimizer-state HBM gauge (opt_hbm_bytes, stamped into period rates by
-# the training loop) — older sidecars rebuild cleanly
-VERSION = 5
+# and per-repoch rate metrics (mfu); v5 added the per-device
+# optimizer-state HBM gauge (opt_hbm_bytes); v6 adds the prefix-cache
+# counters (prefix_hit/prefix_insert/kv_cow_copy + serve_admit's
+# cached/prefill token split) — older sidecars rebuild cleanly
+VERSION = 6
 
 # the serving-cursor sidecar this module's cache superseded; removed
 # opportunistically when the fold sidecar is written so a job dir does
@@ -164,7 +165,14 @@ class StreamFold:
             "n": 0, "sum": 0.0, "max": None, "last": None,
             "last_ts": None, "by_repoch": {},  # str(repoch) -> [ts, latency]
         }
-        self.serve = {"admit": 0, "shed": 0, "retire": 0, "kv_last": None}
+        self.serve = {
+            "admit": 0, "shed": 0, "retire": 0, "kv_last": None,
+            # prefix-cache economics (round 17): hit/insert/CoW counts
+            # plus the cached-vs-computed prompt-token split off
+            # serve_admit — the numbers behind summarize's hit-rate line
+            "prefix_hits": 0, "prefix_hit_tokens": 0, "prefix_inserts": 0,
+            "cow_copies": 0, "cached_tokens": 0, "prefill_tokens": 0,
+        }
         # job-level restart accounting: every host of a pod emits its
         # own pod_restart event for the SAME pod-wide restart, so the
         # per-stream "restarts" counter (kept for the per-host export/
@@ -268,12 +276,25 @@ class StreamFold:
             self.serving.observe(e)
         elif kind == "serve_admit":
             self.serve["admit"] += 1
+            self.serve["cached_tokens"] += int(e.get("cached_tokens", 0))
+            self.serve["prefill_tokens"] += int(
+                e.get("prefill_tokens", e.get("prompt_len", 0) or 0)
+            )
         elif kind == "serve_shed":
             self.serve["shed"] += 1
         elif kind == "serve_retire":
             self.serve["retire"] += 1
         elif kind == "kv_pool_stats":
             self.serve["kv_last"] = dict(e)
+        elif kind == "prefix_hit":
+            self.serve["prefix_hits"] += 1
+            self.serve["prefix_hit_tokens"] += int(
+                e.get("cached_tokens", 0)
+            )
+        elif kind == "prefix_insert":
+            self.serve["prefix_inserts"] += int(e.get("blocks", 1))
+        elif kind == "kv_cow_copy":
+            self.serve["cow_copies"] += 1
         elif kind == "trace_span":
             tr = self.trace
             tr["spans"] += 1

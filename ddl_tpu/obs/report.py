@@ -244,6 +244,36 @@ def summarize_from_fold(fold) -> dict:
         for key in ("anomalies", "stalls", "captures")
     }
 
+    # -- serving engine counters (admits/sheds + prefix-cache economics) -
+    serve = None
+
+    def _ssum(key):
+        return sum(fold.streams[nm].serve.get(key, 0) for nm in names)
+
+    admits = _ssum("admit")
+    sheds = _ssum("shed")
+    # sheds alone must surface too: a pool so misconfigured that every
+    # request sheds before the first admit is exactly when an operator
+    # reads this section
+    if admits or sheds:
+        cached = _ssum("cached_tokens")
+        computed = _ssum("prefill_tokens")
+        total_prompt = cached + computed
+        serve = {
+            "admits": admits,
+            "sheds": sheds,
+            "retires": _ssum("retire"),
+            "prefix_hits": _ssum("prefix_hits"),
+            "prefix_hit_tokens": _ssum("prefix_hit_tokens"),
+            "prefix_inserts": _ssum("prefix_inserts"),
+            "cow_copies": _ssum("cow_copies"),
+            "cached_tokens": cached,
+            "prefill_tokens": computed,
+            "prefix_hit_rate": (
+                cached / total_prompt if total_prompt else None
+            ),
+        }
+
     # -- causal-trace reduction (obs/trace.py kinds) ---------------------
     tr = fold.trace_totals()
     trace = None
@@ -276,6 +306,7 @@ def summarize_from_fold(fold) -> dict:
         "peak_hbm_bytes": hbm,
         "hosts": hosts,
         "decode": decode,
+        "serve": serve,
         "profile_captures": _merge_sorted(fold, "captures"),
         "restart_latency": restart_latency,
         "trace": trace,
@@ -379,6 +410,17 @@ def render_summary(s: dict, job_id: str = "") -> str:
 
             lines.append("-- decode percentiles (warm requests) --")
             lines.extend(render_percentiles(d["percentiles"]))
+    sv = s.get("serve")
+    if sv:
+        rate = sv.get("prefix_hit_rate")
+        rate_s = f"{rate:.0%}" if rate is not None else "n/a"
+        lines.append(
+            f"serve: {sv['admits']} admit(s), {sv['sheds']} shed(s) | "
+            f"prefix cache: {sv['prefix_hits']} hit(s), "
+            f"{sv['cached_tokens']} cached / {sv['prefill_tokens']} "
+            f"computed prompt tokens ({rate_s} hit rate), "
+            f"{sv['cow_copies']} cow cop(ies)"
+        )
     tr = s.get("trace")
     if tr and tr.get("slowest"):
         sl = tr["slowest"]
@@ -661,7 +703,11 @@ def main(argv=None) -> None:
     )
     sel.add_argument(
         "--slowest-request", action="store_true",
-        help="trace the slowest request on record (fold-selected)",
+        help="trace the slowest request on record (fold-selected). "
+        "Under trace sampling (DDL_OBS_TRACE_SAMPLE=N emits spans for "
+        "1-in-N requests, deterministic by request sequence number) "
+        "this is the slowest SAMPLED request — an untraced outlier is "
+        "invisible here",
     )
     sel.add_argument(
         "--incident", type=int, metavar="N",

@@ -439,10 +439,15 @@ class ServingStats:
 
     @staticmethod
     def _span_label(event: dict) -> str:
+        # engine AND run: every ServeEngine stamps engine="serve", so
+        # two serve-bench processes appending to one job stream would
+        # otherwise merge into a single span whose idle gap between the
+        # runs swamps the aggregate (the same failure mode the per-run
+        # keying already fixed for engine-less decode smokes)
         engine = event.get("engine")
-        if engine:
-            return str(engine)
         run = event.get("run")
+        if engine:
+            return f"{engine}:{run}" if run else str(engine)
         return f"run:{run}" if run else "decode"
 
     def observe(self, event: dict) -> None:

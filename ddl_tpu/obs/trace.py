@@ -20,9 +20,14 @@ Span sources (the span model ARCHITECTURE.md documents):
 * **native trace events** — ``trace_span``/``trace_mark`` kinds, emitted
   where causality is not reconstructable from aggregate events: the
   serving request path (``serve/engine.py``: request root, queue wait,
-  prefill, every ridden decode dispatch; ``serve/admission.py``: shed).
-  Ids are deterministic paths (``<req>/req``, ``<req>/queue``,
-  ``<req>/d<seq>``) — no RNG, so traces are reproducible.
+  prefill — one span per chunk under chunked prefill — every ridden
+  decode dispatch; ``serve/admission.py``: shed).  Ids are
+  deterministic paths (``<req>/req``, ``<req>/queue``, ``<req>/d<seq>``)
+  — no RNG, so traces are reproducible.  At production request volumes
+  set ``DDL_OBS_TRACE_SAMPLE=N`` to emit spans for 1-in-N requests
+  (deterministic by request sequence number, not an RNG draw — a
+  replay samples the same requests); ``--slowest-request`` then
+  selects over the sampled subset only.
 * **derived spans** — existing kinds lifted into spans by this builder:
   step phases (``span`` events: t0 = ts - dur), barrier joins
   (``coord_barrier``: arrive_ts -> completed_ts), relaunch-to-first-step

@@ -169,6 +169,20 @@ def build_frame(fold, job_id: str, now: float | None = None) -> str:
                 f"admission: {admit} admitted, {shed} shed, "
                 f"{retire} retired{pool}"
             )
+            sv = s.get("serve") or {}
+            if sv.get("prefix_hits") or sv.get("cached_tokens"):
+                rate = sv.get("prefix_hit_rate")
+                lines.append(
+                    f"prefix cache: {sv['prefix_hits']} hit(s), "
+                    f"{sv['cached_tokens']} cached / "
+                    f"{sv['prefill_tokens']} computed prompt tokens"
+                    + (f" ({rate:.0%} hit rate)" if rate is not None
+                       else "")
+                    + (
+                        f", {kv['cached']} block(s) cached"
+                        if kv and kv.get("cached") is not None else ""
+                    )
+                )
 
     rl = s.get("restart_latency")
     if rl:

@@ -6,17 +6,26 @@ package is the layer between the model and concurrent users:
 
 * ``kv_pool``   — block-granular KV slots: fixed device pools per layer
                   (``init_kv_cache``'s fused layouts chopped along the
-                  sequence dim), a host-side ``BlockAllocator`` with
-                  allocate/free/defrag, per-request block tables.
-* ``scheduler`` — the continuous batch: lanes, admit/retire, worst-case
-                  block reservation (admitted requests always finish).
+                  sequence dim), a host-side refcounted
+                  ``BlockAllocator`` with allocate/share/free/defrag +
+                  LRU-evictable cached blocks, the content-keyed
+                  ``PrefixIndex`` (shared prompt prefixes are shared
+                  blocks), per-request block tables.
+* ``scheduler`` — the continuous batch: lanes, admit/retire,
+                  reservation split into shared-prefix + private blocks
+                  (admitted requests always finish; admission charges
+                  only the private demand).
 * ``admission`` — bounded queue + shed policies (reject-new /
                   shed-oldest) with ``serve_shed`` obs events.
-* ``engine``    — the two XLA programs (bucketed single-request
-                  prefill+first-token; one static-shape batched decode
-                  step over gathered block tables) and the serving loop.
+* ``engine``    — the XLA program families (bucketed single-request
+                  prefill+first-token; chunked prefill continuing a
+                  pool-resident context; one static-shape batched
+                  decode step over gathered block tables) and the
+                  serving loop.
 * ``bench``     — ``ddl_tpu serve-bench``: N synthetic concurrent
-                  clients, percentile report, sequential baseline.
+                  clients, a scenario matrix (shared-prefix /
+                  long-prompt / bursty / mixed), percentile report,
+                  bit-exact sequential comparison.
 
 Grounded in the Gemma-on-TPU serving comparison (PAPERS.md): batched
 TPU serving throughput is won or lost in the scheduler and KV-cache
@@ -25,13 +34,14 @@ management, not the matmuls.
 
 from ddl_tpu.serve.admission import AdmissionController
 from ddl_tpu.serve.engine import ServeEngine, make_serve_step_fns
-from ddl_tpu.serve.kv_pool import BlockAllocator, init_kv_pool
+from ddl_tpu.serve.kv_pool import BlockAllocator, PrefixIndex, init_kv_pool
 from ddl_tpu.serve.scheduler import ContinuousScheduler, Request
 
 __all__ = [
     "AdmissionController",
     "BlockAllocator",
     "ContinuousScheduler",
+    "PrefixIndex",
     "Request",
     "ServeEngine",
     "init_kv_pool",

@@ -78,8 +78,9 @@ class AdmissionController:
             )
             # terminal causal mark: a shed request's trace ends here,
             # not at a retire (obs/trace.py renders it as the trace's
-            # final instant)
-            if self.trace:
+            # final instant); the request's 1-in-N sampling decision
+            # (Request.traced) applies here too
+            if self.trace and getattr(req, "traced", True):
                 self.obs.emit(
                     "trace_mark",
                     trace=req.id,
@@ -117,6 +118,13 @@ class AdmissionController:
         self.queue.append(req)
         self.admitted += 1
         return "queued_shed_oldest"
+
+    def shed_request(self, req: Request, reason: str) -> None:
+        """Shed an ALREADY-POPPED request (engine drain loop: a queued
+        head whose cached prefix was evicted may no longer ever fit —
+        parking it would livelock the requests behind it).  Same event/
+        callback path as a queue-policy shed."""
+        self._emit_shed(req, reason)
 
     def peek(self) -> Request | None:
         return self.queue[0] if self.queue else None

@@ -32,12 +32,25 @@ Two XLA programs, generalizing the PR-5 token-exact prefill/decode split
   requests don't pay attention over the whole pool) — bounding the
   program count at ``log2(max_steps) * log2(max_blocks_per_seq)``.
 
+* **chunk prefill** (round 17, one program per (chunk-bucket, view
+  width, mode)): prompt rows computed against context already IN the
+  pool — written by an earlier chunk of the same request, or by a
+  different request entirely via the prefix cache
+  (``kv_pool.PrefixIndex``: shared prompt prefixes are refcount-shared
+  block-table entries, prefill starts at the first uncached token).
+  Chunks interleave with decode dispatches in the scheduler loop, so a
+  32k prompt cannot stall admission behind its prefill.
+
 Token-exactness: per lane, the program sequence (prefill logits at the
 true prompt end -> sample -> forward -> sample ...) is the same program
 sequence ``make_lm_generator`` runs for a single request, over the same
 attention math — the engine with N concurrent clients produces
 bit-identical tokens to N sequential decodes
-(tests/test_serve.py::test_engine_matches_sequential_decode).
+(tests/test_serve.py::test_engine_matches_sequential_decode), and the
+prefix cache / chunked prefill change scheduling and footprint, never
+tokens (tests/test_serve_prefix.py; the one documented exception is
+int8 prefix REUSE, which attends the lossy stored rows — see
+``ServeEngine.__init__``'s ``prefix_cache`` comment).
 
 Sharding: lanes over ``data`` (the decode batch is the serving batch),
 heads over ``model`` inside the program via the training rule table,
@@ -47,6 +60,7 @@ pool blocks over ``seq`` (the paged sequence dim) — validated by the
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque, namedtuple
 from time import perf_counter
@@ -82,10 +96,12 @@ from ddl_tpu.parallel.sharding import (
 from ddl_tpu.serve.admission import AdmissionController
 from ddl_tpu.serve.kv_pool import (
     BlockAllocator,
+    PrefixIndex,
     apply_block_permutation,
     blocks_for,
     cache_write_token,
     init_kv_pool,
+    pool_copy_block,
     pool_gather,
     pool_write_token,
     pool_write_prefill,
@@ -292,10 +308,20 @@ class ServeDecode(nn.Module):
 
 ServeStepFns = namedtuple(
     "ServeStepFns",
-    ["prefill_for", "decode_for", "mesh", "contract", "cfg",
+    ["prefill_for", "chunk_for", "decode_for", "mesh", "contract", "cfg",
      "block_size", "num_blocks", "max_batch", "max_blocks_per_seq",
      "kv_quant", "init_pools"],
 )
+
+# Minimum gathered-view rows for the CHUNK prefill programs (Tq > 1
+# masked attention over a pool view).  Empirically (probed on this
+# runtime, pinned by the bit-identity e2es): masked cached attention
+# reproduces the fused causal prefill bit-for-bit at every probed view
+# width >= 64 rows, while 16/32-row views drift at ~1e-6 — enough to
+# flip a near-tie argmax.  Chunk programs therefore gather at least
+# this many rows; single-token decode (Tq == 1) is bit-stable at every
+# width and keeps its tight view.
+MIN_CHUNK_VIEW_ROWS = 64
 
 
 def make_serve_step_fns(
@@ -462,6 +488,83 @@ def make_serve_step_fns(
         _prefill_cache[bucket_len] = prog
         return prog
 
+    chunk_model = LMDecode(cfg)
+    _chunk_cache: dict[tuple[int, int, str], object] = {}
+
+    def _slice_cache(cache, off, span):
+        """Rows [off, off+span) of a gathered contiguous cache — the
+        layout ``pool_write_prefill`` scatters (span static, off traced).
+        QuantKV scale leaves keep the sequence dim LAST."""
+        if isinstance(cache, QuantKV):
+            r = lambda a: lax.dynamic_slice_in_dim(a, off, span, axis=1)
+            s = lambda a: lax.dynamic_slice_in_dim(a, off, span, axis=2)
+            return QuantKV(r(cache.kq), s(cache.ks), r(cache.vq), s(cache.vs))
+        return tuple(
+            lax.dynamic_slice_in_dim(a, off, span, axis=1) for a in cache
+        )
+
+    def chunk_for(cb: int, nmax: int, mode: str = "final"):
+        """The jitted CHUNK prefill program over one request's block
+        table: ``(params, pools, tokens (1, cb), table (nmax,), off,
+        last_index, rng)`` computes prompt rows [off, off+cb) against
+        the already-written context [0, off) gathered from the pool —
+        the continuation of a prefill another program (or another
+        REQUEST, via the prefix cache) started.
+
+        ``off`` is traced, which routes ``LMDecode`` through its
+        masked cached-attention branch (positions/mask derive from the
+        offset); probed bit-identical to the fused offset-0 prefill at
+        every view width >= ``MIN_CHUNK_VIEW_ROWS``.  Chunk starts are
+        ALWAYS block-aligned (a fully-cached prompt re-prefills its
+        whole last block, through copy-on-write, rather than running an
+        unaligned single-row chunk).  Modes:
+
+        * ``"mid"``    — intermediate chunk: scatters its rows into the
+          pool blocks, logits discarded (head over one row).
+        * ``"final"``  — last chunk: scatters rows AND samples the
+          first token at ``last_index`` (same rng split sequence as the
+          one-shot prefill), returning ``(tok0, rng, pools)``.
+
+        ``(cb, nmax, mode)`` all ride power-of-two bucketing, so
+        ``precompile`` still bounds the program set."""
+        if mode not in ("mid", "final"):
+            raise ValueError(f"unknown chunk mode {mode!r}")
+        if cb % block_size:
+            raise ValueError(
+                f"chunk {cb} must be a multiple of block_size {block_size}"
+            )
+        prog = _chunk_cache.get((cb, nmax, mode))
+        if prog is not None:
+            return prog, False
+
+        def _chunk(params, pools, tokens, table, off, last_index, rng):
+            tables = table[None, :]
+            caches = tuple(pool_gather(p, tables) for p in pools)
+            with nn.logical_axis_rules(rules):
+                logits, caches = chunk_model.apply(
+                    {"params": params}, tokens, caches, off,
+                    last_index=last_index if mode != "mid" else 0,
+                )
+            ids = lax.dynamic_slice(
+                table, (off // block_size,), (cb // block_size,)
+            )
+            pools = tuple(
+                pool_write_prefill(
+                    pools[i], _slice_cache(caches[i], off, cb), ids
+                )
+                for i in range(cfg.n_layers)
+            )
+            if mode == "mid":
+                return pools
+            last = logits[0, 0]
+            rng, sub = jax.random.split(rng)
+            tok0 = sample_one(last, sub)
+            return tok0, rng, pools
+
+        prog = jax.jit(_chunk)
+        _chunk_cache[cb, nmax, mode] = prog
+        return prog, True
+
     contract = {
         "in_specs": {"pending": DECODE_TOKEN_SPEC},
         "donate_state": False,
@@ -470,7 +573,8 @@ def make_serve_step_fns(
         "replicated_params_ok": True,
     }
     return ServeStepFns(
-        prefill_for=prefill_for, decode_for=decode_for, mesh=mesh,
+        prefill_for=prefill_for, chunk_for=chunk_for,
+        decode_for=decode_for, mesh=mesh,
         contract=contract, cfg=cfg, block_size=block_size,
         num_blocks=num_blocks, max_batch=max_batch,
         max_blocks_per_seq=max_blocks_per_seq, kv_quant=kv_quant,
@@ -523,6 +627,10 @@ class ServeEngine:
         min_free_blocks: int = 0,
         max_steps_per_dispatch: int = 8,
         defrag_threshold: float | None = None,
+        prefix_cache: bool | str = "auto",
+        prefill_chunk: int | None = None,
+        scenario: str | None = None,
+        trace_sample: int | None = None,
         obs=None,
         trace_requests: bool = True,
         devices=None,
@@ -542,14 +650,50 @@ class ServeEngine:
         # the obs stream, so `obs trace <job> --request ID` reconstructs
         # that one request's timeline.  A handful of events per request
         # on top of the decode/serve_* kinds; operators running at
-        # volumes where that matters turn it off here.
+        # volumes where that matters turn it off here, or keep 1-in-N
+        # via ``trace_sample`` (default: DDL_OBS_TRACE_SAMPLE, else
+        # every request) — deterministic by request sequence number, so
+        # a re-run samples the same requests.
         self.trace_requests = bool(trace_requests)
+        if trace_sample is None:
+            try:
+                trace_sample = int(
+                    os.environ.get("DDL_OBS_TRACE_SAMPLE") or 1
+                )
+            except ValueError:
+                trace_sample = 1
+        self.trace_sample = max(1, int(trace_sample))
         self.defrag_threshold = defrag_threshold
+        # prefix caching: "auto" enables it for lossless (non-int8)
+        # pools only.  An int8 pool stores K/V lossily, so a reused
+        # prefix is attended at quantization precision while a fresh
+        # prefill attends the raw activations — prefix reuse there is
+        # within int8 tolerance, not bit-identical, and must be an
+        # explicit opt-in (documented in ARCHITECTURE.md).
+        if prefix_cache == "auto":
+            prefix_cache = not kv_quant
+        self.prefix = PrefixIndex(block_size) if prefix_cache else None
+        # chunked prefill: a prompt longer than this runs as multiple
+        # bounded chunk programs interleaved with decode dispatches in
+        # the scheduler loop, so one 32k prompt cannot stall admission.
+        if prefill_chunk is not None:
+            if (
+                prefill_chunk < block_size
+                or prompt_bucket(prefill_chunk, block_size) != prefill_chunk
+            ):
+                raise ValueError(
+                    f"prefill_chunk must be a power-of-two multiple of "
+                    f"block_size {block_size}, got {prefill_chunk}"
+                )
+        self.prefill_chunk = prefill_chunk
+        self.scenario = scenario
         self.pools = self.fns.init_pools()
         self.allocator = BlockAllocator(num_blocks, block_size)
+        if self.prefix is not None:
+            self.allocator.on_evict = self.prefix.forget_block
         self.scheduler = ContinuousScheduler(
             self.allocator, max_batch, self.fns.max_blocks_per_seq,
-            min_free_blocks=min_free_blocks,
+            min_free_blocks=min_free_blocks, prefix_index=self.prefix,
         )
         self.admission = AdmissionController(
             max_queue=max_queue, policy=policy, obs=obs,
@@ -572,10 +716,13 @@ class ServeEngine:
         self.request_log: deque = deque(maxlen=65536)
         self._rngs = jnp.zeros((max_batch, 2), jnp.uint32)
         self._req_counter = 0
+        self._cow_prog = None  # lazily-jitted pool_copy_block
         self.stats = {
             "submitted": 0, "completed": 0, "shed": 0,
             "prefill_compiles": 0, "decode_compiles": 0,
             "decode_steps": 0, "decode_dispatches": 0, "peak_blocks": 0,
+            "prefix_hits": 0, "prefix_hit_tokens": 0, "prefix_inserts": 0,
+            "prefill_tokens": 0, "prefill_chunks": 0, "cow_copies": 0,
         }
         self._compiled_buckets: set[int] = set()
 
@@ -588,6 +735,7 @@ class ServeEngine:
         ``AdmissionController.offer``)."""
         if request_id is None:
             request_id = f"r{self._req_counter:05d}"
+        seq = self._req_counter
         self._req_counter += 1
         req = Request(
             id=request_id,
@@ -597,6 +745,12 @@ class ServeEngine:
                 perf_counter() if submitted_at is None else submitted_at
             ),
             rng_seed=rng_seed,
+            # 1-in-N trace sampling, deterministic by request sequence
+            # number (NOT an RNG draw): request k is traced iff
+            # k % trace_sample == 0, so re-runs and replays sample the
+            # same requests and `obs trace --slowest-request` selects
+            # over a stable subset
+            traced=self.trace_requests and seq % self.trace_sample == 0,
         )
         self.stats["submitted"] += 1
         outcome = self.admission.offer(
@@ -618,13 +772,15 @@ class ServeEngine:
     # -- engine iteration -------------------------------------------------
     def _emit_trace_span(
         self, name: str, t0_pc: float, t1_pc: float, *,
-        trace: str, span: str, parent: str | None, **args,
+        trace: str, span: str, parent: str | None, traced: bool = True,
+        **args,
     ) -> None:
         """One completed causal span into the obs stream.  Engine timing
         runs on ``perf_counter``; trace consumers need wall clock (spans
         merge across hosts through the clock-offset fit), so both stamps
-        are mapped through the current (wall, perf_counter) pair."""
-        if self.obs is None or not self.trace_requests:
+        are mapped through the current (wall, perf_counter) pair.
+        ``traced`` carries the request's 1-in-N sampling decision."""
+        if self.obs is None or not self.trace_requests or not traced:
             return
         wall, pc = time.time(), perf_counter()
         self.obs.emit(
@@ -682,9 +838,11 @@ class ServeEngine:
                 ),
                 end,
                 trace=req.id, span=f"{req.id}/req", parent=None,
+                traced=req.traced,
                 request_id=req.id, lane=state.lane,
                 prompt_len=req.prompt_len, new_tokens=len(state.outputs),
                 dispatches=len(state.dispatches), outcome="ok",
+                cached_tokens=state.cached_tokens,
             )
             if self.obs is not None:
                 self.obs.emit("decode", **record)
@@ -698,13 +856,84 @@ class ServeEngine:
                 )
                 self._emit_pool_stats()
 
-    def _admit_one(self, req: Request) -> None:
-        state = self.scheduler.try_admit(req)
+    def _admit_one(
+        self, req: Request, shared: list[int] | None = None
+    ) -> None:
+        state = self.scheduler.try_admit(req, shared)
         assert state is not None  # caller checked can_admit
+        fns = self.fns
+        t0 = perf_counter()
+        state.admitted_at = t0
+        # the pool peak is set at ADMISSION (the reservation just
+        # happened) — a chunked lane's _finish_prefill runs many steps
+        # later, by which time co-resident lanes may have retired
+        self.stats["peak_blocks"] = max(
+            self.stats["peak_blocks"], self.allocator.used_blocks
+        )
+        if state.cached_tokens:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_hit_tokens"] += state.cached_tokens
+            if self.obs is not None:
+                self.obs.emit(
+                    "prefix_hit",
+                    request_id=req.id,
+                    cached_tokens=state.cached_tokens,
+                    blocks=state.shared_blocks,
+                    prompt_len=req.prompt_len,
+                )
+        # chunked prefill engages when the prompt continues a cached
+        # prefix (start at the first uncached token) or exceeds the
+        # chunk bound; otherwise the original single-program bucketed
+        # prefill runs inline — byte-identical program sequence to the
+        # pre-prefix-cache engine
+        chunked = state.prefill_pos > 0 or (
+            self.prefill_chunk is not None
+            and req.prompt_len > self.prefill_chunk
+        )
+        if not chunked:
+            self._full_prefill(state, t0)
+        # chunk programs run one per scheduler iteration
+        # (_advance_prefill), interleaved with decode dispatches, so a
+        # long prompt never monopolizes the loop
+        if req.submitted_at is not None and req.submitted_at < t0:
+            self._emit_trace_span(
+                "queue", req.submitted_at, t0,
+                trace=req.id, span=f"{req.id}/queue",
+                parent=f"{req.id}/req", traced=req.traced,
+                request_id=req.id,
+            )
+        if self.obs is not None:
+            self.obs.emit(
+                "serve_admit",
+                request_id=req.id,
+                lane=state.lane,
+                bucket=prompt_bucket(req.prompt_len, fns.block_size),
+                prompt_len=req.prompt_len,
+                max_new=req.max_new,
+                blocks=len(state.block_ids),
+                cached_tokens=state.cached_tokens,
+                prefill_tokens=req.prompt_len - state.cached_tokens,
+                queue_delay=(
+                    max(0.0, t0 - req.submitted_at)
+                    if req.submitted_at is not None else 0.0
+                ),
+                # for an inline full prefill this is ITS compile flag;
+                # a chunked admission hasn't run any program yet, so
+                # chunked=True tells consumers to read per-chunk
+                # compile flags off the prefill trace spans instead
+                compiled=state.cold,
+                chunked=chunked,
+                **({"scenario": self.scenario} if self.scenario else {}),
+            )
+            self._emit_pool_stats()
+
+    def _full_prefill(self, state, t0: float) -> None:
+        """The original whole-prompt bucketed prefill, run inline at
+        admission (short prompts with no cached prefix)."""
+        req = state.request
         fns = self.fns
         bucket = prompt_bucket(req.prompt_len, fns.block_size)
         first_use = bucket not in self._compiled_buckets
-        t0 = perf_counter()
         prog = fns.prefill_for(bucket)
         prompt = np.zeros((1, bucket), np.int32)
         prompt[0, : req.prompt_len] = req.prompt
@@ -719,7 +948,6 @@ class ServeEngine:
                 jnp.asarray(ids), jnp.int32(req.prompt_len), rng,
             )
         tok0 = int(tok0)  # fences the first token: a REAL TTFT
-        ttft = perf_counter() - t0
         # compile detection by executable count, not first-build: the
         # same program compiles AGAIN on its second call when the pools
         # go from fresh to committed (precompile's two-pass rationale) —
@@ -731,56 +959,209 @@ class ServeEngine:
         self._compiled_buckets.add(bucket)
         if compiled:
             self.stats["prefill_compiles"] += 1
-        state.admitted_at = t0
-        state.ttft_s = ttft
+        self.stats["prefill_tokens"] += req.prompt_len
+        self._emit_trace_span(
+            "prefill", t0, perf_counter(),
+            trace=req.id, span=f"{req.id}/prefill",
+            parent=f"{req.id}/req", traced=req.traced,
+            request_id=req.id, lane=state.lane,
+            bucket=bucket, compiled=compiled,
+        )
+        self._finish_prefill(state, tok0, rng, cold=compiled)
+
+    def _finish_prefill(self, state, tok0: int, rng, cold: bool) -> None:
+        """Common prefill completion: first token recorded (the TTFT
+        fence already happened), rng parked in the lane slot, prompt
+        blocks registered in the prefix index."""
+        req = state.request
+        state.ttft_s = perf_counter() - state.admitted_at
         state.pending_tok = tok0
         state.outputs.append(tok0)
-        # cold (percentile-excluded) if the prefill bucket compiled; a
+        # cold (percentile-excluded) if any prefill program compiled; a
         # first-use decode program additionally cold-marks every lane in
         # that chunk (_decode_batch)
-        state.cold = compiled
+        state.cold = state.cold or cold
+        state.prefill_done = True
+        state.prefill_pos = req.prompt_len
+        state.length = req.prompt_len
         if state.done:
             state.finished_at = perf_counter()
         self._rngs = self._rngs.at[state.lane].set(rng)
         self.stats["peak_blocks"] = max(
             self.stats["peak_blocks"], self.allocator.used_blocks
         )
-        if req.submitted_at is not None and req.submitted_at < t0:
-            self._emit_trace_span(
-                "queue", req.submitted_at, t0,
-                trace=req.id, span=f"{req.id}/queue",
-                parent=f"{req.id}/req", request_id=req.id,
+        if self.prefix is not None:
+            n = self.prefix.insert(
+                req.prompt, state.block_ids, self.allocator,
+                keys=req.chain_keys,
             )
-        self._emit_trace_span(
-            "prefill", t0, perf_counter(),
-            trace=req.id, span=f"{req.id}/prefill",
-            parent=f"{req.id}/req", request_id=req.id, lane=state.lane,
-            bucket=bucket, compiled=compiled,
+            if n:
+                self.stats["prefix_inserts"] += n
+                if self.obs is not None:
+                    self.obs.emit(
+                        "prefix_insert",
+                        request_id=req.id,
+                        blocks=n,
+                        tokens=n * self.fns.block_size,
+                    )
+
+    # -- chunked prefill --------------------------------------------------
+    def _view_blocks(self, n_blocks: int) -> int:
+        """Block-table width for a chunk program over an ``n_blocks``
+        reservation: rounded up to a power of two, floored at
+        MIN_CHUNK_VIEW_ROWS rows (the bit-identity clamp — see the
+        constant's comment), capped by the engine envelope.  The ONE
+        width formula: ``precompile`` walks reservations through this
+        same helper, so the precompiled grid always matches runtime."""
+        fns = self.fns
+        vmin = pow2_at_least(blocks_for(
+            max(MIN_CHUNK_VIEW_ROWS, fns.block_size), fns.block_size
+        ))
+        return min(
+            fns.max_blocks_per_seq, max(pow2_at_least(n_blocks), vmin)
         )
+
+    def _chunk_view_blocks(self, state) -> int:
+        return self._view_blocks(len(state.block_ids))
+
+    def _cow(self, state, block_index: int) -> None:
+        """Copy-on-write: the lane is about to write into a block other
+        tables (or the prefix index) still need — give it a private
+        bit-identical copy first.  The copy target was pre-allocated at
+        admission when the trigger was known (fully-cached prompt);
+        otherwise one block is drawn from the pool."""
+        src = state.block_ids[block_index]
+        if state.cow_block is not None:
+            dst, state.cow_block = state.cow_block, None
+        else:  # structurally unreachable today; guard stays honest
+            dst = self.allocator.alloc(1)[0]
+        if self._cow_prog is None:
+            self._cow_prog = jax.jit(pool_copy_block)
+        with jax.set_mesh(self.fns.mesh):
+            self.pools = self._cow_prog(
+                self.pools, jnp.int32(src), jnp.int32(dst)
+            )
+        state.block_ids[block_index] = dst
+        self.allocator.free([src])  # drop this lane's share of the original
+        self.stats["cow_copies"] += 1
         if self.obs is not None:
             self.obs.emit(
-                "serve_admit",
-                request_id=req.id,
-                lane=state.lane,
-                bucket=bucket,
-                prompt_len=req.prompt_len,
-                max_new=req.max_new,
-                blocks=len(state.block_ids),
-                queue_delay=(
-                    max(0.0, t0 - req.submitted_at)
-                    if req.submitted_at is not None else 0.0
-                ),
-                compiled=compiled,
+                "kv_cow_copy",
+                request_id=state.request.id,
+                src=src, dst=dst, block_index=block_index,
             )
-            self._emit_pool_stats()
+
+    def _advance_prefill(self) -> None:
+        """Run ONE chunk of the oldest still-prefilling lane — the
+        scheduler-loop interleaving that bounds how long any prompt can
+        keep the batched decode dispatch waiting."""
+        lanes = [
+            s for s in self.scheduler.active() if not s.prefill_done
+        ]
+        if not lanes:
+            return
+        self._prefill_chunk(min(lanes, key=lambda s: s.admitted_at))
+
+    def _prefill_chunk(self, state) -> None:
+        fns = self.fns
+        req = state.request
+        p = req.prompt_len
+        off = state.prefill_pos
+        remaining = p - off
+        c = min(
+            remaining,
+            self.prefill_chunk if self.prefill_chunk else remaining,
+        )
+        cb = prompt_bucket(c, fns.block_size)
+        nmax_rows = self._chunk_view_blocks(state) * fns.block_size
+        # the bucket rounds the chunk UP, and a late start can push
+        # the padded end past the gathered view (e.g. a 17-token
+        # tail at off 40 buckets to 32 rows against a 64-row view:
+        # 72 > 64).  dynamic_slice would then CLAMP the start and
+        # silently read/write the wrong rows — shrink the chunk so
+        # the padded span fits; the remainder runs as another chunk
+        while off + cb > nmax_rows:
+            cb //= 2
+        assert cb >= fns.block_size, (off, cb, nmax_rows)
+        c = min(c, cb)
+        mode = "final" if off + c >= p else "mid"
+        # write-path CoW guard: the span scatter targets only the
+        # lane's private tail by construction (chunk starts are
+        # block-aligned past the shared prefix), EXCEPT the fully-
+        # cached recompute of the last shared block — any block that
+        # is still shared or index-registered gets a private
+        # bit-identical copy before being written (the scheduler
+        # pre-allocated the copy target as state.cow_block)
+        for bi in range(
+            off // fns.block_size,
+            min(-(-(off + cb) // fns.block_size), len(state.block_ids)),
+        ):
+            bid = state.block_ids[bi]
+            if (
+                self.allocator.refcount(bid) > 1
+                or self.allocator.is_indexed(bid)
+            ):
+                self._cow(state, bi)
+        final = mode != "mid"
+        nmax = self._chunk_view_blocks(state)
+        tokens = np.zeros((1, cb), np.int32)
+        tokens[0, :c] = req.prompt[off:off + c]
+        table = np.full((nmax,), fns.num_blocks, np.int32)
+        n = min(nmax, len(state.block_ids))
+        table[:n] = state.block_ids[:n]
+        t0 = perf_counter()
+        prog, built = fns.chunk_for(cb, nmax, mode)
+        before = _jit_compiles(prog)
+        rng = jax.random.PRNGKey(req.rng_seed)
+        with jax.set_mesh(fns.mesh):
+            out = prog(
+                self.params, self.pools, jnp.asarray(tokens),
+                jnp.asarray(table), jnp.int32(off),
+                jnp.int32(c - 1), rng,
+            )
+        if final:
+            tok0, rng, self.pools = out
+            tok0 = int(tok0)  # fences the first token: a REAL TTFT
+        else:
+            self.pools = out
+            jax.block_until_ready(
+                self.pools[0].kq
+                if isinstance(self.pools[0], QuantKV) else self.pools[0][0]
+            )
+        compiled = (
+            _jit_compiles(prog) != before if before is not None else built
+        )
+        if compiled:
+            self.stats["prefill_compiles"] += 1
+            state.cold = True
+        self.stats["prefill_tokens"] += c
+        self.stats["prefill_chunks"] += 1
+        chunk_idx = state.prefill_chunks
+        state.prefill_chunks += 1
+        state.prefill_pos = off + c
+        self._emit_trace_span(
+            "prefill", t0, perf_counter(),
+            trace=req.id, span=f"{req.id}/p{chunk_idx}",
+            parent=f"{req.id}/req", traced=req.traced,
+            request_id=req.id, lane=state.lane,
+            bucket=cb, chunk=chunk_idx, offset=off, compiled=compiled,
+            mode=mode,
+        )
+        if final:
+            self._finish_prefill(state, tok0, rng, cold=compiled)
 
     def _decode_batch(self) -> None:
         fns = self.fns
         # a lane can be done straight out of admission (max_new=1: the
         # prefill's sampled token IS the whole output, finished_at set
-        # in _admit_one) — it waits for the next retire pass and must
-        # not enter the chunk-length min below (remaining would be 0)
-        active = [s for s in self.scheduler.active() if not s.done]
+        # at prefill completion) — it waits for the next retire pass and
+        # must not enter the chunk-length min below (remaining would be
+        # 0).  Lanes still mid-chunked-prefill have no pending token yet
+        # and sit the dispatch out too.
+        active = [
+            s for s in self.scheduler.active()
+            if s.prefill_done and not s.done
+        ]
         if not active:
             return
         # chunk length: fuse up to max_steps_per_dispatch single-token
@@ -839,7 +1220,7 @@ class ServeEngine:
             self._emit_trace_span(
                 "decode", t0, now,
                 trace=s.request.id, span=f"{s.request.id}/d{seq}",
-                parent=f"{s.request.id}/req",
+                parent=f"{s.request.id}/req", traced=s.request.traced,
                 request_id=s.request.id, lane=s.lane, dispatch=seq,
                 steps=k, riders=len(active),
             )
@@ -847,13 +1228,34 @@ class ServeEngine:
                 s.finished_at = now
 
     def step(self) -> bool:
-        """One scheduler iteration; False when fully drained."""
+        """One scheduler iteration; False when fully drained.  Order:
+        retire -> admit -> ONE prefill chunk -> one batched decode
+        dispatch — chunked prefills and decode interleave, so a long
+        prompt stalls the decode batch for at most one bounded chunk
+        per iteration instead of its whole prefill."""
         self._retire_finished()
         while self.admission.queue:
             head = self.admission.peek()
-            if not self.scheduler.can_admit(head):
+            # ONE chain-hash lookup per head per iteration, threaded
+            # through fits/can_admit/admit (hashing a parked 32k prompt
+            # three times per scheduler tick would tax the loop that
+            # chunked prefill exists to keep responsive)
+            shared = self.scheduler.cached_prefix(head)
+            if not self.scheduler.fits_ever(head, len(shared)):
+                # defensive re-check: under the CURRENT accounting
+                # fits_ever is invariant to cache eviction (sharing
+                # never changes a request's total residency), so a head
+                # that passed at offer time cannot fail here.  The
+                # guard stays because a future admission-policy change
+                # that breaks the invariant would otherwise park the
+                # head forever and livelock the drain loop behind it.
+                self.admission.shed_request(self.admission.pop(), "too_large")
+                self.stats["shed"] += 1
+                continue
+            if not self.scheduler.can_admit(head, shared):
                 break
-            self._admit_one(self.admission.pop())
+            self._admit_one(self.admission.pop(), shared)
+        self._advance_prefill()
         if self.scheduler.active():
             self._decode_batch()
         if (
@@ -895,10 +1297,10 @@ class ServeEngine:
         actually hits.  Every dummy block id is out of range, so pool
         writes drop and the pool CONTENT is untouched (the committed
         arrays are kept, matching the steady-state signature).
-        Returns ``{"prefill": n, "decode": m}`` newly-compiled counts
-        (also recorded in ``stats['precompiled_*']``)."""
+        Returns ``{"prefill": n, "decode": m, "chunk": c}``
+        newly-compiled counts (also in ``stats['precompiled_*']``)."""
         fns = self.fns
-        compiled = {"prefill": 0, "decode": 0}
+        compiled = {"prefill": 0, "decode": 0, "chunk": 0}
         top_bucket = prompt_bucket(max(1, max_prompt_len), fns.block_size)
         buckets = []
         b = fns.block_size
@@ -906,6 +1308,12 @@ class ServeEngine:
             buckets.append(b)
             b *= 2
         buckets.append(top_bucket)
+        if self.prefill_chunk is not None:
+            # prompts longer than the chunk bound run as chunk programs,
+            # never through a whole-prompt prefill bucket — don't pay
+            # those compiles
+            full_cap = prompt_bucket(self.prefill_chunk, fns.block_size)
+            buckets = [b for b in buckets if b <= full_cap]
         # decode grid FIRST: the decode jit pins the pending-token
         # sharding, so its outputs are committed regardless of input
         # state — after one feedback pass ``self.pools``/rngs are
@@ -973,11 +1381,76 @@ class ServeEngine:
             self._rngs = self._rngs.at[0].set(out[1])
             self._compiled_buckets.add(bucket)
             compiled["prefill"] += 1
+        # chunk-prefill programs: reachable when prompts can continue a
+        # cached prefix (prefix cache on) or exceed the chunk bound.
+        # View widths ride the same reservation-derived grid as decode,
+        # floored at the MIN_CHUNK_VIEW_ROWS clamp.
+        modes = []
+        if self.prefill_chunk is not None or self.prefix is not None:
+            # "mid" is reachable WITHOUT a chunk bound too: the view
+            # clamp in _prefill_chunk can shrink a prefix-hit tail
+            # below its remainder, leaving a mid chunk to finish it
+            modes = ["mid", "final"]
+        if modes:
+            vmaxes = sorted({
+                self._view_blocks(n) for n in range(1, max_blocks + 1)
+            })
+            cap = (
+                min(self.prefill_chunk, top_bucket)
+                if self.prefill_chunk else top_bucket
+            )
+            cbs = [b for b in buckets if b <= cap] or [fns.block_size]
+            for nmax in vmaxes:
+                t = jnp.full((nmax,), fns.num_blocks, jnp.int32)
+                for mode in modes:
+                    for cb in cbs:
+                        if cb > nmax * fns.block_size:
+                            # a chunk never outgrows its own view: the
+                            # runtime width covers the lane's WHOLE
+                            # reservation (>= off + cb rows)
+                            continue
+                        prog, built = fns.chunk_for(cb, nmax, mode)
+                        if not built:
+                            continue
+                        for _ in range(2):
+                            with jax.set_mesh(fns.mesh):
+                                # a FRESH PRNGKey per call, like the real
+                                # chunk dispatches (threading the rng
+                                # output back in would precompile a
+                                # committed-rng signature the runtime
+                                # never presents)
+                                out = prog(
+                                    self.params, self.pools,
+                                    jnp.zeros((1, cb), jnp.int32), t,
+                                    jnp.int32(0), jnp.int32(0),
+                                    jax.random.PRNGKey(0),
+                                )
+                            if mode == "mid":
+                                self.pools = out
+                                jax.block_until_ready(
+                                    self.pools[0].kq
+                                    if isinstance(self.pools[0], QuantKV)
+                                    else self.pools[0][0]
+                                )
+                            else:
+                                jax.block_until_ready(out[0])
+                                self.pools = out[2]
+                        compiled["chunk"] += 1
+            if self.prefix is not None and self._cow_prog is None:
+                # the CoW copy program: src == dst is a content no-op
+                self._cow_prog = jax.jit(pool_copy_block)
+                last = jnp.int32(fns.num_blocks - 1)
+                for _ in range(2):
+                    with jax.set_mesh(fns.mesh):
+                        self.pools = self._cow_prog(self.pools, last, last)
         self.stats["precompiled_prefill"] = (
             self.stats.get("precompiled_prefill", 0) + compiled["prefill"]
         )
         self.stats["precompiled_decode"] = (
             self.stats.get("precompiled_decode", 0) + compiled["decode"]
+        )
+        self.stats["precompiled_chunk"] = (
+            self.stats.get("precompiled_chunk", 0) + compiled["chunk"]
         )
         return compiled
 
@@ -989,12 +1462,19 @@ class ServeEngine:
         ``precompile``) — a single pass would leave the second compile
         inside the first timed request."""
         prev_trace, self.trace_requests = self.trace_requests, False
+        # the synthetic prompt must not enter the prefix index (a real
+        # request could hit its blocks) nor hit it (the second warmup
+        # pass would take the cached path instead of re-driving the full
+        # prefill program it exists to warm)
+        prev_prefix = self.prefix
+        self.prefix = self.scheduler.prefix_index = None
         try:
             self._warmup_requests(prompt_len, max_new)
         finally:
             # the synthetic request must not become a trace (it would
             # win --slowest-request on its compile time every smoke)
             self.trace_requests = prev_trace
+            self.prefix = self.scheduler.prefix_index = prev_prefix
 
     def _warmup_requests(self, prompt_len: int, max_new: int) -> None:
         for _ in range(2):
@@ -1025,6 +1505,9 @@ class ServeEngine:
             self.pools, plan, self.fns.num_blocks
         )
         self.scheduler.remap_blocks(plan)
+        if self.prefix is not None:
+            # cached (evictable) blocks move too — the index follows
+            self.prefix.remap(plan)
         self.allocator.commit_plan(plan)
         self._emit_pool_stats(defrag=True)
         return True

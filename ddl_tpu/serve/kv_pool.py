@@ -23,6 +23,19 @@ into fixed-size blocks:
   feeds the unmodified cached-attention cores (``ops.quant.kv_attend``
   — einsum or the Pallas one-pass kernel with a per-lane bias row).
 
+Prefix caching (round 17): blocks carry **refcounts** so several
+requests' block tables can point at the same physical block read-only —
+thousands of requests sharing a system prompt share its K/V blocks
+instead of each recomputing and re-storing them.  The ``PrefixIndex``
+keys blocks by a chain hash of the token-id prefix at block granularity;
+a block whose last owner retires keeps its content and parks in an LRU
+**evictable** set (still indexed, reclaimed only under allocation
+pressure), so the cache survives between bursts at zero steady-state
+cost.  Decode appends only ever write a request's private tail blocks,
+so sharing is copy-free in steady state; the one write a shared block
+can see (recomputing the final prompt token of a fully-cached
+block-aligned prompt) goes through ``pool_copy_block`` copy-on-write.
+
 Sharding: the pool's block dim is the sequence dim chopped up, so it
 carries the ``act_seq`` logical axis (context-parallel serving shards
 the pool over ``seq``); the fused feature dim keeps ``act_heads``
@@ -32,16 +45,22 @@ probe (``analysis/contracts.py``).
 
 from __future__ import annotations
 
+import bisect
+import hashlib
+
 import jax.numpy as jnp
+import numpy as np
 
 from ddl_tpu.ops.quant import QuantKV, quantize_q8
 
 __all__ = [
     "BlockAllocator",
     "PoolExhausted",
+    "PrefixIndex",
     "blocks_for",
     "cache_write_token",
     "init_kv_pool",
+    "pool_copy_block",
     "pool_gather",
     "pool_write_prefill",
     "pool_write_token",
@@ -63,13 +82,27 @@ class PoolExhausted(RuntimeError):
 
 
 class BlockAllocator:
-    """Host-side free list over the pool's block ids.
+    """Host-side refcounted free list over the pool's block ids.
 
     Lowest-id-first allocation keeps live blocks packed toward the front
     of the pool (gathers touch a compact prefix; ``defrag`` restores the
-    property when interleaved retire/admit churn breaks it).  Invariants
-    (pinned by tests/test_serve.py): a block is never handed out twice,
-    never freed twice, and ``free + in_use == num_blocks`` always.
+    property when interleaved retire/admit churn breaks it).
+
+    Every live block carries a **refcount**: ``alloc`` hands out private
+    blocks at refcount 1, ``share`` lets another request's block table
+    point at an existing block (+1), and ``free`` decrements — a block
+    returns to circulation only when its last owner retires.  A block
+    the ``PrefixIndex`` has registered (``mark_indexed``) does not go
+    back to the free list at refcount 0: it parks in the LRU
+    **evictable** set with its content intact, ready to be ``share``d
+    by the next request with the same prefix, and is reclaimed (oldest
+    first, ``on_evict`` notified so the index forgets it) only when
+    ``alloc`` runs out of free blocks.
+
+    Invariants (pinned by tests/test_serve.py + test_serve_prefix.py):
+    a block is never handed out twice, never freed below refcount 0
+    (double-free raises), never evicted while referenced, and
+    ``free + refcounted + evictable == num_blocks`` always.
     """
 
     def __init__(self, num_blocks: int, block_size: int) -> None:
@@ -81,8 +114,12 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self._free = list(range(num_blocks))  # kept ascending
-        self._used: set[int] = set()
+        self._refs: dict[int, int] = {}  # live block -> refcount >= 1
+        self._evictable: dict[int, None] = {}  # ref==0 indexed blocks, LRU
+        self._indexed: set[int] = set()  # blocks the PrefixIndex holds
+        self.on_evict = None  # callable(block_id): index forget hook
         self.high_water = 0  # max blocks ever simultaneously in use
+        self.evictions = 0
 
     @property
     def free_blocks(self) -> int:
@@ -90,57 +127,150 @@ class BlockAllocator:
 
     @property
     def used_blocks(self) -> int:
-        return len(self._used)
+        """Blocks with a live owner (refcount >= 1)."""
+        return len(self._refs)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Indexed refcount-0 blocks holding reusable prefix content."""
+        return len(self._evictable)
+
+    def refcount(self, block_id: int) -> int:
+        return self._refs.get(block_id, 0)
+
+    def is_indexed(self, block_id: int) -> bool:
+        """Whether the PrefixIndex holds this block (its content must
+        not be overwritten by a live owner — CoW first)."""
+        return block_id in self._indexed
 
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= len(self._free) + len(self._evictable)
 
     def alloc(self, n: int) -> list[int]:
         if n < 1:
             raise ValueError(f"alloc needs n >= 1, got {n}")
-        if n > len(self._free):
+        if not self.can_alloc(n):
             raise PoolExhausted(
-                f"need {n} blocks, {len(self._free)} free of "
-                f"{self.num_blocks}"
+                f"need {n} blocks, {len(self._free)} free + "
+                f"{len(self._evictable)} evictable of {self.num_blocks}"
             )
+        while len(self._free) < n:
+            self._evict_one()
         ids, self._free = self._free[:n], self._free[n:]
-        self._used.update(ids)
-        self.high_water = max(self.high_water, len(self._used))
+        for i in ids:
+            self._refs[i] = 1
+        self.high_water = max(self.high_water, len(self._refs))
         return ids
 
-    def free(self, ids) -> None:
+    def _evict_one(self) -> None:
+        """Reclaim the least-recently-released evictable block: the
+        prefix index forgets it (``on_evict``) and it joins the free
+        list — the LRU-on-refcount-0 watermark eviction.  Insort, not a
+        re-sort: ``alloc`` evicts in a loop, and a long prompt admitted
+        into a pool full of cached blocks (the prefix cache's steady
+        state) would otherwise re-sort the free list once per block."""
+        bid = next(iter(self._evictable))
+        del self._evictable[bid]
+        self._indexed.discard(bid)
+        self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(bid)
+        bisect.insort(self._free, bid)
+
+    def share(self, ids) -> None:
+        """Add one owner to each block: a request's block table now
+        points at it read-only.  Reactivates evictable (cached) blocks;
+        sharing a free block is a bookkeeping bug and raises."""
         ids = list(ids)
-        bad = [i for i in ids if i not in self._used]
+        bad = [
+            i for i in ids if i not in self._refs and i not in self._evictable
+        ]
+        if bad:
+            raise ValueError(
+                f"sharing blocks with no live or cached content: "
+                f"{sorted(bad)}"
+            )
+        for i in ids:
+            if i in self._evictable:
+                del self._evictable[i]
+                self._refs[i] = 1
+            else:
+                self._refs[i] += 1
+        self.high_water = max(self.high_water, len(self._refs))
+
+    def free(self, ids) -> None:
+        """Drop one owner per block.  At refcount 0 an indexed block
+        parks in the evictable set (content kept for the next prefix
+        hit); an unindexed one returns to the free list."""
+        ids = list(ids)
+        bad = [i for i in ids if i not in self._refs]
         if bad:
             raise ValueError(
                 f"freeing blocks not currently allocated: {sorted(bad)}"
             )
-        self._used.difference_update(ids)
-        self._free = sorted(self._free + ids)
+        released = []
+        for i in ids:
+            self._refs[i] -= 1
+            if self._refs[i] == 0:
+                del self._refs[i]
+                if i in self._indexed:
+                    self._evictable[i] = None  # LRU: append on release
+                else:
+                    released.append(i)
+        if released:
+            self._free = sorted(self._free + released)
+
+    def mark_indexed(self, block_id: int) -> None:
+        """The PrefixIndex registered this block: at refcount 0 it will
+        be cached (evictable), not freed."""
+        if block_id not in self._refs and block_id not in self._evictable:
+            raise ValueError(f"indexing a free block: {block_id}")
+        self._indexed.add(block_id)
+
+    def drop_indexed(self, block_id: int) -> None:
+        """Un-index a block (the public inverse of ``mark_indexed``,
+        for an external invalidation path — no in-tree caller today;
+        eviction uses ``_evict_one``): an evictable block returns to
+        the free list immediately."""
+        self._indexed.discard(block_id)
+        if block_id in self._evictable:
+            del self._evictable[block_id]
+            bisect.insort(self._free, block_id)
+
+    def _live(self) -> set[int]:
+        return set(self._refs) | set(self._evictable)
 
     def fragmentation(self) -> float:
-        """Fraction of the live span that is holes: 1 - used/(max_used+1).
-        0.0 when live blocks are packed at the front (or the pool is
-        empty) — the quantity ``defrag`` drives back to zero."""
-        if not self._used:
+        """Fraction of the live span that is holes: 1 - live/(max+1).
+        0.0 when live (refcounted or cached) blocks are packed at the
+        front — the quantity ``defrag`` drives back to zero."""
+        live = self._live()
+        if not live:
             return 0.0
-        span = max(self._used) + 1
-        return 1.0 - len(self._used) / span
+        span = max(live) + 1
+        return 1.0 - len(live) / span
 
     def compaction_plan(self) -> dict[int, int] | None:
-        """old-id -> new-id mapping that packs live blocks to the lowest
-        ids (preserving relative order), or None when already packed.
-        The caller must apply it to the device pools AND every request's
-        block table (``apply_block_permutation``), then ``commit_plan``.
-        """
-        live = sorted(self._used)
+        """old-id -> new-id mapping that packs live AND cached blocks to
+        the lowest ids (preserving relative order), or None when already
+        packed.  The caller must apply it to the device pools, every
+        request's block table (``apply_block_permutation``) and the
+        ``PrefixIndex`` (``remap``), then ``commit_plan``."""
+        live = sorted(self._live())
         plan = {old: new for new, old in enumerate(live) if old != new}
         return plan or None
 
     def commit_plan(self, plan: dict[int, int]) -> None:
-        """Adopt a compaction plan: live blocks occupy [0, used)."""
-        self._used = {plan.get(i, i) for i in self._used}
-        self._free = sorted(set(range(self.num_blocks)) - self._used)
+        """Adopt a compaction plan: live blocks occupy [0, live)."""
+        self._refs = {plan.get(i, i): r for i, r in self._refs.items()}
+        self._evictable = {
+            plan.get(i, i): None for i in self._evictable
+        }  # dict comprehension preserves LRU order
+        self._indexed = {plan.get(i, i) for i in self._indexed}
+        self._free = sorted(
+            set(range(self.num_blocks)) - set(self._refs)
+            - set(self._evictable)
+        )
 
     def stats(self) -> dict:
         return {
@@ -148,9 +278,103 @@ class BlockAllocator:
             "block_size": self.block_size,
             "free": self.free_blocks,
             "used": self.used_blocks,
+            "cached": self.cached_blocks,
+            "shared": sum(1 for r in self._refs.values() if r > 1),
+            "evictions": self.evictions,
             "high_water": self.high_water,
             "fragmentation": round(self.fragmentation(), 4),
         }
+
+
+class PrefixIndex:
+    """Content-keyed index over pool blocks holding prompt prefixes.
+
+    Key: a chain hash over the token ids at block granularity —
+    ``key_i = H(key_{i-1} || tokens[i*bs:(i+1)*bs])`` — so a block's key
+    commits to the WHOLE prefix through it, not just its own tokens
+    (two prompts sharing block 3's tokens but not block 2's can never
+    collide).  ``lookup`` walks the chain and returns the longest run of
+    cached blocks; ``insert`` registers a finished prefill's full prompt
+    blocks.  Pure host-side maps; block lifetime (refcounts, LRU
+    eviction) lives in ``BlockAllocator`` — the allocator calls
+    ``forget_block`` when it evicts, the engine calls ``remap`` after a
+    defrag.
+    """
+
+    def __init__(self, block_size: int) -> None:
+        self.block_size = int(block_size)
+        self._by_key: dict[str, int] = {}
+        self._by_block: dict[int, str] = {}
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def chain_keys(self, tokens) -> list[str]:
+        """One key per FULL block of ``tokens`` (len // block_size)."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        keys = []
+        h = b""
+        for i in range(len(toks) // self.block_size):
+            blk = toks[i * self.block_size:(i + 1) * self.block_size]
+            h = hashlib.sha1(h + blk.tobytes()).digest()
+            keys.append(h.hex())
+        return keys
+
+    def lookup(self, tokens, keys: list[str] | None = None) -> list[int]:
+        """Block ids of the longest cached block-aligned prefix of
+        ``tokens`` (full blocks only; possibly empty).  ``keys`` lets a
+        caller reuse one ``chain_keys`` pass — the hash is a pure
+        function of the immutable prompt, only this dict walk needs to
+        be fresh (a queue head is re-looked-up every scheduler tick)."""
+        ids = []
+        for key in keys if keys is not None else self.chain_keys(tokens):
+            bid = self._by_key.get(key)
+            if bid is None:
+                break
+            ids.append(bid)
+        return ids
+
+    def insert(
+        self, tokens, block_ids, allocator: BlockAllocator,
+        keys: list[str] | None = None,
+    ) -> int:
+        """Register ``tokens``'s full-block prefix as cached content in
+        ``block_ids`` (the request's block table).  Blocks already
+        indexed under the same key are skipped (first writer wins — both
+        copies hold identical K/V, only one is worth keeping); returns
+        how many blocks were newly registered."""
+        new = 0
+        if keys is None:
+            keys = self.chain_keys(tokens)
+        for i, key in enumerate(keys):
+            if i >= len(block_ids):
+                break
+            if key in self._by_key:
+                continue
+            bid = int(block_ids[i])
+            if bid in self._by_block:
+                # the block already backs a different chain position
+                # (cannot happen for distinct live tables, but a stale
+                # insert after eviction could) — keep the existing entry
+                continue
+            self._by_key[key] = bid
+            self._by_block[bid] = key
+            allocator.mark_indexed(bid)
+            new += 1
+        return new
+
+    def forget_block(self, block_id: int) -> None:
+        """Allocator eviction hook: drop the block's index entry."""
+        key = self._by_block.pop(block_id, None)
+        if key is not None:
+            self._by_key.pop(key, None)
+
+    def remap(self, plan: dict[int, int]) -> None:
+        """Rewrite block ids per a defrag compaction plan."""
+        self._by_block = {
+            plan.get(b, b): k for b, k in self._by_block.items()
+        }
+        self._by_key = {k: b for b, k in self._by_block.items()}
 
 
 def init_kv_pool(
@@ -329,6 +553,21 @@ def pool_gather(pool_layer, tables):
         b, nmax * bs, x.shape[-1]
     )
     return (rows(pk), rows(pv))
+
+
+def pool_copy_block(pools, src, dst):
+    """Copy one block row ``src`` -> ``dst`` across every layer's pool —
+    the device half of copy-on-write (a request about to write into a
+    block other tables share gets its own bit-identical copy first).
+    ``src``/``dst`` are int32 scalars (traced: one compiled program
+    serves every copy)."""
+    def one(layer):
+        cp = lambda x: x.at[dst].set(x[src])
+        if isinstance(layer, QuantKV):
+            return QuantKV(*(cp(a) for a in layer))
+        return tuple(cp(a) for a in layer)
+
+    return tuple(one(layer) for layer in pools)
 
 
 def apply_block_permutation(pools, plan: dict[int, int], num_blocks: int):

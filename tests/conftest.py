@@ -74,6 +74,10 @@ SLOW_TESTS = (
     "test_serve.py::test_engine_matches_sequential_decode",
     "test_serve.py::test_engine_matches_sequential_variants",
     "test_serve.py::test_shed_under_pressure_e2e",
+    "test_serve_prefix.py::test_shared_prefix_bit_identical",
+    "test_serve_prefix.py::test_int8_prefix_reuse_within_tolerance",
+    "test_serve_prefix.py::test_chunked_prefill_interleaves_decode",
+    "test_serve_prefix.py::test_serve_bench_scenario_cli",
     "test_trainer.py::test_resume_from_snapshot",
     "test_trainer.py::test_trainer_end_to_end",
     "test_transformer.py::TestLearning::test_remat_policy_invariance",

@@ -98,7 +98,8 @@ def test_allocator_fragmentation_and_compaction():
     assert plan == {4: 2, 5: 3}
     a.commit_plan(plan)
     assert a.fragmentation() == 0.0
-    assert sorted(a._used) == [0, 1, 2, 3]
+    assert sorted(a._refs) == [0, 1, 2, 3]
+    assert all(a.refcount(b) == 1 for b in range(4))
     assert a.free_blocks == 4
     del x, z
 
@@ -667,8 +668,12 @@ def test_bucket_bounded_recompiles_counted_via_obs(lm, tmp_path):
 
     cfg, params, spec = lm
     obs = EventWriter(tmp_path, "serve-test")
+    # prefix cache off: this test pins the BUCKETED full-prefill program
+    # accounting, and these arange prompts share full-block prefixes
+    # that would otherwise (correctly) divert admits to the chunk path
     eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=64,
-                      max_batch=4, max_steps_per_dispatch=4, obs=obs)
+                      max_batch=4, max_steps_per_dispatch=4, obs=obs,
+                      prefix_cache=False)
     # lens 3..8 share bucket 8; lens 9..15 bucket 16
     clients = [
         ("a", np.arange(1, 6, dtype=np.int32), 6),    # bucket 8
@@ -790,10 +795,13 @@ def test_engine_precompile_covers_grid(lm):
     eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=32,
                       max_batch=2, max_steps_per_dispatch=2)
     counts = eng.precompile(12, 8)
-    # buckets {8, 16}; ks {1, 2}; nmaxes pow2-ceil over 1..3 -> {1, 2, 4}
-    assert counts == {"prefill": 2, "decode": 6}
+    # buckets {8, 16}; ks {1, 2}; nmaxes pow2-ceil over 1..3 -> {1, 2, 4};
+    # chunk grid (prefix cache on by default): (mid + final) x {8, 16}
+    # at the single clamped view width (mid is reachable without a
+    # chunk bound: the view clamp can split a prefix-hit tail)
+    assert counts == {"prefill": 2, "decode": 6, "chunk": 4}
     # second call: everything cached
-    assert eng.precompile(12, 8) == {"prefill": 0, "decode": 0}
+    assert eng.precompile(12, 8) == {"prefill": 0, "decode": 0, "chunk": 0}
     # a request inside the envelope then compiles NOTHING new
     eng.submit(np.arange(1, 11, dtype=np.int32), 8, request_id="r")
     eng.run()
@@ -901,8 +909,12 @@ def test_request_log_feeds_serving_stats(lm):
     from ddl_tpu.serve.engine import ServeEngine
 
     cfg, params, spec = lm
+    # prefix cache off: the three IDENTICAL prompts would (correctly)
+    # hit the cache and run the CoW recompute path, whose chunk-program
+    # compile cold-marks request 2 — this test wants 3 warm full
+    # prefills feeding the stats
     eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=32,
-                      max_batch=2)
+                      max_batch=2, prefix_cache=False)
     # precompiled engine: every request runs warm (compile detection is
     # per executable, so un-warmed second-signature compiles would
     # otherwise cold-mark trailing requests too)

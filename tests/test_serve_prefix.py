@@ -1,0 +1,647 @@
+"""Shared-prefix KV reuse, chunked prefill, and the scenario matrix
+(round 17, `ddl_tpu/serve/`).
+
+Host tier (no JAX): refcounted-allocator invariants under
+allocate/share/free/defrag (no block freed while referenced, no leak
+after all owners retire, double-free raises), prefix-index chain
+lookup/insert/LRU eviction at the allocation watermark, the
+prefix-aware admission accounting (a fully-cached request admits into a
+pool sized below its nominal footprint — the round-17 bugfix), and the
+obs fold's prefix counters (sidecar v6, warm==cold preserved).
+
+Device tier (CPU JAX, slow): shared-prefix clients bit-identical to
+cache-off AND to sequential `make_lm_generator` runs in greedy/sampled
+variants; int8 prefix reuse at documented quantization tolerance;
+copy-on-write on fully-cached block-aligned prompts; chunked prefill
+interleaving decode dispatches (a long prompt cannot stall short
+requests); eviction under pool pressure; the serve-bench --scenario CLI
+with the exact --compare-sequential gate; deterministic 1-in-N trace
+sampling.
+"""
+
+import numpy as np
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# host tier: refcounted allocator
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_refcount_share_free():
+    from ddl_tpu.serve.kv_pool import BlockAllocator
+
+    a = BlockAllocator(8, 4)
+    x = a.alloc(3)  # refcount 1 each
+    a.share(x[:2])  # a second owner for blocks 0, 1
+    assert [a.refcount(b) for b in x] == [2, 2, 1]
+    # first owner retires: referenced blocks stay allocated
+    a.free(x)
+    assert a.used_blocks == 2 and a.free_blocks == 6
+    assert [a.refcount(b) for b in x] == [1, 1, 0]
+    # freeing the unreferenced block again is a double free
+    with pytest.raises(ValueError):
+        a.free([x[2]])
+    # second owner retires: no leak — everything back in circulation
+    a.free(x[:2])
+    assert a.used_blocks == 0 and a.free_blocks == 8
+    # sharing a free block is a bookkeeping bug
+    with pytest.raises(ValueError):
+        a.share([x[0]])
+    # invariant held throughout
+    assert a.free_blocks + a.used_blocks + a.cached_blocks == 8
+
+
+def test_allocator_evictable_lru_eviction():
+    from ddl_tpu.serve.kv_pool import BlockAllocator
+
+    a = BlockAllocator(4, 4)
+    evicted = []
+    a.on_evict = evicted.append
+    x = a.alloc(3)
+    for b in x:
+        a.mark_indexed(b)
+    # release in a known order -> LRU order 0, 1, 2
+    a.free([x[0]])
+    a.free([x[1]])
+    a.free([x[2]])
+    assert a.used_blocks == 0 and a.cached_blocks == 3
+    assert a.free_blocks == 1  # cached blocks are NOT free
+    assert a.can_alloc(4)  # ... but they are allocatable via eviction
+    # allocating 3 takes the free block + evicts the 2 least-recently
+    # released cached blocks, notifying the index hook
+    y = a.alloc(3)
+    assert evicted == [x[0], x[1]]
+    assert a.evictions == 2
+    assert a.cached_blocks == 1 and x[2] not in y
+    # reactivating the surviving cached block via share
+    a.share([x[2]])
+    assert a.refcount(x[2]) == 1 and a.cached_blocks == 0
+    a.free([x[2]])
+    assert a.cached_blocks == 1  # still indexed -> parks again
+    a.drop_indexed(x[2])  # explicit index invalidation frees it
+    assert a.cached_blocks == 0 and a.free_blocks == 1
+    a.free(y)
+    assert a.free_blocks + a.used_blocks + a.cached_blocks == 4
+
+
+def test_allocator_compaction_with_cached_blocks():
+    from ddl_tpu.serve.kv_pool import BlockAllocator, PrefixIndex
+
+    a = BlockAllocator(8, 4)
+    idx = PrefixIndex(4)
+    a.on_evict = idx.forget_block
+    toks = np.arange(8, dtype=np.int32)
+    x = a.alloc(2)  # [0, 1]
+    y = a.alloc(2)  # [2, 3]
+    idx.insert(toks, y, a)  # blocks 2, 3 hold toks' two full blocks
+    a.free(x)  # holes at 0, 1
+    a.free(y)  # 2, 3 -> evictable (indexed), content retained
+    assert a.cached_blocks == 2
+    plan = a.compaction_plan()
+    assert plan == {2: 0, 3: 1}  # cached blocks are live content: packed
+    idx.remap(plan)
+    a.commit_plan(plan)
+    assert idx.lookup(toks) == [0, 1]
+    assert a.cached_blocks == 2 and a.free_blocks == 6
+
+
+# ---------------------------------------------------------------------------
+# host tier: prefix index
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_index_chain_lookup():
+    from ddl_tpu.serve.kv_pool import BlockAllocator, PrefixIndex
+
+    a = BlockAllocator(16, 4)
+    idx = PrefixIndex(4)
+    p1 = np.arange(10, dtype=np.int32)  # 2 full blocks + 2 tail tokens
+    b1 = a.alloc(3)
+    assert idx.insert(p1, b1, a) == 2  # only FULL blocks registered
+    # same first block, different second block -> 1-block chain only
+    p2 = np.concatenate([p1[:4], p1[4:8] + 1, p1[8:]])
+    assert idx.lookup(p1) == b1[:2]
+    assert idx.lookup(p2) == b1[:1]
+    # chain hash commits to the WHOLE prefix: same tokens in block 1 but
+    # a different block 0 must not chain onto b1[1]
+    p3 = np.concatenate([p1[:4] + 1, p1[4:8]])
+    assert idx.lookup(p3) == []
+    # first writer wins: re-inserting the same content registers nothing
+    b2 = a.alloc(3)
+    assert idx.insert(p1, b2, a) == 0
+    # eviction hook forgets the block and breaks the chain there
+    idx.forget_block(b1[1])
+    assert idx.lookup(p1) == b1[:1]
+
+
+# ---------------------------------------------------------------------------
+# host tier: prefix-aware admission (the round-17 accounting fix)
+# ---------------------------------------------------------------------------
+
+
+def _req(rid, prompt, max_new):
+    from ddl_tpu.serve.scheduler import Request
+
+    return Request(
+        id=rid, prompt=np.asarray(prompt, np.int32), max_new=max_new
+    )
+
+
+def test_admit_charges_private_demand_only():
+    from ddl_tpu.serve.kv_pool import BlockAllocator, PrefixIndex
+    from ddl_tpu.serve.scheduler import ContinuousScheduler
+
+    a = BlockAllocator(8, 4)
+    idx = PrefixIndex(4)
+    a.on_evict = idx.forget_block
+    s = ContinuousScheduler(a, max_batch=4, max_blocks_per_seq=8,
+                            prefix_index=idx)
+    prefix = np.arange(8, dtype=np.int32)  # 2 full blocks
+    first = s.try_admit(_req("a", np.concatenate([prefix, [9, 9]]), 3))
+    idx.insert(first.request.prompt, first.block_ids, a)
+    used_before = a.used_blocks
+    # second request shares the 2 prefix blocks read-only and allocates
+    # only its private remainder: 12 rows -> 3 blocks total, 1 private
+    second = s.try_admit(_req("b", np.concatenate([prefix, [7, 7]]), 3))
+    assert second.cached_tokens == 8 and second.shared_blocks == 2
+    assert second.block_ids[:2] == first.block_ids[:2]
+    assert a.used_blocks == used_before + 1  # ONE private block
+    assert a.refcount(first.block_ids[0]) == 2
+    # retire in either order: shared blocks survive until the last owner
+    s.retire(first.lane)
+    assert a.refcount(second.block_ids[0]) == 1
+    s.retire(second.lane)
+    # all owners gone: indexed blocks park evictable, rest freed
+    assert a.used_blocks == 0
+    assert a.cached_blocks == 2  # the two indexed prefix blocks
+    assert a.free_blocks + a.cached_blocks == 8
+
+
+def test_fits_ever_fully_cached_regression():
+    """The round-17 admission bugfix: a request whose prefix is fully
+    cached must NOT be rejected (or parked forever) for a worst-case
+    footprint it will never allocate."""
+    from ddl_tpu.serve.kv_pool import BlockAllocator, PrefixIndex
+    from ddl_tpu.serve.scheduler import ContinuousScheduler
+
+    # (1) residency envelope (review round 2): sharing shrinks what a
+    # request ALLOCATES, never the blocks it needs to exist — a
+    # 6-residency request must be rejected by a 5-block pool even with
+    # its prefix fully cached (fits_ever=True there would park it at
+    # the queue head forever: can_admit can never beat
+    # num_blocks - shared_n headroom, and run() livelocks)
+    a0 = BlockAllocator(5, 4)
+    idx0 = PrefixIndex(4)
+    s0 = ContinuousScheduler(a0, max_batch=2, max_blocks_per_seq=8,
+                             prefix_index=idx0)
+    prefix = np.arange(16, dtype=np.int32)  # 4 full blocks
+    prompt = np.concatenate([prefix, [1, 1]])  # 18 tokens
+    big = _req("big", prompt, 4)  # 21 rows -> 6 blocks nominal
+    assert s0.blocks_needed(big) == 6
+    assert not s0.fits_ever(big)  # nothing cached: can never fit 5
+    owner0 = s0.try_admit(_req("o", prompt, 3))  # 5 blocks
+    idx0.insert(prompt, owner0.block_ids, a0)
+    assert not s0.fits_ever(big)  # still 4 shared + 2 private > 5
+    # (2) live sharing — the actual round-17 win: with the owner still
+    # RESIDENT (5 of 8 blocks), worst-case accounting sees 6 needed >
+    # 3 free and parks the request forever; charging only the private
+    # demand admits it immediately (the shared prefix counts against
+    # the pool once, not once per request)
+    a = BlockAllocator(8, 4)
+    idx = PrefixIndex(4)
+    s = ContinuousScheduler(a, max_batch=2, max_blocks_per_seq=8,
+                            prefix_index=idx)
+    owner = s.try_admit(_req("o", prompt, 3))
+    idx.insert(prompt, owner.block_ids, a)
+    assert a.free_blocks == 3  # < the nominal 6-block footprint
+    assert s.can_admit(big)
+    st = s.try_admit(big)
+    assert st is not None and st.cached_tokens == 16
+    assert st.shared_blocks == 4
+    assert a.free_blocks == 1  # only the 2 private blocks were drawn
+    # (3) review round 3: a fully-cached block-aligned prompt that fits
+    # the pool EXACTLY must not become unadmittable because the CoW
+    # recompute would charge one extra resident block — the chain is
+    # capped (last cached block dropped and recomputed) instead
+    a3 = BlockAllocator(3, 4)
+    idx3 = PrefixIndex(4)
+    a3.on_evict = idx3.forget_block
+    s3 = ContinuousScheduler(a3, max_batch=2, max_blocks_per_seq=4,
+                             prefix_index=idx3)
+    p8 = np.arange(8, dtype=np.int32)  # exactly 2 blocks
+    exact = _req("exact", p8, 5)  # 12 rows -> ALL 3 pool blocks
+    first = s3.try_admit(_req("o", p8, 5))
+    idx3.insert(p8, first.block_ids, a3)
+    s3.retire(first.lane)
+    again = _req("again", p8, 5)
+    assert s3.fits_ever(again)  # capped chain: residency == need == 3
+    st3 = s3.try_admit(again)
+    assert st3 is not None
+    assert st3.cow_block is None  # fell back to recompute, not CoW
+    assert st3.shared_blocks == 1 and st3.cached_tokens == 4
+    del exact
+
+
+def test_fully_cached_aligned_prompt_reserves_cow_target():
+    from ddl_tpu.serve.kv_pool import BlockAllocator, PrefixIndex
+    from ddl_tpu.serve.scheduler import ContinuousScheduler
+
+    a = BlockAllocator(8, 4)
+    idx = PrefixIndex(4)
+    s = ContinuousScheduler(a, max_batch=2, max_blocks_per_seq=8,
+                            prefix_index=idx)
+    prompt = np.arange(8, dtype=np.int32)  # exactly 2 blocks
+    owner = s.try_admit(_req("o", prompt, 3))
+    idx.insert(prompt, owner.block_ids, a)
+    st = s.try_admit(_req("b", prompt, 3))
+    # whole prompt cached: re-prefill the last BLOCK (block-aligned
+    # chunk start) into a pre-allocated private copy of the last
+    # shared block
+    assert st.cached_tokens == 4  # prompt_len - block_size
+    assert st.prefill_pos == 4 and not st.prefill_done
+    assert st.cow_block is not None
+    assert st.shared_blocks == 2
+
+
+# ---------------------------------------------------------------------------
+# host tier: obs fold prefix counters (sidecar v6)
+# ---------------------------------------------------------------------------
+
+
+def test_fold_prefix_counters_and_summary(tmp_path):
+    import json
+
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.report import render_summary, summarize_from_fold
+
+    d = tmp_path / "by_job_id" / "j"
+    d.mkdir(parents=True)
+    events = [
+        {"ts": 1.0, "run": "r", "host": 0, "kind": "serve_admit",
+         "cached_tokens": 0, "prefill_tokens": 10, "prompt_len": 10},
+        {"ts": 2.0, "run": "r", "host": 0, "kind": "prefix_insert",
+         "blocks": 1, "tokens": 8},
+        {"ts": 3.0, "run": "r", "host": 0, "kind": "serve_admit",
+         "cached_tokens": 8, "prefill_tokens": 2, "prompt_len": 10},
+        {"ts": 3.1, "run": "r", "host": 0, "kind": "prefix_hit",
+         "cached_tokens": 8, "blocks": 1},
+        {"ts": 4.0, "run": "r", "host": 0, "kind": "kv_cow_copy",
+         "src": 1, "dst": 5},
+    ]
+    (d / "events-h000.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in events)
+    )
+    summary = summarize_from_fold(fold_job(tmp_path, "j"))
+    sv = summary["serve"]
+    assert sv["admits"] == 2 and sv["prefix_hits"] == 1
+    assert sv["cached_tokens"] == 8 and sv["prefill_tokens"] == 12
+    assert sv["prefix_hit_rate"] == pytest.approx(8 / 20)
+    assert sv["cow_copies"] == 1 and sv["prefix_inserts"] == 1
+    text = render_summary(summary, "j")
+    assert "prefix cache: 1 hit(s)" in text
+    # warm (sidecar) fold renders byte-identically to a cold parse
+    warm = render_summary(
+        summarize_from_fold(fold_job(tmp_path, "j")), "j"
+    )
+    cold = render_summary(
+        summarize_from_fold(fold_job(tmp_path, "j", cache=False)), "j"
+    )
+    assert warm == cold == text
+
+
+# ---------------------------------------------------------------------------
+# device tier (CPU JAX)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg(**kw):
+    from ddl_tpu.models.transformer import LMConfig
+
+    base = dict(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=8, head_dim=8,
+        d_ff=256, compute_dtype="float32",
+    )
+    base.update(kw)
+    return LMConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.models.transformer import TransformerLM
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+
+    cfg = _tiny_cfg()
+    params = nn.meta.unbox(
+        TransformerLM(cfg, None).init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    )
+    return cfg, params, LMMeshSpec()
+
+
+def _sequential_tokens(cfg, spec, params, clients, seed, **gen_kw):
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.infer.decode import make_lm_generator
+
+    out, gens = {}, {}
+    for cid, prompt, mn in clients:
+        key = (len(prompt), mn)
+        if key not in gens:
+            gens[key] = make_lm_generator(
+                cfg, spec, prompt_len=len(prompt), max_new=mn, batch=1,
+                **gen_kw,
+            )
+        toks = gens[key](
+            params, jnp.asarray(prompt[None, :]), jax.random.PRNGKey(seed)
+        )
+        out[cid] = np.asarray(toks)[0]
+    return out
+
+
+def _shared_prefix_clients(n, prefix_len=24, seed=5):
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, 256, prefix_len).astype(np.int32)
+    return [
+        (
+            f"c{i}",
+            np.concatenate(
+                [prefix,
+                 rng.integers(0, 256, int(rng.integers(3, 10)))
+                 .astype(np.int32)]
+            ),
+            int(rng.integers(4, 9)),
+        )
+        for i in range(n)
+    ]
+
+
+def _drive(cfg, params, spec, clients, *, seed=3, **engine_kw):
+    from ddl_tpu.serve.engine import ServeEngine
+
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=64,
+                      max_batch=4, **engine_kw)
+    for cid, prompt, mn in clients:
+        eng.submit(prompt, mn, request_id=cid, rng_seed=seed)
+    return eng, eng.run()
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [dict(), dict(temperature=0.8, top_k=17)],
+    ids=["greedy", "sampled"],
+)
+def test_shared_prefix_bit_identical(lm, kw):
+    """THE round-17 acceptance e2e: shared-prefix clients through the
+    engine with the prefix cache ON are bit-identical to the cache-OFF
+    engine AND to one-at-a-time `make_lm_generator` replays — reuse
+    changes scheduling and footprint, never tokens."""
+    cfg, params, spec = lm
+    clients = _shared_prefix_clients(6)
+    eng_on, got_on = _drive(cfg, params, spec, clients, **kw)
+    eng_off, got_off = _drive(
+        cfg, params, spec, clients, prefix_cache=False, **kw
+    )
+    want = _sequential_tokens(cfg, spec, params, clients, seed=3, **kw)
+    for cid in want:
+        np.testing.assert_array_equal(got_on[cid], want[cid])
+        np.testing.assert_array_equal(got_off[cid], want[cid])
+    # the cache actually did something: every client after the first
+    # hit the 24-token (3-block) shared prefix
+    assert eng_on.stats["prefix_hits"] == 5
+    assert eng_on.stats["prefix_hit_tokens"] == 5 * 24
+    assert eng_on.stats["prefill_tokens"] < eng_off.stats["prefill_tokens"]
+    assert eng_off.stats["prefix_hits"] == 0
+    # all owners retired: shared blocks parked evictable, none leaked
+    assert eng_on.allocator.used_blocks == 0
+    assert eng_on.allocator.cached_blocks > 0
+    a = eng_on.allocator
+    assert a.free_blocks + a.cached_blocks == a.num_blocks
+
+
+def test_fully_cached_prompt_cow_bit_identical(lm):
+    """Identical block-aligned prompts: the repeat requests share every
+    prompt block, copy-on-write duplicates the last one for the
+    last-block recompute, and tokens stay bit-identical."""
+    cfg, params, spec = lm
+    prompt = np.arange(1, 17, dtype=np.int32)  # exactly 2 blocks of 8
+    clients = [(f"c{i}", prompt, 5) for i in range(3)]
+    eng, got = _drive(cfg, params, spec, clients)
+    want = _sequential_tokens(cfg, spec, params, clients, seed=3)
+    for cid in want:
+        np.testing.assert_array_equal(got[cid], want[cid])
+    assert eng.stats["cow_copies"] == 2  # one per repeat request
+    assert eng.stats["prefix_hits"] == 2
+    # each repeat recomputed exactly its LAST BLOCK (8 tokens)
+    assert eng.stats["prefill_tokens"] == 16 + 2 * 8
+
+
+def test_fully_cached_max_new_one_bit_identical(lm):
+    """Regression (review round 5): the fully-cached recompute with
+    max_new=1 sizes the gathered view at exactly the reservation — the
+    old unaligned single-row chunk overflowed it (off=63 + an 8-row
+    bucket against a 64-row view) and dynamic_update_slice clamped the
+    start, corrupting attended rows.  Block-aligned recompute fits."""
+    cfg, params, spec = lm
+    prompt = np.arange(0, 64, dtype=np.int32)  # exactly 8 blocks of 8
+    clients = [("a", prompt, 1), ("b", prompt, 1)]
+    eng, got = _drive(cfg, params, spec, clients)
+    want = _sequential_tokens(cfg, spec, params, clients, seed=3)
+    for cid in want:
+        np.testing.assert_array_equal(got[cid], want[cid])
+    assert eng.stats["cow_copies"] == 1
+    assert eng.stats["prefix_hits"] == 1
+
+
+def test_int8_prefix_reuse_within_tolerance(lm):
+    """int8 pools store K/V lossily, so a reused prefix is attended at
+    quantization precision while a fresh prefill attends the raw
+    activations — prefix reuse there is an explicit opt-in and is
+    token-ACCURATE, not bit-identical (the same tolerance class as int8
+    itself vs f32; see ARCHITECTURE.md).  Cache-off int8 stays exact."""
+    cfg, params, spec = lm
+    clients = _shared_prefix_clients(5)
+    # auto default: int8 engines do NOT enable the prefix cache
+    from ddl_tpu.serve.engine import ServeEngine
+
+    auto = ServeEngine(cfg, params, spec, block_size=8, num_blocks=64,
+                       max_batch=4, kv_quant=True)
+    assert auto.prefix is None
+    eng_off, got_off = _drive(
+        cfg, params, spec, clients, kv_quant=True, prefix_cache=False
+    )
+    want = _sequential_tokens(
+        cfg, spec, params, clients, seed=3, kv_quant=True
+    )
+    for cid in want:
+        np.testing.assert_array_equal(got_off[cid], want[cid])
+    # explicit opt-in: runs to completion, hits the cache, and agrees
+    # with the exact reference on (nearly) every greedy token
+    eng_on, got_on = _drive(
+        cfg, params, spec, clients, kv_quant=True, prefix_cache=True
+    )
+    assert eng_on.stats["prefix_hits"] >= 4
+    total = agree = 0
+    for cid in want:
+        total += len(want[cid])
+        agree += int((got_on[cid] == want[cid]).sum())
+    assert agree / total >= 0.7, (agree, total)
+
+
+def test_chunked_prefill_interleaves_decode(lm):
+    """A long prompt under `prefill_chunk` runs as bounded chunks with
+    decode dispatches BETWEEN them: a short request admitted alongside
+    finishes while the long prompt is still prefilling, and tokens stay
+    bit-identical to the sequential replay."""
+    cfg, params, spec = lm
+    from ddl_tpu.serve.engine import ServeEngine
+
+    rng = np.random.default_rng(9)
+    long_prompt = rng.integers(0, 256, 96).astype(np.int32)
+    short_prompt = rng.integers(0, 256, 7).astype(np.int32)
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=64,
+                      max_batch=4, prefill_chunk=16, prefix_cache=False)
+    eng.submit(long_prompt, 4, request_id="long", rng_seed=3)
+    eng.submit(short_prompt, 3, request_id="short", rng_seed=3)
+    short_done_at = long_prefill_done_at = None
+    steps = 0
+    while eng.step():
+        steps += 1
+        if short_done_at is None and "short" in eng.results:
+            short_done_at = steps
+        lane = next(
+            (s for s in eng.scheduler.active()
+             if s.request.id == "long"), None
+        )
+        if long_prefill_done_at is None and (
+            lane is None or lane.prefill_done
+        ):
+            long_prefill_done_at = steps
+    assert eng.stats["prefill_chunks"] >= 96 // 16
+    # the short request retired BEFORE the long prompt finished prefill
+    assert short_done_at is not None and long_prefill_done_at is not None
+    assert short_done_at < long_prefill_done_at
+    clients = [("long", long_prompt, 4), ("short", short_prompt, 3)]
+    want = _sequential_tokens(cfg, spec, params, clients, seed=3)
+    for cid in want:
+        np.testing.assert_array_equal(eng.results[cid], want[cid])
+
+
+def test_eviction_under_pool_pressure(lm):
+    """Distinct prompts churning through a small pool force LRU
+    eviction of cached (refcount-0) prefix blocks; the allocator
+    invariants hold and every request still completes exactly."""
+    cfg, params, spec = lm
+    from ddl_tpu.serve.engine import ServeEngine
+
+    rng = np.random.default_rng(11)
+    clients = [
+        (f"c{i}",
+         rng.integers(0, 256, 20 + 2 * i).astype(np.int32),
+         4)
+        for i in range(6)
+    ]
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=16,
+                      max_batch=2)
+    for cid, prompt, mn in clients:
+        eng.submit(prompt, mn, request_id=cid, rng_seed=3)
+    got = eng.run()
+    want = _sequential_tokens(cfg, spec, params, clients, seed=3)
+    for cid in want:
+        np.testing.assert_array_equal(got[cid], want[cid])
+    a = eng.allocator
+    assert a.evictions > 0  # pressure actually evicted cached blocks
+    assert a.used_blocks == 0
+    assert a.free_blocks + a.cached_blocks == a.num_blocks
+    # index and allocator agree about what is cached
+    assert len(eng.prefix) == a.cached_blocks
+
+
+def test_chunk_bucket_clamped_to_view(lm):
+    """Regression (review round 1): a tail whose BUCKET overruns the
+    gathered view (17-token tail at off 40 buckets to 32 rows against a
+    64-row view: 72 > 64) must shrink the chunk, not let dynamic_slice
+    clamp the start and silently read/write the wrong pool rows."""
+    cfg, params, spec = lm
+    from ddl_tpu.serve.engine import ServeEngine
+
+    rng = np.random.default_rng(21)
+    prefix = rng.integers(0, 256, 40).astype(np.int32)  # 5 blocks of 8
+    tails = [rng.integers(0, 256, 17).astype(np.int32) for _ in range(2)]
+    # DIFFERENT tails: the hit shares exactly the 5 prefix blocks
+    # (identical prompts would share 7 full blocks and sidestep the
+    # overflowing 32-row tail bucket this test exists to exercise)
+    clients = [
+        ("owner", np.concatenate([prefix, tails[0]]), 8),
+        ("hit", np.concatenate([prefix, tails[1]]), 8),
+    ]
+    # total = 57 + 8 - 1 = 64 rows -> 8 blocks -> a 64-row view exactly
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=64,
+                      max_batch=2, max_blocks_per_seq=8)
+    # "hit" shares 5 blocks and prefills from off=40 with 17 remaining:
+    # the 32-row bucket would end at 72 > 64 without the clamp
+    for cid, p, mn in clients:
+        eng.submit(p, mn, request_id=cid, rng_seed=3)
+    got = eng.run()
+    want = _sequential_tokens(cfg, spec, params, clients, seed=3)
+    for cid in want:
+        np.testing.assert_array_equal(got[cid], want[cid])
+    assert eng.stats["prefix_hits"] == 1
+    # the clamp split the tail into two chunks (16 + remainder)
+    assert eng.stats["prefill_chunks"] >= 2
+
+
+def test_trace_sampling_deterministic(lm, tmp_path):
+    """DDL_OBS_TRACE_SAMPLE=N emits request spans for 1-in-N requests,
+    keyed by submit sequence number — request 0, 2, ... traced, the
+    rest silent, and a re-run samples identically."""
+    import json
+
+    from ddl_tpu.obs import EventWriter
+    from ddl_tpu.obs.events import events_path
+    from ddl_tpu.serve.engine import ServeEngine
+
+    cfg, params, spec = lm
+    obs = EventWriter(tmp_path, "sampled")
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=64,
+                      max_batch=4, obs=obs, trace_sample=2,
+                      prefix_cache=False)
+    for i in range(4):
+        eng.submit(np.arange(1, 7 + i, dtype=np.int32), 3,
+                   request_id=f"c{i}", rng_seed=3)
+    eng.run()
+    obs.close()
+    events = [
+        json.loads(line)
+        for line in events_path(tmp_path, "sampled").read_text().splitlines()
+    ]
+    roots = sorted(
+        e["request_id"] for e in events
+        if e["kind"] == "trace_span" and e.get("name") == "request"
+    )
+    assert roots == ["c0", "c2"]
+    # decode latency events are NOT sampled — percentiles see everything
+    assert sum(e["kind"] == "decode" for e in events) == 4
+
+
+def test_serve_bench_scenario_cli(capsys):
+    """`serve-bench --scenario shared-prefix --compare-sequential`
+    reports the hit rate and exits cleanly on bit-identical tokens."""
+    from ddl_tpu.serve import bench
+
+    bench.main([
+        "--clients", "6", "--scenario", "shared-prefix",
+        "--shared-prefix-len", "16", "--prompt-len", "3:8",
+        "--max-new", "6", "--block-size", "8", "--num-blocks", "64",
+        "--max-batch", "4", "--compare-sequential", "--seed", "0",
+    ])
+    out = capsys.readouterr().out
+    assert "scenario: shared-prefix" in out
+    assert "prefix cache:" in out and "hit rate" in out
+    assert "bit-identical to the sequential replay" in out

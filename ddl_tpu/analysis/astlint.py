@@ -27,9 +27,28 @@ by reference, not by name:
 * functions lexically nested in a traced function, and functions called
   by name from traced code, are traced (closure to fixpoint).
 
-Cross-module calls are not followed — the rules are per-file by design
-(fast, no imports); the sharding-contract checker (``contracts.py``)
-covers the cross-module composition at trace level.
+**Cross-module inference** (``infer_traced_program``): when linting the
+whole package, the per-module fixpoint runs inside an outer fixpoint
+over the import/call graph (``callgraph.py``) — a function in
+``utils/`` called from traced code in ``train/`` (directly, through an
+``import`` alias, a re-export, or by being passed into another module's
+sink parameter) becomes traced in *its* module, and the host-interop
+rules fire there with a ``(traced via …)`` provenance note.  A host
+sync hidden behind a helper in a different module is no longer
+invisible.  ``lint_file`` on an explicit path stays single-file (fast,
+editor-on-save); the sharding-contract checker (``contracts.py``)
+still covers composition at trace level.
+
+Beyond the host-interop rules, the module also carries the
+**collective-symmetry** family (a ``coord`` barrier/agree/arrive, a
+``lax`` collective, or a ``Rendezvous`` method reachable only under a
+host-dependent condition — ``host_id``/``process_index``/``DDL_*`` env
+— is a split-brain hang: the hosts that don't take the branch never
+arrive) and the **recompile-hazard** family (Python branching on traced
+``.shape``/``.dtype``, unhashable or freshly-constructed static args at
+``jit`` boundaries, traced functions closing over mutable module
+globals — the failure class where steps/s craters with no error
+anywhere because XLA silently compiles a new program per step).
 """
 
 from __future__ import annotations
@@ -40,7 +59,14 @@ from pathlib import Path
 
 from ddl_tpu.analysis.findings import Finding, suppressed
 
-__all__ = ["Registry", "lint_file", "lint_package", "load_registry", "MESH_AXES"]
+__all__ = [
+    "Registry",
+    "infer_traced_program",
+    "lint_file",
+    "lint_package",
+    "load_registry",
+    "MESH_AXES",
+]
 
 # The mesh-axis vocabulary (parallel/mesh.py + parallel/sharding.py).
 # PartitionSpec literals anywhere in the package must draw from this set
@@ -128,21 +154,80 @@ _COORD_EXIT_MODULES = frozenset({
     "obs/watchdog.py",
 })
 
+# Collective-symmetry scope: the modules where a host-conditionally-
+# reachable collective/barrier is a pod-hang, not a style nit.  The
+# coordination layer itself, the shared training loop, and the step
+# factories (whose traced collectives must be identical on every host
+# of the SPMD world).
+_COLLECTIVE_MODULES = frozenset({
+    "coord.py",
+    "supervisor.py",
+    "train/loop.py",
+}) | _STEP_MODULES
+
+# lax collectives: every host of the mesh must execute the same sequence
+# or the program hangs (PAPERS.md "Collective Communication for 100k+
+# GPUs" — asymmetric collectives are the dominant at-scale hang class).
+_COLLECTIVE_LAST = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_gather", "all_to_all", "ppermute", "pshuffle",
+})
+_COLLECTIVE_PREFIXES = ("", "lax", "jax.lax")
+
+# Blocking Rendezvous primitives (coord.py): `barrier` and `agree` wait
+# for peers, and a host-conditional `arrive` starves every peer's
+# blocking wait on that barrier — all three must be symmetric.
+_BARRIER_ATTRS = frozenset({"barrier", "agree", "arrive"})
+
+# Names whose appearance in a branch condition makes the branch
+# host-dependent: different hosts of one pod evaluate it differently.
+_HOST_COND_NAMES = frozenset({
+    "host", "host_id", "rank", "process_index", "process_id",
+})
+
+# Constructor calls that are safe as jit static args: value-hashed
+# built-ins (a fresh `tuple(...)` of equal elements cache-hits; a fresh
+# instance of an arbitrary class identity-hashes and never does).
+_VALUE_HASHED_CTORS = frozenset({
+    "tuple", "frozenset", "int", "float", "bool", "str", "bytes", "len",
+})
+
+# Call forms that build a mutable container (module-global hazard).
+_MUTABLE_CTORS = frozenset({
+    "dict", "list", "set", "bytearray",
+    "defaultdict", "collections.defaultdict",
+    "deque", "collections.deque",
+    "Counter", "collections.Counter",
+    "OrderedDict", "collections.OrderedDict",
+})
+_MUTABLE_LITERALS = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+)
+
 
 @dataclasses.dataclass
 class Registry:
-    """Names the obs-event rule validates against, parsed from
-    ``ddl_tpu/obs/events.py`` without importing it."""
+    """Names the obs-event rules validate against, parsed from
+    ``<package>/obs/events.py`` without importing it.  ``kind_lines``
+    maps each EVENT_KINDS entry to its source line (where the
+    dead-event-kind rule anchors its finding and reads suppressions)."""
 
     event_kinds: frozenset[str]
     anomaly_types: frozenset[str]
+    kind_lines: dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 def load_registry(package_root: Path) -> Registry:
-    """Parse EVENT_KINDS / ANOMALY_TYPES tuples out of obs/events.py."""
-    src = (Path(package_root) / "obs" / "events.py").read_text()
+    """Parse EVENT_KINDS / ANOMALY_TYPES tuples out of obs/events.py.
+    A package without one (fixture packages) gets an empty registry —
+    the obs rules simply have nothing to check against."""
+    try:
+        src = (Path(package_root) / "obs" / "events.py").read_text()
+    except OSError:
+        return Registry(frozenset(), frozenset())
     tree = ast.parse(src)
     found: dict[str, frozenset] = {}
+    kind_lines: dict[str, int] = {}
     for node in tree.body:
         if not isinstance(node, ast.Assign) or len(node.targets) != 1:
             continue
@@ -150,15 +235,18 @@ def load_registry(package_root: Path) -> Registry:
         if not isinstance(target, ast.Name):
             continue
         if target.id in ("EVENT_KINDS", "ANOMALY_TYPES"):
-            values = [
-                e.value
+            consts = [
+                e
                 for e in ast.walk(node.value)
                 if isinstance(e, ast.Constant) and isinstance(e.value, str)
             ]
-            found[target.id] = frozenset(values)
+            found[target.id] = frozenset(e.value for e in consts)
+            if target.id == "EVENT_KINDS":
+                kind_lines = {e.value: e.lineno for e in consts}
     return Registry(
         event_kinds=found.get("EVENT_KINDS", frozenset()),
         anomaly_types=found.get("ANOMALY_TYPES", frozenset()),
+        kind_lines=kind_lines,
     )
 
 
@@ -244,15 +332,44 @@ class _Module:
 
     # -- resolution helpers -------------------------------------------------
 
-    def resolve_func(self, expr: ast.AST) -> _Func | None:
+    def resolve_func(
+        self, expr: ast.AST, enclosing: "_Func | None" = None
+    ) -> _Func | None:
         """A Name (or functools.partial(Name, ...)) referring to a
-        module function, else None."""
+        module function, else None.  With ``enclosing`` (the call
+        site's innermost function) resolution is scope-aware: among
+        same-named definitions, the one defined in the NEAREST lexical
+        scope of the call site wins — so three factories each defining
+        a local ``step`` resolve their own, not whichever parsed last."""
         if isinstance(expr, ast.Call) and _is_partial(expr.func):
-            return self.resolve_func(expr.args[0]) if expr.args else None
-        if isinstance(expr, ast.Name):
-            candidates = self.by_name.get(expr.id)
-            return candidates[-1] if candidates else None
-        return None
+            return (
+                self.resolve_func(expr.args[0], enclosing)
+                if expr.args else None
+            )
+        if not isinstance(expr, ast.Name):
+            return None
+        candidates = self.by_name.get(expr.id)
+        if not candidates:
+            return None
+        if enclosing is not None:
+            chain_ids = [
+                id(f.node) for f in self.enclosing_chain(enclosing)
+            ]  # innermost -> outermost
+            best, best_rank = None, None
+            for c in candidates:
+                if c.parent is None:
+                    rank = len(chain_ids)  # module scope: outermost
+                elif id(c.parent.node) in chain_ids:
+                    rank = chain_ids.index(id(c.parent.node))
+                else:
+                    continue  # not lexically visible from the call site
+                # <=: a later definition at the same depth rebinds
+                if best_rank is None or rank <= best_rank:
+                    best, best_rank = c, rank
+            if best is not None:
+                return best
+        top = [c for c in candidates if c.parent is None]
+        return (top or candidates)[-1]
 
     def enclosing_chain(self, fn: _Func | None):
         while fn is not None:
@@ -283,9 +400,13 @@ def _func_args(call: ast.Call):
             yield kw.value
 
 
-def _infer_traced(mod: _Module) -> set[int]:
-    """Fixpoint over {traced functions} x {sink parameters}."""
-    traced: set[int] = set()
+def _infer_traced(
+    mod: _Module, traced: set[int] | None = None
+) -> set[int]:
+    """Fixpoint over {traced functions} x {sink parameters}.  An
+    existing ``traced`` set (cross-module seeds from
+    ``infer_traced_program``) is extended in place."""
+    traced = set() if traced is None else traced
 
     # seeds: decorators that are transforms
     for fn in mod.funcs.values():
@@ -313,7 +434,7 @@ def _infer_traced(mod: _Module) -> set[int]:
             )
             if transform_call:
                 for arg in _func_args(call):
-                    target = mod.resolve_func(arg)
+                    target = mod.resolve_func(arg, enclosing)
                     if target is not None and id(target.node) not in traced:
                         traced.add(id(target.node))
                         changed = True
@@ -332,7 +453,7 @@ def _infer_traced(mod: _Module) -> set[int]:
                                 changed = True
 
             # (2) call to a local function with sink params: map args
-            callee_fn = mod.resolve_func(call.func)
+            callee_fn = mod.resolve_func(call.func, enclosing)
             if callee_fn is not None and callee_fn.sink_params:
                 bound: list[tuple[str, ast.AST]] = []
                 for i, arg in enumerate(call.args):
@@ -344,7 +465,7 @@ def _infer_traced(mod: _Module) -> set[int]:
                 for pname, arg in bound:
                     if pname not in callee_fn.sink_params:
                         continue
-                    target = mod.resolve_func(arg)
+                    target = mod.resolve_func(arg, enclosing)
                     if target is not None and id(target.node) not in traced:
                         traced.add(id(target.node))
                         changed = True
@@ -363,7 +484,7 @@ def _infer_traced(mod: _Module) -> set[int]:
             # and a *called parameter* of an enclosing function is a sink
             # (accumulate_grads' scan body calling grad_fn)
             if enclosing is not None and id(enclosing.node) in traced:
-                target = mod.resolve_func(call.func)
+                target = mod.resolve_func(call.func, enclosing)
                 if target is not None and id(target.node) not in traced:
                     traced.add(id(target.node))
                     changed = True
@@ -384,6 +505,143 @@ def _infer_traced(mod: _Module) -> set[int]:
                 changed = True
 
     return traced
+
+
+# ---------------------------------------------------------------------------
+# cross-module traced-set inference (over callgraph.CallGraph)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_callable(graph, info, expr, enclosing=None):
+    """A Target for a callee/argument expression: a Name or dotted
+    Attribute chain (optionally wrapped in functools.partial).  Local
+    scope-aware resolution first (the call site's own module binds
+    tightest), then the cross-module import/re-export chase."""
+    if isinstance(expr, ast.Call) and _is_partial(expr.func):
+        return (
+            _resolve_callable(graph, info, expr.args[0], enclosing)
+            if expr.args else None
+        )
+    if isinstance(expr, ast.Name):
+        local = info.mod.resolve_func(expr, enclosing)
+        if local is not None:
+            from ddl_tpu.analysis.callgraph import Target
+
+            return Target(info.name, local)
+    d = _dotted(expr)
+    if d is None:
+        return None
+    return graph.resolve_dotted(info, d)
+
+
+def infer_traced_program(graph):
+    """Traced sets for every module of a ``callgraph.CallGraph``,
+    propagated interprocedurally ACROSS module boundaries.
+
+    Returns ``(traced, reasons)`` where ``traced`` maps module name to
+    the set of traced function-node ids and ``reasons`` maps
+    ``(module, node_id)`` to a human-readable provenance string for
+    functions traced only through a cross-module edge (so a finding in
+    ``utils/helpers.py`` can say which step factory pulled it under a
+    trace).
+
+    The outer fixpoint interleaves three cross-module edges with the
+    per-module closure (``_infer_traced``):
+
+    * a function *reference* resolved into another module passed to a
+      JAX transform (``jax.jit(helpers.step)``) → traced root there;
+    * a *call* from traced code resolved into another module
+      (``helpers.sync_mean(loss)`` inside ``loss_fn``) → callee traced;
+    * an argument bound to another module's **sink parameter**
+      (``wrap_loss(inner)`` where ``wrap_loss`` in another module feeds
+      its parameter into ``value_and_grad``) → the argument is traced,
+      and a parameter of the *calling* function forwarded that way
+      becomes a sink itself.
+    """
+    traced: dict[str, set[int]] = {}
+    reasons: dict[tuple[str, int], str] = {}
+    for name, info in graph.modules.items():
+        traced[name] = _infer_traced(info.mod)
+
+    def mark(target, why: str) -> bool:
+        s = traced[target.module]
+        if id(target.func.node) in s:
+            return False
+        s.add(id(target.func.node))
+        reasons.setdefault((target.module, id(target.func.node)), why)
+        return True
+
+    def size() -> int:
+        return sum(len(s) for s in traced.values()) + sum(
+            len(fn.sink_params)
+            for info in graph.modules.values()
+            for fn in info.mod.funcs.values()
+        )
+
+    while True:
+        before = size()
+        for name, info in graph.modules.items():
+            tset = traced[name]
+            for call, enclosing in info.mod.calls:
+                callee_d = _dotted(call.func)
+                transform_call = callee_d in _TRANSFORMS or (
+                    _is_partial(call.func)
+                    and call.args
+                    and _dotted(call.args[0]) in _TRANSFORMS
+                )
+                if transform_call:
+                    for arg in _func_args(call):
+                        t = _resolve_callable(graph, info, arg, enclosing)
+                        if t is not None and t.module != name:
+                            mark(t, f"passed to a JAX transform in {info.rel}")
+                    continue
+                callee = _resolve_callable(graph, info, call.func, enclosing)
+                # call FROM traced code into another module
+                if (
+                    enclosing is not None
+                    and id(enclosing.node) in tset
+                    and callee is not None
+                    and callee.module != name
+                ):
+                    mark(
+                        callee,
+                        f"called from traced code in "
+                        f"{info.rel}::{enclosing.name}",
+                    )
+                # arguments bound to a cross-module callee's sink params
+                if callee is not None and callee.func.sink_params:
+                    bound: list[tuple[str, ast.AST]] = []
+                    for i, arg in enumerate(call.args):
+                        if i < len(callee.func.params):
+                            bound.append((callee.func.params[i], arg))
+                    for kw in call.keywords:
+                        if kw.arg is not None:
+                            bound.append((kw.arg, kw.value))
+                    for pname, arg in bound:
+                        if pname not in callee.func.sink_params:
+                            continue
+                        t = _resolve_callable(graph, info, arg, enclosing)
+                        if t is not None:
+                            mark(
+                                t,
+                                f"flows into traced sink parameter "
+                                f"{pname!r} of {callee.module}."
+                                f"{callee.func.name}",
+                            )
+                        # forwarding an own parameter into a foreign sink
+                        # makes it a sink here too
+                        base = arg
+                        if isinstance(arg, ast.Call) and _is_partial(arg.func):
+                            base = arg.args[0] if arg.args else arg
+                        if isinstance(base, ast.Name) and enclosing is not None:
+                            for outer in info.mod.enclosing_chain(enclosing):
+                                if base.id in outer.params:
+                                    outer.sink_params.add(base.id)
+            # close locally with the augmented set (lexical children and
+            # same-module calls of newly-traced functions)
+            _infer_traced(info.mod, traced=tset)
+        if size() == before:
+            return traced, reasons
 
 
 # ---------------------------------------------------------------------------
@@ -412,8 +670,16 @@ def _iter_with_enclosing(tree: ast.Module, mod: _Module):
 
 
 def _rule_traced_interop(
-    tree, mod: _Module, traced: set[int], rel: str, add
+    tree, mod: _Module, traced: set[int], rel: str, add,
+    reasons: dict[int, str] | None = None,
 ) -> None:
+    def via(enclosing) -> str:
+        # provenance for functions traced only through a cross-module
+        # edge: names the step factory (etc.) that pulled them under a
+        # trace, so a finding in utils/ is actionable without grepping
+        why = (reasons or {}).get(id(enclosing.node))
+        return f" (traced: {why})" if why else ""
+
     for node, enclosing in _iter_with_enclosing(tree, mod):
         if enclosing is None or id(enclosing.node) not in traced:
             continue
@@ -428,7 +694,7 @@ def _rule_traced_interop(
                     f"{d}() inside traced function "
                     f"'{enclosing.name}' forces a host sync (or fails the "
                     "trace); keep device values on device until the period "
-                    "fence")
+                    f"fence{via(enclosing)}")
             elif (
                 isinstance(node.func, ast.Attribute)
                 and node.func.attr in _HOST_SYNC_METHODS
@@ -436,23 +702,23 @@ def _rule_traced_interop(
             ):
                 add(node, "host-sync",
                     f".{node.func.attr}() inside traced function "
-                    f"'{enclosing.name}' forces a host sync per call")
+                    f"'{enclosing.name}' forces a host sync per call{via(enclosing)}")
             elif isinstance(node.func, ast.Name) and node.func.id == "float":
                 add(node, "host-sync",
                     f"float() inside traced function '{enclosing.name}' "
                     "concretizes a tracer (host sync / trace error); use "
-                    "jnp.float32 or .astype for dtype casts")
+                    f"jnp.float32 or .astype for dtype casts{via(enclosing)}")
             elif full is not None:
                 if d in _NONDET_DOTTED or full in _NONDET_DOTTED:
                     add(node, "nondeterminism",
                         f"{d}() inside traced function '{enclosing.name}': "
                         "wall-clock reads bake a constant into the compiled "
-                        "program (and differ across hosts)")
+                        f"program (and differ across hosts){via(enclosing)}")
                 elif full.startswith(("random.", "numpy.random.")):
                     add(node, "nondeterminism",
                         f"{d}() inside traced function '{enclosing.name}': "
                         "Python/NumPy RNG is host-side and per-process; use "
-                        "jax.random with an explicit key")
+                        f"jax.random with an explicit key{via(enclosing)}")
         elif isinstance(node, (ast.For, ast.comprehension)):
             it = node.iter
             is_set = isinstance(it, ast.Set) or (
@@ -465,7 +731,7 @@ def _rule_traced_interop(
                     f"iteration over a set inside traced function "
                     f"'{enclosing.name}': set order varies per process, so "
                     "traced program structure diverges across hosts; sort "
-                    "or use a tuple")
+                    f"or use a tuple{via(enclosing)}")
 
 
 def _rule_excepts(tree, rel: str, add) -> None:
@@ -540,20 +806,33 @@ def _rule_compat(tree, rel: str, add) -> None:
                         "check_vma= (compat.py translates on old runtimes)")
 
 
+# Call attrs treated as obs-event emission sites: the writer itself and
+# the thin `_emit` forwarders (Supervisor/PodSupervisor wrap EventWriter
+# behind one) — their literal kinds must be registered too, and they
+# count as "emitted" for the dead-kind rule.
+_EMIT_ATTRS = frozenset({"emit", "_emit"})
+
+
+def _emit_kind_literal(node: ast.Call) -> str | None:
+    """The literal event kind an emit/_emit call names, else None."""
+    kind = None
+    if node.args and isinstance(node.args[0], ast.Constant):
+        kind = node.args[0].value
+    for kw in node.keywords:
+        if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+            kind = kw.value.value
+    return kind if isinstance(kind, str) else None
+
+
 def _rule_obs_events(tree, registry: Registry, rel: str, add) -> None:
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or not isinstance(
             node.func, ast.Attribute
         ):
             continue
-        if node.func.attr == "emit":
-            kind = None
-            if node.args and isinstance(node.args[0], ast.Constant):
-                kind = node.args[0].value
-            for kw in node.keywords:
-                if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
-                    kind = kw.value.value
-            if isinstance(kind, str) and kind not in registry.event_kinds:
+        if node.func.attr in _EMIT_ATTRS:
+            kind = _emit_kind_literal(node)
+            if kind is not None and kind not in registry.event_kinds:
                 add(node, "obs-event-unregistered",
                     f"obs event kind {kind!r} is not in "
                     "obs/events.py EVENT_KINDS; register it (or fix the "
@@ -719,6 +998,359 @@ def _rule_exit_intent(tree, mod: _Module, rel: str, add) -> None:
                 "Rendezvous.publish_intent) before exiting")
 
 
+# ---------------------------------------------------------------------------
+# collective-symmetry rule family
+# ---------------------------------------------------------------------------
+
+
+def _host_dependent_why(test: ast.AST) -> str | None:
+    """A short description of why a branch condition is host-dependent
+    (different hosts of one pod evaluate it differently), or None."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in _HOST_COND_NAMES:
+            return f"reads '{n.id}'"
+        if isinstance(n, ast.Attribute) and n.attr in _HOST_COND_NAMES:
+            d = _dotted(n)
+            return f"reads '{d or n.attr}'"
+        if (
+            isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and n.value.startswith("DDL_")
+        ):
+            return f"branches on env {n.value!r}"
+    return None
+
+
+def _collective_callee(call: ast.Call) -> str | None:
+    """'lax.psum' / 'rv.barrier' when the call is a collective or a
+    blocking rendezvous primitive, else None."""
+    d = _dotted(call.func)
+    if d is not None:
+        parts = d.split(".")
+        if parts[-1] in _COLLECTIVE_LAST and (
+            ".".join(parts[:-1]) in _COLLECTIVE_PREFIXES
+        ):
+            return d
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _BARRIER_ATTRS
+    ):
+        return d or f".{call.func.attr}"
+    return None
+
+
+def _rule_collective_symmetry(tree, mod: _Module, rel: str, add) -> None:
+    """In the coordination layer, the shared loop, and the step modules,
+    a collective / barrier / agree call reachable only under a
+    host-dependent condition is a split-brain hang: the hosts that don't
+    take the branch never make the matching call, and the ones that do
+    block forever (barrier timeout at best, a wedged all-reduce at
+    worst).  Conditions inside a *nested function definition* reset the
+    stack — the definition site does not gate the call's execution."""
+    if rel_suffix(rel) not in _COLLECTIVE_MODULES:
+        return
+
+    def visit(node: ast.AST, why: str | None) -> None:
+        if isinstance(node, _FUNC_NODES):
+            for child in ast.iter_child_nodes(node):
+                visit(child, None)
+            return
+        if isinstance(node, ast.Call) and why is not None:
+            callee = _collective_callee(node)
+            if callee is not None:
+                add(node, "collective-symmetry",
+                    f"collective/barrier call '{callee}' is reachable "
+                    f"only under a host-dependent condition ({why}): "
+                    "hosts that don't take this branch never make the "
+                    "matching call — a split-brain hang at pod scale. "
+                    "Make the call unconditional (same sequence on every "
+                    "host) or restructure so all hosts branch "
+                    "identically")
+        if isinstance(node, (ast.If, ast.While)):
+            new_why = _host_dependent_why(node.test) or why
+            visit(node.test, why)
+            for child in node.body:
+                visit(child, new_why)
+            for child in node.orelse:
+                visit(child, new_why)
+            return
+        if isinstance(node, ast.IfExp):
+            new_why = _host_dependent_why(node.test) or why
+            visit(node.test, why)
+            visit(node.body, new_why)
+            visit(node.orelse, new_why)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, why)
+
+    visit(tree, None)
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard rule family
+# ---------------------------------------------------------------------------
+
+
+def _rule_recompile_shape_branch(
+    tree, mod: _Module, traced: set[int], rel: str, add
+) -> None:
+    """Python branching on ``.shape``/``.dtype`` inside traced code:
+    legal (shapes are Python values under trace) but it specializes the
+    compiled program per input shape — every new shape silently
+    recompiles, the exact steps/s cliff the pjit paper chases.  Where
+    the dispatch is intentional (a fixed bucket grid the factory
+    precompiles), suppress with a justification."""
+    for node, enclosing in _iter_with_enclosing(tree, mod):
+        if enclosing is None or id(enclosing.node) not in traced:
+            continue
+        if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            continue
+        # a guard clause (body is a lone `raise`, no else) is a shape
+        # ASSERTION: the other program variant doesn't exist, invalid
+        # shapes just error — not the dispatch hazard this rule hunts
+        if (
+            isinstance(node, ast.If)
+            and not node.orelse
+            and len(node.body) == 1
+            and isinstance(node.body[0], ast.Raise)
+        ):
+            continue
+        attrs = sorted({
+            n.attr
+            for n in ast.walk(node.test)
+            if isinstance(n, ast.Attribute) and n.attr in ("shape", "dtype")
+        })
+        if attrs:
+            add(node, "recompile-shape-branch",
+                f"branch on .{'/.'.join(attrs)} inside traced function "
+                f"'{enclosing.name}': the Python branch specializes the "
+                "compiled program per input shape/dtype, so every new "
+                "shape recompiles silently (steps/s craters with no "
+                "error); pad/bucket inputs, or keep the dispatch but "
+                "bound the bucket set and precompile it")
+
+
+def _mutable_globals(tree: ast.Module) -> dict[str, str]:
+    """Module-level names bound to mutable containers (plus names
+    reassigned through ``global``), with a short description each."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if value is None:
+            continue
+        kind = None
+        if isinstance(value, _MUTABLE_LITERALS):
+            kind = type(value).__name__.lower().replace("comp", " comp")
+        elif isinstance(value, ast.Call):
+            d = _dotted(value.func)
+            if d in _MUTABLE_CTORS:
+                kind = f"{d}()"
+        if kind:
+            for t in targets:
+                out[t.id] = kind
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            for name in node.names:
+                out.setdefault(name, "reassigned via 'global'")
+    return out
+
+
+def _rule_recompile_mutable_global(
+    tree, mod: _Module, traced: set[int], rel: str, add
+) -> None:
+    """A traced function reading a mutable module global bakes its
+    trace-time value into the compiled program: later mutations silently
+    don't apply (or, if the object participates in a hash, force
+    retraces).  Pass the value as an argument or make it an immutable
+    constant."""
+    mutables = _mutable_globals(tree)
+    if not mutables:
+        return
+    seen: set[tuple[int, str]] = set()
+    for node, enclosing in _iter_with_enclosing(tree, mod):
+        if enclosing is None or id(enclosing.node) not in traced:
+            continue
+        if not isinstance(node, ast.Name) or not isinstance(
+            node.ctx, ast.Load
+        ):
+            continue
+        name = node.id
+        if name not in mutables:
+            continue
+        # shadowed by a parameter anywhere up the lexical chain -> the
+        # load reads the local, not the module global
+        if any(
+            name in outer.params
+            for outer in mod.enclosing_chain(enclosing)
+        ):
+            continue
+        key = (id(enclosing.node), name)
+        if key in seen:
+            continue
+        seen.add(key)
+        add(node, "recompile-mutable-global",
+            f"traced function '{enclosing.name}' closes over mutable "
+            f"module global '{name}' ({mutables[name]}): its value is "
+            "baked in at trace time — later mutations silently don't "
+            "apply to the compiled program; pass it as an argument or "
+            "freeze it into an immutable constant")
+
+
+def _static_decls(call: ast.Call) -> tuple[set[int], set[str]] | None:
+    """(static positions, static names) a jit call declares, else None."""
+    if _dotted(call.func) not in ("jax.jit", "jit"):
+        return None
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        consts = (
+            [kw.value] if isinstance(kw.value, ast.Constant)
+            else list(ast.walk(kw.value))
+        )
+        for e in consts:
+            if isinstance(e, ast.Constant):
+                if isinstance(e.value, int):
+                    nums.add(e.value)
+                elif isinstance(e.value, str):
+                    names.add(e.value)
+    if not nums and not names:
+        return None
+    return nums, names
+
+
+def _rule_recompile_static_args(tree, mod: _Module, rel: str, add) -> None:
+    """Hazards at ``jit(..., static_argnums/static_argnames=...)``
+    boundaries, seen from the call sites of the jitted wrapper:
+
+    * an unhashable literal (list/dict/set) as a static arg — jit hashes
+      static args for its compile cache, so this throws at dispatch;
+    * a freshly-constructed object (``Cfg(...)`` at the call site) — a
+      new instance per call identity-hashes, so the compile cache
+      misses EVERY call and the program silently recompiles each step
+      (the fresh-PRNGKey-as-static class of bug).  Value-hashed
+      built-ins (``tuple(...)``/``frozenset(...)``) are fine.
+    """
+    jitted: dict[str, tuple[set[int], set[str]]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            decls = _static_decls(node.value)
+            if decls is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted[t.id] = decls
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    target = dec
+                    if _is_partial(dec.func) and dec.args:
+                        # @partial(jax.jit, static_argnames=...)
+                        if _dotted(dec.args[0]) not in ("jax.jit", "jit"):
+                            continue
+                        target = ast.Call(
+                            func=dec.args[0], args=[], keywords=dec.keywords
+                        )
+                    decls = _static_decls(target)
+                    if decls is not None:
+                        jitted[node.name] = decls
+    if not jitted:
+        return
+
+    def check(arg: ast.AST, where: str) -> None:
+        if isinstance(arg, _MUTABLE_LITERALS):
+            add(arg, "recompile-unhashable-static",
+                f"unhashable {type(arg).__name__.lower()} literal as the "
+                f"static arg {where}: jit hashes static args for its "
+                "compile cache — this raises at dispatch; pass a tuple/"
+                "frozen structure (or make the arg traced)")
+        elif isinstance(arg, ast.Call):
+            d = _dotted(arg.func) or "<call>"
+            if d in _VALUE_HASHED_CTORS or _is_partial(arg.func):
+                return
+            add(arg, "recompile-fresh-static",
+                f"freshly-constructed '{d}(...)' as the static arg "
+                f"{where}: a new instance per call identity-hashes, so "
+                "the jit compile cache misses EVERY call — a silent "
+                "recompile per step; construct it once at factory level "
+                "(or use a value-hashed/immutable type)")
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Name
+        ):
+            continue
+        decls = jitted.get(node.func.id)
+        if decls is None:
+            continue
+        nums, names = decls
+        for i, arg in enumerate(node.args):
+            if i in nums:
+                check(arg, f"(position {i}) of '{node.func.id}'")
+        for kw in node.keywords:
+            if kw.arg in names:
+                check(kw.value, f"'{kw.arg}=' of '{node.func.id}'")
+
+
+# ---------------------------------------------------------------------------
+# package-level rule: dead event kinds (needs every module's emits)
+# ---------------------------------------------------------------------------
+
+
+def _collect_emitted_kinds(trees) -> set[str]:
+    kinds: set[str] = set()
+    for tree in trees:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EMIT_ATTRS
+            ):
+                kind = _emit_kind_literal(node)
+                if kind is not None:
+                    kinds.add(kind)
+    return kinds
+
+
+def _rule_dead_event_kinds(
+    trees, registry: Registry, events_rel: str, events_src: str | None
+) -> list[Finding]:
+    """Every EVENT_KINDS entry must be emitted somewhere in the package:
+    a kind nothing emits is either dead weight or evidence the emitter
+    was deleted while its dashboards still query the name.  Anchored at
+    the registry line, so a justified keep is a suppression comment on
+    that entry."""
+    if not registry.event_kinds:
+        return []
+    emitted = _collect_emitted_kinds(trees)
+    lines = (events_src or "").splitlines()
+    findings: list[Finding] = []
+    for kind in sorted(registry.event_kinds - emitted):
+        line = registry.kind_lines.get(kind, 1)
+        src_line = lines[line - 1] if 0 < line <= len(lines) else ""
+        if suppressed(src_line, "obs-event-dead"):
+            continue
+        findings.append(Finding(
+            events_rel, line, "obs-event-dead",
+            f"event kind {kind!r} is registered in EVENT_KINDS but "
+            "nothing in the package emits it; prune it (or suppress "
+            "with a justification if an external emitter owns it)",
+        ))
+    return findings
+
+
 def rel_suffix(rel: str) -> str:
     """'ddl_tpu/train/loop.py' -> 'train/loop.py' (module path within
     the package, for the per-module rule scopes)."""
@@ -733,9 +1365,49 @@ def rel_suffix(rel: str) -> str:
 # ---------------------------------------------------------------------------
 
 
+def _run_rules(
+    tree,
+    mod: _Module,
+    traced: set[int],
+    rel: str,
+    src: str,
+    registry: Registry,
+    reasons: dict[int, str] | None = None,
+) -> list[Finding]:
+    """Every per-module rule over one parsed module, with ``traced``
+    supplied by the caller (local inference for ``lint_file``, the
+    cross-module program inference for ``lint_package``)."""
+    lines = src.splitlines()
+    findings: list[Finding] = []
+
+    def add(node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        src_line = lines[line - 1] if 0 < line <= len(lines) else ""
+        if suppressed(src_line, rule):
+            return
+        findings.append(Finding(rel, line, rule, message))
+
+    _rule_traced_interop(tree, mod, traced, rel, add, reasons)
+    _rule_excepts(tree, rel, add)
+    _rule_compat(tree, rel, add)
+    _rule_obs_events(tree, registry, rel, add)
+    _rule_pspec(tree, mod, rel, add)
+    _rule_pspec_hand_rolled(tree, mod, rel, add)
+    _rule_donation(tree, mod, rel, add)
+    _rule_exit_intent(tree, mod, rel, add)
+    _rule_collective_symmetry(tree, mod, rel, add)
+    _rule_recompile_shape_branch(tree, mod, traced, rel, add)
+    _rule_recompile_mutable_global(tree, mod, traced, rel, add)
+    _rule_recompile_static_args(tree, mod, rel, add)
+    return findings
+
+
 def lint_file(
     path: str | Path, repo_root: str | Path, registry: Registry
 ) -> list[Finding]:
+    """Single-file run (explicit CLI paths, editor-on-save): every
+    per-module rule with module-local traced inference — no cross-module
+    propagation, no package-level rules."""
     path = Path(path)
     try:
         rel = path.relative_to(repo_root).as_posix()
@@ -746,41 +1418,69 @@ def lint_file(
         tree = ast.parse(src)
     except SyntaxError as e:
         return [Finding(rel, e.lineno or 1, "syntax-error", str(e.msg))]
-    lines = src.splitlines()
     mod = _Module(tree)
     traced = _infer_traced(mod)
-    findings: list[Finding] = []
-
-    def add(node: ast.AST, rule: str, message: str) -> None:
-        line = getattr(node, "lineno", 1)
-        src_line = lines[line - 1] if 0 < line <= len(lines) else ""
-        if suppressed(src_line, rule):
-            return
-        findings.append(Finding(rel, line, rule, message))
-
-    _rule_traced_interop(tree, mod, traced, rel, add)
-    _rule_excepts(tree, rel, add)
-    _rule_compat(tree, rel, add)
-    _rule_obs_events(tree, registry, rel, add)
-    _rule_pspec(tree, mod, rel, add)
-    _rule_pspec_hand_rolled(tree, mod, rel, add)
-    _rule_donation(tree, mod, rel, add)
-    _rule_exit_intent(tree, mod, rel, add)
-    return sorted(findings)
+    return sorted(_run_rules(tree, mod, traced, rel, src, registry))
 
 
 def lint_package(
-    package_root: str | Path, files: list[Path] | None = None
+    package_root: str | Path,
+    files: list[Path] | None = None,
+    graph=None,
 ) -> list[Finding]:
-    """Run every AST rule over the package (or an explicit file list).
+    """Run every AST rule over the package with WHOLE-PROGRAM traced-set
+    inference: the import/call graph (``callgraph.CallGraph``) is always
+    built over the full package, so a host sync hidden behind a helper
+    in another module is attributed correctly even when ``files``
+    narrows the *reported* set (``lint --changed``).  Package-level
+    rules (dead event kinds) run only on full-package reports.
     ``package_root`` is the ``ddl_tpu`` directory; paths in findings are
-    relative to its parent (the repo root)."""
+    relative to its parent (the repo root).  A caller that already built
+    the ``graph`` (the ``--changed`` CLI computes the closure from one)
+    passes it in to avoid a second full parse — it MUST reflect the
+    current on-disk sources."""
+    from ddl_tpu.analysis.callgraph import CallGraph
+
     package_root = Path(package_root)
     repo_root = package_root.parent
     registry = load_registry(package_root)
+    if graph is None:
+        graph = CallGraph(package_root)
+    traced, reasons = infer_traced_program(graph)
+    full_run = files is None
     if files is None:
         files = sorted(package_root.rglob("*.py"))
     findings: list[Finding] = []
     for f in files:
-        findings.extend(lint_file(f, repo_root, registry))
+        f = Path(f)
+        try:
+            rel = f.relative_to(repo_root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        info = graph.by_rel.get(rel)
+        if info is None:
+            # outside the package, or a syntax error the graph skipped:
+            # single-file fallback (reports the syntax error)
+            findings.extend(lint_file(f, repo_root, registry))
+            continue
+        mod_reasons = {
+            node_id: why
+            for (mname, node_id), why in reasons.items()
+            if mname == info.name
+        }
+        findings.extend(_run_rules(
+            info.tree, info.mod, traced[info.name], rel, info.src,
+            registry, mod_reasons,
+        ))
+    events_rel = f"{package_root.name}/obs/events.py"
+    if full_run or any(
+        Path(f).name == "events.py" for f in files
+    ):
+        events_info = graph.by_rel.get(events_rel)
+        findings.extend(_rule_dead_event_kinds(
+            [i.tree for i in graph.modules.values()],
+            registry,
+            events_rel,
+            events_info.src if events_info is not None else None,
+        ))
     return sorted(findings)

@@ -1,0 +1,256 @@
+"""Package-wide import/call graph — the whole-program half of the lint.
+
+``CallGraph`` parses every module of a package once and answers the two
+questions the per-module AST engine (``astlint.py``) cannot:
+
+* **name resolution across modules** — given ``helpers.sync_mean`` (or a
+  bare ``sync_mean`` bound by ``from .helpers import sync_mean``) inside
+  module M, which function *definition* does it refer to?  Handles
+  ``import x.y as z`` attribute chains, ``from x import y`` (absolute and
+  relative, any level), and re-export chains (``ddl_tpu.ops.__init__``
+  re-exporting ``cross_entropy_loss`` from ``ops/losses.py``) to a
+  bounded depth.  Resolution is *static and conservative*: only
+  module-level ``def``s reachable through import bindings resolve;
+  methods, dynamically-bound attributes, and anything outside the
+  package return ``None``.
+* **module dependency closure** — which modules (transitively) import a
+  given module.  This is what ``ddl_tpu lint --changed`` uses to lint a
+  git diff plus every module whose traced-set inference could have been
+  changed by it.
+
+The traced-set inference itself stays in ``astlint.py``
+(``infer_traced_program``) — this module is pure structure, no rules, no
+JAX import.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import subprocess
+from pathlib import Path
+
+from ddl_tpu.analysis.astlint import _Func, _Module
+
+__all__ = ["CallGraph", "ModuleInfo", "Target", "changed_package_files"]
+
+_MAX_REEXPORT_DEPTH = 8  # bound re-export chases (and import cycles)
+
+
+@dataclasses.dataclass
+class Target:
+    """A resolved function definition: which module owns it + its node."""
+
+    module: str
+    func: _Func
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str  # dotted module name, e.g. "ddl_tpu.utils.backoff"
+    path: Path
+    rel: str  # repo-relative posix path, e.g. "ddl_tpu/utils/backoff.py"
+    src: str
+    tree: ast.Module
+    mod: _Module
+    # local binding -> fully-qualified dotted name.  For ``import x.y``
+    # the binding is "x" -> "x" (the attribute chain completes it); for
+    # ``from a.b import c as d`` it is "d" -> "a.b.c" with relative
+    # levels resolved against this module's package.
+    fq_imports: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _module_name(package_root: Path, path: Path) -> str:
+    rel = path.relative_to(package_root.parent)
+    parts = list(rel.with_suffix("").parts)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class CallGraph:
+    """Parsed view of one package: every module, its imports resolved to
+    fully-qualified names, and the module-level dependency graph."""
+
+    def __init__(self, package_root: str | Path) -> None:
+        self.package_root = Path(package_root)
+        self.repo_root = self.package_root.parent
+        self.package = self.package_root.name
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_rel: dict[str, ModuleInfo] = {}
+        for f in sorted(self.package_root.rglob("*.py")):
+            src = f.read_text()
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue  # astlint reports the syntax error per-file
+            name = _module_name(self.package_root, f)
+            rel = f.relative_to(self.repo_root).as_posix()
+            info = ModuleInfo(name, f, rel, src, tree, _Module(tree))
+            self.modules[name] = info
+            self.by_rel[rel] = info
+        for info in self.modules.values():
+            info.fq_imports = self._fq_imports(info)
+        self._deps = {
+            name: self._module_deps(info)
+            for name, info in self.modules.items()
+        }
+
+    # ------------------------------------------------------------ imports
+
+    def _fq_imports(self, info: ModuleInfo) -> dict[str, str]:
+        out: dict[str, str] = {}
+        parts = info.name.split(".")
+        is_pkg = info.path.name == "__init__.py"
+        # the package a level-1 relative import resolves against
+        parent = parts if is_pkg else parts[:-1]
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        out[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        out[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = parent[: len(parent) - (node.level - 1)]
+                    mod = ".".join(
+                        base
+                        + (node.module.split(".") if node.module else [])
+                    )
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    out[a.asname or a.name] = (
+                        f"{mod}.{a.name}" if mod else a.name
+                    )
+        return out
+
+    # --------------------------------------------------------- resolution
+
+    def resolve_dotted(
+        self, info: ModuleInfo, dotted: str, _depth: int = 0
+    ) -> Target | None:
+        """The function definition a dotted reference in ``info`` names,
+        or None (method, external, or not statically resolvable)."""
+        if not dotted or _depth > _MAX_REEXPORT_DEPTH:
+            return None
+        parts = dotted.split(".")
+        head = parts[0]
+        # a bare local name: the module's own def wins (it is the binding
+        # the module actually calls in the common shadowing case)
+        if len(parts) == 1:
+            cands = info.mod.by_name.get(head)
+            if cands:
+                top = [c for c in cands if c.parent is None]
+                if top:
+                    return Target(info.name, top[-1])
+        fq = info.fq_imports.get(head)
+        if fq is None:
+            return None
+        return self._resolve_fq(fq.split(".") + parts[1:], _depth + 1)
+
+    def _resolve_fq(
+        self, parts: list[str], _depth: int = 0
+    ) -> Target | None:
+        if _depth > _MAX_REEXPORT_DEPTH:
+            return None
+        # longest module prefix inside the package
+        for i in range(len(parts), 0, -1):
+            mname = ".".join(parts[:i])
+            if mname not in self.modules:
+                continue
+            rest = parts[i:]
+            tinfo = self.modules[mname]
+            if not rest:
+                return None  # names a module, not a function
+            if len(rest) == 1:
+                cands = tinfo.mod.by_name.get(rest[0])
+                top = [c for c in (cands or []) if c.parent is None]
+                if top:
+                    return Target(mname, top[-1])
+            # re-export chase: the first remaining part is itself an
+            # import binding in the matched module (package __init__
+            # re-exporting a submodule's function, or a module alias)
+            fq2 = tinfo.fq_imports.get(rest[0])
+            if fq2:
+                return self._resolve_fq(
+                    fq2.split(".") + rest[1:], _depth + 1
+                )
+            return None
+        return None
+
+    # ------------------------------------------------------- dependencies
+
+    def _module_deps(self, info: ModuleInfo) -> set[str]:
+        deps: set[str] = set()
+        for fq in info.fq_imports.values():
+            parts = fq.split(".")
+            for i in range(len(parts), 0, -1):
+                m = ".".join(parts[:i])
+                if m in self.modules:
+                    deps.add(m)
+                    break
+        deps.discard(info.name)
+        return deps
+
+    def reverse_closure(self, names: set[str]) -> set[str]:
+        """``names`` plus every module that (transitively) imports one of
+        them — the set whose lint verdict a change to ``names`` can
+        affect."""
+        rev: dict[str, set[str]] = {}
+        for m, ds in self._deps.items():
+            for d in ds:
+                rev.setdefault(d, set()).add(m)
+        out = {n for n in names if n in self.modules}
+        frontier = list(out)
+        while frontier:
+            n = frontier.pop()
+            for m in rev.get(n, ()):
+                if m not in out:
+                    out.add(m)
+                    frontier.append(m)
+        return out
+
+
+def changed_package_files(repo_root: str | Path) -> list[str] | None:
+    """Paths (relative to ``repo_root``) of ``.py`` files touched in
+    the working tree (staged + unstaged + untracked) vs HEAD, or None
+    when git is unavailable (callers fall back to a full run).
+
+    ``git diff`` reports paths relative to the git TOPLEVEL while
+    ``git ls-files --others`` reports them relative to the cwd — both
+    are normalized against the toplevel and re-relativized to
+    ``repo_root``, so a package nested below the git root still
+    matches the call graph's ``by_rel`` keys; files outside
+    ``repo_root`` are dropped."""
+    repo_root = Path(repo_root).resolve()
+    try:
+        toplevel = Path(subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=repo_root, capture_output=True, text=True, check=True,
+        ).stdout.strip())
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=toplevel, capture_output=True, text=True, check=True,
+        ).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=toplevel, capture_output=True, text=True, check=True,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    out = set()
+    for line in (diff + untracked).splitlines():
+        line = line.strip()
+        if not line.endswith(".py"):
+            continue
+        abs_path = toplevel / line
+        try:
+            out.add(abs_path.resolve().relative_to(repo_root).as_posix())
+        except ValueError:
+            continue  # outside repo_root (sibling package in a monorepo)
+    return sorted(out)

@@ -5,12 +5,38 @@
     python -m ddl_tpu.cli lint --baseline LINT_BASELINE.json
     python -m ddl_tpu.cli lint --baseline LINT_BASELINE.json --update-baseline
     python -m ddl_tpu.cli lint --no-contracts path/to/file.py ...
+    python -m ddl_tpu.cli lint --changed             # git-diff scope +
+                                                     #  reverse-dep closure
+    python -m ddl_tpu.cli lint --fix                 # autofix mechanical
+                                                     #  findings, then re-lint
+    python -m ddl_tpu.cli lint --fix --check         # CI gate: diff + exit 1
+                                                     #  if fixes are pending
 
 Exit codes: 0 = clean (every finding baselined or suppressed), 1 = new
 findings.  With ``--baseline`` the committed ``LINT_BASELINE.json``
 gates CI: pre-existing findings don't fail the build, new ones do, and
 stale entries are reported so the baseline only ever shrinks
 (``--update-baseline`` rewrites it after intentional changes).
+
+``--fix`` applies the deterministic autofixes (``analysis/fixes.py``:
+bare excepts, compat-bypass imports/kwargs, hand-rolled PartitionSpec
+literals → rule-table constants, unregistered emitted event kinds →
+EVENT_KINDS) and then re-lints; a second ``--fix`` run is a byte-level
+no-op.  ``--fix --check`` prints the unified diff instead of writing
+and exits nonzero when any mechanical fix is pending — the pre-commit /
+CI twin of ``git diff --exit-code``.
+
+``--changed`` lints the modules git says changed (worktree vs HEAD,
+staged + untracked) PLUS their reverse-dependency closure over the
+package import graph (``analysis/callgraph.py``) — the whole set whose
+verdict the edit can affect, because traced-set inference crosses
+module boundaries.  Contract probes are skipped (fast pre-commit use);
+the AST pass still builds the full-package call graph, so cross-module
+findings inside the scope are exact, not approximated.
+
+``--package-root DIR`` lints an alternate package tree (fixture
+packages in tests); the baseline default and the fixers' registry/rule
+-table lookups follow it.
 """
 
 from __future__ import annotations
@@ -29,7 +55,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "paths", nargs="*",
         help="specific files to lint (default: the whole package; "
-        "explicit paths run the AST rules only)",
+        "explicit paths run the AST rules only, without cross-module "
+        "inference)",
     )
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument(
@@ -46,25 +73,144 @@ def main(argv=None) -> int:
         help="skip the sharding-contract probes (AST rules only — "
         "no JAX, runs in milliseconds)",
     )
+    ap.add_argument(
+        "--fix", action="store_true",
+        help="apply deterministic autofixes for the mechanical finding "
+        "classes, then re-lint (implies --no-contracts)",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="with --fix: print the unified diff of pending fixes, "
+        "write nothing, exit 1 if any fix is pending",
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="lint only git-changed package modules plus their "
+        "reverse-dependency closure (skips contract probes)",
+    )
+    ap.add_argument(
+        "--package-root", default=None, metavar="DIR",
+        help="lint this package directory instead of the installed "
+        "ddl_tpu (fixture packages in tests)",
+    )
     args = ap.parse_args(argv)
+    if args.check and not args.fix:
+        ap.error("--check requires --fix")
+    if args.fix and args.update_baseline:
+        ap.error("--fix and --update-baseline are mutually exclusive")
+    if args.changed and args.paths:
+        ap.error("--changed and explicit paths are mutually exclusive")
+    if args.changed and args.update_baseline:
+        # a scoped run sees only the closure's findings — rewriting the
+        # baseline from it would silently delete every out-of-scope entry
+        ap.error("--update-baseline needs a full run, not --changed")
 
     from ddl_tpu.analysis.findings import save_baseline
     from ddl_tpu.analysis.runner import package_root, run_lint
 
+    pkg = (
+        Path(args.package_root).resolve()
+        if args.package_root else package_root()
+    )
+    repo_root = pkg.parent
     files = [Path(p) for p in args.paths] or None
+    notes: list[str] = []
+    graph = None  # prebuilt by --changed; reused by the first lint pass
+
+    if args.changed:
+        from ddl_tpu.analysis.callgraph import (
+            CallGraph,
+            changed_package_files,
+        )
+
+        changed = changed_package_files(repo_root)
+        if changed is None:
+            print("lint --changed: git unavailable; run a full lint")
+            return 2
+        graph = CallGraph(pkg)  # reused by lint_once below
+        changed_mods = {
+            graph.by_rel[rel].name
+            for rel in changed if rel in graph.by_rel
+        }
+        if not changed_mods:
+            print("lint --changed: no changed package modules")
+            return 0
+        closure = graph.reverse_closure(changed_mods)
+        files = sorted(graph.modules[n].path for n in closure)
+        notes.append(
+            f"--changed scope: {len(changed_mods)} changed module(s) + "
+            f"{len(closure) - len(changed_mods)} reverse dependent(s)"
+        )
+
     baseline_path = args.baseline
     if args.update_baseline and baseline_path is None:
-        baseline_path = package_root().parent / "LINT_BASELINE.json"
-
-    result = run_lint(
-        files=files,
-        contracts=not args.no_contracts and files is None,
-        baseline_path=(
-            baseline_path
-            if baseline_path and Path(baseline_path).exists()
-            else None
-        ),
+        baseline_path = repo_root / "LINT_BASELINE.json"
+    contracts = (
+        not args.no_contracts
+        and files is None
+        and not args.fix
+        and not args.changed
+        # the contract probes build the REAL package's step factories;
+        # they don't apply to an alternate --package-root tree
+        and args.package_root is None
     )
+    scope_rels = (
+        {
+            Path(f).resolve().relative_to(repo_root).as_posix()
+            for f in files
+        }
+        if args.changed else None
+    )
+
+    def lint_once(reuse_graph=None):
+        return run_lint(
+            root=pkg,
+            files=files,
+            contracts=contracts,
+            baseline_path=(
+                baseline_path
+                if baseline_path and Path(baseline_path).exists()
+                else None
+            ),
+            scope_rels=scope_rels,
+            graph=reuse_graph,
+        )
+
+    result = lint_once(reuse_graph=graph)
+
+    if args.fix:
+        from ddl_tpu.analysis.fixes import plan_fixes
+
+        plan = plan_fixes(result.findings, repo_root, pkg)
+        if args.check:
+            if plan.changed:
+                print(plan.unified_diff(repo_root), end="")
+                print(
+                    f"lint --fix --check: {len(plan.fixed)} mechanical "
+                    "fix(es) pending (nothing written); run "
+                    "`ddl_tpu lint --fix`"
+                )
+                return 1
+            print("lint --fix --check: nothing to fix")
+            return 0
+        if plan.changed:
+            plan.apply()
+            print(
+                f"fixed {len(plan.fixed)} finding(s) in "
+                f"{len(plan.edits)} file(s)"
+            )
+            for path in sorted(plan.edits):
+                try:
+                    print(f"  {path.relative_to(repo_root)}")
+                except ValueError:
+                    print(f"  {path}")
+        else:
+            print("lint --fix: nothing to fix")
+        for f in plan.unfixable:
+            print(f"not auto-fixable: {f.format()}")
+        # re-lint so the verdict reflects the repaired tree (fresh
+        # graph: --fix may have rewritten sources on disk)
+        result = lint_once()
 
     if args.update_baseline:
         save_baseline(baseline_path, result.findings)
@@ -73,13 +219,14 @@ def main(argv=None) -> int:
         )
         return 0
 
+    notes = notes + result.notes
     if args.as_json:
         print(json.dumps(
             {
                 "new": [f.to_dict() for f in result.new],
                 "baselined": [f.to_dict() for f in result.known],
                 "stale_baseline": [f.to_dict() for f in result.stale],
-                "notes": result.notes,
+                "notes": notes,
                 "ok": result.ok,
             },
             indent=1,
@@ -88,7 +235,7 @@ def main(argv=None) -> int:
 
     for f in result.new:
         print(f.format())
-    for note in result.notes:
+    for note in notes:
         print(f"note: {note}")
     if result.known:
         print(f"{len(result.known)} baselined finding(s) (not failing)")

@@ -36,15 +36,22 @@ def run_lint(
     files: list[Path] | None = None,
     contracts: bool = True,
     baseline_path: str | Path | None = None,
+    scope_rels: set[str] | None = None,
+    graph=None,
 ) -> LintResult:
     """Run both engines and fold in the baseline.
 
     ``contracts=False`` keeps the run pure-AST (no JAX import — usable
-    on a log-analysis host, and what editors want on save)."""
+    on a log-analysis host, and what editors want on save).
+    ``scope_rels`` narrows the *baseline comparison* to those
+    repo-relative paths (``lint --changed``: baseline entries for
+    out-of-scope files are neither matched nor reported stale).
+    ``graph`` is an optional prebuilt, current ``CallGraph`` (the
+    ``--changed`` CLI reuses the one it computed the closure from)."""
     from ddl_tpu.analysis.astlint import lint_package
 
     root = root or package_root()
-    findings = list(lint_package(root, files=files))
+    findings = list(lint_package(root, files=files, graph=graph))
     notes: list[str] = []
     if contracts and files is None:
         from ddl_tpu.analysis.contracts import run_contracts
@@ -56,6 +63,8 @@ def run_lint(
     baseline = (
         load_baseline(baseline_path) if baseline_path is not None else []
     )
+    if scope_rels is not None:
+        baseline = [f for f in baseline if f.path in scope_rels]
     new, known, stale = split_by_baseline(findings, baseline)
     return LintResult(
         findings=findings, new=new, known=known, stale=stale, notes=notes

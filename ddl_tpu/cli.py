@@ -62,11 +62,18 @@ with ``DDL_OBS_PROFILE=1`` anomalies additionally arm a rate-limited
 ``jax.profiler`` capture whose per-op digest lands in the stream —
 ``ddl_tpu/obs/profiler.py``.)
 
-Static analysis (``ddl_tpu/analysis/``): AST anti-pattern rules plus the
-sharding-contract probes, gated by the committed ``LINT_BASELINE.json``:
+Static analysis (``ddl_tpu/analysis/``): AST anti-pattern rules with
+whole-program traced-set inference over the package call graph
+(host-sync/nondeterminism through cross-module helpers,
+collective-symmetry, recompile hazards, dead event kinds) plus the
+sharding-contract probes, gated by the committed ``LINT_BASELINE.json``;
+``--fix`` applies the deterministic autofixes (``--check`` diffs them
+without writing) and ``--changed`` scopes a run to the git diff plus its
+reverse-dependency closure:
 
     python -m ddl_tpu.cli lint [--json] [--baseline LINT_BASELINE.json]
-        [--update-baseline] [--no-contracts] [paths...]
+        [--update-baseline] [--no-contracts] [--changed]
+        [--fix [--check]] [paths...]
 
 Headline perf gate (``ddl_tpu/bench/gate.py``): the MFU / steps-per-sec
 regression gate against ``BASELINE.json``'s stored headline (the bench

@@ -1,0 +1,46 @@
+"""Known-bad fixture: collective-symmetry violations — barriers /
+collectives reachable only under host-dependent conditions.  Linted by
+tests with a coord-module rel path (supervisor.py / coord.py /
+train/loop.py); the rule does not apply elsewhere.  Parsed by
+tests/test_lint_v2.py — never imported."""
+
+import os
+
+from jax import lax
+
+
+def rank_gated_barrier(rv, epoch):
+    if rv.host == 0:
+        rv.barrier(f"e{epoch}-join")  # collective-symmetry: rv.host branch
+    return epoch
+
+
+def env_gated_arrive(rv):
+    if os.environ.get("DDL_FAST_RESTART"):
+        rv.arrive("join")  # collective-symmetry: DDL_* env branch
+    rv.barrier("start")  # unconditional: fine
+
+
+def conditional_psum(x, host_id):
+    while host_id != 0:
+        x = lax.psum(x, "data")  # collective-symmetry: host_id loop
+    return x
+
+
+def symmetric_protocol(rv, compute_fn):
+    # every host runs the same sequence: none of these may be flagged
+    rv.barrier("start")
+    value = rv.agree("resume", compute_fn)
+    rv.arrive("done")
+    return value
+
+
+def defines_under_condition(rv, host_id):
+    # a function DEFINED under a host branch is not a call made under
+    # it — the nested body resets the condition stack
+    if host_id == 0:
+        def proposer():
+            return rv.barrier("propose")  # fine: definition, not a call
+
+        return proposer
+    return None

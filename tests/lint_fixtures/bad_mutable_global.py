@@ -1,0 +1,37 @@
+"""Known-bad fixture: recompile-mutable-global — traced functions
+closing over mutable module globals.  The immutable constant and the
+host-side reader must NOT be flagged.  Parsed by tests/test_lint_v2.py
+— never imported."""
+
+import jax
+
+_CACHE = {}  # mutated by host code between steps
+_SCALES = [1.0, 0.5]
+FROZEN = (1.0, 0.5)
+
+
+def make_step():
+    def step(x):
+        # both reads bake the trace-time value into the program
+        y = x * _SCALES[0]  # recompile-mutable-global (_SCALES)
+        return y + len(_CACHE)  # recompile-mutable-global (_CACHE)
+
+    return jax.jit(step)
+
+
+def make_clean_step():
+    def step(x):
+        return x * FROZEN[0]  # immutable constant: fine
+
+    return jax.jit(step)
+
+
+def host_lookup(key):
+    return _CACHE.get(key)  # not traced: fine
+
+
+def shadowed(_SCALES):
+    def step(x):
+        return x * _SCALES[0]  # parameter shadows the global: fine
+
+    return jax.jit(step)

@@ -1,0 +1,28 @@
+"""Known-bad fixture: recompile-shape-branch — Python branching on
+traced ``.shape``/``.dtype``.  The lone-raise guard clause and the
+host-side branch must NOT be flagged.  Parsed by tests/test_lint_v2.py
+— never imported."""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_step():
+    def step(x):
+        if x.shape[0] > 4:  # recompile-shape-branch (If on .shape)
+            y = x * 2
+        else:
+            y = x + 1
+        z = x.sum() if x.dtype == jnp.float32 else x.mean()  # recompile-shape-branch (IfExp on .dtype)
+        if x.shape[0] % 2:  # guard clause: lone raise -> NOT flagged
+            raise ValueError("odd batch")
+        return y + z
+
+    return jax.jit(step)
+
+
+def host_side_bucketing(x):
+    # not traced: factory-level shape dispatch is the recommended fix
+    if x.shape[0] > 4:
+        return "big"
+    return "small"

@@ -1,0 +1,33 @@
+"""Known-bad fixture: recompile-unhashable-static /
+recompile-fresh-static — hazardous arguments at jit static boundaries.
+The module-constant and value-hashed call sites must NOT be flagged.
+Parsed by tests/test_lint_v2.py — never imported."""
+
+from functools import partial
+
+import jax
+
+CFG = ("adam", 0.1)
+
+
+def apply_model(x, cfg):
+    return x * len(cfg)
+
+
+wrapped = jax.jit(apply_model, static_argnames=("cfg",))
+by_pos = jax.jit(apply_model, static_argnums=(1,))
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def decorated(x, mode):
+    return x if mode == "train" else x * 0
+
+
+def drive(x, make_cfg):
+    wrapped(x, cfg={"opt": "adam"})  # recompile-unhashable-static (dict)
+    wrapped(x, cfg=make_cfg())  # recompile-fresh-static (ctor per call)
+    by_pos(x, [1, 2])  # recompile-unhashable-static (list, positional)
+    decorated(x, mode=make_cfg())  # recompile-fresh-static (decorator form)
+    wrapped(x, cfg=CFG)  # module constant: fine
+    wrapped(x, cfg=tuple(x))  # value-hashed builtin: fine
+    return x

@@ -1,0 +1,7 @@
+"""Fixture emitter: keeps ``span`` alive so only ``ghost`` (and the
+suppressed ``external``) go unemitted.  Copied to a tmp package by
+tests/test_lint_v2.py — never imported."""
+
+
+def beat(writer):
+    writer.emit("span", step=0)

@@ -1,0 +1,11 @@
+"""Fixture registry with a DEAD kind: ``ghost`` is registered but
+nothing in the package emits it — the dead-event-kind rule must flag
+it at this file's EVENT_KINDS line.  ``external`` is also unemitted
+but carries a suppression (the justified-keep escape hatch).  Copied
+to a tmp package by tests/test_lint_v2.py — never imported."""
+
+EVENT_KINDS = (
+    "span",
+    "ghost",
+    "external",  # ddl-lint: disable=obs-event-dead  (emitted by an external agent)
+)

@@ -1,0 +1,12 @@
+"""Fixture mini-registry for the autofix round-trip tests: the
+``--fix`` run must append ``runtime.py``'s unregistered kind here.
+Copied to a tmp ``ddl_tpu`` package by tests/test_lint_v2.py — never
+imported."""
+
+EVENT_KINDS = (
+    "span",
+)
+
+ANOMALY_TYPES = (
+    "loss_spike",
+)

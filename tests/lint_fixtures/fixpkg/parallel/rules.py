@@ -1,0 +1,9 @@
+"""Fixture mini rule table: the constants the pspec autofix rewrites
+hand-rolled literals to (values mirror the real parallel/rules.py).
+Copied to a tmp ``ddl_tpu`` package by tests/test_lint_v2.py — never
+imported."""
+
+from jax.sharding import PartitionSpec as P
+
+BATCH_SPEC = P("data")
+TOKEN_SPEC = P(("data", "expert"), "seq")

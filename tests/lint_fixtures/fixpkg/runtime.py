@@ -1,0 +1,16 @@
+"""Fixture module carrying every remaining autofixable class: legacy
+shard_map import, check_rep kwarg (on a continuation line), bare
+except, and an emitted-but-unregistered event kind.  Copied to a tmp
+``ddl_tpu`` package by tests/test_lint_v2.py — never imported."""
+
+from jax.experimental.shard_map import shard_map
+
+
+def wrap(writer, f, mesh):
+    writer.emit("span")
+    writer.emit("new_kind", x=1)
+    try:
+        return shard_map(f, mesh=mesh, in_specs=None, out_specs=None,
+                         check_rep=False)
+    except:
+        return None

@@ -1,0 +1,9 @@
+"""Fixture step-factory module with hand-rolled PartitionSpec literals
+whose values match the rule-table constants — ``--fix`` must rewrite
+both to the constant names and add the import.  Copied to a tmp
+``ddl_tpu`` package by tests/test_lint_v2.py — never imported."""
+
+from jax.sharding import PartitionSpec as P
+
+SPEC = P(("data", "expert"), "seq")
+OTHER = P("data")

@@ -1,0 +1,8 @@
+"""Known-bad fixture PACKAGE: cross-module traced-set inference.
+Re-exports ``sync_mean`` so ``steps.py`` can reach it through the
+package ``__init__`` — the re-export chase the callgraph must follow.
+Parsed by tests/test_lint_v2.py — never imported."""
+
+from .helpers import sync_mean, takes_a_loss_fn
+
+__all__ = ["sync_mean", "takes_a_loss_fn"]

@@ -1,0 +1,29 @@
+"""Known-bad fixture: helpers that are only traced CROSS-MODULE.
+
+Nothing in this file is traced on its own — no transform call, no
+decorator.  ``sync_mean`` becomes traced because ``steps.py``'s jitted
+step calls it (through the package re-export), and ``takes_a_loss_fn``
+is a sink whose callers' arguments land under ``value_and_grad``.  The
+single-file engine sees a clean module; the whole-program pass must
+flag both host syncs.  Parsed by tests/test_lint_v2.py — never
+imported."""
+
+import numpy as np
+
+import jax
+
+
+def sync_mean(x):
+    # host-sync, but ONLY when reached from steps.py's traced step
+    return float(np.asarray(x).mean())
+
+
+def takes_a_loss_fn(f):
+    # sink parameter: anything passed as `f` from ANY module lands
+    # under a trace here
+    return jax.value_and_grad(f)
+
+
+def host_side_report(xs):
+    # never traced: a host-side caller may sync freely
+    return float(np.mean([np.asarray(x) for x in xs]))

@@ -1,0 +1,29 @@
+"""Known-bad fixture: the traced side of the cross-module pair.
+
+``train_step`` is jitted here; the helpers it pulls under the trace
+live in ``helpers.py`` (one reached through the package re-export, one
+through a module attribute, one by flowing into a foreign sink
+parameter).  Parsed by tests/test_lint_v2.py — never imported."""
+
+import jax
+
+from xmod_pkg import sync_mean
+from xmod_pkg import helpers
+
+
+def make_step(tx):
+    def train_step(state, x):
+        loss = (x * x).sum()
+        # cross-module call from traced code, via the __init__ re-export:
+        # helpers.sync_mean's float(np.asarray(...)) must be flagged THERE
+        m = sync_mean(loss)
+        return state, loss + m
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def make_other():
+    def inner_loss(p, x):
+        return float(x.mean())  # traced via helpers.takes_a_loss_fn's sink
+
+    return helpers.takes_a_loss_fn(inner_loss)

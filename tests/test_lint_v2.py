@@ -1,0 +1,527 @@
+"""Static analysis v2 (`ddl_tpu lint`): the whole-program half.
+
+Covers the package-wide call graph (callgraph.py: import/re-export
+resolution + reverse-dependency closure), cross-module traced-set
+inference (a host sync hidden behind a helper in ANOTHER module is
+flagged, fixture-proven with a two-file package), the
+collective-symmetry and recompile-hazard rule families, the
+dead-event-kind rule, `lint --fix [--check]` round trips
+(fix -> clean lint -> second fix is a byte-level no-op), and
+`lint --changed`'s git-scoped closure.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from ddl_tpu.analysis.astlint import (
+    lint_file,
+    lint_package,
+    load_registry,
+)
+from ddl_tpu.analysis.callgraph import CallGraph
+from ddl_tpu.analysis.fixes import plan_fixes
+
+REPO = Path(__file__).resolve().parents[1]
+PACKAGE = REPO / "ddl_tpu"
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+REGISTRY = load_registry(PACKAGE)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def _lint_tmp(tmp_path, rel, source):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return lint_file(p, tmp_path, REGISTRY)
+
+
+def _copy_pkg(tmp_path, fixture_name, as_name):
+    dst = tmp_path / as_name
+    shutil.copytree(FIXTURES / fixture_name, dst)
+    return dst
+
+
+# ---------------------------------------------------------------------------
+# callgraph: resolution + dependency closure (over the real package)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return CallGraph(PACKAGE)
+
+
+def test_callgraph_resolves_from_import(graph):
+    steps = graph.modules["ddl_tpu.train.steps"]
+    t = graph.resolve_dotted(steps, "forward_stages")
+    assert t is not None and t.module == "ddl_tpu.models.densenet"
+    assert t.func.name == "forward_stages"
+
+
+def test_callgraph_resolves_reexport_chain(graph):
+    # train/steps.py: `from ddl_tpu.ops import cross_entropy_loss` —
+    # ops/__init__ re-exports it from ops/losses.py
+    steps = graph.modules["ddl_tpu.train.steps"]
+    t = graph.resolve_dotted(steps, "cross_entropy_loss")
+    assert t is not None and t.module == "ddl_tpu.ops.losses"
+
+
+def test_callgraph_resolves_module_attribute(graph):
+    # supervisor.py: `from ddl_tpu import coord` then coord.acquire_launch
+    sup = graph.modules["ddl_tpu.supervisor"]
+    t = graph.resolve_dotted(sup, "coord.acquire_launch")
+    assert t is not None and t.module == "ddl_tpu.coord"
+    assert t.func.name == "acquire_launch"
+
+
+def test_callgraph_external_names_unresolved(graph):
+    steps = graph.modules["ddl_tpu.train.steps"]
+    assert graph.resolve_dotted(steps, "jax.jit") is None
+    assert graph.resolve_dotted(steps, "no_such_name_anywhere") is None
+
+
+def test_reverse_closure_contains_importers(graph):
+    closure = graph.reverse_closure({"ddl_tpu.obs.events"})
+    assert "ddl_tpu.obs.events" in closure
+    # steptrace imports events directly; supervisor via its events
+    # helper; report/fold downstream
+    assert "ddl_tpu.obs.steptrace" in closure
+    assert "ddl_tpu.supervisor" in closure
+    # an unrelated leaf module must not ride along
+    assert "ddl_tpu.utils.backoff" not in closure
+
+
+# ---------------------------------------------------------------------------
+# cross-module traced-set inference (the two-file fixture package)
+# ---------------------------------------------------------------------------
+
+
+def test_cross_module_host_sync_flagged(tmp_path):
+    """A host sync reachable ONLY through a helper in a different
+    module is flagged (the acceptance scenario): steps.py's jitted step
+    calls helpers.sync_mean through the package re-export."""
+    pkg = _copy_pkg(tmp_path, "xmod_pkg", "xmod_pkg")
+    fs = lint_package(pkg)
+    helpers = [f for f in fs if f.path.endswith("helpers.py")]
+    # sync_mean: float() + np.asarray, both only traced cross-module
+    assert _rules(helpers) == ["host-sync", "host-sync"]
+    assert all("sync_mean" in f.message for f in helpers)
+    # provenance names the calling module
+    assert any("traced:" in f.message and "steps.py" in f.message
+               for f in helpers)
+    # the host-side caller in the same file stays clean
+    assert not any("host_side_report" in f.message for f in helpers)
+
+
+def test_cross_module_sink_param_flow(tmp_path):
+    """steps.py's inner_loss flows into helpers.takes_a_loss_fn's sink
+    parameter -> traced -> its float() is flagged in steps.py."""
+    pkg = _copy_pkg(tmp_path, "xmod_pkg", "xmod_pkg")
+    fs = lint_package(pkg)
+    steps = [f for f in fs if f.path.endswith("steps.py")]
+    assert any(
+        f.rule == "host-sync" and "inner_loss" in f.message for f in steps
+    )
+
+
+def test_single_file_engine_stays_blind_cross_module():
+    """lint_file on helpers.py alone must NOT flag sync_mean — nothing
+    in that file traces it.  (This is the regression the whole-program
+    pass exists to close; if this starts failing the fixture stopped
+    isolating the cross-module edge.)"""
+    fs = lint_file(
+        FIXTURES / "xmod_pkg" / "helpers.py", REPO, REGISTRY
+    )
+    assert [f for f in fs if f.rule == "host-sync"] == []
+
+
+# ---------------------------------------------------------------------------
+# collective-symmetry
+# ---------------------------------------------------------------------------
+
+
+BARRIER_SRC = (FIXTURES / "bad_conditional_barrier.py").read_text()
+
+
+def test_conditional_barrier_flagged_in_coord_modules(tmp_path):
+    for rel in ("supervisor.py", "coord.py", "train/loop.py"):
+        fs = [
+            f for f in _lint_tmp(tmp_path, rel, BARRIER_SRC)
+            if f.rule == "collective-symmetry"
+        ]
+        # rank-gated barrier, env-gated arrive, host_id-gated psum
+        assert len(fs) == 3, (rel, fs)
+        msgs = " | ".join(f.message for f in fs)
+        assert "rv.barrier" in msgs and "rv.arrive" in msgs
+        assert "lax.psum" in msgs
+        assert "DDL_FAST_RESTART" in msgs
+    # outside the coordination/step modules the rule does not apply
+    assert [
+        f for f in _lint_tmp(tmp_path, "bench/lm.py", BARRIER_SRC)
+        if f.rule == "collective-symmetry"
+    ] == []
+
+
+def test_symmetric_and_nested_def_paths_not_flagged(tmp_path):
+    fs = [
+        f for f in _lint_tmp(tmp_path, "coord.py", BARRIER_SRC)
+        if f.rule == "collective-symmetry"
+    ]
+    lines = BARRIER_SRC.splitlines()
+    for f in fs:
+        flagged = lines[f.line - 1]
+        assert "fine" not in flagged, flagged
+
+
+def test_conditional_barrier_suppression(tmp_path):
+    ok = BARRIER_SRC.replace(
+        'rv.barrier(f"e{epoch}-join")  # collective-symmetry: rv.host branch',
+        'rv.barrier(f"e{epoch}-join")  # ddl-lint: disable=collective-symmetry',
+    ).replace(
+        'rv.arrive("join")  # collective-symmetry: DDL_* env branch',
+        'rv.arrive("join")  # ddl-lint: disable=collective-symmetry',
+    ).replace(
+        'x = lax.psum(x, "data")  # collective-symmetry: host_id loop',
+        'x = lax.psum(x, "data")  # ddl-lint: disable=collective-symmetry',
+    )
+    assert [
+        f for f in _lint_tmp(tmp_path, "supervisor.py", ok)
+        if f.rule == "collective-symmetry"
+    ] == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard family
+# ---------------------------------------------------------------------------
+
+
+def test_shape_branch_fixture(tmp_path):
+    fs = _lint_tmp(
+        tmp_path, "m.py", (FIXTURES / "bad_shape_branch.py").read_text()
+    )
+    shape = [f for f in fs if f.rule == "recompile-shape-branch"]
+    # the If on .shape and the IfExp on .dtype; the lone-raise guard and
+    # the host-side branch are exempt
+    assert len(shape) == 2, shape
+    msgs = " | ".join(f.message for f in shape)
+    assert ".shape" in msgs and ".dtype" in msgs
+    lines = (FIXTURES / "bad_shape_branch.py").read_text().splitlines()
+    for f in shape:
+        assert "NOT flagged" not in lines[f.line - 1]
+
+
+def test_mutable_global_fixture(tmp_path):
+    fs = _lint_tmp(
+        tmp_path, "m.py", (FIXTURES / "bad_mutable_global.py").read_text()
+    )
+    mg = [f for f in fs if f.rule == "recompile-mutable-global"]
+    assert len(mg) == 2, mg
+    msgs = " | ".join(f.message for f in mg)
+    assert "_CACHE" in msgs and "_SCALES" in msgs
+    assert "FROZEN" not in msgs
+
+
+def test_static_args_fixture(tmp_path):
+    fs = _lint_tmp(
+        tmp_path, "m.py", (FIXTURES / "bad_static_args.py").read_text()
+    )
+    unhashable = [f for f in fs if f.rule == "recompile-unhashable-static"]
+    fresh = [f for f in fs if f.rule == "recompile-fresh-static"]
+    assert len(unhashable) == 2, unhashable  # dict kwarg + list positional
+    assert len(fresh) == 2, fresh  # assigned wrapper + decorator form
+    src_lines = (FIXTURES / "bad_static_args.py").read_text().splitlines()
+    for f in unhashable + fresh:
+        assert "fine" not in src_lines[f.line - 1]
+
+
+def test_recompile_rules_only_inside_traced(tmp_path):
+    src = """
+def host(x):
+    if x.shape[0] > 4:
+        return x * 2
+    return x
+"""
+    assert _lint_tmp(tmp_path, "m.py", src) == []
+
+
+# ---------------------------------------------------------------------------
+# dead event kinds
+# ---------------------------------------------------------------------------
+
+
+def test_dead_event_kind_flagged(tmp_path):
+    pkg = _copy_pkg(tmp_path, "deadpkg", "deadpkg")
+    fs = lint_package(pkg)
+    dead = [f for f in fs if f.rule == "obs-event-dead"]
+    assert len(dead) == 1, fs
+    assert "'ghost'" in dead[0].message
+    assert dead[0].path.endswith("obs/events.py")
+    # anchored at the registry entry's line
+    src_lines = (pkg / "obs" / "events.py").read_text().splitlines()
+    assert '"ghost"' in src_lines[dead[0].line - 1]
+    # 'external' is unemitted too, but its suppression holds
+    assert not any("'external'" in f.message for f in dead)
+
+
+def test_shipped_event_kinds_all_alive():
+    fs = [f for f in lint_package(PACKAGE) if f.rule == "obs-event-dead"]
+    assert fs == [], "\n".join(f.format() for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# lint --fix / --check round trips
+# ---------------------------------------------------------------------------
+
+
+def _fix_pkg(tmp_path):
+    return _copy_pkg(tmp_path, "fixpkg", "ddl_tpu")
+
+
+def _pkg_bytes(pkg):
+    return {p.relative_to(pkg): p.read_bytes() for p in pkg.rglob("*.py")}
+
+
+def test_fix_check_diffs_and_writes_nothing(tmp_path, capsys):
+    from ddl_tpu.analysis.cli import main
+
+    pkg = _fix_pkg(tmp_path)
+    before = _pkg_bytes(pkg)
+    rc = main(["--package-root", str(pkg), "--fix", "--check"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert _pkg_bytes(pkg) == before, "--check must write nothing"
+    assert "--- a/ddl_tpu/runtime.py" in out
+    assert "+from jax import shard_map" in out
+    assert "+SPEC = TOKEN_SPEC" in out
+
+
+def test_fix_round_trip_clean_then_byte_noop(tmp_path, capsys):
+    from ddl_tpu.analysis.cli import main
+
+    pkg = _fix_pkg(tmp_path)
+    rc = main(["--package-root", str(pkg), "--fix"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "fixed" in out
+
+    runtime = (pkg / "runtime.py").read_text()
+    assert "from jax import shard_map" in runtime
+    assert "check_vma=False" in runtime and "check_rep=" not in runtime
+    assert "except Exception:" in runtime
+    steps = (pkg / "train" / "steps.py").read_text()
+    assert "SPEC = TOKEN_SPEC" in steps and "OTHER = BATCH_SPEC" in steps
+    assert "from ddl_tpu.parallel.rules import BATCH_SPEC, TOKEN_SPEC" in steps
+    events = (pkg / "obs" / "events.py").read_text()
+    assert '"new_kind"' in events
+
+    # fixed tree lints clean
+    rc = main(["--package-root", str(pkg)])
+    capsys.readouterr()
+    assert rc == 0
+
+    # second --fix: byte-level no-op, and --check agrees
+    before = _pkg_bytes(pkg)
+    rc = main(["--package-root", str(pkg), "--fix"])
+    capsys.readouterr()
+    assert rc == 0
+    assert _pkg_bytes(pkg) == before
+    rc = main(["--package-root", str(pkg), "--fix", "--check"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "nothing to fix" in out
+
+
+def test_fix_is_deterministic(tmp_path, capsys):
+    from ddl_tpu.analysis.cli import main
+
+    a = _copy_pkg(tmp_path / "a", "fixpkg", "ddl_tpu")
+    b = _copy_pkg(tmp_path / "b", "fixpkg", "ddl_tpu")
+    main(["--package-root", str(a), "--fix"])
+    main(["--package-root", str(b), "--fix"])
+    capsys.readouterr()
+    assert _pkg_bytes(a) == _pkg_bytes(b)
+
+
+def test_fix_preserves_import_aliases(tmp_path):
+    """Extending an existing rules import must keep `as` aliases — the
+    module's alias uses would otherwise NameError at import."""
+    pkg = _fix_pkg(tmp_path)
+    steps = pkg / "train" / "steps.py"
+    steps.write_text(
+        '"""doc"""\n'
+        "from jax.sharding import PartitionSpec as P\n\n"
+        "from ddl_tpu.parallel.rules import BATCH_SPEC as BS\n\n"
+        "OTHER = BS\n"
+        'SPEC = P(("data", "expert"), "seq")\n'
+    )
+    plan = plan_fixes(lint_package(pkg), pkg.parent, pkg)
+    plan.apply()
+    fixed = steps.read_text()
+    assert (
+        "from ddl_tpu.parallel.rules import BATCH_SPEC as BS, TOKEN_SPEC"
+        in fixed
+    )
+    assert "OTHER = BS" in fixed and "SPEC = TOKEN_SPEC" in fixed
+
+
+def test_fix_registry_insert_survives_trailing_comment(tmp_path):
+    """A trailing comment on the last EVENT_KINDS entry must not swallow
+    the inserted comma (implicit string concatenation would silently
+    merge two kinds)."""
+    import ast as ast_mod
+
+    pkg = _fix_pkg(tmp_path)
+    events = pkg / "obs" / "events.py"
+    events.write_text(
+        'EVENT_KINDS = (\n    "span",  # the envelope kind\n'
+        '    "last"  # no trailing comma\n)\n'
+    )
+    plan = plan_fixes(lint_package(pkg), pkg.parent, pkg)
+    plan.apply()
+    src = events.read_text()
+    tree = ast_mod.parse(src)
+    kinds = [
+        e.value for e in ast_mod.walk(tree)
+        if isinstance(e, ast_mod.Constant) and isinstance(e.value, str)
+    ]
+    assert set(kinds) >= {"span", "last", "new_kind"}, src
+
+
+def test_changed_update_baseline_rejected():
+    from ddl_tpu.analysis.cli import main
+
+    with pytest.raises(SystemExit) as e:
+        main(["--changed", "--update-baseline"])
+    assert e.value.code == 2
+
+
+def test_unmatched_pspec_literal_is_unfixable(tmp_path):
+    pkg = _fix_pkg(tmp_path)
+    steps = pkg / "train" / "steps.py"
+    steps.write_text(
+        steps.read_text() + 'NO_CONSTANT = P("model", "seq")\n'
+    )
+    findings = lint_package(pkg)
+    plan = plan_fixes(findings, pkg.parent, pkg)
+    assert any(
+        f.rule == "pspec-hand-rolled" and "model" in f.message
+        for f in plan.unfixable
+    )
+    # the matchable literals are still planned
+    assert any(f.rule == "pspec-hand-rolled" for f in plan.fixed)
+
+
+# ---------------------------------------------------------------------------
+# lint --changed (git-scoped closure)
+# ---------------------------------------------------------------------------
+
+
+def _git(repo, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=repo, check=True, capture_output=True,
+    )
+
+
+@pytest.fixture()
+def changed_repo(tmp_path):
+    repo = tmp_path / "repo"
+    pkg = repo / "ddl_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "base.py").write_text("def helper(x):\n    return x\n")
+    (pkg / "mid.py").write_text(
+        "from ddl_tpu.base import helper\n\n"
+        "def use(x):\n    return helper(x)\n"
+    )
+    (pkg / "leaf.py").write_text("def lonely(x):\n    return x\n")
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    return repo, pkg
+
+
+def test_changed_scopes_to_reverse_closure(changed_repo, capsys):
+    from ddl_tpu.analysis.cli import main
+
+    repo, pkg = changed_repo
+    rc = main(["--package-root", str(pkg), "--changed"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "no changed package modules" in out
+
+    # edit base.py: mid.py (importer) joins the scope, leaf.py does not
+    (pkg / "base.py").write_text(
+        "def helper(x):\n    return x + 1\n"
+    )
+    rc = main(["--package-root", str(pkg), "--changed"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 changed module(s) + 1 reverse dependent(s)" in out
+
+
+def test_changed_reports_cross_module_finding(changed_repo, capsys):
+    """A traced host sync introduced in a HELPER is reported when only
+    the helper changed — the reverse-dep closure pulls the traced
+    caller in, and inference over the full graph attributes it."""
+    from ddl_tpu.analysis.cli import main
+
+    repo, pkg = changed_repo
+    (pkg / "mid.py").write_text(
+        "import jax\n\nfrom ddl_tpu.base import helper\n\n"
+        "def make(tx):\n"
+        "    def step(x):\n"
+        "        return helper(x)\n"
+        "    return jax.jit(step)\n"
+    )
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "traced caller")
+    (pkg / "base.py").write_text(
+        "def helper(x):\n    return float(x.sum())\n"
+    )
+    rc = main(["--package-root", str(pkg), "--changed"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ddl_tpu/base.py:2: [host-sync]" in out
+
+
+# ---------------------------------------------------------------------------
+# CLI acceptance: seeded violations fail with file:line findings
+# ---------------------------------------------------------------------------
+
+
+def test_cli_seeded_barrier_and_shape_branch_fail(tmp_path, capsys):
+    from ddl_tpu.analysis.cli import main
+
+    pkg = tmp_path / "ddl_tpu"
+    pkg.mkdir()
+    shutil.copy(
+        FIXTURES / "bad_conditional_barrier.py", pkg / "supervisor.py"
+    )
+    shutil.copy(FIXTURES / "bad_shape_branch.py", pkg / "steps_probe.py")
+    rc = main(["--package-root", str(pkg)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ddl_tpu/supervisor.py:14: [collective-symmetry]" in out
+    assert "[recompile-shape-branch]" in out
+    assert "ddl_tpu/steps_probe.py:12:" in out
+
+
+# ---------------------------------------------------------------------------
+# shipped package stays clean under the new rules
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_package_clean_under_v2_rules():
+    new_rules = {
+        "collective-symmetry",
+        "recompile-shape-branch",
+        "recompile-mutable-global",
+        "recompile-unhashable-static",
+        "recompile-fresh-static",
+        "obs-event-dead",
+    }
+    fs = [f for f in lint_package(PACKAGE) if f.rule in new_rules]
+    assert fs == [], "\n".join(f.format() for f in fs)

@@ -1039,6 +1039,15 @@ def _collective_callee(call: ast.Call) -> str | None:
     return None
 
 
+def _suite_terminates(stmts: list) -> bool:
+    """True when a statement suite always leaves the enclosing scope /
+    loop iteration (its last statement is a return/raise/continue/
+    break) — the early-exit shape the asymmetry extension keys on."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
 def _rule_collective_symmetry(tree, mod: _Module, rel: str, add) -> None:
     """In the coordination layer, the shared loop, and the step modules,
     a collective / barrier / agree call reachable only under a
@@ -1046,14 +1055,38 @@ def _rule_collective_symmetry(tree, mod: _Module, rel: str, add) -> None:
     take the branch never make the matching call, and the ones that do
     block forever (barrier timeout at best, a wedged all-reduce at
     worst).  Conditions inside a *nested function definition* reset the
-    stack — the definition site does not gate the call's execution."""
+    stack — the definition site does not gate the call's execution.
+
+    Two reachability shapes are covered:
+
+    * a collective lexically INSIDE a host-dependent branch (the
+      condition-stack walk);
+    * **early-return asymmetry**: ``if host...: return`` (or raise /
+      continue / break) makes every later statement in the same suite
+      reachable only by the hosts that did NOT take the branch — the
+      same split brain with the collective OUTSIDE the branch, which
+      the condition stack alone cannot see.  A host-dependent ``if``
+      whose taken branch terminates while the other continues taints
+      the rest of its suite.
+    """
     if rel_suffix(rel) not in _COLLECTIVE_MODULES:
         return
 
     def visit(node: ast.AST, why: str | None) -> None:
+        if isinstance(node, ast.Module):
+            visit_suite(node.body, why)
+            return
+        if isinstance(node, ast.ClassDef):
+            for expr in (*node.decorator_list, *node.bases,
+                         *(kw.value for kw in node.keywords)):
+                visit(expr, why)
+            visit_suite(node.body, why)
+            return
         if isinstance(node, _FUNC_NODES):
-            for child in ast.iter_child_nodes(node):
-                visit(child, None)
+            if isinstance(node.body, list):
+                visit_suite(node.body, None)
+            else:  # lambda: body is a single expression
+                visit(node.body, None)
             return
         if isinstance(node, ast.Call) and why is not None:
             callee = _collective_callee(node)
@@ -1069,10 +1102,8 @@ def _rule_collective_symmetry(tree, mod: _Module, rel: str, add) -> None:
         if isinstance(node, (ast.If, ast.While)):
             new_why = _host_dependent_why(node.test) or why
             visit(node.test, why)
-            for child in node.body:
-                visit(child, new_why)
-            for child in node.orelse:
-                visit(child, new_why)
+            visit_suite(node.body, new_why)
+            visit_suite(node.orelse, new_why)
             return
         if isinstance(node, ast.IfExp):
             new_why = _host_dependent_why(node.test) or why
@@ -1080,8 +1111,52 @@ def _rule_collective_symmetry(tree, mod: _Module, rel: str, add) -> None:
             visit(node.body, new_why)
             visit(node.orelse, new_why)
             return
+        # every other statement suite walks suite-aware too, so a
+        # host-gated continue/break/return INSIDE a loop / with / try
+        # taints the rest of that suite (the shapes _suite_terminates
+        # lists can only occur here)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            visit(node.target, why)
+            visit(node.iter, why)
+            visit_suite(node.body, why)
+            visit_suite(node.orelse, why)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                visit(item, why)
+            visit_suite(node.body, why)
+            return
+        if isinstance(node, ast.Try):
+            visit_suite(node.body, why)
+            for h in node.handlers:
+                visit_suite(h.body, why)
+            visit_suite(node.orelse, why)
+            visit_suite(node.finalbody, why)
+            return
         for child in ast.iter_child_nodes(node):
             visit(child, why)
+
+    def visit_suite(stmts: list, why: str | None) -> None:
+        for stmt in stmts:
+            visit(stmt, why)
+            if why is None and isinstance(stmt, ast.If):
+                host_why = _host_dependent_why(stmt.test)
+                if host_why is None:
+                    continue
+                body_exits = _suite_terminates(stmt.body)
+                else_exits = (
+                    _suite_terminates(stmt.orelse) if stmt.orelse else False
+                )
+                # asymmetric continuation: one side leaves, the other
+                # falls through — everything after this statement runs
+                # on a host-dependent subset.  Both sides terminating
+                # is symmetric (nothing after is reachable at all).
+                if body_exits != else_exits:
+                    why = (
+                        "code after an early "
+                        f"{'return' if body_exits else 'fall-through'} "
+                        f"behind a host-dependent branch ({host_why})"
+                    )
 
     visit(tree, None)
 

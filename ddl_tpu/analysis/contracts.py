@@ -722,6 +722,51 @@ def _probe_lm_pipeline() -> _Probe:
     return probe
 
 
+def _probe_lm_pipeline_zb() -> _Probe:
+    """The zero-bubble (B/W-split) schedule on a (data=2, pipe=2,
+    model=2) mesh: the input-cotangent-only and weight-cotangent-only
+    vjps, the W ring queue carried through the scan, and the head
+    epilogue cond must all lower under GSPMD auto axes beside the
+    manual pipe axis — and the factory's contract must declare the
+    schedule it compiled (``pipeline_schedule``, drawn from
+    ``parallel/rules.PIPELINE_SCHEDULES``)."""
+    import jax
+    import optax
+
+    from ddl_tpu.parallel import rules as prules
+    from ddl_tpu.parallel.lm_pipeline import make_lm_pipeline_step_fns
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    probe = _Probe(make_lm_pipeline_step_fns)
+    fns = make_lm_step_fns(
+        _tiny_lm_cfg(), LMMeshSpec(data=2, pipe=2, model=2),
+        optax.adam(1e-3),
+        jax.random.key(0), batch=8, seq_len=32, num_microbatches=4,
+        pipeline_schedule="zb",
+    )
+    _check_boundary(probe, fns.train.contract, fns.mesh)
+    declared = fns.train.contract.get("pipeline_schedule")
+    if declared != "zb":
+        probe.add(
+            "contract-rules",
+            f"pipeline factory contract declares pipeline_schedule="
+            f"{declared!r} for a zb build — the schedule facts the "
+            "contract carries drifted from the compiled program",
+        )
+    if declared is not None and declared not in prules.PIPELINE_SCHEDULES:
+        probe.add(
+            "contract-rules",
+            f"contract pipeline_schedule {declared!r} is not in "
+            f"parallel/rules.PIPELINE_SCHEDULES {prules.PIPELINE_SCHEDULES}",
+        )
+    state = fns.init_state()
+    tok = jax.ShapeDtypeStruct((8, 32), jax.numpy.int32)
+    _lower(probe, fns.train, state, tok, tok, what="LM zb pipeline train step")
+    _check_params(probe, state.params, fns.mesh, fns.train.contract)
+    return probe
+
+
 def _probe_vit_pipeline() -> _Probe:
     """The pipeline-parallel ViT factory (vit_steps pipeline path over
     the shared blocks-pipeline clock loop)."""
@@ -762,6 +807,7 @@ PROBES = (
     ("lm_decode", _probe_decode),
     ("serve_decode", _probe_serve_decode),
     ("lm_pipeline", _probe_lm_pipeline),
+    ("lm_pipeline_zb", _probe_lm_pipeline_zb),
     ("vit_pipeline", _probe_vit_pipeline),
 )
 

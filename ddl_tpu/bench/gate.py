@@ -22,7 +22,11 @@ regress again" rule:
   benches write).  The digest also prints a per-device
   **optimizer-state HBM** table (rule-table-derived Adam moment bytes
   per family, replicated vs ZeRO at ``--opt-hbm-dp``) — the capacity
-  axis a device-time trace cannot show.
+  axis a device-time trace cannot show — and the modeled
+  **pipeline-schedule bubble** table (gpipe / 1f1b / interleaved / zb
+  idle units at ``--sched-pipe``/``--sched-microbatches``,
+  ``obs/schedule_model.py``) — the schedule axis the per-op digest
+  cannot attribute.
 """
 
 from __future__ import annotations
@@ -158,6 +162,29 @@ def _print_opt_hbm(rows: list[dict]) -> None:
               f"{100 * saving:7.1f}%  {r['zero_sharded_leaves']}/{r['leaves']}")
 
 
+def _print_schedule_table(rows: list[dict]) -> None:
+    if not rows:
+        return
+    live = [r for r in rows if "skipped" not in r]
+    if not live:
+        return
+    p, m = live[0]["pipe"], live[0]["microbatches"]
+    print(f"# modeled pipeline-schedule bubble (pipe={p}, microbatches={m}, "
+          "t_F=t_B=t_W=1 unit; obs/schedule_model.py)")
+    print(f"  {'schedule':18s} {'makespan':>10s} {'idle':>10s} "
+          f"{'bubble':>8s}  per-stage idle")
+    for r in rows:
+        if "skipped" in r:
+            print(f"  {r['schedule']:18s} skipped: {r['skipped']}")
+            continue
+        label = r["schedule"] + (
+            f" (V={r['virtual']})" if r["virtual"] > 1 else ""
+        )
+        idles = "/".join(f"{st['idle']:g}" for st in r["per_stage"])
+        print(f"  {label:18s} {r['makespan']:>10g} {r['idle_units']:>10g} "
+              f"{r['bubble_fraction']:>7.1%}  {idles}")
+
+
 def _digest(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="ddl_tpu bench digest",
@@ -175,6 +202,19 @@ def _digest(argv: list[str]) -> int:
         "--opt-hbm-dp", type=int, default=8, metavar="DP",
         help="data-axis size for the optimizer-state HBM column "
         "(default 8; 0 disables the section)",
+    )
+    ap.add_argument(
+        "--sched-pipe", type=int, default=4, metavar="P",
+        help="pipeline stages for the modeled schedule-bubble table "
+        "(default 4; 0 disables the section)",
+    )
+    ap.add_argument(
+        "--sched-microbatches", type=int, default=16, metavar="M",
+        help="microbatches for the schedule-bubble table (default 16)",
+    )
+    ap.add_argument(
+        "--sched-virtual", type=int, default=2, metavar="V",
+        help="virtual stages for the table's interleaved row (default 2)",
     )
     args = ap.parse_args(argv)
 
@@ -194,9 +234,17 @@ def _digest(argv: list[str]) -> int:
         print(f"bench digest: {e}", file=sys.stderr)
         return 2
     hbm_rows = opt_hbm_rows(args.opt_hbm_dp) if args.opt_hbm_dp > 0 else []
+    sched_rows = []
+    if args.sched_pipe > 0:
+        from ddl_tpu.obs.schedule_model import schedule_table
+
+        sched_rows = schedule_table(
+            args.sched_pipe, args.sched_microbatches, args.sched_virtual
+        )
     if args.as_json:
         print(json.dumps(
-            {"trace_dir": trace_dir, **dig, "opt_hbm": hbm_rows}
+            {"trace_dir": trace_dir, **dig, "opt_hbm": hbm_rows,
+             "schedules": sched_rows}
         ))
         return 0
     print(f"# digest: {trace_dir}")
@@ -208,6 +256,7 @@ def _digest(argv: list[str]) -> int:
     if dig.get("top_op"):
         print(f"# top op: {dig['top_op']}")
     _print_opt_hbm(hbm_rows)
+    _print_schedule_table(sched_rows)
     return 0
 
 

@@ -84,6 +84,7 @@ digest renderer behind the "open every perf PR with a digest" rule:
         [--result bench_out.json] [--baseline BASELINE.json]
         [--update-baseline]      # needs the real chip unless --result
     python -m ddl_tpu.cli bench digest <trace_dir|latest> [--top 5] [--json]
+        [--opt-hbm-dp 8] [--sched-pipe 4 --sched-microbatches 16]
 
 Serving (``ddl_tpu/serve/``): the continuous-batching engine — paged
 block KV pool with refcounted shared-prefix caching (a shared system

@@ -86,6 +86,12 @@ EVENT_KINDS = (
     # --fail-slowdown` — the metric the elastic-restart/compile-cache
     # ROADMAP direction must move
     "restart_latency",
+    # pipeline-schedule identity + modeled per-stage F/B/W/idle
+    # accounting (obs/schedule_model.py), one event per pipelined run
+    # (train/loop.BaseTrainer._emit_pipe_schedule); `obs trace --step`
+    # rebuilds the schedule lanes from it and summarize renders the
+    # modeled bubble line
+    "pipe_schedule",
     # causal tracing (obs/trace.py): a completed span / an instant mark
     # carrying trace/span/parent ids — emitted natively where causality
     # is not reconstructable from the aggregate kinds (the serving

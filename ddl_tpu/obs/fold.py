@@ -62,10 +62,12 @@ SIDECAR_NAME = ".obs_fold.json"
 # whole-summary fold with t-digest serving state; v4 added the causal-
 # trace reducer (trace_span/trace_mark counts + slowest-request cell)
 # and per-repoch rate metrics (mfu); v5 added the per-device
-# optimizer-state HBM gauge (opt_hbm_bytes); v6 adds the prefix-cache
+# optimizer-state HBM gauge (opt_hbm_bytes); v6 added the prefix-cache
 # counters (prefix_hit/prefix_insert/kv_cow_copy + serve_admit's
-# cached/prefill token split) — older sidecars rebuild cleanly
-VERSION = 6
+# cached/prefill token split); v7 adds the pipe_schedule cell (pipeline
+# schedule identity + modeled bubble accounting) — older sidecars
+# rebuild cleanly
+VERSION = 7
 
 # the serving-cursor sidecar this module's cache superseded; removed
 # opportunistically when the fold sidecar is written so a job dir does
@@ -189,6 +191,10 @@ class StreamFold:
         self.trace = {
             "spans": 0, "marks": 0, "requests": 0, "slowest": None,
         }
+        # pipeline-schedule cell (pipe_schedule events): last-wins — the
+        # schedule is static per run, and on a resume the newest event
+        # describes the layout actually training
+        self.pipe_schedule: dict | None = None
         self.serving = ServingStats(capacity)
 
     def _push(self, key: str, item: dict) -> None:
@@ -310,6 +316,8 @@ class StreamFold:
                         tr["slowest"] = cand
         elif kind == "trace_mark":
             self.trace["marks"] += 1
+        elif kind == "pipe_schedule":
+            self.pipe_schedule = dict(e)
 
         if kind in ("span", "heartbeat", "stall"):
             if step is not None:
@@ -411,6 +419,7 @@ class StreamFold:
             "restart_latency": self.restart_latency,
             "serve": self.serve,
             "trace": self.trace,
+            "pipe_schedule": self.pipe_schedule,
             "pod_restart_epochs": sorted(self.pod_restart_epochs),
             "relaunches": self.relaunches,
             "serving": self.serving.state_dict(),
@@ -441,6 +450,7 @@ class StreamFold:
         sf.restart_latency = dict(state["restart_latency"])
         sf.serve = dict(state["serve"])
         sf.trace = dict(state["trace"])
+        sf.pipe_schedule = state.get("pipe_schedule")
         sf.pod_restart_epochs = {
             int(r) for r in state["pod_restart_epochs"]
         }
@@ -480,6 +490,22 @@ class JobFold:
         for name in sorted(self.streams):
             merged.merge(self.streams[name].serving)
         return merged
+
+    def pipe_schedule(self) -> dict | None:
+        """The job's pipeline-schedule cell, merged deterministically:
+        every host of a pipelined run emits the same schedule, so pick
+        the newest event (ties broken by stream name) — last-wins like
+        the per-stream cell."""
+        best_key = None
+        out = None
+        for name in sorted(self.streams):
+            ps = self.streams[name].pipe_schedule
+            if ps is None:
+                continue
+            key = (ps.get("ts") or 0.0, name)
+            if best_key is None or key >= best_key:
+                best_key, out = key, ps
+        return out
 
     def trace_totals(self) -> dict:
         """Job-wide causal-trace reduction: span/mark/request counts plus

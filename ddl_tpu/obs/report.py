@@ -310,6 +310,7 @@ def summarize_from_fold(fold) -> dict:
         "profile_captures": _merge_sorted(fold, "captures"),
         "restart_latency": restart_latency,
         "trace": trace,
+        "pipe_schedule": fold.pipe_schedule(),
     }
 
 
@@ -364,6 +365,20 @@ def render_summary(s: dict, job_id: str = "") -> str:
         )
     if s["peak_hbm_bytes"]:
         lines.append(f"peak HBM: {s['peak_hbm_bytes'] / 1e9:.2f} GB")
+    ps = s.get("pipe_schedule")
+    if ps:
+        line = (
+            f"pipeline: {ps.get('schedule')} pipe={ps.get('pipe')} "
+            f"microbatches={ps.get('microbatches')} "
+            f"virtual={ps.get('virtual')}"
+        )
+        if ps.get("bubble_fraction") is not None:
+            line += (
+                f" | modeled bubble {ps['bubble_fraction']:.1%} of "
+                f"stage-time ({ps.get('idle_units')} idle / "
+                f"{ps.get('makespan')} unit makespan)"
+            )
+        lines.append(line)
     rl = s.get("restart_latency")
     if rl:
         lines.append(

@@ -194,8 +194,15 @@ def _collect_request(streams, request_id):
 
 def _collect_step(streams, step):
     spans, marks = [], []
+    sched = None  # (host, event) of the newest pipe_schedule on record
     for host in sorted(streams):
         for e in streams[host]:
+            if e.get("kind") == "pipe_schedule":
+                if sched is None or (e.get("ts") or 0.0) >= (
+                    sched[1].get("ts") or 0.0
+                ):
+                    sched = (host, e)
+                continue
             if e.get("kind") != "span" or e.get("step") != step:
                 continue
             dur = float(e.get("dur", 0.0))
@@ -207,7 +214,48 @@ def _collect_step(streams, step):
                 key=f"h{host}/{e.get('name')}/{len(spans)}",
                 args=_slim_args(e, drop=("dur", "depth", "period")),
             ))
+    spans.extend(_schedule_lane_spans(sched, spans))
     return spans, marks, []
+
+
+def _schedule_lane_spans(sched, phase_spans) -> list[dict]:
+    """Per-stage F/B/W schedule lanes for a step trace: the modeled
+    clock-loop schedule (``obs/schedule_model.py``), rebuilt from the
+    run's ``pipe_schedule`` event and scaled into the step's measured
+    phase window, one Perfetto thread per pipeline stage.  The lanes
+    are a *model* of where the schedule puts each microbatch's
+    forward / activation-backward / weight-backward work (every span
+    carries ``modeled: true``) — the measured spans beside them stay
+    the ground truth."""
+    if sched is None or not phase_spans:
+        return []
+    from ddl_tpu.obs.schedule_model import schedule_lanes
+
+    host, e = sched
+    try:
+        lanes = schedule_lanes(
+            str(e.get("schedule", "gpipe")), int(e["pipe"]),
+            int(e["microbatches"]), int(e.get("virtual") or 1),
+        )
+    except (KeyError, TypeError, ValueError):
+        return []  # malformed event or unmodeled combo: lanes are a bonus
+    t0 = min(s["t0"] for s in phase_spans)
+    t1 = max(s["t1"] for s in phase_spans)
+    makespan = max(u["t1"] for lane in lanes for u in lane)
+    scale = (t1 - t0) / makespan if makespan and t1 > t0 else 1e-3
+    out = []
+    for si, lane in enumerate(lanes):
+        for u in lane:
+            out.append(_span(
+                host, "pipeline", f'{u["phase"]}{u["mb"]}',
+                t0 + u["t0"] * scale, t0 + u["t1"] * scale,
+                tid=si, tname=f"stage {si}", cat="schedule",
+                args={
+                    "phase": u["phase"], "mb": u["mb"],
+                    "stage": u["stage"], "modeled": True,
+                },
+            ))
+    return out
 
 
 # ---------------------------------------------------------------------------

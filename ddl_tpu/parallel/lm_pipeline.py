@@ -38,7 +38,12 @@ Design (scan-over-ticks, stage-stacked params):
   later valid writes overwrite, so no masking is needed on the data path.
 * the backward schedule is autodiff through the scan: each ``ppermute``
   transposes into the reverse hop and the ticks replay backwards — the same
-  property the CNN pipeline exploits (``parallel/pipeline.py``).
+  property the CNN pipeline exploits (``parallel/pipeline.py``).  The
+  hand-written alternatives interleave forward and backward in one scan:
+  ``make_blocks_pipeline_1f1b`` (joint per-tick ``jax.vjp``) and
+  ``make_blocks_pipeline_zb`` (zero-bubble: the vjp split into an
+  activation-cotangent B pass on the critical path and a weight-gradient
+  W pass deferred through a per-stage queue into the cooldown ticks).
 * per-stage MoE aux losses leave the manual region as a ``P('pipe')``-sharded
   ``(pipe,)`` vector and are summed outside, keeping loss reductions out of
   the differentiated manual region (psum-under-grad transposes into a psum
@@ -101,6 +106,7 @@ __all__ = [
     "make_blocks_pipeline",
     "make_blocks_pipeline_1f1b",
     "make_blocks_pipeline_interleaved",
+    "make_blocks_pipeline_zb",
     "blocks_pipeline_api",
     "split_lm_params",
     "merge_lm_params",
@@ -651,6 +657,255 @@ def make_blocks_pipeline_1f1b(
     )
 
 
+def make_blocks_pipeline_zb(
+    mesh: Mesh,
+    block_mod: nn.Module,
+    head_loss,
+    *,
+    n_stages: int,
+    num_microbatches: int,
+    mb: int,
+    d_model: int,
+    compute_dtype,
+    aux_cotangent: float,
+    zero_metrics,
+    dropout: bool = False,
+):
+    """Zero-bubble (ZB-H1-style) schedule: the 1F1B clock loop with the
+    full per-tick backward split into its two halves — the activation
+    cotangent (**B**) stays on the critical path, the weight gradient
+    (**W**) is deferred into a per-stage queue and drained during the
+    ticks the stage would otherwise idle.
+
+    The 1F1B tick runs one joint ``jax.vjp`` per tick: cotangents for
+    the stage *input* (which the reverse hop needs THIS tick — the next
+    stage's backward blocks on it) and for the stage *weights* (which
+    nothing consumes until the optimizer update after the scan) are
+    computed together, so the weight half of the backward sits on the
+    inter-stage critical path for no reason.  Here the B pass is a
+    ``jax.vjp`` w.r.t. the stage input only (weights closed over) and
+    the W pass a ``jax.vjp`` w.r.t. the weights only (input closed
+    over), applied to the SAME output cotangent — by linearity of the
+    vjp in which inputs are held fixed, the two halves are exactly the
+    joint vjp's two components, so gradients match GPipe/1F1B to float
+    tolerance (``tests/test_lm_pipeline.py`` asserts <= 1e-6).
+
+    Schedule: F and B keep the 1F1B timetable — at tick ``t`` stage
+    ``s`` runs the forward of microbatch ``t - s`` and the B pass of
+    microbatch ``t - (2(P-1) - s)`` — and the scan still closes in
+    ``M + 2(P-1)`` ticks.  Each B tick enqueues its W work item (the
+    stage input, the output cotangent, and the microbatch index for the
+    dropout-key refold) into a ring queue of ``min(P-1, M) + 1`` slots;
+    one item drains per tick when the queue is over its deferral
+    capacity or the stage's B schedule has gone quiet.  The capacity is
+    the stage's tail-idle tick count: stage ``s`` finishes its B passes
+    ``s`` ticks before the scan ends (its last B is at tick
+    ``M - 1 + 2(P-1) - s``), so deferring up to ``s`` W passes lands
+    them exactly in the cooldown ticks where 1F1B computes nothing —
+    the ZB-H1 move of filling the drain bubble with weight-gradient
+    work.  Every queued item is drained by the final tick (steady state
+    is one-in-one-out above capacity; the tail holds at most ``s``
+    items and has ``s`` ticks), so no microbatch's weight gradient is
+    dropped, and items drain oldest-first — microbatch order, the same
+    accumulation order as 1F1B.
+
+    On the uniform-tick SPMD realisation every device still executes
+    every slot every tick, so the win is *modeled*, not wall-clock on a
+    sim mesh: ``obs/schedule_model.py`` quantifies it (zb idles half of
+    1F1B's stage-time at t_F = t_B = t_W), ``obs trace --step`` renders
+    the lanes, and the PERF.md round-19 protocol banks the chip number.
+    Memory: the queue adds ``2 * (min(P-1, M) + 1)`` microbatch-sized
+    buffers on top of 1F1B's ``min(2(P-1)+1, M)``-deep stage-input ring
+    — still O(P), independent of M.
+
+    Dropout masks are a pure function of ``_mb_stage_key(step_key,
+    microbatch, stage)``; the W pass refolds the key from the queued
+    microbatch index, so the forward-for-handoff, the B-tick recompute,
+    and the deferred W-tick recompute all draw the identical mask —
+    schedule-invariant gradients, same fold chain as GPipe/1F1B.
+
+    Interface matches ``make_blocks_pipeline_1f1b`` with ``virtual=1``
+    (the B/W split is single-chunk; virtual stages compose with 1F1B).
+    """
+    P_, M = n_stages, num_microbatches
+    last = P_ - 1
+    d = d_model
+    raw_stage_fn = _make_stage_fn(block_mod, dropout)
+    depth = min(2 * last + 1, M)
+    n_ticks = M + 2 * last
+    # W queue slots: the in-flight count peaks at cap_s + 1 = s + 1
+    # (enqueue lands before the over-capacity drain), bounded by M + 1
+    # when M is smaller than the deepest capacity
+    K = min(last, M) + 1
+    fwd_ring = [(i, i + 1) for i in range(last)]
+    bwd_ring = [(i + 1, i) for i in range(last)]
+
+    def pipeline_body(blocks_stacked, head_params, x_mb, tgt_mb, *step_key):
+        local_blocks = jax.tree.map(lambda a: a[0], blocks_stacked)
+        s = lax.axis_index(PIPE_AXIS)
+        t_len = x_mb.shape[2]
+        cap = jnp.minimum(s, M)  # deferral depth = stage s's tail-idle ticks
+
+        def tick(carry, t):
+            (fwd_buf, bwd_buf, resid, dx_acc, g_blocks, g_head, met, aux,
+             qx, qg, qm, q_tail, q_len) = carry
+            f_idx = jnp.clip(t - s, 0, M - 1)
+            fwd_valid = (t >= s) & (t - s < M)
+            off = 2 * last - s
+            b_idx = jnp.clip(t - off, 0, M - 1)
+            bwd_valid = (t >= off) & (t - off < M)
+
+            if dropout:
+                fwd_stage_fn = lambda blocks, x: raw_stage_fn(
+                    blocks, x, _mb_stage_key(step_key[0], f_idx, s)
+                )
+                bwd_stage_fn = lambda blocks, x: raw_stage_fn(
+                    blocks, x, _mb_stage_key(step_key[0], b_idx, s)
+                )
+            else:
+                fwd_stage_fn = bwd_stage_fn = raw_stage_fn
+
+            x_first = lax.dynamic_index_in_dim(x_mb, f_idx, 0, keepdims=False)
+            x_in = jnp.where(s == 0, x_first, fwd_buf)
+            resid = masked_slot_update(resid, x_in, f_idx % depth, fwd_valid)
+            x_b = lax.dynamic_index_in_dim(
+                resid, b_idx % depth, 0, keepdims=False
+            )
+            tgt_b = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, b_idx, 0, keepdims=False),
+                tgt_mb,
+            )
+
+            # As in the 1F1B loop, every collective-bearing computation
+            # runs unconditionally on every device (nested seq cores /
+            # MoE dispatch compile to whole-mesh channel ops); only the
+            # head epilogue sits in a cond.
+            out, _ = fwd_stage_fn(local_blocks, x_in)
+            # B: input-cotangent-only vjp — the stage params are closed
+            # over, so this computes exactly the dx half of 1F1B's
+            # joint vjp and nothing of the weight half
+            (y_b, aux_b), b_vjp = jax.vjp(
+                lambda x: bwd_stage_fn(local_blocks, x), x_b
+            )
+
+            def last_branch(y):
+                _, head_vjp, m = jax.vjp(
+                    lambda hp, yy: head_loss(hp, yy, tgt_b),
+                    head_params,
+                    y,
+                    has_aux=True,
+                )
+                dh, g_y = head_vjp(jnp.ones((), jnp.float32))
+                return dh, g_y.astype(y.dtype), m
+
+            def mid_branch(y):
+                dh = jax.tree.map(jnp.zeros_like, head_params)
+                return dh, bwd_buf.astype(y.dtype), zero_metrics
+
+            dh, g_y, m = lax.cond(s == last, last_branch, mid_branch, y_b)
+            (dx,) = b_vjp(
+                (g_y, jnp.asarray(aux_cotangent, jnp.float32))
+            )
+
+            def acc(old, new):
+                return jax.tree.map(
+                    lambda o, n: o + jnp.where(bwd_valid, n, jnp.zeros_like(n)),
+                    old,
+                    new,
+                )
+
+            g_head, met = acc(g_head, dh), acc(met, m)
+            aux = aux + jnp.where(bwd_valid, aux_b, 0.0)
+            dx_acc = masked_slot_update(
+                dx_acc, dx, b_idx, bwd_valid & (s == 0)
+            )
+
+            # enqueue this tick's W work: the stage input, the output
+            # cotangent, and the microbatch index (dropout-key refold)
+            slot = q_tail % K
+            qx = masked_slot_update(qx, x_b, slot, bwd_valid)
+            qg = masked_slot_update(
+                qg, g_y.astype(compute_dtype), slot, bwd_valid
+            )
+            qm = masked_slot_update(qm, b_idx, slot, bwd_valid)
+            q_tail = q_tail + bwd_valid.astype(jnp.int32)
+            q_len = q_len + bwd_valid.astype(jnp.int32)
+
+            # drain the oldest item when over the deferral capacity or
+            # when the B schedule has gone quiet (the cooldown ticks)
+            do_drain = (q_len > 0) & ((q_len > cap) | ~bwd_valid)
+            head_slot = (q_tail - q_len) % K
+            xw = lax.dynamic_index_in_dim(qx, head_slot, 0, keepdims=False)
+            gw = lax.dynamic_index_in_dim(qg, head_slot, 0, keepdims=False)
+            mw = lax.dynamic_index_in_dim(qm, head_slot, 0, keepdims=False)
+            if dropout:
+                w_stage_fn = lambda blocks, x: raw_stage_fn(
+                    blocks, x, _mb_stage_key(step_key[0], mw, s)
+                )
+            else:
+                w_stage_fn = raw_stage_fn
+            # W: weight-cotangent-only vjp at the queued (input,
+            # cotangent) — the dual closure of the B pass; runs
+            # unconditionally (collectives), accumulated under the
+            # drain mask
+            (y_w, _aux_w), w_vjp = jax.vjp(
+                lambda blocks: w_stage_fn(blocks, xw), local_blocks
+            )
+            (db,) = w_vjp(
+                (gw.astype(y_w.dtype), jnp.asarray(aux_cotangent, jnp.float32))
+            )
+            g_blocks = jax.tree.map(
+                lambda g, n: g + jnp.where(do_drain, n, jnp.zeros_like(n)),
+                g_blocks,
+                db,
+            )
+            q_len = q_len - do_drain.astype(jnp.int32)
+
+            fwd_buf = lax.ppermute(
+                out.astype(compute_dtype), PIPE_AXIS, fwd_ring
+            )
+            bwd_buf = lax.ppermute(
+                dx.astype(compute_dtype), PIPE_AXIS, bwd_ring
+            )
+            return (fwd_buf, bwd_buf, resid, dx_acc, g_blocks, g_head,
+                    met, aux, qx, qg, qm, q_tail, q_len), None
+
+        buf0 = jnp.zeros((mb, t_len, d), compute_dtype)
+        init = (
+            buf0,
+            buf0,
+            jnp.zeros((depth, mb, t_len, d), compute_dtype),
+            jnp.zeros((M, mb, t_len, d), compute_dtype),
+            jax.tree.map(jnp.zeros_like, local_blocks),
+            jax.tree.map(jnp.zeros_like, head_params),
+            zero_metrics,
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((K, mb, t_len, d), compute_dtype),
+            jnp.zeros((K, mb, t_len, d), compute_dtype),
+            jnp.zeros((K,), jnp.int32),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros((), jnp.int32),
+        )
+        (_, _, _, dx_acc, g_blocks, g_head, met, aux, *_), _ = lax.scan(
+            tick, init, jnp.arange(n_ticks)
+        )
+        g_blocks = jax.tree.map(lambda g: g[None], g_blocks)
+        g_head = jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), g_head)
+        dx_acc = lax.psum(dx_acc, PIPE_AXIS)
+        met = jax.tree.map(lambda x: lax.psum(x, PIPE_AXIS), met)
+        aux = lax.psum(aux, PIPE_AXIS)
+        return g_blocks, g_head, dx_acc, met, aux
+
+    return jax.shard_map(
+        pipeline_body,
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P(), P(), P()) + ((P(),) if dropout else ()),
+        out_specs=(P(PIPE_AXIS), P(), P(), P(), P()),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )
+
+
 class _Embed(nn.Module):
     """Stage-0 prologue.  Uses ``make_embed`` — the same construction
     ``TransformerLM`` composes — so full-model checkpoints restructure 1:1
@@ -931,28 +1186,40 @@ def make_lm_pipeline_step_fns(
     interleave is not implemented for virtual stages).
 
     ``schedule``: ``"gpipe"`` (all forwards then all backwards, derived by
-    autodiff of the forward scan) or ``"1f1b"`` (explicit interleaved
+    autodiff of the forward scan), ``"1f1b"`` (explicit interleaved
     forward/backward, ``make_blocks_pipeline_1f1b`` — O(pipe) instead of
     O(microbatches) *stage-activation* residency; the embed/head edge
-    buffers stay O(batch) under both schedules — same gradients).
-    Evaluation always uses the forward-only GPipe schedule."""
+    buffers stay O(batch) under both schedules — same gradients), or
+    ``"zb"`` (zero-bubble, ``make_blocks_pipeline_zb`` — the 1F1B clock
+    loop with the backward split into B/W passes and the weight
+    gradients deferred into the cooldown ticks; single-chunk only, so
+    ``virtual_stages`` must be 1).  Evaluation always uses the
+    forward-only GPipe schedule."""
     cfg = normalize_flash(cfg, spec, seq_len)  # resolve flash="auto"
     validate_kv_head_sharding(cfg, spec)
     n_stages, M = spec.pipe, num_microbatches
     V = virtual_stages
     if n_stages < 2:
         raise ValueError("make_lm_pipeline_step_fns needs spec.pipe >= 2")
-    if schedule not in ("gpipe", "1f1b"):
+    from ddl_tpu.parallel.rules import PIPELINE_SCHEDULES, lm_rules
+
+    if schedule not in PIPELINE_SCHEDULES:
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    if cfg.ce_vocab_chunk and schedule == "1f1b":
+    if cfg.ce_vocab_chunk and schedule in ("1f1b", "zb"):
         raise ValueError(
-            "ce_vocab_chunk is not supported with the 1F1B schedule (its "
-            "per-microbatch head loss runs inside the manual region, "
-            "where the vocab-scan custom VJP is unverified); use the "
-            "GPipe schedule or ce_chunk"
+            f"ce_vocab_chunk is not supported with the {schedule.upper()} "
+            "schedule (its per-microbatch head loss runs inside the manual "
+            "region, where the vocab-scan custom VJP is unverified); use "
+            "the GPipe schedule or ce_chunk"
         )
     if V < 1:
         raise ValueError(f"virtual_stages must be >= 1, got {V}")
+    if schedule == "zb" and V > 1:
+        raise ValueError(
+            f"virtual_stages={V} requires schedule='gpipe' or '1f1b' "
+            "(the zero-bubble B/W-split clock loop is single-chunk; "
+            "compose virtual stages with 1f1b instead)"
+        )
     if V > 1 and M % n_stages:
         raise ValueError(
             f"num_microbatches {M} % pipe {n_stages} != 0 (the interleaved "
@@ -1219,7 +1486,7 @@ def make_lm_pipeline_step_fns(
         return loss, (logits, {"loss": loss, "ce": ce, "moe_aux": aux})
 
     manual_grad_fn = None
-    if schedule == "1f1b":
+    if schedule in ("1f1b", "zb"):
         # Loss inside the manual region: per-microbatch CE on the last
         # stage, contributing ce/M to the full-batch mean; the raw ce rides
         # out as a metric.
@@ -1247,10 +1514,7 @@ def make_lm_pipeline_step_fns(
             ce, _ = onehot_cross_entropy_mean(logits, tgt)
             return ce / M, ce
 
-        pipeline_1f1b = make_blocks_pipeline_1f1b(
-            mesh,
-            block_mod,
-            head_loss,
+        bw_kwargs = dict(
             n_stages=n_stages,
             num_microbatches=M,
             mb=mb,
@@ -1259,8 +1523,15 @@ def make_lm_pipeline_step_fns(
             aux_cotangent=cfg.moe_aux_weight / M,
             zero_metrics=jnp.zeros((), jnp.float32),
             dropout=use_dropout,
-            virtual=V,
         )
+        if schedule == "zb":
+            pipeline_bw = make_blocks_pipeline_zb(
+                mesh, block_mod, head_loss, **bw_kwargs
+            )
+        else:
+            pipeline_bw = make_blocks_pipeline_1f1b(
+                mesh, block_mod, head_loss, virtual=V, **bw_kwargs
+            )
 
         def manual_grad_fn(params, inputs, targets, step=None):
             with nn.logical_axis_rules(rules):
@@ -1278,7 +1549,7 @@ def make_lm_pipeline_step_fns(
                 key_args = (
                     (dropout_step_key(rng, step),) if use_dropout else ()
                 )
-                g_blocks, g_head, dx_mb, ce_sum, aux_sum = pipeline_1f1b(
+                g_blocks, g_head, dx_mb, ce_sum, aux_sum = pipeline_bw(
                     blocks_of(params), params["head"], x_mb, tgt_mb, *key_args
                 )
                 # close the gradient path GPipe's shard_map transpose handles
@@ -1295,6 +1566,15 @@ def make_lm_pipeline_step_fns(
             }
             return grads, {"loss": loss, "ce": ce, "moe_aux": moe_aux}
 
+    # the family rule table's contract, extended with the pipeline facts
+    # the zb contract probe (analysis/contracts.py) validates: which
+    # schedule this factory compiled and its stage/chunk geometry
+    contract = lm_rules(cfg.fsdp).contract(
+        pipeline_schedule=schedule,
+        pipeline_stages=n_stages,
+        virtual_stages=V,
+    )
     return finalize_step_fns(
-        mesh, tx, loss_fn, create_state, rng, manual_grad_fn=manual_grad_fn
+        mesh, tx, loss_fn, create_state, rng, manual_grad_fn=manual_grad_fn,
+        contract=contract,
     )

@@ -63,6 +63,7 @@ __all__ = [
     "spec_num_shards",
     "optimizer_hbm_bytes",
     "ZERO_THRESHOLD",
+    "PIPELINE_SCHEDULES",
     "BATCH_SPEC",
     "IMAGE_SPEC",
     "TOKEN_SPEC",
@@ -75,6 +76,18 @@ __all__ = [
 # more than the replicated bytes); the same line the contract checker
 # draws for silent replication (analysis/contracts.REPLICATION_THRESHOLD).
 ZERO_THRESHOLD = 8192
+
+# The blocks-pipeline schedule vocabulary (parallel/lm_pipeline.py):
+# "gpipe" (autodiff through the forward scan; virtual_stages > 1 makes
+# it the interleaved schedule), "1f1b" (hand-written interleaved
+# forward/backward), "zb" (zero-bubble: 1F1B with the backward split
+# into B/W and W deferred into the cooldown ticks).  The step
+# factories validate against this tuple and stamp the selected
+# schedule into their boundary contract (``pipeline_schedule``), which
+# the contract probes (analysis/contracts.py) check membership of —
+# one vocabulary, declared where the rest of the partitioning facts
+# live.
+PIPELINE_SCHEDULES = ("gpipe", "1f1b", "zb")
 
 # ---------------------------------------------------------------------------
 # Named jit-boundary batch specs.  Defined HERE (not in the step

@@ -33,6 +33,7 @@ from ddl_tpu.parallel.ring_attention import make_ring_self_attention
 # PartitionSpec axis literals (astlint 'pspec-hand-rolled').
 from ddl_tpu.parallel.rules import (
     LM_MANUAL_ATTN_SPEC,
+    PIPELINE_SCHEDULES,
     TOKEN_SPEC,
     lm_rules,
 )
@@ -417,7 +418,7 @@ def make_lm_step_fns(
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    if pipeline_schedule not in ("gpipe", "1f1b"):
+    if pipeline_schedule not in PIPELINE_SCHEDULES:
         raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
     cfg = normalize_flash(cfg, spec, seq_len)
     validate_kv_head_sharding(cfg, spec)

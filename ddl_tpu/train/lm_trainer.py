@@ -54,6 +54,9 @@ class LMRunConfig:
     steps: int = 100
     num_microbatches: int = 0
     accum_steps: int = 1
+    # "gpipe" | "1f1b" | "zb" (parallel/rules.PIPELINE_SCHEDULES): zb is
+    # the zero-bubble B/W-split 1F1B — weight grads deferred into the
+    # cooldown ticks; requires virtual_stages == 1
     pipeline_schedule: str = "gpipe"
     virtual_stages: int = 1
     # ZeRO-1 optimizer-state sharding over 'data' (requires a fused Adam
@@ -138,6 +141,10 @@ class LMTrainer(BaseTrainer):
             else None
         )
         self._init_obs(run.log_dir, run.job_id, "lm")
+        self._emit_pipe_schedule(
+            run.pipeline_schedule, self.spec.pipe,
+            run.num_microbatches or self.spec.pipe, run.virtual_stages,
+        )
         self.halt_on_nan = run.halt_on_nan
         from ddl_tpu.train.recovery import make_policy
 

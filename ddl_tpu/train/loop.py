@@ -274,6 +274,38 @@ class BaseTrainer:
 
             self.obs = StepTrace.create(log_dir, job_id, family, host=host_id())
 
+    def _emit_pipe_schedule(
+        self, schedule: str, pipe: int, microbatches: int, virtual: int = 1
+    ) -> None:
+        """One ``pipe_schedule`` event per run when pipeline parallelism
+        is active: the schedule's identity plus the modeled per-stage
+        F/B/W/idle accounting (``obs/schedule_model.py``).  The schedule
+        is static for the whole run, so one event suffices — ``obs
+        trace --step`` recomputes the lanes from these parameters and
+        scales them into any step's measured window, and ``obs
+        summarize`` renders the bubble line.  Combinations the model
+        does not cover (interleaved 1F1B) emit the identity fields with
+        the modeled ones null."""
+        if self.obs is None or pipe <= 1:
+            return
+        from ddl_tpu.obs.schedule_model import schedule_summary
+
+        try:
+            summ = schedule_summary(schedule, pipe, microbatches, virtual)
+        except ValueError:
+            summ = {}
+        self.obs.writer.emit(
+            "pipe_schedule",
+            schedule=schedule,
+            pipe=pipe,
+            microbatches=microbatches,
+            virtual=virtual,
+            makespan=summ.get("makespan"),
+            idle_units=summ.get("idle_units"),
+            bubble_fraction=summ.get("bubble_fraction"),
+            per_stage=summ.get("per_stage"),
+        )
+
     @property
     def best_label(self) -> str:
         return (self.best_metric or "metric").upper()

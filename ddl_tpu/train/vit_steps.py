@@ -25,7 +25,7 @@ from ddl_tpu.ops.losses import cross_entropy_loss
 # Jit-boundary batch spec + the family rule table come from the
 # partition-rule engine — this module is lint-banned from hand-writing
 # PartitionSpec axis literals (astlint 'pspec-hand-rolled').
-from ddl_tpu.parallel.rules import IMAGE_SPEC, vit_rules
+from ddl_tpu.parallel.rules import IMAGE_SPEC, PIPELINE_SCHEDULES, vit_rules
 from ddl_tpu.parallel.sharding import (
     LMMeshSpec,
     build_lm_mesh,
@@ -74,7 +74,7 @@ def make_vit_step_fns(
     validate_kv_head_sharding(cfg.block_config(), spec)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    if pipeline_schedule not in ("gpipe", "1f1b"):
+    if pipeline_schedule not in PIPELINE_SCHEDULES:
         raise ValueError(f"unknown pipeline schedule {pipeline_schedule!r}")
     if spec.pipe > 1:
         if accum_steps > 1:
@@ -303,10 +303,15 @@ def _make_vit_pipeline_step_fns(
 
     n_stages, M = spec.pipe, num_microbatches
     V = virtual_stages
-    if schedule not in ("gpipe", "1f1b"):
+    if schedule not in PIPELINE_SCHEDULES:
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if V < 1:
         raise ValueError(f"virtual_stages must be >= 1, got {V}")
+    if schedule == "zb" and V > 1:
+        raise ValueError(
+            f"virtual_stages={V} requires schedule='gpipe' or '1f1b' "
+            "(the zero-bubble B/W-split clock loop is single-chunk)"
+        )
     if V > 1 and M % n_stages:
         raise ValueError(
             f"num_microbatches {M} % pipe {n_stages} != 0 (the interleaved "
@@ -426,8 +431,11 @@ def _make_vit_pipeline_step_fns(
             )
 
     manual_grad_fn = None
-    if schedule == "1f1b":
-        from ddl_tpu.parallel.lm_pipeline import make_blocks_pipeline_1f1b
+    if schedule in ("1f1b", "zb"):
+        from ddl_tpu.parallel.lm_pipeline import (
+            make_blocks_pipeline_1f1b,
+            make_blocks_pipeline_zb,
+        )
 
         def head_loss(head_p, y, tgt):
             with nn.logical_axis_rules(rules):
@@ -440,15 +448,21 @@ def _make_vit_pipeline_step_fns(
             acc = (jnp.argmax(logits, -1) == tgt).mean()
             return ce / M, jnp.stack([ce, acc])
 
-        pipeline_1f1b = make_blocks_pipeline_1f1b(
-            mesh, block_mod, head_loss,
+        bw_kwargs = dict(
             n_stages=n_stages, num_microbatches=M, mb=mb,
             d_model=d, compute_dtype=cfg.dtype,
             aux_cotangent=0.0,  # ViT blocks have no MoE aux
             zero_metrics=jnp.zeros((2,), jnp.float32),
             dropout=use_dropout,
-            virtual=V,
         )
+        if schedule == "zb":
+            pipeline_bw = make_blocks_pipeline_zb(
+                mesh, block_mod, head_loss, **bw_kwargs
+            )
+        else:
+            pipeline_bw = make_blocks_pipeline_1f1b(
+                mesh, block_mod, head_loss, virtual=V, **bw_kwargs
+            )
 
         def manual_grad_fn(params, images, labels, step=None):
             with nn.logical_axis_rules(rules):
@@ -464,7 +478,7 @@ def _make_vit_pipeline_step_fns(
                 key_args = (
                     (dropout_step_key(rng, step),) if use_dropout else ()
                 )
-                g_blocks, g_head, dx_mb, met, _aux = pipeline_1f1b(
+                g_blocks, g_head, dx_mb, met, _aux = pipeline_bw(
                     blocks_of(params), params["head"],
                     x_mb, lab_mb, *key_args
                 )
@@ -480,4 +494,8 @@ def _make_vit_pipeline_step_fns(
 
     return _finalize_vit(mesh, tx, forward, create_state, rng,
                          manual_grad_fn=manual_grad_fn,
-                         contract=table.contract())
+                         contract=table.contract(
+                             pipeline_schedule=schedule,
+                             pipeline_stages=n_stages,
+                             virtual_stages=V,
+                         ))

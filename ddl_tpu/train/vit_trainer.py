@@ -38,6 +38,7 @@ class ViTRunConfig:
     epochs: int = 3
     num_microbatches: int = 0
     accum_steps: int = 1
+    # "gpipe" | "1f1b" | "zb" (parallel/rules.PIPELINE_SCHEDULES)
     pipeline_schedule: str = "gpipe"
     virtual_stages: int = 1
     # ZeRO-1 optimizer-state sharding over 'data' (requires a fused Adam
@@ -120,6 +121,10 @@ class ViTTrainer(BaseTrainer):
             else None
         )
         self._init_obs(run.log_dir, run.job_id, "vit")
+        self._emit_pipe_schedule(
+            run.pipeline_schedule, self.spec.pipe,
+            run.num_microbatches or self.spec.pipe, run.virtual_stages,
+        )
         self.num_periods = run.epochs
         self.halt_on_nan = run.halt_on_nan
         from ddl_tpu.train.recovery import make_policy

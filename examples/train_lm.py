@@ -35,10 +35,13 @@ def main() -> None:
     ap.add_argument("--microbatches", type=int, default=0,
                     help="pipeline microbatches when --pipe > 1 (default: --pipe)")
     ap.add_argument("--pipeline-schedule", default="gpipe",
-                    choices=["gpipe", "1f1b"],
+                    choices=["gpipe", "1f1b", "zb"],
                     help="pipeline schedule when --pipe > 1: gpipe (all "
-                    "forwards then all backwards) or 1f1b (interleaved, "
-                    "O(pipe) stage-activation residency)")
+                    "forwards then all backwards), 1f1b (interleaved, "
+                    "O(pipe) stage-activation residency), or zb "
+                    "(zero-bubble: 1f1b with the backward split into "
+                    "B/W and weight grads deferred into the cooldown "
+                    "ticks; needs --virtual-stages 1)")
     ap.add_argument("--virtual-stages", type=int, default=1,
                     help="interleaved pipeline: layer chunks per device "
                     "(>1 shrinks the bubble by that factor; composes with "

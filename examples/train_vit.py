@@ -34,9 +34,10 @@ def main() -> None:
     ap.add_argument("--microbatches", type=int, default=0,
                     help="microbatches when --pipe > 1 (default: --pipe)")
     ap.add_argument("--pipeline-schedule", default="gpipe",
-                    choices=["gpipe", "1f1b"],
+                    choices=["gpipe", "1f1b", "zb"],
                     help="pipeline schedule when --pipe > 1 (1f1b: "
-                    "interleaved, O(pipe) stage-activation residency)")
+                    "interleaved, O(pipe) stage-activation residency; "
+                    "zb: zero-bubble B/W-split 1f1b, --virtual-stages 1)")
     ap.add_argument("--virtual-stages", type=int, default=1,
                     help="interleaved pipeline: layer chunks per device "
                     "(>1 shrinks the bubble by that factor)")

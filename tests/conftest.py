@@ -60,6 +60,8 @@ SLOW_TESTS = (
     "test_lm_pipeline.py::test_lm_pipeline_matches_single_dense",
     "test_lm_pipeline.py::test_lm_pipeline_moe_composition",
     "test_lm_pipeline.py::test_lm_pipeline_with_sequence_parallel_attention",
+    "test_lm_pipeline.py::test_lm_pipeline_zb_matches_gpipe_and_1f1b",
+    "test_vit.py::test_pipeline_zb_matches_gpipe_and_1f1b",
     "test_misc.py::TestGraftEntry::",
     "test_multihost.py::",
     "test_observability.py::test_train_lm_corpus_eval_writes_val_metrics",

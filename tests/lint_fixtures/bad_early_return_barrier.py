@@ -1,0 +1,89 @@
+"""Known-bad/known-good fixture: early-return asymmetry — a
+host-dependent branch that LEAVES the function makes every later
+statement in the suite reachable only by the hosts that stayed, so a
+barrier/collective after it is the same split-brain hang as one inside
+the branch.  Linted by tests with a coord-module rel path; parsed by
+tests/test_lint_v2.py — never imported."""
+
+import os
+
+from jax import lax
+
+# module-level early exit: the suite-aware walk covers import-time code
+# too — everything below the raise runs only on hosts that skipped it
+if os.environ.get("DDL_SKIP_MODULE_INIT"):
+    raise SystemExit(0)
+
+_INIT = lax.psum(1, "data")  # collective-symmetry: module-level DDL_* gate
+
+
+def early_return_then_barrier(rv, host_id):
+    if host_id != 0:
+        return None
+    rv.barrier("propose")  # collective-symmetry: only host 0 arrives
+    return rv
+
+
+def env_gated_raise_then_psum(x):
+    if os.environ.get("DDL_SKIP_REDUCE"):
+        raise RuntimeError("skipped")
+    return lax.psum(x, "data")  # collective-symmetry: DDL_* early raise
+
+
+def early_return_else_branch(rv, host):
+    if host == 0:
+        pass
+    else:
+        return None
+    rv.arrive("leader-only")  # collective-symmetry: non-leaders left
+
+
+def continue_gated_barrier_in_loop(rv, host_id, steps):
+    for step in range(steps):
+        if host_id != 0:
+            continue
+        rv.barrier(f"tick-{step}")  # collective-symmetry: host 0 only
+    return steps
+
+
+def loop_barrier_after_symmetric_skip(rv, ready, steps):
+    for step in range(steps):
+        if not ready:
+            continue
+        rv.barrier(f"tick-{step}")  # fine: the skip is not host-gated
+    return steps
+
+
+def barrier_before_early_return(rv, host_id):
+    rv.barrier("start")  # fine: every host arrives before the split
+    if host_id != 0:
+        return None
+    return rv
+
+
+def early_return_not_host_dependent(rv, ready):
+    if not ready:
+        return None
+    rv.barrier("start")  # fine: the early return is not host-gated
+
+
+def both_branches_return(rv, host_id):
+    # symmetric: EVERY host leaves here, nothing below is reachable
+    if host_id == 0:
+        return "leader"
+    else:
+        return "follower"
+    rv.barrier("dead")  # fine: dead code, no host reaches it
+
+
+def early_return_in_nested_def(rv, host_id):
+    # the nested body resets the suite taint — defining a function
+    # under a host branch is not calling one
+    def helper():
+        if host_id != 0:
+            return None
+        return rv
+
+    helper()
+    rv.barrier("join")  # fine: every host calls this
+    return rv

@@ -123,7 +123,7 @@ def test_vit_pipeline_dropout_runs():
     imgs = jnp.asarray(rng.integers(0, 255, (B, 16, 16, 3)).astype(np.uint8))
     labels = jnp.asarray(rng.integers(0, 5, (B,)).astype(np.int32))
     out = {}
-    for sched in ("gpipe", "1f1b"):
+    for sched in ("gpipe", "1f1b", "zb"):
         fns = make_vit_step_fns(vcfg, LMMeshSpec(pipe=2), optax.adam(1e-3),
                                 jax.random.key(0), B, num_microbatches=2,
                                 pipeline_schedule=sched,
@@ -136,6 +136,12 @@ def test_vit_pipeline_dropout_runs():
         lambda a, b: float(np.max(np.abs(np.asarray(a) - np.asarray(b)))),
         out["gpipe"][1], out["1f1b"][1]))
     assert err < 1e-5, err
+    # the zb W pass refolds the mask key from the queued microbatch
+    # index — identical masks, so zb matches 1f1b exactly
+    err_zb = jax.tree.reduce(max, jax.tree.map(
+        lambda a, b: float(np.max(np.abs(np.asarray(a) - np.asarray(b)))),
+        out["zb"][1], out["1f1b"][1]))
+    assert err_zb <= 1e-6, err_zb
 
 
 def test_vit_dropout():

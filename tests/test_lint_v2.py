@@ -179,6 +179,47 @@ def test_symmetric_and_nested_def_paths_not_flagged(tmp_path):
         assert "fine" not in flagged, flagged
 
 
+EARLY_RETURN_SRC = (FIXTURES / "bad_early_return_barrier.py").read_text()
+
+
+def test_early_return_asymmetry_flagged(tmp_path):
+    """`if host: return` before a barrier/collective is the same split
+    brain as a barrier inside the branch — the PR-13 follow-on the
+    condition-stack walk could not see."""
+    fs = [
+        f for f in _lint_tmp(tmp_path, "coord.py", EARLY_RETURN_SRC)
+        if f.rule == "collective-symmetry"
+    ]
+    # module-level DDL_*-gated raise, host-gated early return, DDL_*
+    # early raise, else-branch return, and the continue-gated barrier
+    # inside a for-loop body
+    assert len(fs) == 5, fs
+    msgs = " | ".join(f.message for f in fs)
+    assert "rv.barrier" in msgs and "lax.psum" in msgs and "rv.arrive" in msgs
+    assert "early" in msgs
+    lines = EARLY_RETURN_SRC.splitlines()
+    for f in fs:
+        assert "collective-symmetry:" in lines[f.line - 1], lines[f.line - 1]
+
+
+def test_early_return_known_good_not_flagged(tmp_path):
+    """The known-good half: barrier before the split, non-host-gated
+    early returns, symmetric both-branches-return, and nested-def
+    bodies must all stay clean."""
+    fs = [
+        f for f in _lint_tmp(tmp_path, "supervisor.py", EARLY_RETURN_SRC)
+        if f.rule == "collective-symmetry"
+    ]
+    lines = EARLY_RETURN_SRC.splitlines()
+    for f in fs:
+        assert "fine" not in lines[f.line - 1], lines[f.line - 1]
+    # outside the coordination/step modules the rule does not apply
+    assert [
+        f for f in _lint_tmp(tmp_path, "bench/lm.py", EARLY_RETURN_SRC)
+        if f.rule == "collective-symmetry"
+    ] == []
+
+
 def test_conditional_barrier_suppression(tmp_path):
     ok = BARRIER_SRC.replace(
         'rv.barrier(f"e{epoch}-join")  # collective-symmetry: rv.host branch',

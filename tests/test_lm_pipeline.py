@@ -221,6 +221,80 @@ def test_lm_pipeline_interleaved_1f1b_matches_interleaved_gpipe(
     assert _maxerr(states["gpipe"], states["1f1b"]) < 5e-5
 
 
+@pytest.mark.parametrize(
+    "spec,microbatches,kw",
+    [
+        (LMMeshSpec(data=2, pipe=2), 4, {}),
+        (LMMeshSpec(data=2, pipe=2), 4, dict(dropout_rate=0.1)),
+        (LMMeshSpec(data=1, pipe=2), 4, {}),
+        (LMMeshSpec(data=1, pipe=2), 4, dict(dropout_rate=0.1)),
+        (LMMeshSpec(data=1, pipe=4), 8, {}),
+    ],
+    ids=["dp2_pp2", "dp2_pp2_dropout", "pp2", "pp2_dropout", "pp4_m8"],
+)
+def test_lm_pipeline_zb_matches_gpipe_and_1f1b(spec, microbatches, kw):
+    """The zero-bubble schedule's split backward (B-pass vjp w.r.t. the
+    stage input, W-pass vjp w.r.t. the weights, applied to the same
+    output cotangent) is exactly the joint vjp's two components, so a
+    3-step fused-Adam trajectory must track BOTH reference schedules to
+    1e-6 — loss and post-update parameters, dropout on or off (the W
+    pass refolds the mask key from the queued microbatch index)."""
+    from ddl_tpu.train.fused_optim import fused_adam
+
+    cfg = _cfg(**kw)
+    rng = jax.random.key(0)
+    inp, tgt = _batch()
+    traj = {}
+    for sched in ("gpipe", "1f1b", "zb"):
+        fns = make_lm_step_fns(
+            cfg, spec, fused_adam(1e-2), rng, B, T,
+            devices=jax.devices()[: spec.num_devices],
+            num_microbatches=microbatches,
+            pipeline_schedule=sched,
+        )
+        st = fns.init_state()
+        losses = []
+        for _ in range(3):
+            st, m = fns.train(st, inp, tgt)
+            losses.append(float(m["loss"]))
+        traj[sched] = (losses, jax.device_get(st.params))
+    for ref in ("gpipe", "1f1b"):
+        dloss = max(
+            abs(a - b) for a, b in zip(traj["zb"][0], traj[ref][0])
+        )
+        assert dloss <= 1e-6, (ref, dloss)
+        derr = _maxerr(traj["zb"][1], traj[ref][1])
+        assert derr <= 1e-6, (ref, derr)
+
+
+def test_lm_pipeline_zb_w_queue_drains_all_microbatches():
+    """M well past the deferral capacity (P=2: cap_s <= 1, M=6) forces
+    the queue through every regime in one step — same-tick drains on
+    stage 0, steady-state one-in-one-out on stage 1, and the cooldown
+    tail — and a single dropped or double-counted W item would shift
+    the block gradients, so gradient parity with GPipe proves every
+    microbatch's deferred weight gradient landed exactly once.  (The
+    drain ORDER is pinned by the schedule model:
+    test_schedule_model.py asserts W units drain in microbatch
+    order.)"""
+    cfg = _cfg()
+    tx = optax.adam(1e-2)
+    rng = jax.random.key(0)
+    toks = np.random.default_rng(2).integers(0, 32, (12, T + 1))
+    inp, tgt = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    out = {}
+    for sched in ("gpipe", "zb"):
+        fns = make_lm_step_fns(
+            cfg, LMMeshSpec(data=1, pipe=2), tx, rng, 12, T,
+            devices=jax.devices()[:2], num_microbatches=6,
+            pipeline_schedule=sched,
+        )
+        s1, m = fns.train(fns.init_state(), inp, tgt)
+        out[sched] = (float(m["loss"]), jax.device_get(s1.params))
+    assert abs(out["zb"][0] - out["gpipe"][0]) <= 1e-6
+    assert _maxerr(out["zb"][1], out["gpipe"][1]) <= 1e-6
+
+
 def test_lm_pipeline_1f1b_matches_single():
     """1F1B end-to-end against the non-pipelined single-device run (not
     just against GPipe): two steps, loss and post-Adam parameter parity."""
@@ -574,6 +648,18 @@ def test_lm_pipeline_validation_errors():
         make_lm_pipeline_step_fns(
             _cfg(), LMMeshSpec(pipe=2), tx, rng, B, T, 2,
             devices=jax.devices()[:2], schedule="zb1",
+        )
+    # the zero-bubble B/W-split loop is single-chunk: zb x virtual
+    # stages is rejected, not silently degraded
+    with pytest.raises(ValueError, match="single-chunk|1f1b"):
+        make_lm_pipeline_step_fns(
+            _cfg(n_layers=8), LMMeshSpec(pipe=2), tx, rng, B, T, 2,
+            devices=jax.devices()[:2], schedule="zb", virtual_stages=2,
+        )
+    with pytest.raises(ValueError, match="ce_vocab_chunk"):
+        make_lm_pipeline_step_fns(
+            _cfg(ce_vocab_chunk=8), LMMeshSpec(pipe=2), tx, rng, B, T, 2,
+            devices=jax.devices()[:2], schedule="zb",
         )
     with pytest.raises(ValueError, match="pipeline_schedule"):
         make_lm_step_fns(

@@ -173,6 +173,52 @@ def test_fold_equivalence_under_arbitrary_splits(tmp_path):
     assert "restart latency: 3 restart(s)" in warm_s
 
 
+def test_fold_pipe_schedule_cell_and_byte_identity(tmp_path):
+    """The pipe_schedule reducer (sidecar v7): last-wins cell, rendered
+    as summarize's pipeline line, with warm==cold byte identity across
+    a resume that appends a NEWER schedule event (a resumed run can
+    change layout)."""
+    import json as _json
+
+    job = "sched"
+    ev1 = _ev(
+        0, "pipe_schedule", 5.0, schedule="1f1b", pipe=2, microbatches=4,
+        virtual=1, makespan=14.0, idle_units=4.0, bubble_fraction=0.142857,
+        per_stage=[{"F": 4.0, "B": 4.0, "W": 4.0, "idle": 2.0}] * 2,
+    )
+    _append(tmp_path, job, 0, [_json.dumps(e) for e in (ev1,)])
+    _append(tmp_path, job, 0, [_json.dumps(e) for e in _rich_events(0)[:3]])
+    warm, _, fold = _render_both(tmp_path, job, cache=True)
+    cold, _, _ = _render_both(tmp_path, job, cache=False)
+    assert warm == cold
+    assert "pipeline: 1f1b pipe=2 microbatches=4" in warm
+    assert "modeled bubble 14.3%" in warm
+    assert fold.pipe_schedule()["schedule"] == "1f1b"
+
+    # resume with a newer zb event: the cell flips last-wins, warm
+    # (resumed sidecar) still byte-identical to cold
+    ev2 = dict(ev1, ts=50.0, mono=50.0, schedule="zb", idle_units=2.0,
+               bubble_fraction=0.076923, makespan=13.0)
+    _append(tmp_path, job, 0, [_json.dumps(ev2)])
+    warm2, _, fold2 = _render_both(tmp_path, job, cache=True)
+    cold2, _, _ = _render_both(tmp_path, job, cache=False)
+    assert warm2 == cold2
+    assert "pipeline: zb" in warm2
+    assert fold2.pipe_schedule()["schedule"] == "zb"
+
+    # an event without modeled fields (unmodeled combo) still renders
+    # the identity half of the line
+    job2 = "sched2"
+    _append(tmp_path, job2, 0, [_json.dumps(_ev(
+        0, "pipe_schedule", 6.0, schedule="1f1b", pipe=2, microbatches=4,
+        virtual=2, makespan=None, idle_units=None, bubble_fraction=None,
+        per_stage=None,
+    ))])
+    warm3, _, _ = _render_both(tmp_path, job2, cache=True)
+    assert "pipeline: 1f1b pipe=2 microbatches=4 virtual=2" in warm3
+    assert "modeled bubble" not in warm3
+
+
 def test_fold_reads_only_appended_bytes(tmp_path):
     """The O(appended-bytes) acceptance: a resumed fold's read volume is
     bounded by the appended tail (plus the 64-byte head fingerprints),

@@ -219,6 +219,53 @@ def test_step_trace_spans_phases(tmp_path):
     assert {e["name"] for e in xs} == {"step", "data_wait"}
 
 
+def test_step_trace_renders_schedule_lanes(tmp_path):
+    """With a pipe_schedule event on record, `obs trace --step` adds
+    the modeled per-stage F/B/W lanes beside the measured phase spans —
+    one Perfetto thread per stage, every unit marked modeled, scaled
+    into the step's measured window."""
+    from ddl_tpu.obs.trace import trace_job
+
+    _write(tmp_path, "zbsteps", 0, [
+        _ev(0, "pipe_schedule", 5.0, schedule="zb", pipe=2,
+            microbatches=4, virtual=1),
+        _ev(0, "span", 10.0, step=3, name="step", dur=0.08, depth=0,
+            period=0),
+        _ev(0, "span", 10.2, step=3, name="fence", dur=0.01, depth=0,
+            period=0),
+    ])
+    trace = trace_job(tmp_path, "zbsteps", step=3)
+    _assert_valid_chrome_trace(trace)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    lanes = [e for e in xs if e.get("args", {}).get("modeled")]
+    phases = {e["args"]["phase"] for e in lanes}
+    assert phases == {"F", "B", "W"}
+    # every stage contributes M units of each phase
+    per_stage = {}
+    for e in lanes:
+        per_stage.setdefault(e["tid"], []).append(e)
+    assert set(per_stage) == {0, 1}
+    for units in per_stage.values():
+        assert len(units) == 3 * 4
+    # stage threads are named and the measured spans are still there
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"stage 0", "stage 1"} <= names
+    assert {"step", "fence"} <= {e["name"] for e in xs}
+
+    # a malformed/unmodeled pipe_schedule event degrades to no lanes,
+    # never a crash
+    _write(tmp_path, "badsched", 0, [
+        _ev(0, "pipe_schedule", 5.0, schedule="1f1b", pipe=2,
+            microbatches=4, virtual=2),
+        _ev(0, "span", 10.0, step=1, name="step", dur=0.05, depth=0,
+            period=0),
+    ])
+    t2 = trace_job(tmp_path, "badsched", step=1)
+    assert not [e for e in t2["traceEvents"]
+                if e["ph"] == "X" and e.get("args", {}).get("modeled")]
+
+
 def test_selector_errors_are_actionable(tmp_path):
     from ddl_tpu.obs.trace import trace_job
 

@@ -1,0 +1,121 @@
+"""Modeled pipeline-schedule accounting (obs/schedule_model.py): the
+dependency-respecting lane simulator behind the ``pipe_schedule`` obs
+event, the ``obs trace --step`` schedule lanes, and the ``bench
+digest`` bubble table.  Pure stdlib — no JAX, no mesh."""
+
+import pytest
+
+from ddl_tpu.obs.schedule_model import (
+    SCHEDULES,
+    schedule_lanes,
+    schedule_summary,
+    schedule_table,
+)
+
+
+def _by_task(lanes):
+    return {
+        (u["phase"], u["mb"], u["stage"]): u
+        for lane in lanes
+        for u in lane
+    }
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "zb"])
+@pytest.mark.parametrize("P,M", [(2, 4), (4, 8), (4, 16)])
+def test_lanes_are_complete_and_dependency_respecting(schedule, P, M):
+    lanes = schedule_lanes(schedule, P, M)
+    tasks = _by_task(lanes)
+    # every (phase, microbatch) unit exactly once
+    assert len(tasks) == 3 * M * P
+    for lane in lanes:
+        # a stage is a serial processor: no overlapping units
+        ordered = sorted(lane, key=lambda u: u["t0"])
+        for a, b in zip(ordered, ordered[1:]):
+            assert a["t1"] <= b["t0"] + 1e-9
+    for (phase, m, sig), u in tasks.items():
+        if phase == "F" and sig > 0:
+            assert tasks[("F", m, sig - 1)]["t1"] <= u["t0"] + 1e-9
+        if phase == "B":
+            assert tasks[("F", m, sig)]["t1"] <= u["t0"] + 1e-9
+            if sig < P - 1:
+                assert tasks[("B", m, sig + 1)]["t1"] <= u["t0"] + 1e-9
+        if phase == "W":
+            assert tasks[("B", m, sig)]["t1"] <= u["t0"] + 1e-9
+
+
+def test_zb_w_passes_drain_in_microbatch_order_none_dropped():
+    """The W queue drains oldest-first: per stage, the W units appear in
+    strictly increasing microbatch order and all M are present — the
+    deferred-weight-grad lifecycle the clock loop implements."""
+    for P, M in ((2, 4), (4, 8), (2, 8)):
+        lanes = schedule_lanes("zb", P, M)
+        for lane in lanes:
+            ws = [u for u in lane if u["phase"] == "W"]
+            ws.sort(key=lambda u: u["t0"])
+            assert [u["mb"] for u in ws] == list(range(M))
+
+
+def test_zb_defers_w_into_the_bubble():
+    """The last stage's first W runs strictly after its first B would
+    have fused it in 1F1B — the deferral is visible in the lanes."""
+    P, M = 4, 8
+    zb = _by_task(schedule_lanes("zb", P, M))
+    o = _by_task(schedule_lanes("1f1b", P, M))
+    s = P - 1
+    assert zb[("W", 0, s)]["t0"] > o[("W", 0, s)]["t0"]
+
+
+@pytest.mark.parametrize("P", [2, 4, 8])
+def test_zb_strictly_fewer_idle_units_than_1f1b_at_m_ge_2p(P):
+    """The acceptance bound: at M >= 2P the zero-bubble schedule idles
+    strictly less stage-time than 1F1B (and no schedule idles less
+    than zb among the modeled four)."""
+    for M in (2 * P, 4 * P):
+        rows = {r["schedule"]: r for r in schedule_table(P, M)}
+        zb, o = rows["zb"], rows["1f1b"]
+        assert zb["idle_units"] < o["idle_units"]
+        assert zb["makespan"] <= o["makespan"]
+        # gpipe and 1f1b share the classic (P-1)(tF+tB+tW) bubble —
+        # 1F1B buys memory, not bubble; zb buys bubble
+        assert rows["gpipe"]["idle_units"] == o["idle_units"]
+        assert min(
+            r["idle_units"] for r in rows.values() if "skipped" not in r
+        ) == zb["idle_units"]
+
+
+def test_interleaved_shrinks_gpipe_bubble():
+    g = schedule_summary("gpipe", 4, 8)
+    iv = schedule_summary("interleaved", 4, 8, virtual=2)
+    assert iv["bubble_fraction"] < g["bubble_fraction"]
+    # "interleaved" implies >= 2 chunks; the recorded metadata must
+    # match the V the numbers were modeled at, not the raw argument
+    iv1 = schedule_summary("interleaved", 4, 8, virtual=1)
+    assert iv1["virtual"] == 2
+    assert iv1["makespan"] == iv["makespan"]
+
+
+def test_summary_shape_and_table_rows():
+    s = schedule_summary("zb", 2, 4)
+    assert s["pipe"] == 2 and s["microbatches"] == 4
+    assert len(s["per_stage"]) == 2
+    for st in s["per_stage"]:
+        assert st["F"] == st["B"] == st["W"] == 4.0
+        assert st["idle"] >= 0.0
+    assert 0.0 <= s["bubble_fraction"] < 1.0
+    rows = schedule_table(2, 4)
+    assert [r["schedule"] for r in rows] == list(SCHEDULES)
+    # M % P != 0: the interleaved row reports itself skipped instead of
+    # silently vanishing (the no-silent-caps rule)
+    rows = schedule_table(2, 3)
+    iv = next(r for r in rows if r["schedule"] == "interleaved")
+    assert "skipped" in iv
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        schedule_lanes("zb1", 2, 4)
+    with pytest.raises(ValueError, match="single|gpipe"):
+        schedule_lanes("zb", 2, 4, virtual=2)
+    with pytest.raises(ValueError, match="groups of pipe"):
+        schedule_lanes("interleaved", 2, 3, virtual=2)

@@ -282,17 +282,33 @@ class _Func:
 class _Module:
     """One parsed module with enough structure for the traced-set
     inference: functions (with lexical nesting), every call site (with
-    its innermost enclosing function), and the import alias map."""
+    its innermost enclosing function), the import alias map, and —
+    since the class-method round — classes: each class's direct
+    methods, its base-name list, every function's enclosing class
+    context (what ``self.m()`` resolves against), and a conservative
+    ``var = ClassName(...)`` instance map (what ``obj.m()`` resolves
+    against)."""
 
     def __init__(self, tree: ast.Module) -> None:
         self.funcs: dict[int, _Func] = {}
         self.by_name: dict[str, list[_Func]] = {}
         self.calls: list[tuple[ast.Call, _Func | None]] = []
         self.imports: dict[str, str] = {}  # local alias -> real module
+        # class name -> {direct method name -> _Func}
+        self.classes: dict[str, dict[str, _Func]] = {}
+        # class name -> dotted base names (single-expression bases only)
+        self.class_bases: dict[str, list[str]] = {}
+        # id(func node) -> name of the class whose body (transitively)
+        # contains it — the receiver type of ``self``/``cls`` there
+        self.cls_context: dict[int, str] = {}
+        # (id(enclosing func node) | None, var) -> constructor dotted
+        # name, from simple ``var = C(...)`` assignments (last wins)
+        self.var_classes: dict[tuple, str] = {}
         self._index(tree)
 
     def _index(self, tree: ast.Module) -> None:
         stack: list[_Func] = []
+        class_stack: list[tuple[str, int]] = []  # (name, func depth)
 
         def visit(node: ast.AST) -> None:
             if isinstance(node, _FUNC_NODES):
@@ -307,13 +323,43 @@ class _Module:
                 fn = _Func(node, name, stack[-1] if stack else None, params)
                 self.funcs[id(node)] = fn
                 self.by_name.setdefault(name, []).append(fn)
+                if class_stack:
+                    cname, depth = class_stack[-1]
+                    self.cls_context[id(node)] = cname
+                    if len(stack) == depth:  # directly in the class body
+                        self.classes.setdefault(cname, {})[name] = fn
                 stack.append(fn)
                 for child in ast.iter_child_nodes(node):
                     visit(child)
                 stack.pop()
                 return
+            if isinstance(node, ast.ClassDef):
+                self.classes.setdefault(node.name, {})
+                self.class_bases[node.name] = [
+                    b for b in (_dotted(base) for base in node.bases)
+                    if b is not None
+                ]
+                class_stack.append((node.name, len(stack)))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                class_stack.pop()
+                return
             if isinstance(node, ast.Call):
                 self.calls.append((node, stack[-1] if stack else None))
+            elif isinstance(node, ast.Assign):
+                # conservative instance typing: ``var = C(...)`` with a
+                # single Name target; re-assignment rebinds (last wins)
+                if (
+                    len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    ctor = _dotted(node.value.func)
+                    if ctor is not None:
+                        scope = id(stack[-1].node) if stack else None
+                        self.var_classes[
+                            (scope, node.targets[0].id)
+                        ] = ctor
             elif isinstance(node, ast.Import):
                 for alias in node.names:
                     self.imports[alias.asname or alias.name.split(".")[0]] = (
@@ -376,6 +422,70 @@ class _Module:
             yield fn
             fn = fn.parent
 
+    # -- class-method resolution --------------------------------------------
+
+    def lookup_method(
+        self, cls_name: str, meth: str, _depth: int = 0
+    ) -> "_Func | None":
+        """``cls_name``'s method ``meth``, chasing same-module base
+        classes to a bounded depth (cross-module bases resolve at the
+        call-graph layer)."""
+        if _depth > 8:
+            return None
+        methods = self.classes.get(cls_name)
+        if methods is None:
+            return None
+        if meth in methods:
+            return methods[meth]
+        for base in self.class_bases.get(cls_name, ()):
+            found = self.lookup_method(base, meth, _depth + 1)
+            if found is not None:
+                return found
+        return None
+
+    def instance_class(
+        self, name: str, enclosing: "_Func | None"
+    ) -> str | None:
+        """The constructor dotted name a variable was bound to
+        (``obj = C(...)``), nearest enclosing scope first, module scope
+        last — or None when the variable's type is not statically
+        evident."""
+        for outer in self.enclosing_chain(enclosing):
+            ctor = self.var_classes.get((id(outer.node), name))
+            if ctor is not None:
+                return ctor
+        return self.var_classes.get((None, name))
+
+    def resolve_method(
+        self, expr: ast.AST, enclosing: "_Func | None"
+    ) -> "_Func | None":
+        """A method call/reference resolved WITHIN this module:
+        ``self.m()`` / ``cls.m()`` against the call site's enclosing
+        class, ``C.m`` against a local class, ``obj.m()`` against a
+        local ``obj = C(...)`` binding.  Cross-module receivers return
+        None here and are chased by ``_resolve_callable`` through the
+        call graph."""
+        if not isinstance(expr, ast.Attribute) or not isinstance(
+            expr.value, (ast.Name, ast.Attribute)
+        ):
+            return None
+        meth = expr.attr
+        base = _dotted(expr.value)
+        if base is None:
+            return None
+        if base in ("self", "cls"):
+            for outer in self.enclosing_chain(enclosing):
+                cname = self.cls_context.get(id(outer.node))
+                if cname is not None:
+                    return self.lookup_method(cname, meth)
+            return None
+        if base in self.classes:  # C.m (unbound reference)
+            return self.lookup_method(base, meth)
+        ctor = self.instance_class(base, enclosing)
+        if ctor is not None and ctor in self.classes:
+            return self.lookup_method(ctor, meth)
+        return None
+
 
 def _is_partial(func_expr: ast.AST) -> bool:
     d = _dotted(func_expr)
@@ -398,6 +508,21 @@ def _func_args(call: ast.Call):
     for kw in call.keywords:
         if kw.value is not None:
             yield kw.value
+
+
+def _resolve_local(mod: _Module, expr: ast.AST, enclosing) -> _Func | None:
+    """Local callable resolution: module functions (scope-aware) first,
+    then class methods (``self.m`` / ``C.m`` / ``obj.m`` with a local
+    ``obj = C(...)`` binding); partial-wrapped references unwrap."""
+    if isinstance(expr, ast.Call) and _is_partial(expr.func):
+        return (
+            _resolve_local(mod, expr.args[0], enclosing)
+            if expr.args else None
+        )
+    fn = mod.resolve_func(expr, enclosing)
+    if fn is not None:
+        return fn
+    return mod.resolve_method(expr, enclosing)
 
 
 def _infer_traced(
@@ -434,7 +559,7 @@ def _infer_traced(
             )
             if transform_call:
                 for arg in _func_args(call):
-                    target = mod.resolve_func(arg, enclosing)
+                    target = _resolve_local(mod, arg, enclosing)
                     if target is not None and id(target.node) not in traced:
                         traced.add(id(target.node))
                         changed = True
@@ -453,7 +578,7 @@ def _infer_traced(
                                 changed = True
 
             # (2) call to a local function with sink params: map args
-            callee_fn = mod.resolve_func(call.func, enclosing)
+            callee_fn = _resolve_local(mod, call.func, enclosing)
             if callee_fn is not None and callee_fn.sink_params:
                 bound: list[tuple[str, ast.AST]] = []
                 for i, arg in enumerate(call.args):
@@ -465,7 +590,7 @@ def _infer_traced(
                 for pname, arg in bound:
                     if pname not in callee_fn.sink_params:
                         continue
-                    target = mod.resolve_func(arg, enclosing)
+                    target = _resolve_local(mod, arg, enclosing)
                     if target is not None and id(target.node) not in traced:
                         traced.add(id(target.node))
                         changed = True
@@ -484,7 +609,7 @@ def _infer_traced(
             # and a *called parameter* of an enclosing function is a sink
             # (accumulate_grads' scan body calling grad_fn)
             if enclosing is not None and id(enclosing.node) in traced:
-                target = mod.resolve_func(call.func, enclosing)
+                target = _resolve_local(mod, call.func, enclosing)
                 if target is not None and id(target.node) not in traced:
                     traced.add(id(target.node))
                     changed = True
@@ -529,9 +654,23 @@ def _resolve_callable(graph, info, expr, enclosing=None):
 
             return Target(info.name, local)
     d = _dotted(expr)
-    if d is None:
-        return None
-    return graph.resolve_dotted(info, d)
+    if d is not None:
+        t = graph.resolve_dotted(info, d)
+        if t is not None:
+            return t
+    # class-method edges: self.m()/C.m/obj.m() resolved locally first,
+    # then an imported receiver class chased through the call graph
+    if isinstance(expr, ast.Attribute):
+        local_m = info.mod.resolve_method(expr, enclosing)
+        if local_m is not None:
+            from ddl_tpu.analysis.callgraph import Target
+
+            return Target(info.name, local_m)
+        if isinstance(expr.value, ast.Name):
+            ctor = info.mod.instance_class(expr.value.id, enclosing)
+            if ctor is not None:
+                return graph.resolve_class_method(info, ctor, expr.attr)
+    return None
 
 
 def infer_traced_program(graph):

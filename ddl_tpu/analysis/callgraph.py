@@ -172,6 +172,11 @@ class CallGraph:
                 top = [c for c in (cands or []) if c.parent is None]
                 if top:
                     return Target(mname, top[-1])
+            # Cls.method references (``mod.C.m`` passed to a transform)
+            if len(rest) == 2 and rest[0] in tinfo.mod.classes:
+                return self.resolve_class_method(
+                    tinfo, rest[0], rest[1], _depth + 1
+                )
             # re-export chase: the first remaining part is itself an
             # import binding in the matched module (package __init__
             # re-exporting a submodule's function, or a module alias)
@@ -180,6 +185,64 @@ class CallGraph:
                 return self._resolve_fq(
                     fq2.split(".") + rest[1:], _depth + 1
                 )
+            return None
+        return None
+
+    # ------------------------------------------------- class methods
+
+    def resolve_class_method(
+        self, info: ModuleInfo, cls_dotted: str, meth: str,
+        _depth: int = 0,
+    ) -> Target | None:
+        """Method ``meth`` of the class a (possibly imported) dotted
+        constructor name refers to in ``info``'s namespace — the edge
+        behind ``obj = C(...); obj.m()`` when ``C`` lives in another
+        package module.  Base classes chase through import bindings to
+        the same bounded depth as re-exports; anything outside the
+        package resolves to None."""
+        if not cls_dotted or _depth > _MAX_REEXPORT_DEPTH:
+            return None
+        parts = cls_dotted.split(".")
+        if len(parts) == 1 and parts[0] in info.mod.classes:
+            fn = info.mod.lookup_method(parts[0], meth)
+            if fn is not None:
+                return Target(info.name, fn)
+            # same-module lookup exhausted: chase cross-module bases
+            for base in info.mod.class_bases.get(parts[0], ()):
+                if base.split(".")[0] in info.mod.classes:
+                    continue  # local base, already chased above
+                t = self.resolve_class_method(info, base, meth, _depth + 1)
+                if t is not None:
+                    return t
+            return None
+        fq = info.fq_imports.get(parts[0])
+        if fq is None:
+            return None
+        return self._resolve_fq_method(
+            fq.split(".") + parts[1:], meth, _depth + 1
+        )
+
+    def _resolve_fq_method(
+        self, parts: list[str], meth: str, _depth: int = 0
+    ) -> Target | None:
+        if _depth > _MAX_REEXPORT_DEPTH:
+            return None
+        for i in range(len(parts), 0, -1):
+            mname = ".".join(parts[:i])
+            if mname not in self.modules:
+                continue
+            rest = parts[i:]
+            tinfo = self.modules[mname]
+            if len(rest) == 1:
+                if rest[0] in tinfo.mod.classes:
+                    return self.resolve_class_method(
+                        tinfo, rest[0], meth, _depth + 1
+                    )
+                fq2 = tinfo.fq_imports.get(rest[0])
+                if fq2:
+                    return self._resolve_fq_method(
+                        fq2.split("."), meth, _depth + 1
+                    )
             return None
         return None
 

@@ -35,19 +35,26 @@ with ``obs``).  Run inspection over the structured event streams every
 trainer writes (``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
 
     python -m ddl_tpu.cli obs summarize <job_id> [--log-dir DIR]
+    python -m ddl_tpu.cli obs goodput <job_id> [--json]
     python -m ddl_tpu.cli obs tail <job_id> [-n 20]
     python -m ddl_tpu.cli obs diff <job_a> <job_b>
     python -m ddl_tpu.cli obs baseline <job_id> --out FILE
     python -m ddl_tpu.cli obs diff <job_id> --baseline FILE [--fail-slowdown 0.5]
+        [--fail-goodput-drop 0.2]
     python -m ddl_tpu.cli obs pod <job_id> [--log-dir DIR] [--json]
     python -m ddl_tpu.cli obs watch <job_id> [--interval 2] [--once]
     python -m ddl_tpu.cli obs export <job_id> [--prom FILE | --http PORT] [--once]
     python -m ddl_tpu.cli obs trace <job_id> (--request ID | --slowest-request |
-        --incident N | --step N) [--out trace.json]
+        --incident N | --step N | --http PORT) [--out trace.json]
     python -m ddl_tpu.cli obs fleet [log_root] [--json] [--prom FILE]
 
 (``summarize`` includes decode p50/p95/p99 latency/queue-delay/TTFT when
-the run served requests; ``pod`` merges ALL hosts' streams into the
+the run served requests, plus the goodput headline; ``goodput`` is the
+full chip-time ledger — productive vs data-wait/recompile/bubble/
+rolled-back/checkpoint/stall/barrier/restart-gap/untracked per (host,
+restart-epoch) incarnation and whole-job, sums-to-total by construction
+(``obs/goodput.py``), gateable via ``obs diff --fail-goodput-drop``;
+``pod`` merges ALL hosts' streams into the
 straggler/skew table — with barrier-fit clock offsets — barrier-wait
 attribution, and the skew-corrected incident timeline; ``watch`` is the
 live view — push mode: it redraws when a stream grows, ``--interval``

@@ -24,6 +24,7 @@ separate inference model:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Callable, Optional
 
@@ -329,9 +330,34 @@ def make_lm_generator(
     jitted_rest = jax.jit(_rest, out_shardings=tok_sharding)
 
     warmed = False
+    # native request tracing (obs/trace.py span model): the one-shot
+    # path emits the same trace_span chain the serve engine does —
+    # request root, queue (when the caller timestamps enqueue), prefill
+    # (dispatch -> first token), decode (the tail) — so `obs trace
+    # --request/--slowest-request` works outside the serve engine.
+    # Request ids are deterministic per generator (run id + sequence);
+    # DDL_OBS_TRACE_SAMPLE=N thins to 1-in-N by sequence number, same
+    # contract as ServeEngine(trace_sample=)
+    seq = 0
+    try:
+        trace_sample = max(
+            1, int(os.environ.get("DDL_OBS_TRACE_SAMPLE") or 1)
+        )
+    except ValueError:
+        trace_sample = 1
+
+    def _trace_span(name, t0_pc, t1_pc, *, trace, span, parent, **args):
+        import time as _time
+
+        wall, pc = _time.time(), _time.perf_counter()
+        obs.emit(
+            "trace_span", trace=trace, span=span, parent=parent,
+            name=name, cat="decode",
+            t0=wall - (pc - t0_pc), t1=wall - (pc - t1_pc), **args,
+        )
 
     def run(params, prompt, rng=None, submitted_at=None):
-        nonlocal warmed
+        nonlocal warmed, seq
         if rng is None:
             rng = jax.random.key(0)
         if obs is None:
@@ -345,6 +371,9 @@ def make_lm_generator(
         # can exclude it from steady-state percentiles (the same warmup
         # discipline as bench/analysis.comm_time_summary)
         warm, warmed = warmed, True
+        req_id = f"{obs.run_id[:8]}-d{seq}"
+        traced = seq % trace_sample == 0
+        seq += 1
         t0 = perf_counter()
         # queueing delay: enqueue -> dispatch, when the serving harness
         # timestamps enqueue (perf_counter base); inline callers have no
@@ -371,8 +400,36 @@ def make_lm_generator(
                 ttft = perf_counter() - t0
                 fence(toks)
         dur = perf_counter() - t0
+        if traced:
+            end = perf_counter()
+            first_tok = t0 + ttft
+            root_t0 = submitted_at if submitted_at is not None else t0
+            _trace_span(
+                "request", root_t0, end,
+                trace=req_id, span=f"{req_id}/req", parent=None,
+                request_id=req_id, prompt_len=prompt_len,
+                new_tokens=max_new, outcome="ok", dispatches=1,
+            )
+            if submitted_at is not None and submitted_at < t0:
+                _trace_span(
+                    "queue", submitted_at, t0,
+                    trace=req_id, span=f"{req_id}/queue",
+                    parent=f"{req_id}/req", request_id=req_id,
+                )
+            _trace_span(
+                "prefill", t0, first_tok,
+                trace=req_id, span=f"{req_id}/prefill",
+                parent=f"{req_id}/req", tokens=prompt_len,
+            )
+            _trace_span(
+                "decode", first_tok, end,
+                trace=req_id, span=f"{req_id}/d0",
+                parent=f"{req_id}/req", dispatch=0,
+                new_tokens=max_new,
+            )
         obs.emit(
             "decode",
+            request_id=req_id,
             prompt_len=prompt_len,
             new_tokens=max_new,
             batch=batch,

@@ -36,6 +36,15 @@ Chrome trace (``ddl_tpu obs trace``) from native
 derived from the existing kinds; ``obs/fleet.py`` rolls up every job
 under a log root into one table / combined Prometheus scrape
 (``ddl_tpu obs fleet``).
+
+The accounting layer (PR 20): ``obs/goodput.py`` folds all of the
+above into the one number fleet operation bills by — an exhaustive
+per-(host, restart-epoch) chip-time account (productive vs data-wait /
+recompile / modeled bubble / rolled-back replay / checkpoint / stall /
+barrier / restart-gap / untracked residual, sums-to-total by
+construction) rendered by ``ddl_tpu obs goodput`` and re-used by
+summarize / watch / export / fleet / the ``obs diff
+--fail-goodput-drop`` CI gate.
 """
 
 from ddl_tpu.obs.anomaly import (
@@ -46,6 +55,7 @@ from ddl_tpu.obs.anomaly import (
 )
 from ddl_tpu.obs.events import EventWriter, events_path, read_events
 from ddl_tpu.obs.fold import JobFold, StreamFold, estimate_clock_offsets, fold_job
+from ddl_tpu.obs.goodput import ledger_from_fold, render_goodput
 from ddl_tpu.obs.profiler import TraceCapturer
 from ddl_tpu.obs.serving import QuantileAccumulator, ServingStats, TDigest
 from ddl_tpu.obs.steptrace import PHASES, StepTrace
@@ -69,5 +79,7 @@ __all__ = [
     "estimate_clock_offsets",
     "events_path",
     "fold_job",
+    "ledger_from_fold",
     "read_events",
+    "render_goodput",
 ]

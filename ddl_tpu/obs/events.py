@@ -76,6 +76,14 @@ EVENT_KINDS = (
     # serve_admit additionally carries cached_tokens/prefill_tokens and
     # an optional scenario tag (serve-bench --scenario)
     "prefix_hit", "prefix_insert", "kv_cow_copy",
+    # snapshot restore at trainer startup (all three families): dur +
+    # the resume cursor (period/offset) the restored state represents.
+    # The goodput ledger (obs/goodput.py) books the dur into the
+    # `checkpoint` bucket and uses the cursor to charge a prior
+    # incarnation's periods beyond it as rolled-back (replayed) work —
+    # an exact preemption resume charges nothing, a crash resume
+    # charges everything past the snapshot
+    "snapshot_restore",
     # supervisor.py restart lifecycle
     "supervisor_start", "supervisor_relaunch", "supervisor_done",
     # pod-level coordinated recovery (coord.py + PodSupervisor)

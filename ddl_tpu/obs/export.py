@@ -318,6 +318,37 @@ def fill_metrics(m: "_Metrics", fold, job_id: str, summary=None) -> None:
 
     # -- job-level serving percentiles (per-stream digests merged) -------
     s = summarize_from_fold(fold) if summary is None else summary
+
+    # -- goodput ledger (obs/goodput.py — the same account summarize,
+    # watch, fleet, and `obs goodput` render) ----------------------------
+    gp = s.get("goodput")
+    if gp:
+        for inc in gp["incarnations"]:
+            labels = {
+                "host": str(inc["host"]), "repoch": str(inc["repoch"]),
+                **job,
+            }
+            for cat, sec in sorted(inc["seconds"].items()):
+                m.add(
+                    "goodput_seconds", "gauge",
+                    "chip-time account: seconds per badput/goodput "
+                    "category for one (host, restart-epoch) incarnation "
+                    "(sums to the incarnation's wall clock)",
+                    sec, category=cat, **labels,
+                )
+            if inc["ratio"] is not None:
+                m.add(
+                    "goodput_ratio", "gauge",
+                    "productive fraction of one incarnation's wall clock",
+                    inc["ratio"], **labels,
+                )
+        if gp["job"]["ratio"] is not None:
+            m.add(
+                "goodput_job_ratio", "gauge",
+                "productive fraction of the job's whole chip-time "
+                "(all hosts, all incarnations, coordination included)",
+                gp["job"]["ratio"], **job,
+            )
     d = s.get("decode")
     if d:
         m.add(

@@ -87,6 +87,8 @@ def _job_row(fold, summary: dict) -> dict:
         default=None,
     )
     tr = summary.get("trace") or {}
+    gp = (summary.get("goodput") or {}).get("job") or {}
+    dom = gp.get("dominant_badput")
     return {
         "hosts": len(hosts),
         "steps": summary.get("steps", 0),
@@ -94,6 +96,8 @@ def _job_row(fold, summary: dict) -> dict:
             summary["steps"] / elapsed if elapsed > 0 else None
         ),
         "mfu": mfu,
+        "goodput": gp.get("ratio"),
+        "badput": dom[0] if dom else None,
         "ttft_p99_s": p.get("p99"),
         "agg_tok_per_s_per_chip": d.get("agg_tok_per_s_per_chip"),
         "requests": d.get("requests", 0),
@@ -159,16 +163,22 @@ def render_fleet(
     ]
     lines.append(
         f"{'job':<20} {'hosts':>5} {'steps':>7} {'steps/s':>8} "
-        f"{'mfu':>6} {'p99_ttft':>9} {'tok/s/chip':>10} {'rstrt':>5} "
+        f"{'mfu':>6} {'goodput':>8} {'badput':>12} {'p99_ttft':>9} "
+        f"{'tok/s/chip':>10} {'rstrt':>5} "
         f"{'anom':>5} {'stall':>5} {'age_s':>8}"
     )
     for job in sorted(summary):
         r = summary[job]
         age = now - r["last_ts"] if r["last_ts"] is not None else None
+        goodput = (
+            f"{r['goodput']:.1%}" if r.get("goodput") is not None else "-"
+        )
         lines.append(
             f"{job[:20]:<20} {r['hosts']:>5} {r['steps']:>7} "
             f"{_fmt(r['steps_per_sec'], '.2f', 8)} "
             f"{_fmt(r['mfu'], '.3f', 6)} "
+            f"{goodput:>8} "
+            f"{(r.get('badput') or '-')[:12]:>12} "
             f"{_fmt(r['ttft_p99_s'], '.4g', 9)} "
             f"{_fmt(r['agg_tok_per_s_per_chip'], '.1f', 10)} "
             f"{r['restarts']:>5} {r['anomalies']:>5} {r['stalls']:>5} "

@@ -64,10 +64,13 @@ SIDECAR_NAME = ".obs_fold.json"
 # and per-repoch rate metrics (mfu); v5 added the per-device
 # optimizer-state HBM gauge (opt_hbm_bytes); v6 added the prefix-cache
 # counters (prefix_hit/prefix_insert/kv_cow_copy + serve_admit's
-# cached/prefill token split); v7 adds the pipe_schedule cell (pipeline
-# schedule identity + modeled bubble accounting) — older sidecars
-# rebuild cleanly
-VERSION = 7
+# cached/prefill token split); v7 added the pipe_schedule cell (pipeline
+# schedule identity + modeled bubble accounting); v8 adds the goodput
+# ledger reducer (per-repoch wall-clock accounting: window bounds,
+# phase/compile/restore/stall sums, replay charging off rollback +
+# snapshot_restore cursors — obs/goodput.py renders it) — older
+# sidecars rebuild cleanly
+VERSION = 8
 
 # the serving-cursor sidecar this module's cache superseded; removed
 # opportunistically when the fold sidecar is written so a job dir does
@@ -80,8 +83,25 @@ TIMELINE_KINDS = (
     "run_start", "run_end", "supervisor_start", "supervisor_relaunch",
     "supervisor_done", "pod_restart", "peer_stale", "coord_barrier",
     "anomaly", "stall", "watchdog_exit", "rollback", "profile_capture",
-    "restart_latency",
+    "restart_latency", "snapshot_restore",
 )
+
+# kinds emitted by a SUPERVISOR process into the same stream as its
+# child trainer.  They are job-scoped coordination, not incarnation
+# compute, so the goodput ledger excludes them from the per-(host,
+# repoch) incarnation windows (a supervisor keeps stamping repoch-0
+# events for the whole job's lifetime — letting them extend the window
+# would make every later incarnation overlap repoch 0's account).
+SUPERVISOR_KINDS = frozenset((
+    "supervisor_start", "supervisor_relaunch", "supervisor_done",
+    "pod_restart", "peer_stale", "coord_barrier",
+))
+
+# goodput per-repoch replay bookkeeping: retain the last N periods'
+# (step+fence seconds, offset, steps) triples — a rollback/resume only
+# ever rewinds to a recent snapshot, and the sidecar must stay bounded
+_GOODPUT_PERIOD_CAP = 160
+_GOODPUT_PERIOD_KEEP = 128
 
 # per-stream cap on each retained incident-event list (anomalies,
 # stalls, captures, timeline).  The sidecar must stay bounded no matter
@@ -122,6 +142,23 @@ def _new_repoch_agg() -> dict:
         "periods": 0, "steps": 0, "elapsed": 0.0, "compiles": 0,
         "phases": {}, "last_sps": None, "last_step": None, "loss": None,
         "last_ts": None, "mfu": None, "opt_hbm_bytes": None,
+    }
+
+
+def _new_goodput() -> dict:
+    """One (repoch) incarnation's goodput-ledger accumulation.  Every
+    field is a sum, a min/max, or a bounded last-wins map, so resumed
+    slices reduce identically to one pass (the byte-identity contract).
+    ``periods`` maps period -> [step+fence seconds, start offset, steps]
+    — the coverage record replay charging consumes (and pops) when a
+    rollback or snapshot-restore cursor says that ground is re-run."""
+    return {
+        "first_ts": None, "last_ts": None,  # incarnation-scoped kinds
+        "decision_ts": None,  # earliest restart decision INTO this repoch
+        "phases": {}, "compile_s": 0.0, "restore_s": 0.0,
+        "stall_s": 0.0, "gap_s": 0.0, "rolled_back_s": 0.0,
+        "serve_t0": None, "serve_t1": None,
+        "periods": {}, "await_bad": None,
     }
 
 
@@ -195,6 +232,11 @@ class StreamFold:
         # schedule is static per run, and on a resume the newest event
         # describes the layout actually training
         self.pipe_schedule: dict | None = None
+        # goodput ledger (obs/goodput.py renders it): per-repoch
+        # incarnation accounts plus the stream's all-event time span
+        # (the job-level wall clock, supervisor coordination included)
+        self.goodput: dict[int, dict] = {}
+        self.all_span: list = [None, None]  # [first_ts, last_ts], any kind
         self.serving = ServingStats(capacity)
 
     def _push(self, key: str, item: dict) -> None:
@@ -222,6 +264,32 @@ class StreamFold:
         if ts is not None and (rec["last_ts"] is None or ts >= rec["last_ts"]):
             rec["last_ts"] = ts
 
+        # -- goodput window bookkeeping --------------------------------
+        if ts is not None:
+            if self.all_span[0] is None or ts < self.all_span[0]:
+                self.all_span[0] = ts
+            if self.all_span[1] is None or ts > self.all_span[1]:
+                self.all_span[1] = ts
+        if kind not in SUPERVISOR_KINDS:
+            g = self.goodput.setdefault(repoch, _new_goodput())
+            if ts is not None:
+                if (
+                    kind == "run_start"
+                    and not e.get("resumed")
+                    and g["last_ts"] is not None
+                    and ts > g["last_ts"]
+                ):
+                    # a NEW process's run_start after a dead window in
+                    # the same repoch (single-host supervised relaunch):
+                    # the dead time is restart gap, not untracked
+                    g["gap_s"] += ts - g["last_ts"]
+                if g["first_ts"] is None or ts < g["first_ts"]:
+                    g["first_ts"] = ts
+                if g["last_ts"] is None or ts > g["last_ts"]:
+                    g["last_ts"] = ts
+        else:
+            g = None
+
         if kind == "period":
             self._consume_period(e, h, step, ts, repoch)
         elif kind == "span":
@@ -237,6 +305,16 @@ class StreamFold:
             self._track_step(rec, step)
             rec["stalls"] += 1
             self.pod["stalls"] += 1
+            # goodput: the hung window is time since the last beat.
+            # Charged only under the "exit" escalation, where the
+            # wedged phase is GUARANTEED never to emit its span (the
+            # process dies) — in "dump" mode a recovered phase later
+            # reports its full duration including the hang, and
+            # charging both would attribute the same wall clock twice
+            # (a dump-mode hang the process never recovers from lands
+            # in untracked instead, which is honest)
+            if g is not None and e.get("action") == "exit":
+                g["stall_s"] += float(e.get("age", 0.0) or 0.0)
             slim = {k: v for k, v in e.items() if k != "stacks"}
             slim["stacks_n"] = len(e.get("stacks") or {})
             self._push("stalls", slim)
@@ -264,6 +342,14 @@ class StreamFold:
             if done is not None:
                 self.barrier_ts[f"{repoch}:{name}"] = done
         elif kind == "restart_latency":
+            dts = e.get("decision_ts")
+            if g is not None and dts is not None:
+                # earliest restart decision INTO this incarnation: the
+                # ledger starts the incarnation's wall clock here, so
+                # the relaunch gap (rendezvous, backoff, spawn, ...)
+                # is accounted instead of falling between windows
+                if g["decision_ts"] is None or dts < g["decision_ts"]:
+                    g["decision_ts"] = float(dts)
             lat = e.get("latency")
             if lat is not None:
                 rl = self.restart_latency
@@ -279,6 +365,16 @@ class StreamFold:
                 if prev is None or (ts or 0.0) >= prev[0]:
                     rl["by_repoch"][str(repoch)] = [ts or 0.0, lat]
         elif kind == "decode":
+            if g is not None and ts is not None:
+                # serving activity window (one-shot decode AND engine
+                # requests): [min(ts - dur), max(ts)] — a coarse union
+                # approximation that is exact for the back-to-back
+                # request trains the smokes run
+                t0 = float(ts) - float(e.get("dur", 0.0) or 0.0)
+                if g["serve_t0"] is None or t0 < g["serve_t0"]:
+                    g["serve_t0"] = t0
+                if g["serve_t1"] is None or ts > g["serve_t1"]:
+                    g["serve_t1"] = ts
             self.serving.observe(e)
         elif kind == "serve_admit":
             self.serve["admit"] += 1
@@ -318,6 +414,37 @@ class StreamFold:
             self.trace["marks"] += 1
         elif kind == "pipe_schedule":
             self.pipe_schedule = dict(e)
+        elif kind == "rollback":
+            if g is not None:
+                # in-loop NaN rollback: every period already recorded at
+                # or beyond the resume point is about to be re-run —
+                # charge it as rolled-back work.  The bad period's own
+                # event arrives AFTER this rollback event (end_period
+                # runs after the recovery handler), so remember it
+                g["restore_s"] += float(e.get("restore_dur", 0.0) or 0.0)
+                self._charge_replay(
+                    g, int(e.get("resumed_at", 0) or 0), 0
+                )
+                if e.get("period") is not None:
+                    g["await_bad"] = int(e["period"])
+        elif kind == "snapshot_restore":
+            if g is not None:
+                g["restore_s"] += float(e.get("dur", 0.0) or 0.0)
+                p = int(e.get("period", 0) or 0)
+                off = int(e.get("offset", 0) or 0)
+                # replay charge: work recorded beyond the restored
+                # cursor was lost and is about to be re-run.  Charge the
+                # SAME repoch (single-host supervised relaunches share
+                # repoch 0) and EVERY earlier repoch (pod mode: the
+                # dying incarnation holds the newest lost periods, but a
+                # resume-from-scratch also re-runs ground older
+                # incarnations saved — pop-on-charge keeps each record
+                # chargeable at most once, so walking all of them never
+                # double-counts)
+                self._charge_replay(g, p, off)
+                for r in sorted(self.goodput):
+                    if r < repoch:
+                        self._charge_replay(self.goodput[r], p, off)
 
         if kind in ("span", "heartbeat", "stall"):
             if step is not None:
@@ -332,6 +459,41 @@ class StreamFold:
             )
 
     @staticmethod
+    def _charge_replay(g: dict, period: int, offset: int) -> None:
+        """Move recorded period coverage at/beyond a resume cursor
+        ``(period, offset)`` into the rolled-back bucket.  A period
+        event describes batches ``[o, o + steps)`` of its period; the
+        cursor says batches up to ``offset`` of ``period`` (and every
+        earlier period) are SAVED — only the part beyond it was lost.
+        An exact preemption resume therefore charges nothing (its
+        recorded coverage ends exactly at the cursor), while a crash
+        resumed from an older snapshot charges everything past it.
+        Charged coverage is removed (a second restore must not
+        double-charge ground already charged) but the SAVED slice of a
+        boundary-straddling record is kept — a deeper later restore
+        must still be able to charge it."""
+        for key in sorted(g["periods"], key=int):
+            p = int(key)
+            if p < period:
+                continue
+            sf, o, steps = g["periods"][key]
+            if p > period or not steps:
+                g["rolled_back_s"] += sf
+                del g["periods"][key]
+                continue
+            saved_steps = max(0, min(offset, o + steps) - o)
+            charged = (steps - saved_steps) / steps
+            g["rolled_back_s"] += sf * charged
+            if saved_steps > 0:
+                # keep the saved slice [o, o + saved_steps) at its
+                # share of the recorded seconds
+                g["periods"][key] = [
+                    sf * (saved_steps / steps), o, saved_steps,
+                ]
+            else:
+                del g["periods"][key]
+
+    @staticmethod
     def _track_step(rec: dict, step) -> None:
         if step is not None:
             rec["last_step"] = (
@@ -342,6 +504,32 @@ class StreamFold:
     def _consume_period(self, e, h, step, ts, repoch) -> None:
         phases = e.get("phases") or {}
         sps = e.get("steps_per_sec")
+
+        # -- goodput ledger accumulation -------------------------------
+        g = self.goodput.setdefault(repoch, _new_goodput())
+        for name, dur in phases.items():
+            g["phases"][name] = g["phases"].get(name, 0.0) + dur
+        g["compile_s"] += float(e.get("compile_s", 0.0) or 0.0)
+        step_fence = phases.get("step", 0.0) + phases.get("fence", 0.0)
+        p = e.get("period")
+        if p is not None:
+            p = int(p)
+            if g["await_bad"] is not None and p == g["await_bad"]:
+                # the non-finite period a rollback just rewound past:
+                # its compute is replayed ground, never saved coverage
+                g["rolled_back_s"] += step_fence
+                g["await_bad"] = None
+            else:
+                g["periods"][str(p)] = [
+                    step_fence,
+                    int(e.get("offset", 0) or 0),
+                    int(e.get("steps", 0) or 0),
+                ]
+                if len(g["periods"]) > _GOODPUT_PERIOD_CAP:
+                    drop = sorted(g["periods"], key=int)
+                    for k in drop[: len(drop) - _GOODPUT_PERIOD_KEEP]:
+                        del g["periods"][k]
+
         key = f"{repoch}:{e.get('period')}"
         self.ptable[key] = [
             sps,
@@ -420,6 +608,8 @@ class StreamFold:
             "serve": self.serve,
             "trace": self.trace,
             "pipe_schedule": self.pipe_schedule,
+            "goodput": {str(r): a for r, a in self.goodput.items()},
+            "all_span": self.all_span,
             "pod_restart_epochs": sorted(self.pod_restart_epochs),
             "relaunches": self.relaunches,
             "serving": self.serving.state_dict(),
@@ -451,6 +641,10 @@ class StreamFold:
         sf.serve = dict(state["serve"])
         sf.trace = dict(state["trace"])
         sf.pipe_schedule = state.get("pipe_schedule")
+        sf.goodput = {
+            int(r): dict(a) for r, a in state["goodput"].items()
+        }
+        sf.all_span = list(state["all_span"])
         sf.pod_restart_epochs = {
             int(r) for r in state["pod_restart_epochs"]
         }

@@ -6,7 +6,13 @@
                                 decode p50/p95/p99 (latency, queue delay,
                                 TTFT, tok/s — obs/serving.py), profile
                                 captures, anomalies, stalls, restart
-                                latencies, peak HBM, per-host liveness
+                                latencies, peak HBM, per-host liveness,
+                                goodput headline
+    goodput <job_id> [--json]   the chip-time ledger (obs/goodput.py):
+                                productive vs badput buckets per (host,
+                                restart-epoch) incarnation and whole-job
+                                — sums to the wall clock by construction,
+                                residual reported as `untracked`
     tail <job_id> [-n N]        last N events, rendered one per line
     diff <job_a> <job_b>        phase/throughput comparison of two runs
     baseline <job_id> --out F   store one run's summary as a JSON baseline
@@ -16,7 +22,9 @@
                                 runs carry the signals, on a decode p95
                                 latency / p99 TTFT / restart-latency
                                 inflation or an aggregate tokens/s/chip
-                                drop past the same fraction (the CI gate)
+                                drop past the same fraction (the CI
+                                gate); --fail-goodput-drop F additionally
+                                gates the job-level goodput ratio
     pod <job_id>                pod-wide view over ALL hosts' streams
                                 (obs/pod.py): per-host skew/straggler
                                 table with barrier-fit clock offsets,
@@ -38,7 +46,9 @@
                                 offset corrected across hosts
                                 (obs/trace.py): --request ID |
                                 --slowest-request | --incident N |
-                                --step N, --out trace.json
+                                --step N, --out trace.json; --http PORT
+                                serves trace JSON + a Perfetto
+                                deep-link index instead
     fleet [log_root]            rollup across ALL jobs under a log
                                 root (obs/fleet.py): per-job steps/s,
                                 MFU, p99 TTFT, restarts, incident
@@ -288,6 +298,11 @@ def summarize_from_fold(fold) -> dict:
             ),
         }
 
+    # -- goodput ledger (obs/goodput.py — one fold, every surface) -------
+    from ddl_tpu.obs.goodput import ledger_from_fold
+
+    goodput = ledger_from_fold(fold)
+
     return {
         "runs": sorted(runs),
         "events": fold.events,
@@ -311,6 +326,7 @@ def summarize_from_fold(fold) -> dict:
         "restart_latency": restart_latency,
         "trace": trace,
         "pipe_schedule": fold.pipe_schedule(),
+        "goodput": goodput,
     }
 
 
@@ -378,6 +394,26 @@ def render_summary(s: dict, job_id: str = "") -> str:
                 f"stage-time ({ps.get('idle_units')} idle / "
                 f"{ps.get('makespan')} unit makespan)"
             )
+        lines.append(line)
+    gp = s.get("goodput")
+    if gp and gp["job"]["wall_s"] > 0:
+        job = gp["job"]
+        ratio = job["ratio"]
+        line = (
+            f"goodput: "
+            + (f"{ratio:.1%}" if ratio is not None else "n/a")
+            + f" of {job['wall_s']:.1f}s chip-time productive"
+        )
+        dom = job.get("dominant_badput")
+        if dom:
+            cat, sec = dom
+            line += (
+                f" | top badput: {cat} {sec:.1f}s "
+                f"({sec / job['wall_s']:.1%})"
+            )
+        line += (
+            f" — `ddl_tpu obs goodput{f' {job_id}' if job_id else ''}`"
+        )
         lines.append(line)
     rl = s.get("restart_latency")
     if rl:
@@ -543,6 +579,12 @@ def diff_runs(sa: dict, sb: dict, job_a: str, job_b: str) -> str:
             f"(x{lb / la:.2f})" if la else
             f"restart latency (max): {la:.1f}s vs {lb:.1f}s"
         )
+    ga, gb = _goodput_ratio(sa), _goodput_ratio(sb)
+    if ga is not None and gb is not None:
+        lines.append(
+            f"goodput: {ga:.1%} vs {gb:.1%}"
+            + (f" (x{gb / ga:.2f})" if ga else "")
+        )
     pa, pb = _decode_percentiles(sa), _decode_percentiles(sb)
     if pa and pb:
         lines.append(
@@ -573,6 +615,13 @@ def _restart_latency(s: dict) -> float | None:
     restarted, or the baseline predates the field)."""
     rl = s.get("restart_latency")
     return rl.get("max") if rl else None
+
+
+def _goodput_ratio(s: dict) -> float | None:
+    """A summary's job-level goodput ratio (None when the run carries
+    no account, or a stored baseline predates the ledger)."""
+    gp = s.get("goodput")
+    return (gp.get("job") or {}).get("ratio") if gp else None
 
 
 def _render_event(e: dict) -> str:
@@ -647,6 +696,25 @@ def main(argv=None) -> None:
         "— job_a with --baseline, else job_b — is more than FRAC "
         "slower (steps/s) than its comparison run",
     )
+    p_diff.add_argument(
+        "--fail-goodput-drop", type=float, default=None, metavar="FRAC",
+        help="CI goodput gate: exit nonzero when the run under test's "
+        "job-level goodput ratio (productive chip-time fraction, "
+        "obs/goodput.py) is more than FRAC below the comparison run's "
+        "— both sides must carry a goodput account (regenerate a "
+        "pre-ledger baseline first)",
+    )
+    p_good = sub.add_parser(
+        "goodput", parents=[common],
+        help="end-to-end chip-time account: productive vs badput per "
+        "(host, restart-epoch) incarnation and whole-job "
+        "(obs/goodput.py)",
+    )
+    p_good.add_argument("job_id")
+    p_good.add_argument(
+        "--json", action="store_true",
+        help="emit the ledger as JSON instead of the rendered tables",
+    )
     p_base = sub.add_parser(
         "baseline", parents=[common],
         help="store one run's summary as a JSON baseline for later diffs",
@@ -711,7 +779,15 @@ def main(argv=None) -> None:
         "trace-event JSON (Perfetto-loadable; obs/trace.py)",
     )
     p_trace.add_argument("job_id")
-    sel = p_trace.add_mutually_exclusive_group(required=True)
+    sel = p_trace.add_mutually_exclusive_group(required=False)
+    sel.add_argument(
+        "--http", metavar="PORT", type=int, default=None,
+        help="serve rendered trace JSON plus a Perfetto deep-link "
+        "index page on PORT instead of writing one trace file: "
+        "GET / lists the slowest request and every incident with "
+        "ui.perfetto.dev deep links; GET /trace.json?request=ID|"
+        "slowest=1|incident=N|step=N builds any trace on demand",
+    )
     sel.add_argument(
         "--request", metavar="ID",
         help="trace one serving request by id",
@@ -760,6 +836,14 @@ def main(argv=None) -> None:
     if args.command == "summarize":
         fold = _fold_or_exit(args)
         print(render_summary(summarize_from_fold(fold), args.job_id))
+    elif args.command == "goodput":
+        from ddl_tpu.obs.goodput import ledger_from_fold, render_goodput
+
+        ledger = ledger_from_fold(_fold_or_exit(args))
+        if args.json:
+            print(json.dumps(ledger))
+        else:
+            print(render_goodput(ledger, args.job_id))
     elif args.command == "tail":
         events = load_run(args.log_dir, args.job_id)
         for e in events[-args.n:]:
@@ -866,6 +950,38 @@ def main(argv=None) -> None:
                 )
                 + ")"
             )
+        if args.fail_goodput_drop is not None:
+            frac = args.fail_goodput_drop
+            ga, gb = _goodput_ratio(sa), _goodput_ratio(sb)
+            if ga is None or gb is None:
+                # the flag was explicit — a side without an account must
+                # not pass silently (that is the shape of a pre-ledger
+                # baseline, or a run that emitted nothing)
+                raise SystemExit(
+                    f"FAIL: --fail-goodput-drop needs a goodput account "
+                    f"on both sides ({name_a}: "
+                    f"{'%.3f' % ga if ga is not None else 'none'}, "
+                    f"{name_b}: "
+                    f"{'%.3f' % gb if gb is not None else 'none'}) — "
+                    "regenerate the baseline with a post-ledger "
+                    "`obs baseline`"
+                )
+            if gb < (1.0 - frac) * ga:
+                sb_dom = (sb.get("goodput") or {}).get("job", {}).get(
+                    "dominant_badput"
+                )
+                dom_note = (
+                    f" (dominant badput: {sb_dom[0]} {sb_dom[1]:.1f}s)"
+                    if sb_dom else ""
+                )
+                raise SystemExit(
+                    f"FAIL: {name_b} goodput {gb:.1%} is more than "
+                    f"{frac:.0%} below {name_a} ({ga:.1%}){dom_note}"
+                )
+            print(
+                f"OK: goodput within the {frac:.0%} gate "
+                f"({ga:.1%} -> {gb:.1%})"
+            )
     elif args.command == "baseline":
         fold = _fold_or_exit(args)
         payload = {
@@ -901,6 +1017,14 @@ def main(argv=None) -> None:
             interval=args.interval, cache=not args.no_cache,
         )
     elif args.command == "trace":
+        if args.http is not None:
+            from ddl_tpu.obs.trace import serve_trace_http
+
+            serve_trace_http(
+                args.log_dir, args.job_id, args.http,
+                cache=not args.no_cache,
+            )
+            return
         from ddl_tpu.obs.trace import trace_job, write_trace
 
         trace = trace_job(

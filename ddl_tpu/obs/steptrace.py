@@ -141,6 +141,7 @@ class StepTrace:
         self._compiles = _CompileCounter.shared()
         self._period = None
         self._period_compiles = self._compiles.count
+        self._period_compile_s = self._compiles.secs
         self._totals: dict[str, float] = defaultdict(float)
         self.run_totals: dict[str, float] = defaultdict(float)
         self._needs_run_start = False  # set by finish() for train() reuse
@@ -267,6 +268,7 @@ class StepTrace:
         self._period = period
         self._totals = defaultdict(float)
         self._period_compiles = self._compiles.count
+        self._period_compile_s = self._compiles.secs
         if self.watchdog is not None:
             self.watchdog.beat()
 
@@ -278,12 +280,18 @@ class StepTrace:
         steps: int,
         metrics: dict | None = None,
         rates: dict | None = None,
+        offset: int = 0,
     ) -> dict:
         """Emit the per-period summary event and feed the anomaly
         detectors; returns the phase-total dict.  ``rates`` is the
         family's ``rate_metrics`` dict (tokens/sec, img/sec, mfu, ...);
         stamping it into the period event is what lets the fleet rollup
-        (``obs fleet``) tabulate MFU per job without the CSVs."""
+        (``obs fleet``) tabulate MFU per job without the CSVs.
+        ``offset`` is the batch offset this period's data stream STARTED
+        at (nonzero only for the first period after an exact mid-period
+        resume) — together with ``steps`` it states exactly which slice
+        of the period this event describes, which is what lets the
+        goodput ledger decide whether a later resume replays it."""
         from ddl_tpu.utils.memory import hbm_stats
 
         phases = dict(self._totals)
@@ -296,16 +304,19 @@ class StepTrace:
             loss = float(raw) if raw is not None else None
         steps_per_sec = steps / elapsed if elapsed > 0 else 0.0
         compiles = self._compiles.count - self._period_compiles
+        compile_s = self._compiles.secs - self._period_compile_s
         self.writer.emit(
             "period",
             step=idx,
             period=period,
             steps=steps,
+            offset=offset,
             elapsed=elapsed,
             steps_per_sec=steps_per_sec,
             phases=phases,
             loss=loss,
             compiles=compiles,
+            compile_s=compile_s,
             hbm_bytes_in_use=mem["bytes_in_use"] if mem else None,
             hbm_peak_bytes=mem["peak_bytes_in_use"] if mem else None,
             **({"rates": dict(rates)} if rates else {}),

@@ -60,6 +60,7 @@ __all__ = [
     "INCIDENT_GAP_S",
     "build_chrome_trace",
     "collect_incidents",
+    "serve_trace_http",
     "trace_job",
 ]
 
@@ -574,7 +575,7 @@ def trace_job(
     ) != 1:
         raise SystemExit(
             "obs trace takes exactly one of --request/--slowest-request/"
-            "--incident/--step"
+            "--incident/--step (or --http PORT to serve them all)"
         )
     fold = fold_job(log_dir, job_id, cache=cache)
     if not fold.events:
@@ -622,6 +623,152 @@ def trace_job(
         )
         label = f"incident {incident}"
     return build_chrome_trace(spans, marks, flows, offsets, label=label)
+
+
+def serve_trace_http(
+    log_dir: str | os.PathLike,
+    job_id: str,
+    port: int,
+    cache: bool = True,
+    max_requests: int | None = None,
+) -> None:
+    """``obs trace --http PORT``: serve rendered trace JSON plus a
+    Perfetto deep-link index page.
+
+    * ``GET /`` — an HTML index of the job's traceable artifacts: the
+      slowest request on record, every incident cluster, and a step
+      form; each row links the raw trace JSON and a
+      ``ui.perfetto.dev/#!/?url=`` deep link that loads it straight
+      into Perfetto (the trace endpoint sends CORS headers for exactly
+      that fetch).
+    * ``GET /trace.json?request=ID|slowest=1|incident=N|step=N`` — the
+      same JSON ``obs trace --out`` writes, built on demand.
+
+    ``max_requests`` bounds the serve loop (tests)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, quote, urlparse
+
+    served = [0]
+
+    def build(params) -> dict:
+        kw: dict = {}
+        if params.get("request"):
+            kw["request"] = params["request"][0]
+        elif params.get("slowest"):
+            kw["slowest"] = True
+        elif params.get("incident"):
+            kw["incident"] = int(params["incident"][0])
+        elif params.get("step"):
+            kw["step"] = int(params["step"][0])
+        else:
+            raise SystemExit(
+                "trace.json needs one of "
+                "request=/slowest=1/incident=/step="
+            )
+        return trace_job(log_dir, job_id, cache=cache, **kw)
+
+    def index_html(host: str) -> str:
+        from ddl_tpu.obs.fold import estimate_clock_offsets, fold_job
+
+        fold = fold_job(log_dir, job_id, cache=cache)
+        offsets = estimate_clock_offsets({
+            sf.host: sf.barrier_ts
+            for sf in fold.streams.values() if sf.host is not None
+        }) or {}
+        incidents = collect_incidents(
+            _load_streams(log_dir, job_id), offsets
+        )
+        cell = fold.trace_totals()["slowest"]
+
+        def row(label, query):
+            url = f"http://{host}/trace.json?{query}"
+            deep = f"https://ui.perfetto.dev/#!/?url={quote(url, safe='')}"
+            return (
+                f"<li>{label} — <a href='/trace.json?{query}'>json</a>"
+                f" · <a href='{deep}'>open in Perfetto</a></li>"
+            )
+
+        rows = []
+        if cell is not None:
+            rows.append(row(
+                f"slowest request <code>{cell[1]}</code> "
+                f"({cell[0]:.3f}s)", "slowest=1",
+            ))
+        for i, inc in enumerate(incidents):
+            kinds = sorted({e["kind"] for _, _, e in inc["events"]})
+            rows.append(row(
+                f"incident {i}: {len(inc['events'])} event(s) "
+                f"({', '.join(kinds)})", f"incident={i}",
+            ))
+        body = "\n".join(rows) or "<li>(nothing traceable yet)</li>"
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>obs trace — {job_id}</title></head><body>"
+            f"<h1>obs trace — {job_id}</h1>"
+            "<p>Each link loads the clock-corrected Chrome trace JSON; "
+            "the Perfetto deep link opens it in ui.perfetto.dev "
+            "directly (the server sends CORS headers for that fetch). "
+            "Step traces: <code>/trace.json?step=N</code>.</p>"
+            f"<ul>{body}</ul></body></html>"
+        )
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            # ui.perfetto.dev fetches the trace cross-origin
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            served[0] += 1
+            parsed = urlparse(self.path)
+            try:
+                if parsed.path in ("/", "/index.html"):
+                    host = self.headers.get("Host") or (
+                        f"localhost:{port}"
+                    )
+                    self._send(
+                        200, index_html(host).encode(),
+                        "text/html; charset=utf-8",
+                    )
+                elif parsed.path == "/trace.json":
+                    trace = build(parse_qs(parsed.query))
+                    self._send(
+                        200, json.dumps(trace).encode(),
+                        "application/json",
+                    )
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+            except (SystemExit, ValueError) as e:
+                # trace_job's actionable selector errors AND malformed
+                # query values (incident=abc) -> 400, not a dead server
+                self._send(400, f"{e}\n".encode(), "text/plain")
+            except OSError as e:
+                self._send(500, f"trace failed: {e}\n".encode(),
+                           "text/plain")
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+    server = ThreadingHTTPServer(("", port), Handler)
+    bound = server.server_address[1]
+    print(
+        f"[obs trace] serving {job_id!r} on :{bound} — index at "
+        f"http://localhost:{bound}/ (ctrl-c to stop)"
+    )
+    try:
+        if max_requests is None:
+            server.serve_forever()
+        else:
+            while served[0] < max_requests:
+                server.handle_request()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
 
 
 def write_trace(trace: dict, out: str) -> str:

@@ -184,6 +184,36 @@ def build_frame(fold, job_id: str, now: float | None = None) -> str:
                     )
                 )
 
+    # -- goodput ---------------------------------------------------------
+    gp = s.get("goodput")
+    if gp and gp["job"]["wall_s"] > 0:
+        from ddl_tpu.obs.goodput import CATEGORIES
+
+        job = gp["job"]
+        lines.append("-- goodput --")
+        ratio = job["ratio"]
+        lines.append(
+            f"productive: "
+            + (f"{ratio:.1%}" if ratio is not None else "n/a")
+            + f" of {job['wall_s']:.1f}s chip-time "
+            f"({len(gp['incarnations'])} incarnation(s))"
+        )
+        badput = sorted(
+            (
+                (cat, job["seconds"].get(cat, 0.0))
+                for cat in CATEGORIES if cat != "productive"
+            ),
+            key=lambda kv: -kv[1],
+        )[:3]
+        badput = [(c, v) for c, v in badput if v > 0]
+        if badput:
+            lines.append(
+                "top badput: " + ", ".join(
+                    f"{c} {v:.1f}s ({v / job['wall_s']:.0%})"
+                    for c, v in badput
+                )
+            )
+
     rl = s.get("restart_latency")
     if rl:
         lines.append(

@@ -161,7 +161,11 @@ class LMTrainer(BaseTrainer):
             run.checkpoint_dir, run.job_id, run.resume_step,
             run.auto_resume, unit="step",
         )
+        restore_dur = None
         if run.checkpoint_dir and resume_step is not None:
+            from time import perf_counter
+
+            t0 = perf_counter()
             # cross-LAYOUT resume is handled inside _resume; what fails
             # here is a genuinely different model config
             ckpt.run_resume_load(
@@ -170,10 +174,31 @@ class LMTrainer(BaseTrainer):
                 desc=f"job {run.job_id!r} step {resume_step}",
                 hint="pass --fresh (auto_resume=False)",
             )
+            restore_dur = perf_counter() - t0
         # first period whose boundary lies beyond the resume step
         self.periods_run = bisect.bisect_right(
             self._boundaries, self._start_step
         )
+        if restore_dur is not None:
+            # offset: steps into the resume window already covered by
+            # the snapshot (LM periods are step windows, so a step-keyed
+            # resume inside a window is the mid-period-cursor analog).
+            # Also seed the loop's period-event offset with it, so the
+            # resumed window's event states the slice it describes —
+            # what the goodput ledger's replay charging compares resume
+            # cursors against (_period_bounds already resumes by
+            # _start_step; run_period just consumes the one-shot value)
+            window_start = (
+                self._boundaries[self.periods_run - 1]
+                if self.periods_run else 0
+            )
+            self._resume_offset = max(
+                0, self._start_step - window_start
+            )
+            self._emit_snapshot_restore(
+                restore_dur, resume_step, self.periods_run,
+                self._resume_offset,
+            )
 
     def _make_fns(self, cfg: LMConfig):
         run = self.run
@@ -434,6 +459,10 @@ class LMTrainer(BaseTrainer):
         return max(p0, self._start_step), self._boundaries[period]
 
     def run_period(self, period: int, guard=None):
+        # one-shot: the resume offset only describes the FIRST resumed
+        # window (the loop stamps it into that window's period event;
+        # _period_bounds resumes by _start_step regardless)
+        self.consume_resume_offset()
         p0, p1 = self._period_bounds(period)
         metrics, steps = {}, 0
         for i in range(p0, p1):

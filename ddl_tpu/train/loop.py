@@ -274,6 +274,28 @@ class BaseTrainer:
 
             self.obs = StepTrace.create(log_dir, job_id, family, host=host_id())
 
+    def _emit_snapshot_restore(
+        self, dur: float, epoch, period: int, offset: int = 0
+    ) -> None:
+        """One ``snapshot_restore`` event per startup restore: how long
+        the restore took (the goodput ledger's ``checkpoint`` bucket —
+        today only the in-loop save is a traced phase) plus the resume
+        cursor the restored state represents (``period``/``offset``),
+        from which the ledger charges a prior incarnation's periods
+        beyond the cursor as rolled-back (replayed) work.  Families call
+        it right after their startup restore; the in-loop rollback path
+        stays on the ``rollback`` event instead (emitting both would
+        double-charge the replay)."""
+        if self.obs is None:
+            return
+        self.obs.writer.emit(
+            "snapshot_restore",
+            dur=dur,
+            epoch=epoch,
+            period=int(period),
+            offset=int(offset),
+        )
+
     def _emit_pipe_schedule(
         self, schedule: str, pipe: int, microbatches: int, virtual: int = 1
     ) -> None:
@@ -438,7 +460,7 @@ class BaseTrainer:
                     if obs is not None:
                         obs.end_period(
                             period, idx, elapsed, steps, train_metrics,
-                            rates=rates,
+                            rates=rates, offset=offset_base,
                         )
                     if guard is not None and guard.requested:
                         # preempted mid-recovery: exit inside the grace
@@ -532,7 +554,7 @@ class BaseTrainer:
             if obs is not None:
                 obs.end_period(
                     period, idx, elapsed, steps, train_metrics,
-                    rates=rates,
+                    rates=rates, offset=offset_base,
                 )
             self.periods_run = period + 1
             if preempted:
@@ -605,6 +627,7 @@ class BaseTrainer:
                 f"{pol.rollbacks} rollback(s); giving up. "
                 f"Last snapshot: {self.last_snapshot_hint()}"
             )
+        restore_t0 = perf_counter()
         if not self.rollback_to_snapshot():
             raise RuntimeError(
                 f"Non-finite training loss for {pol.consecutive} "
@@ -615,10 +638,17 @@ class BaseTrainer:
         pol.on_rollback()
         self.set_update_scale(pol.grace_scale)
         if obs is not None:
+            # period: the bad period in PERIOD units (step=idx is the
+            # CSV/log index, a step number for the LM family) — the
+            # goodput ledger charges the rolled-back periods >= resumed_at
+            # plus this pending bad one as replayed work; restore_dur
+            # books the rollback restore into the checkpoint bucket
             obs.writer.emit(
                 "rollback",
                 step=idx,
+                period=period,
                 resumed_at=self.periods_run,
+                restore_dur=perf_counter() - restore_t0,
                 grace_scale=pol.grace_scale,
                 grace_periods=pol.grace_periods,
             )

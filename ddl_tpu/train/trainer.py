@@ -271,6 +271,9 @@ class Trainer(BaseTrainer):
             print(f"No snapshot at {path}; starting fresh")
             return
         print(f"Loading snapshot from {path}")
+        from time import perf_counter
+
+        t0 = perf_counter()
         self.state, self.epochs_run = ckpt.run_resume_load(
             # an auto-discovered epoch was integrity-verified by
             # resolve_resume moments ago; only explicit resumes re-verify
@@ -283,6 +286,10 @@ class Trainer(BaseTrainer):
             hint="pass train.auto_resume=false",
         )
         self._apply_cursor(self._resume_job, self._resume_epoch)
+        self._emit_snapshot_restore(
+            perf_counter() - t0, self._resume_epoch,
+            self.epochs_run, self._resume_offset,
+        )
         print(f"Resuming training from epoch {self.epochs_run}")
 
     def save_snapshot(self, epoch: int) -> None:

@@ -142,6 +142,9 @@ class ViTTrainer(BaseTrainer):
             run.checkpoint_dir, run.job_id, run.resume_epoch, run.auto_resume
         )
         if run.checkpoint_dir and resume_epoch is not None:
+            from time import perf_counter
+
+            t0 = perf_counter()
             self.state, self.periods_run = ckpt.run_resume_load(
                 # auto-discovered epochs were verified by resolve_resume
                 lambda: ckpt.load_snapshot(
@@ -153,6 +156,10 @@ class ViTTrainer(BaseTrainer):
                 hint="pass --fresh (auto_resume=False)",
             )
             self._apply_cursor(resume_epoch)
+            self._emit_snapshot_restore(
+                perf_counter() - t0, resume_epoch,
+                self.periods_run, self._resume_offset,
+            )
             print(f"resumed; continuing at epoch {self.periods_run}")
 
     def _make_fns(self):

@@ -1,0 +1,4 @@
+"""Known-bad fixture PACKAGE: class-method edges in the traced-set
+inference (``self.m()`` within a class, ``obj.m()`` through a
+conservative ``obj = C(...)`` binding, locally and across modules).
+Parsed by tests/test_lint_v2.py — never imported."""

@@ -1,0 +1,31 @@
+"""Known-bad fixture: methods that are only traced through method
+edges.  Nothing here is traced on its own; ``steps.py``'s jitted step
+calls ``Model.loss`` on an instance, and ``loss`` reaches
+``_sync_scalar`` through ``self``.  Parsed by tests — never imported."""
+
+import numpy as np
+
+
+class Model:
+    def loss(self, x):
+        # traced via steps.py's `m = Model(); m.loss(x)` inside a jit
+        y = (x * x).sum()
+        return y + self._sync_scalar(y)
+
+    def _sync_scalar(self, y):
+        # host-sync, reached ONLY through the self.m() edge
+        return float(np.asarray(y).mean())
+
+    def report(self, xs):
+        # never traced: a host-side method may sync freely
+        return float(np.mean(xs))
+
+
+class Base:
+    def base_sync(self, y):
+        # host-sync, reached through an inherited-method edge
+        return float(np.asarray(y).sum())
+
+
+class Derived(Base):
+    pass

@@ -1,0 +1,47 @@
+"""Known-bad fixture: the traced side of the method-edge pair.  The
+jitted steps pull methods under the trace: a same-module ``self.m()``
+chain, a cross-module ``obj.m()`` on a ``Model()`` instance, and an
+inherited method on a ``Derived()`` instance.  Parsed by tests —
+never imported."""
+
+import numpy as np
+
+import jax
+
+from method_pkg.model import Derived, Model
+
+
+def make_step():
+    def train_step(state, x):
+        m = Model()
+        # cross-module obj.m() from traced code: Model.loss (and the
+        # self._sync_scalar it calls) must be flagged in model.py
+        return state, m.loss(x)
+
+    return jax.jit(train_step)
+
+
+def make_inherited_step():
+    def inherited_step(x):
+        d = Derived()
+        # inherited method: resolves through Derived -> Base
+        return d.base_sync(x)
+
+    return jax.jit(inherited_step)
+
+
+def make_external_step():
+    def external_step(x):
+        buf = np.zeros(4)
+        # out-of-package receiver: the `buf = np.zeros(...)` binding
+        # must NOT resolve through the graph (numpy is external), so
+        # this stays clean
+        return x + buf.sum()
+
+    return jax.jit(external_step)
+
+
+def host_driver(xs):
+    # host-side instance use: Model.report stays untraced
+    m = Model()
+    return m.report(xs)

@@ -490,3 +490,40 @@ def test_three_host_pod_sim_stall_escalation_and_exact_resume(tmp_path):
         assert rls[-1]["latency"] > 0
         # the decision origin is the epoch record's proposal stamp
         assert rls[-1]["decision_ts"] == pytest.approx(rec["ts"])
+
+    # goodput ledger (round 20) on the real pod-sim streams: every
+    # (host, repoch) incarnation's buckets sum EXACTLY to its wall
+    # clock, the epoch-1 incarnation's wall starts at the pod-wide
+    # restart decision (booking the relaunch gap as restart_gap +
+    # barrier), the resumed child's snapshot restore landed in the
+    # checkpoint bucket, and warm == cold through the sidecar
+    from ddl_tpu.obs.goodput import ledger_from_fold, render_goodput
+
+    for i in range(3):
+        logs = sim / f"logs_h{i}"
+        f_cold = fold_job(logs, "podsim", cache=False)
+        ledger = ledger_from_fold(f_cold)
+        assert ledger["incarnations"], f"h{i}: empty goodput ledger"
+        for inc in ledger["incarnations"]:
+            total = sum(inc["seconds"].values())
+            assert total == pytest.approx(inc["wall_s"], abs=1e-9)
+            # attribution never meaningfully exceeds the wall (the
+            # acceptance's 1% bound on the residual)
+            assert inc["seconds"]["untracked"] >= -0.01 * max(
+                inc["wall_s"], 1e-9
+            ), (i, inc)
+        e1 = [a for a in ledger["incarnations"] if a["repoch"] == 1]
+        trained_e1 = [s for e, s in _read_consumed(sim, i) if e == 1]
+        if e1 and trained_e1:
+            acc = e1[0]
+            # the decision-anchored window books the relaunch cost
+            assert (
+                acc["seconds"]["restart_gap"] + acc["seconds"]["barrier"]
+            ) > 0, acc
+            if agreed is not None:
+                assert acc["seconds"]["checkpoint"] > 0, acc
+        warm = render_goodput(
+            ledger_from_fold(fold_job(logs, "podsim", cache=True)),
+            "podsim",
+        )
+        assert warm == render_goodput(ledger, "podsim")

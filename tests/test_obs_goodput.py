@@ -1,0 +1,877 @@
+"""Goodput ledger (obs/goodput.py + the fold's v8 reducer): exhaustive
+per-(host, repoch) chip-time accounting with badput attribution.
+
+Load-bearing properties:
+
+* every incarnation's buckets sum EXACTLY to its wall clock (the
+  residual is the ``untracked`` bucket, reported, never dropped);
+* warm (sidecar-resumed) folds render byte-identically to a cold parse
+  under arbitrary append/truncate/recreate histories;
+* replay charging is cursor-exact: an exact preemption resume charges
+  nothing, a crash resumed from an older snapshot reclassifies the lost
+  periods as ``rolled_back``;
+* every surface (goodput/summarize/watch/export/fleet/diff gate)
+  renders the same account from one fold.
+"""
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+# ---------------------------------------------------------------------------
+# synthetic streams
+# ---------------------------------------------------------------------------
+
+
+def _ev(host, kind, ts, **kw):
+    e = {
+        "ts": ts, "mono": ts, "run": kw.pop("run", f"r{host}"),
+        "host": host, "step": kw.pop("step", None), "kind": kind,
+    }
+    e.update(kw)
+    return e
+
+
+def _period(host, ts, p, *, repoch=None, steps=10, offset=0, step_s=6.0,
+            fence_s=1.0, data_s=1.5, compile_s=0.0, **kw):
+    phases = {"step": step_s, "fence": fence_s, "data_wait": data_s}
+    phases.update(kw.pop("phases", {}))
+    return _ev(
+        host, "period", ts, step=p, period=p, steps=steps, offset=offset,
+        elapsed=step_s + fence_s + data_s, steps_per_sec=1.0,
+        phases=phases, compiles=1 if compile_s else 0,
+        compile_s=compile_s, loss=2.0,
+        **({"repoch": repoch} if repoch else {}), **kw,
+    )
+
+
+def _goodput_events(host, *, offset=0.0):
+    """A two-incarnation stream exercising every ledger input: periods
+    with compile seconds, an in-loop rollback, a stall, a restart
+    decision + join barrier + snapshot restore into repoch 1, and a
+    decode tail."""
+    o = offset
+    evs = [_ev(host, "run_start", 10.0 + o, family="lm")]
+    evs.append(_period(host, 20.0 + o, 0, compile_s=2.0))
+    evs.append(_period(host, 30.0 + o, 1))
+    # non-finite period 2: rollback to 1, the bad period event follows
+    evs.append(_ev(
+        host, "rollback", 39.0 + o, step=2, period=2, resumed_at=1,
+        restore_dur=0.4, grace_scale=0.1, grace_periods=2,
+    ))
+    evs.append(_period(host, 40.0 + o, 2))
+    evs.append(_period(host, 50.0 + o, 1))  # re-run after rollback
+    evs.append(_ev(
+        host, "stall", 58.0 + o, step=22, age=5.0, deadline=4.0,
+        action="exit", stacks={"t": "tb"},
+    ))
+    evs.append(_ev(host, "run_end", 60.0 + o, phases={}, anomalies=0))
+    # pod restart into repoch 1: decision 62, join barrier, child at 66
+    evs.append(_ev(
+        host, "supervisor_relaunch", 62.0 + o, reason="preempt", rc=75,
+        delay=0.0, decision_ts=62.0 + o,
+    ))
+    evs.append(_ev(
+        host, "coord_barrier", 65.0 + o, name="e1-join", wait=1.5,
+        completed_ts=65.0 + o,
+    ))
+    evs.append(_ev(host, "run_start", 66.0 + o, family="lm", repoch=1))
+    evs.append(_ev(
+        host, "snapshot_restore", 66.6 + o, dur=0.6, epoch=2, period=3,
+        offset=0, repoch=1,
+    ))
+    evs.append(_ev(
+        host, "restart_latency", 75.0 + o, step=30, latency=13.0,
+        decision_ts=62.0 + o, repoch=1,
+    ))
+    evs.append(_period(host, 76.0 + o, 3, repoch=1, compile_s=3.0))
+    evs.append(_ev(
+        host, "decode", 80.0 + o, prompt_len=8, new_tokens=16, batch=1,
+        dur=2.0, queue_delay=0.0, ttft=0.3, tok_per_s=8.0, warm=True,
+        chips=1, repoch=1,
+    ))
+    evs.append(_ev(host, "run_end", 81.0 + o, phases={}, anomalies=0,
+                   repoch=1))
+    return evs
+
+
+def _append(log_dir, job, host, lines, torn=None):
+    d = log_dir / "by_job_id" / job
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / f"events-h{host:03d}.jsonl", "a") as f:
+        for ln in lines:
+            f.write(ln + "\n")
+        if torn is not None:
+            f.write(torn)
+    return d / f"events-h{host:03d}.jsonl"
+
+
+def _render_all(log_dir, job, cache):
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.goodput import ledger_from_fold, render_goodput
+    from ddl_tpu.obs.report import render_summary, summarize_from_fold
+
+    fold = fold_job(log_dir, job, cache=cache)
+    return (
+        render_goodput(ledger_from_fold(fold), job),
+        render_summary(summarize_from_fold(fold), job),
+        fold,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the account itself
+# ---------------------------------------------------------------------------
+
+
+def _assert_exhaustive(ledger):
+    """Buckets sum to the wall clock — the acceptance invariant.  The
+    residual construction makes the sum exact; the 1%-of-wall bound
+    additionally asserts no attribution EXCEEDS the wall (untracked
+    must never be meaningfully negative)."""
+    for inc in ledger["incarnations"]:
+        total = sum(inc["seconds"].values())
+        assert total == pytest.approx(inc["wall_s"], abs=1e-9)
+        assert inc["seconds"]["untracked"] >= -0.01 * max(
+            inc["wall_s"], 1e-9
+        )
+    job = ledger["job"]
+    assert sum(job["seconds"].values()) == pytest.approx(
+        job["wall_s"], abs=1e-9
+    )
+
+
+def test_ledger_buckets_and_exhaustiveness(tmp_path):
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.goodput import ledger_from_fold
+
+    job = "acct"
+    for h in range(2):
+        _append(tmp_path, job, h,
+                [json.dumps(e) for e in _goodput_events(h, offset=0.01 * h)])
+    ledger = ledger_from_fold(fold_job(tmp_path, job, cache=False))
+    _assert_exhaustive(ledger)
+    assert len(ledger["incarnations"]) == 4  # 2 hosts x 2 repochs
+
+    inc0 = next(
+        i for i in ledger["incarnations"]
+        if i["host"] == 0 and i["repoch"] == 0
+    )
+    s = inc0["seconds"]
+    # 4 period events x 7.0s step+fence; the rollback reclassifies the
+    # pre-rollback period 1 (7.0) plus the pending bad period 2 (7.0)
+    assert s["rolled_back"] == pytest.approx(14.0)
+    assert s["recompile"] == pytest.approx(2.0)
+    assert s["productive"] == pytest.approx(4 * 7.0 - 14.0 - 2.0)
+    assert s["data_wait"] == pytest.approx(4 * 1.5)
+    assert s["checkpoint"] == pytest.approx(0.4)  # rollback restore
+    assert s["stall"] == pytest.approx(5.0)
+    assert inc0["wall_s"] == pytest.approx(50.0)  # ts 10 -> 60
+
+    inc1 = next(
+        i for i in ledger["incarnations"]
+        if i["host"] == 0 and i["repoch"] == 1
+    )
+    s1 = inc1["seconds"]
+    # wall starts at the restart DECISION (62), not the first event (66)
+    assert inc1["wall_s"] == pytest.approx(81.0 - 62.0)
+    assert s1["barrier"] == pytest.approx(1.5)
+    assert s1["restart_gap"] == pytest.approx((66.0 - 62.0) - 1.5)
+    assert s1["checkpoint"] == pytest.approx(0.6)  # startup restore
+    assert s1["recompile"] == pytest.approx(3.0)
+    assert s1["serve"] == pytest.approx(2.0)
+    # no replay: the restore cursor (period 3) is past everything saved
+    assert s1["rolled_back"] == 0.0
+
+    # job rolls up both hosts' full spans; the sparse synthetic
+    # timestamps leave untracked dominant — which is the honest answer
+    assert ledger["job"]["wall_s"] == pytest.approx(2 * 71.0, abs=0.1)
+    assert ledger["job"]["dominant_badput"][0] == "untracked"
+    from ddl_tpu.obs.goodput import dominant_badput
+
+    tracked = dict(ledger["job"]["seconds"], untracked=0.0)
+    assert dominant_badput(tracked)[0] == "rolled_back"
+
+
+def test_replay_charging_is_cursor_exact(tmp_path):
+    """Crash resumed from an older snapshot charges the lost periods;
+    an exact preemption resume (coverage ends at the cursor) charges
+    nothing; partial coverage charges the lost fraction."""
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.goodput import ledger_from_fold
+
+    def led(job, evs):
+        _append(tmp_path, job, 0, [json.dumps(e) for e in evs])
+        return ledger_from_fold(fold_job(tmp_path, job, cache=False))
+
+    # crash: snapshot at period-1 boundary, periods 1..2 lost
+    evs = [_ev(0, "run_start", 10.0)]
+    for p in range(3):
+        evs.append(_period(0, 20.0 + 10 * p, p, step_s=3.0, fence_s=0.5,
+                           data_s=1.0))
+    evs.append(_ev(0, "run_start", 50.0, repoch=1))
+    evs.append(_ev(0, "snapshot_restore", 50.5, dur=0.5, epoch=1,
+                   period=1, offset=0, repoch=1))
+    L = led("crash", evs)
+    e0 = next(i for i in L["incarnations"] if i["repoch"] == 0)
+    assert e0["seconds"]["rolled_back"] == pytest.approx(2 * 3.5)
+    _assert_exhaustive(L)
+
+    # exact preempt: period 0 ran 6 steps, cursor says (0, 6) -> nothing
+    evs = [
+        _ev(0, "run_start", 10.0),
+        _period(0, 20.0, 0, steps=6),
+        _ev(0, "run_start", 30.0, repoch=1),
+        _ev(0, "snapshot_restore", 30.5, dur=0.3, epoch=0, period=0,
+            offset=6, repoch=1),
+    ]
+    L = led("preempt", evs)
+    e0 = next(i for i in L["incarnations"] if i["repoch"] == 0)
+    assert e0["seconds"]["rolled_back"] == 0.0
+
+    # partial: the old event covered [2, 10) of period 0, the cursor
+    # saved up to 6 -> half its step time is lost
+    evs = [
+        _ev(0, "run_start", 10.0),
+        _period(0, 20.0, 0, steps=8, offset=2),
+        _ev(0, "run_start", 30.0, repoch=1),
+        _ev(0, "snapshot_restore", 30.5, dur=0.3, epoch=0, period=0,
+            offset=6, repoch=1),
+    ]
+    L = led("partial", evs)
+    e0 = next(i for i in L["incarnations"] if i["repoch"] == 0)
+    assert e0["seconds"]["rolled_back"] == pytest.approx(7.0 * 0.5)
+
+    # a SECOND restore to the same cursor must not double-charge ground
+    # already charged (the popped entries are gone)
+    evs = [_ev(0, "run_start", 10.0)]
+    for p in range(3):
+        evs.append(_period(0, 20.0 + 10 * p, p, step_s=3.0, fence_s=0.5,
+                           data_s=1.0))
+    evs.append(_ev(0, "run_start", 50.0, repoch=1))
+    evs.append(_ev(0, "snapshot_restore", 50.5, dur=0.5, epoch=1,
+                   period=1, offset=0, repoch=1))
+    evs.append(_period(0, 60.0, 1, repoch=1, step_s=3.0, fence_s=0.5,
+                       data_s=1.0))
+    evs.append(_ev(0, "run_start", 70.0, repoch=2))
+    evs.append(_ev(0, "snapshot_restore", 70.5, dur=0.5, epoch=1,
+                   period=1, offset=0, repoch=2))
+    L = led("twice", evs)
+    e0 = next(i for i in L["incarnations"] if i["repoch"] == 0)
+    e1 = next(i for i in L["incarnations"] if i["repoch"] == 1)
+    assert e0["seconds"]["rolled_back"] == pytest.approx(2 * 3.5)
+    # repoch 1's own re-run of period 1 is lost to the second crash
+    assert e1["seconds"]["rolled_back"] == pytest.approx(3.5)
+
+
+def test_dump_mode_stall_not_double_counted(tmp_path):
+    """A dump-mode stall the process RECOVERS from must not be charged:
+    the recovered phase later reports the hang inside its own duration,
+    and charging both would attribute the same wall clock twice (the
+    stall bucket is exit-escalations only)."""
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.goodput import ledger_from_fold
+
+    evs = [
+        _ev(0, "run_start", 10.0),
+        _ev(0, "stall", 140.0, step=5, age=121.0, deadline=120.0,
+            action="dump", stacks={}),
+        # the hung step recovered: its period covers the hang
+        _period(0, 160.0, 0, step_s=140.0, fence_s=1.0, data_s=1.0),
+    ]
+    _append(tmp_path, "dump", 0, [json.dumps(e) for e in evs])
+    L = ledger_from_fold(fold_job(tmp_path, "dump", cache=False))
+    _assert_exhaustive(L)
+    inc = L["incarnations"][0]
+    assert inc["seconds"]["stall"] == 0.0
+    assert inc["seconds"]["productive"] == pytest.approx(141.0)
+
+
+def test_partial_charge_keeps_saved_slice_for_deeper_restore(tmp_path):
+    """An exact-resume restore must not ERASE the saved coverage it did
+    not charge: a later, deeper restore still charges it.  (Regression:
+    _charge_replay used to pop boundary-straddling records whole.)"""
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.goodput import ledger_from_fold
+
+    evs = [
+        _ev(0, "run_start", 10.0),
+        # period 0 ran [0, 6) — 6.0s of step+fence
+        _period(0, 20.0, 0, steps=6, offset=0, step_s=5.0, fence_s=1.0,
+                data_s=1.0),
+        # exact preemption resume at (0, 6): charges nothing
+        _ev(0, "run_start", 30.0, repoch=1),
+        _ev(0, "snapshot_restore", 30.5, dur=0.2, epoch=0, period=0,
+            offset=6, repoch=1),
+        # ... then a crash resumed from SCRATCH: cursor (0, 0) must
+        # still charge repoch 0's saved [0, 6) coverage
+        _ev(0, "run_start", 40.0, repoch=2),
+        _ev(0, "snapshot_restore", 40.5, dur=0.2, epoch=None, period=0,
+            offset=0, repoch=2),
+    ]
+    _append(tmp_path, "deep", 0, [json.dumps(e) for e in evs])
+    L = ledger_from_fold(fold_job(tmp_path, "deep", cache=False))
+    e0 = next(i for i in L["incarnations"] if i["repoch"] == 0)
+    assert e0["seconds"]["rolled_back"] == pytest.approx(6.0)
+    _assert_exhaustive(L)
+
+
+def test_fractions_sum_property_on_synthetic_multi_incarnation(tmp_path):
+    """Property test: across a family of synthetic multi-host,
+    multi-incarnation streams (varying period counts, rollbacks,
+    restarts, stalls, decode tails), every incarnation's bucket
+    fractions sum to 1 and the job account stays exhaustive."""
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.goodput import ledger_from_fold
+
+    for case in range(6):
+        job = f"prop{case}"
+        hosts = 1 + case % 3
+        for h in range(hosts):
+            evs = [_ev(h, "run_start", 10.0)]
+            t = 20.0
+            for p in range(2 + case):
+                evs.append(_period(
+                    h, t, p, compile_s=0.5 if p == 0 else 0.0,
+                    step_s=3.0 + p, fence_s=0.5,
+                ))
+                t += 6.0 + p
+            if case % 2:
+                evs.append(_ev(
+                    h, "rollback", t, step=1, period=1, resumed_at=0,
+                    restore_dur=0.2, grace_scale=0.1, grace_periods=1,
+                ))
+                t += 1.0
+                evs.append(_period(h, t + 9.0, 1))
+                t += 10.0
+            if case % 3 == 0:
+                evs.append(_ev(h, "stall", t, step=9, age=2.0,
+                               deadline=1.0, action="exit", stacks={}))
+                t += 2.0
+            evs.append(_ev(h, "run_end", t, phases={}, anomalies=0))
+            t += 2.0
+            for repoch in range(1, 1 + case % 2 + 1):
+                evs.append(_ev(
+                    h, "run_start", t + 3.0, repoch=repoch, run=f"x{repoch}",
+                ))
+                evs.append(_ev(
+                    h, "snapshot_restore", t + 3.5, dur=0.3, epoch=0,
+                    period=1, offset=0, repoch=repoch,
+                ))
+                evs.append(_ev(
+                    h, "restart_latency", t + 6.0, step=5, latency=5.0,
+                    decision_ts=t + 1.0, repoch=repoch,
+                ))
+                evs.append(_period(h, t + 16.0, 1 + repoch, repoch=repoch))
+                t += 20.0
+            _append(tmp_path, job, h, [json.dumps(e) for e in evs])
+        ledger = ledger_from_fold(fold_job(tmp_path, job, cache=False))
+        _assert_exhaustive(ledger)
+        for inc in ledger["incarnations"]:
+            if inc["wall_s"] > 0:
+                fracs = {
+                    c: v / inc["wall_s"]
+                    for c, v in inc["seconds"].items()
+                }
+                assert sum(fracs.values()) == pytest.approx(1.0)
+
+
+def test_goodput_warm_cold_byte_identity_under_splits(tmp_path):
+    """The v8 sidecar: resumed folds across arbitrary append splits —
+    torn line, truncation, recreation — render `obs goodput` AND
+    summarize byte-identically to a cold parse at every state."""
+    from ddl_tpu.obs.fold import SIDECAR_NAME
+
+    job = "gsplit"
+    lines = {
+        h: [json.dumps(e) for e in _goodput_events(h, offset=0.001 * h)]
+        for h in range(2)
+    }
+    torn_full = lines[1][5]
+    cut = len(torn_full) // 2
+    slices = [
+        {0: (0, 4, None), 1: (0, 5, torn_full[:cut])},
+        {0: (4, 9, None)},
+        {h: (None, None, None) for h in range(2)},
+    ]
+    done = {0: 0, 1: 5}
+    for i, sl in enumerate(slices):
+        for h, (a, b, torn) in sl.items():
+            if a is None:
+                a, b = done[h], len(lines[h])
+            _append(tmp_path, job, h, lines[h][a:b], torn=torn)
+            done[h] = b
+        if i == 1:
+            _append(tmp_path, job, 1, [], torn=torn_full[cut:] + "\n")
+            _append(tmp_path, job, 1, lines[1][6:])
+            done[1] = len(lines[1])
+        warm_g, warm_s, _ = _render_all(tmp_path, job, cache=True)
+        cold_g, cold_s, _ = _render_all(tmp_path, job, cache=False)
+        assert warm_g == cold_g, f"goodput diverged at slice {i}"
+        assert warm_s == cold_s, f"summarize diverged at slice {i}"
+    assert (tmp_path / "by_job_id" / job / SIDECAR_NAME).exists()
+
+    # truncate below the cursor -> clean rebuild
+    path = tmp_path / "by_job_id" / job / "events-h000.jsonl"
+    path.write_text("\n".join(lines[0][:3]) + "\n")
+    warm_g, _, _ = _render_all(tmp_path, job, cache=True)
+    cold_g, _, _ = _render_all(tmp_path, job, cache=False)
+    assert warm_g == cold_g
+
+    # recreate under the same name with different content
+    path.unlink()
+    _append(tmp_path, job, 0,
+            [json.dumps(e) for e in _goodput_events(0, offset=500.0)])
+    warm_g, _, _ = _render_all(tmp_path, job, cache=True)
+    cold_g, _, _ = _render_all(tmp_path, job, cache=False)
+    assert warm_g == cold_g
+
+
+def test_period_record_cap_stays_bounded(tmp_path):
+    """A week-long run's sidecar must not grow one entry per period:
+    the replay record keeps a bounded trailing window, warm==cold
+    through the pruning."""
+    from ddl_tpu.obs.fold import (
+        _GOODPUT_PERIOD_KEEP, SIDECAR_NAME, fold_job,
+    )
+
+    job = "cap"
+    evs = [_ev(0, "run_start", 10.0)]
+    for p in range(400):
+        evs.append(_period(0, 20.0 + p, p))
+    lines = [json.dumps(e) for e in evs]
+    _append(tmp_path, job, 0, lines[:200])
+    _render_all(tmp_path, job, cache=True)
+    _append(tmp_path, job, 0, lines[200:])
+    warm_g, _, _ = _render_all(tmp_path, job, cache=True)
+    cold_g, _, _ = _render_all(tmp_path, job, cache=False)
+    assert warm_g == cold_g
+    sidecar = json.loads(
+        (tmp_path / "by_job_id" / job / SIDECAR_NAME).read_text()
+    )
+    rec = sidecar["streams"]["events-h000.jsonl"]["goodput"]["0"]
+    assert len(rec["periods"]) <= 160
+    assert len(rec["periods"]) >= _GOODPUT_PERIOD_KEEP
+    fold = fold_job(tmp_path, job, cache=True)
+    assert fold.streams["events-h000.jsonl"].goodput[0]["phases"][
+        "step"
+    ] == pytest.approx(400 * 6.0)
+
+
+# ---------------------------------------------------------------------------
+# surfaces: CLI, summarize, watch, export, fleet, gate
+# ---------------------------------------------------------------------------
+
+
+def test_goodput_cli_and_summarize_render_same_account(tmp_path, capsys):
+    from ddl_tpu import cli
+
+    job = "surf"
+    for h in range(2):
+        _append(tmp_path, job, h,
+                [json.dumps(e) for e in _goodput_events(h)])
+    cli.main(["obs", "goodput", job, "--log-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert f"goodput — {job}" in out
+    for cat in ("productive", "rolled_back", "restart_gap", "untracked"):
+        assert cat in out
+    # columns per incarnation + job
+    assert "h0/e0" in out and "h1/e1" in out and "job" in out
+
+    cli.main(["obs", "goodput", job, "--log-dir", str(tmp_path), "--json"])
+    parsed = json.loads(capsys.readouterr().out)
+    job_ratio = parsed["job"]["ratio"]
+    assert 0.0 < job_ratio < 1.0
+
+    # summarize renders the same job ratio from the same fold
+    cli.main(["obs", "summarize", job, "--log-dir", str(tmp_path)])
+    s_out = capsys.readouterr().out
+    assert f"goodput: {job_ratio:.1%}" in s_out
+    assert "top badput:" in s_out
+
+    # watch panel
+    cli.main(["obs", "watch", job, "--log-dir", str(tmp_path), "--once"])
+    w_out = capsys.readouterr().out
+    assert "-- goodput --" in w_out
+    assert f"productive: {job_ratio:.1%}" in w_out
+    assert "top badput:" in w_out
+
+
+def test_goodput_export_series_and_fleet_columns(tmp_path, capsys):
+    from ddl_tpu import cli
+
+    job = "exp"
+    _append(tmp_path, job, 0, [json.dumps(e) for e in _goodput_events(0)])
+    cli.main(["obs", "export", job, "--log-dir", str(tmp_path), "--once"])
+    out = capsys.readouterr().out
+    assert "# TYPE ddl_obs_goodput_seconds gauge" in out
+    assert (
+        f'ddl_obs_goodput_seconds{{category="rolled_back",host="0",'
+        f'job_id="{job}",repoch="0"}} 14' in out
+    )
+    assert (
+        f'ddl_obs_goodput_seconds{{category="barrier",host="0",'
+        f'job_id="{job}",repoch="1"}} 1.5' in out
+    )
+    assert f'ddl_obs_goodput_ratio{{host="0"' in out
+    assert f'ddl_obs_goodput_job_ratio{{job_id="{job}"}}' in out
+    # categories sum to wall in the scrape too
+    import re
+
+    secs = {
+        m.group(1): float(m.group(2))
+        for m in re.finditer(
+            r'ddl_obs_goodput_seconds\{category="(\w+)",host="0",'
+            rf'job_id="{job}",repoch="0"\}} ([\d.e+-]+)', out,
+        )
+    }
+    assert sum(secs.values()) == pytest.approx(50.0, abs=1e-6)
+
+    # fleet: goodput + dominant-badput columns from the same summary
+    cli.main(["obs", "fleet", str(tmp_path), "--json"])
+    fleet = json.loads(capsys.readouterr().out)
+    assert 0.0 < fleet[job]["goodput"] < 1.0
+    assert fleet[job]["badput"] == "untracked"
+    cli.main(["obs", "fleet", str(tmp_path)])
+    table = capsys.readouterr().out
+    assert "goodput" in table and "badput" in table
+    assert "untracked" in table
+
+
+def test_diff_fail_goodput_drop_gate(tmp_path, capsys):
+    """The CI gate: a stall-injected run against a clean baseline fails
+    --fail-goodput-drop; a matching run passes; a pre-ledger baseline
+    is rejected loudly."""
+    from ddl_tpu import cli
+
+    def mk(job, stall_s):
+        evs = [_ev(0, "run_start", 10.0)]
+        for p in range(3):
+            evs.append(_period(0, 20.0 + 8 * p, p))
+        if stall_s:
+            evs.append(_ev(0, "stall", 50.0, step=9, age=stall_s,
+                           deadline=4.0, action="exit", stacks={}))
+            evs.append(_ev(0, "heartbeat", 50.0 + stall_s, step=9))
+        evs.append(_ev(0, "run_end", 51.0 + stall_s, phases={},
+                       anomalies=0))
+        _append(tmp_path, job, 0, [json.dumps(e) for e in evs])
+
+    mk("clean", 0.0)
+    mk("clean2", 0.0)
+    mk("stalled", 120.0)
+
+    base = tmp_path / "base.json"
+    cli.main(["obs", "baseline", "clean", "--log-dir", str(tmp_path),
+              "--out", str(base)])
+    capsys.readouterr()
+
+    cli.main(["obs", "diff", "clean2", "--log-dir", str(tmp_path),
+              "--baseline", str(base), "--fail-goodput-drop", "0.2"])
+    out = capsys.readouterr().out
+    assert "OK: goodput within the 20% gate" in out
+    assert "goodput:" in out  # the diff table line
+
+    with pytest.raises(SystemExit, match="goodput.*below"):
+        cli.main(["obs", "diff", "stalled", "--log-dir", str(tmp_path),
+                  "--baseline", str(base), "--fail-goodput-drop", "0.2"])
+    capsys.readouterr()
+
+    # a baseline without a goodput account (pre-ledger) fails loudly
+    stored = json.loads(base.read_text())
+    del stored["summary"]["goodput"]
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(stored))
+    with pytest.raises(SystemExit, match="regenerate the baseline"):
+        cli.main(["obs", "diff", "clean2", "--log-dir", str(tmp_path),
+                  "--baseline", str(old), "--fail-goodput-drop", "0.2"])
+
+
+# ---------------------------------------------------------------------------
+# obs trace --http (PR-10 carry-over satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_http_serves_index_and_trace_json(tmp_path):
+    from ddl_tpu.obs.trace import serve_trace_http
+
+    job = "http"
+    evs = _goodput_events(0)
+    # a native request trace so /trace.json?slowest=1 resolves
+    evs.append(_ev(
+        0, "trace_span", 90.0, trace="reqA", span="reqA/req",
+        parent=None, name="request", cat="serve", t0=88.0, t1=90.0,
+        request_id="reqA", outcome="ok",
+    ))
+    evs.append(_ev(
+        0, "trace_span", 89.0, trace="reqA", span="reqA/prefill",
+        parent="reqA/req", name="prefill", cat="serve", t0=88.1,
+        t1=88.4,
+    ))
+    _append(tmp_path, job, 0, [json.dumps(e) for e in evs])
+
+    srv = threading.Thread(
+        target=serve_trace_http,
+        args=(tmp_path, job, 0),
+        kwargs={"max_requests": 3},
+        daemon=True,
+    )
+    # port 0 would be ephemeral; bind a fixed free port instead
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = threading.Thread(
+        target=serve_trace_http,
+        args=(tmp_path, job, port),
+        kwargs={"max_requests": 3},
+        daemon=True,
+    )
+    srv.start()
+    time.sleep(0.3)
+    index = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/", timeout=10
+    ).read().decode()
+    assert "ui.perfetto.dev/#!/?url=" in index
+    assert "slowest request" in index
+    assert "incident" in index
+
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/trace.json?slowest=1", timeout=10
+    )
+    assert body.headers["Access-Control-Allow-Origin"] == "*"
+    trace = json.loads(body.read().decode())
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "request" in names and "prefill" in names
+
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/trace.json?incident=0", timeout=10
+    ).read().decode()
+    assert json.loads(body)["traceEvents"]
+    srv.join(timeout=10)
+
+
+def test_trace_cli_requires_selector_or_http(tmp_path):
+    from ddl_tpu import cli
+
+    _append(tmp_path, "sel", 0,
+            [json.dumps(e) for e in _goodput_events(0)])
+    with pytest.raises(SystemExit, match="--http PORT"):
+        cli.main(["obs", "trace", "sel", "--log-dir", str(tmp_path)])
+
+
+# ---------------------------------------------------------------------------
+# one-shot decode: native request trace spans (PR-10 carry-over)
+# ---------------------------------------------------------------------------
+
+
+def test_one_shot_decode_emits_native_request_trace(tmp_path):
+    """`obs trace --request` works OUTSIDE the serve engine: the
+    one-shot generator emits the request/queue/prefill/decode span
+    chain, the fold's slowest-request cell selects it, and the built
+    trace is Perfetto-shaped."""
+    import jax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.infer.decode import make_lm_generator
+    from ddl_tpu.obs.events import EventWriter, read_events
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.trace import trace_job
+
+    cfg = LMConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, head_dim=8,
+        d_ff=32, compute_dtype="float32",
+    )
+    w = EventWriter(tmp_path, "dtrace", host=0)
+    run = make_lm_generator(
+        cfg, prompt_len=4, max_new=3, batch=1, obs=w,
+    )
+    params = jax.eval_shape(lambda: None)  # placeholder; built below
+    import numpy as np
+
+    from flax import linen as nn  # noqa: F401 (import parity with decode)
+    from ddl_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(cfg)
+    variables = model.init(
+        jax.random.key(0), np.zeros((1, 4), np.int32)
+    )
+    prompt = np.arange(4, dtype=np.int32)[None, :]
+    from time import perf_counter
+
+    run(variables["params"], prompt, submitted_at=perf_counter() - 0.05)
+    run(variables["params"], prompt)
+    w.close()
+
+    events = read_events(
+        tmp_path / "by_job_id" / "dtrace" / "events-h000.jsonl"
+    )
+    spans = [e for e in events if e["kind"] == "trace_span"]
+    roots = [s for s in spans if s["name"] == "request"]
+    assert len(roots) == 2
+    names = {s["name"] for s in spans}
+    assert {"request", "prefill", "decode"} <= names
+    assert "queue" in names  # first request carried submitted_at
+    req = roots[0]["trace"]
+    for s in spans:
+        assert s["t1"] >= s["t0"]
+
+    # the fold's slowest-request cell selects a one-shot request now
+    fold = fold_job(tmp_path, "dtrace", cache=False)
+    assert fold.trace_totals()["requests"] == 2
+    slowest = fold.trace_totals()["slowest"][1]
+
+    trace = trace_job(tmp_path, "dtrace", request=req, cache=False)
+    got = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {"request", "prefill", "decode"} <= got
+    trace2 = trace_job(tmp_path, "dtrace", slowest=True, cache=False)
+    assert trace2["otherData"]["trace"] == f"request {slowest}"
+
+    # decode events carry the request id for cross-referencing
+    decs = [e for e in events if e["kind"] == "decode"]
+    assert all(e.get("request_id") for e in decs)
+
+
+def test_decode_trace_sampling_is_deterministic(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDL_OBS_TRACE_SAMPLE", "2")
+    import jax
+    import numpy as np
+
+    from ddl_tpu.models.transformer import LMConfig, TransformerLM
+    from ddl_tpu.infer.decode import make_lm_generator
+    from ddl_tpu.obs.events import EventWriter, read_events
+
+    cfg = LMConfig(
+        vocab_size=64, d_model=16, n_layers=1, n_heads=2, head_dim=8,
+        d_ff=32, compute_dtype="float32",
+    )
+    w = EventWriter(tmp_path, "dsamp", host=0)
+    run = make_lm_generator(cfg, prompt_len=4, max_new=2, batch=1, obs=w)
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+    prompt = np.arange(4, dtype=np.int32)[None, :]
+    for _ in range(4):
+        run(variables["params"], prompt)
+    w.close()
+    events = read_events(
+        tmp_path / "by_job_id" / "dsamp" / "events-h000.jsonl"
+    )
+    roots = [
+        e for e in events
+        if e["kind"] == "trace_span" and e["name"] == "request"
+    ]
+    assert len(roots) == 2  # requests 0 and 2 of 4
+    # decode latency events are NOT sampled
+    assert len([e for e in events if e["kind"] == "decode"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# supervised preempt e2e: restart gap + replayed steps as badput
+# ---------------------------------------------------------------------------
+
+
+def _tiny_lm(tmp_path, job_id, steps, **run_overrides):
+    import optax
+
+    from ddl_tpu.models.transformer import LMConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_trainer import LMRunConfig, LMTrainer
+
+    cfg = LMConfig(
+        vocab_size=256, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+        d_ff=64, compute_dtype="float32", remat=False,
+    )
+    run_kwargs = dict(
+        batch=4, seq_len=16, steps=steps, job_id=job_id,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        log_dir=str(tmp_path / "logs"),
+    )
+    run_kwargs.update(run_overrides)
+    run = LMRunConfig(**run_kwargs)
+    return LMTrainer(cfg, LMMeshSpec(), optax.adam(1e-3), run)
+
+
+def test_supervised_preempt_and_crash_show_up_as_badput(tmp_path):
+    """The acceptance e2e: a supervised run that is preempted (exact
+    resume) and then crashes (resume from the preemption snapshot)
+    books a restart gap AND replayed steps as badput, and the account
+    still sums to the wall clock; warm == cold on the real stream."""
+    import ddl_tpu.obs.steptrace as st_mod
+    from ddl_tpu.supervisor import EXIT_PREEMPTED, Supervisor
+    from ddl_tpu.utils import faultinject
+
+    job = "lm-goodput-e2e"
+    total_steps = 8
+
+    def attempt(restart_index):
+        # in-process supervision: thread the relaunch decision stamp +
+        # reset the once-per-process restart-latency consumption the
+        # way a real child process would see them
+        st_mod._relaunch_consumed = False
+        if sup.last_relaunch_ts and restart_index > 0:
+            os.environ["DDL_RELAUNCH_TS"] = repr(sup.last_relaunch_ts)
+        else:
+            os.environ.pop("DDL_RELAUNCH_TS", None)
+        if restart_index == 0:
+            faultinject.activate("preempt@step:3")
+        elif restart_index == 1:
+            faultinject.activate("crash@step:6")
+        else:
+            faultinject.deactivate()
+        try:
+            t = _tiny_lm(
+                tmp_path, job, steps=total_steps,
+                save_every=10 ** 9, log_every=2,
+            )
+            t.train()
+        except faultinject.InjectedCrash:
+            return 1
+        finally:
+            faultinject.deactivate()
+        if t.preempted:
+            return EXIT_PREEMPTED
+        assert int(t.state.step) == total_steps
+        return 0
+
+    sup = Supervisor(attempt, max_restarts=3, sleep=lambda d: None,
+                     log=lambda m: None)
+    try:
+        assert sup.run() == 0
+    finally:
+        os.environ.pop("DDL_RELAUNCH_TS", None)
+    assert sup.preemptions == 1 and sup.crashes == 1
+
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.goodput import ledger_from_fold
+
+    logs = tmp_path / "logs"
+    ledger = ledger_from_fold(fold_job(logs, job, cache=False))
+    _assert_exhaustive(ledger)
+    job_row = ledger["job"]["seconds"]
+    # the two dead windows between attempts are restart gap, and the
+    # crash relaunch replayed the steps since the preemption snapshot
+    assert job_row["restart_gap"] > 0.0
+    assert job_row["rolled_back"] > 0.0
+    assert job_row["checkpoint"] > 0.0  # startup restores were stamped
+    assert job_row["productive"] > 0.0
+    # the restores actually emitted cursors
+    from ddl_tpu.obs.events import read_events
+
+    events = read_events(logs / "by_job_id" / job / "events-h000.jsonl")
+    restores = [e for e in events if e["kind"] == "snapshot_restore"]
+    assert len(restores) == 2
+    assert all("period" in e and "offset" in e for e in restores)
+    rls = [e for e in events if e["kind"] == "restart_latency"]
+    assert len(rls) == 2
+
+    # the real stream renders warm == cold
+    warm_g, warm_s, _ = _render_all(logs, job, cache=True)
+    cold_g, cold_s, _ = _render_all(logs, job, cache=False)
+    assert warm_g == cold_g and warm_s == cold_s

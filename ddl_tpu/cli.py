@@ -40,7 +40,8 @@ trainer writes (``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
     python -m ddl_tpu.cli obs diff <job_a> <job_b>
     python -m ddl_tpu.cli obs baseline <job_id> --out FILE
     python -m ddl_tpu.cli obs diff <job_id> --baseline FILE [--fail-slowdown 0.5]
-        [--fail-goodput-drop 0.2]
+        [--fail-goodput-drop 0.2] [--fail-slo-burn 2.0 [--slo FILE]]
+    python -m ddl_tpu.cli obs slo <job_id> [--json] [--slo FILE]
     python -m ddl_tpu.cli obs pod <job_id> [--log-dir DIR] [--json]
     python -m ddl_tpu.cli obs watch <job_id> [--interval 2] [--once]
     python -m ddl_tpu.cli obs export <job_id> [--prom FILE | --http PORT] [--once]
@@ -54,7 +55,14 @@ full chip-time ledger — productive vs data-wait/recompile/bubble/
 rolled-back/checkpoint/stall/barrier/restart-gap/untracked per (host,
 restart-epoch) incarnation and whole-job, sums-to-total by construction
 (``obs/goodput.py``), gateable via ``obs diff --fail-goodput-drop``;
-``pod`` merges ALL hosts' streams into the
+``slo`` evaluates declarative per-priority-class error budgets (p99
+TTFT/latency via each tenant's digest CDF, availability = 1 - shed
+rate) from the job's ``slo.json`` into burn rates with fast/slow alert
+windows (``obs/slo.py``) — requests tagged ``tenant``/
+``priority_class`` at submit split every digest, goodput account, and
+``ddl_obs_tenant_*`` export series per tenant, untagged traffic folding
+into the ``"default"`` tenant — gateable via ``obs diff
+--fail-slo-burn``; ``pod`` merges ALL hosts' streams into the
 straggler/skew table — with barrier-fit clock offsets — barrier-wait
 attribution, and the skew-corrected incident timeline; ``watch`` is the
 live view — push mode: it redraws when a stream grows, ``--interval``
@@ -106,7 +114,7 @@ aggregate tokens/s per chip, shed/compile counts):
 
     python -m ddl_tpu.cli serve-bench --cpu-devices 1 --clients 8 \
         --prompt-len 8:24 --max-new 16:32 --block-size 8 --num-blocks 64 \
-        [--scenario shared-prefix|long-prompt|bursty|mixed] \
+        [--scenario shared-prefix|long-prompt|bursty|mixed|multi-tenant] \
         [--shared-prefix-len 64] [--long-prompt-len 256] \
         [--prefix-cache on|off] [--prefill-chunk 64] \
         [--policy shed_oldest] [--int8 kv] [--compare-sequential] \
@@ -116,7 +124,10 @@ aggregate tokens/s per chip, shed/compile counts):
     python examples/serve_lm.py ...      # same engine over a training
                                          # snapshot (--checkpoint-dir/--step)
 
-(``--scenario`` selects a parameterized client mix; with
+(``--scenario`` selects a parameterized client mix — ``multi-tenant``
+fires a weighted interactive/batch/best-effort tenant mix with
+per-class arrival rates, drops a CPU-friendly ``slo.json`` into the job
+dir, and adds a per-tenant percentile block to the report; with
 ``--compare-sequential`` the run additionally verifies every completed
 request's tokens are bit-identical to a one-at-a-time
 ``make_lm_generator`` replay and exits nonzero on mismatch — the gate

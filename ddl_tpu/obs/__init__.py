@@ -45,6 +45,15 @@ barrier / restart-gap / untracked residual, sums-to-total by
 construction) rendered by ``ddl_tpu obs goodput`` and re-used by
 summarize / watch / export / fleet / the ``obs diff
 --fail-goodput-drop`` CI gate.
+
+The tenant layer (PR 21): requests tagged ``tenant``/``priority_class``
+at ``ServeEngine.submit`` split every serving digest, serve counter,
+and goodput account per tenant (untagged traffic folds into
+``"default"`` — ``serving.tenant_of``); ``obs/slo.py`` evaluates
+declarative per-class error budgets from a job-level ``slo.json`` into
+burn rates with fast/slow alert windows (``ddl_tpu obs slo``,
+``ddl_obs_tenant_*`` export series, the ``obs diff --fail-slo-burn``
+CI gate).
 """
 
 from ddl_tpu.obs.anomaly import (
@@ -57,7 +66,13 @@ from ddl_tpu.obs.events import EventWriter, events_path, read_events
 from ddl_tpu.obs.fold import JobFold, StreamFold, estimate_clock_offsets, fold_job
 from ddl_tpu.obs.goodput import ledger_from_fold, render_goodput
 from ddl_tpu.obs.profiler import TraceCapturer
-from ddl_tpu.obs.serving import QuantileAccumulator, ServingStats, TDigest
+from ddl_tpu.obs.serving import (
+    QuantileAccumulator,
+    ServingStats,
+    TDigest,
+    tenant_of,
+)
+from ddl_tpu.obs.slo import evaluate_slo, load_slo, render_slo
 from ddl_tpu.obs.steptrace import PHASES, StepTrace
 from ddl_tpu.obs.watchdog import Watchdog
 
@@ -77,9 +92,13 @@ __all__ = [
     "TraceCapturer",
     "Watchdog",
     "estimate_clock_offsets",
+    "evaluate_slo",
     "events_path",
     "fold_job",
     "ledger_from_fold",
+    "load_slo",
     "read_events",
     "render_goodput",
+    "render_slo",
+    "tenant_of",
 ]

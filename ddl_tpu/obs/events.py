@@ -67,7 +67,15 @@ EVENT_KINDS = (
     # serve/ continuous-batching engine: admission/shed decisions, lane
     # retirement, and block-pool occupancy snapshots (per-request latency
     # still flows through "decode" so one percentile pipeline serves
-    # both the one-shot and the continuous-batching paths)
+    # both the one-shot and the continuous-batching paths).
+    # serve_admit/serve_shed/serve_retire, "decode", and the serving
+    # trace_span/trace_mark events additionally carry optional
+    # ``tenant``/``priority_class`` tags (serve/scheduler.tenant_tags —
+    # omitted entirely when the request is untagged, so pre-tenant
+    # streams are byte-identical); the fold buckets tagged events into
+    # per-tenant digests and goodput accounts, and obs/slo.py evaluates
+    # per-class error budgets over them.  Untagged events fold into the
+    # "default" tenant (obs/serving.tenant_of)
     "serve_admit", "serve_shed", "serve_retire", "kv_pool_stats",
     # prefix caching (round 17): a request admitted onto cached prompt
     # blocks (cached_tokens/blocks args), a finished prefill registering

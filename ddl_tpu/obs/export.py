@@ -28,7 +28,13 @@ additionally rendered as classic cumulative histograms
 (``*_hist_seconds`` with ``_bucket``/``_sum``/``_count``, bounds in
 ``LATENCY_BUCKETS``) evaluated from the same mergeable t-digest the
 quantile gauges read — the form external stacks can aggregate across
-jobs and hosts.  ``obs fleet --prom`` reuses ``fill_metrics`` to emit
+jobs and hosts.  Multi-tenant serving jobs additionally emit
+``ddl_obs_tenant_*`` series (admit/shed/retire counters and latency
+quantiles per ``tenant``/``priority_class`` label) plus
+``ddl_obs_tenant_slo_burn``/``_fast_burn`` gauges — the error-budget
+burn rates ``obs slo`` renders (obs/slo.py), so dashboards can alert on
+the same numbers the CLI and the ``--fail-slo-burn`` CI gate read.
+``obs fleet --prom`` reuses ``fill_metrics`` to emit
 MANY jobs into one combined, per-job-labelled scrape.  Pure stdlib, no
 JAX.
 """
@@ -145,19 +151,24 @@ LATENCY_BUCKETS = (
 )
 
 
-def prometheus_text(fold, job_id: str) -> str:
+def prometheus_text(fold, job_id: str, log_dir=None) -> str:
     """Render a ``JobFold`` as one Prometheus text-format scrape."""
     m = _Metrics()
-    fill_metrics(m, fold, job_id)
+    fill_metrics(m, fold, job_id, log_dir=log_dir)
     return m.render()
 
 
-def fill_metrics(m: "_Metrics", fold, job_id: str, summary=None) -> None:
+def fill_metrics(
+    m: "_Metrics", fold, job_id: str, summary=None, log_dir=None
+) -> None:
     """Fill ``m`` with one job's series (all labelled ``job_id=``).
     ``obs export`` renders one job per scrape; ``obs fleet --prom``
     calls this once per job into a shared accumulator, passing the
     ``summary`` it already computed for the table so the percentile
-    digest merges and timeline sorts don't run twice per job."""
+    digest merges and timeline sorts don't run twice per job.
+    ``log_dir`` (the root holding ``by_job_id/``) enables the
+    per-tenant SLO burn gauges — their budgets come from the job dir's
+    ``slo.json`` (obs/slo.py defaults otherwise)."""
     from ddl_tpu.obs.fold import estimate_clock_offsets
     from ddl_tpu.obs.report import summarize_from_fold
 
@@ -288,6 +299,23 @@ def fill_metrics(m: "_Metrics", fold, job_id: str, summary=None) -> None:
                     metric, "counter", help_text,
                     sf.serve.get(key, 0), host=host, **job,
                 )
+        for t, tc in sorted(getattr(sf, "tenant_serve", {}).items()):
+            tl = {"host": host, "tenant": t, **job}
+            m.add(
+                "tenant_admitted_total", "counter",
+                "requests admitted into decode lanes, by tenant",
+                tc.get("admit", 0), **tl,
+            )
+            m.add(
+                "tenant_shed_total", "counter",
+                "requests shed by admission control, by tenant",
+                tc.get("shed", 0), **tl,
+            )
+            m.add(
+                "tenant_retired_total", "counter",
+                "requests retired complete, by tenant",
+                tc.get("retire", 0), **tl,
+            )
         kv = sf.serve["kv_last"]
         if kv:
             for field, metric in (
@@ -408,6 +436,73 @@ def fill_metrics(m: "_Metrics", fold, job_id: str, summary=None) -> None:
                 "from the mergeable t-digest)",
                 buckets, dig.total, dig.count, **job,
             )
+        # per-tenant serving series from the same merged digests (the
+        # quantile labels mirror the job-level decode gauges); empty
+        # priority_class label = tenant never carried one
+        for t in sorted(stats.tenants):
+            tb = stats.tenants[t]
+            tl = {
+                "tenant": t,
+                "priority_class": tb.get("class") or "",
+                **job,
+            }
+            m.add(
+                "tenant_requests_total", "counter",
+                "decode requests observed, by tenant",
+                tb["requests"], **tl,
+            )
+            m.add(
+                "tenant_tokens_total", "counter",
+                "output tokens generated, by tenant", tb["tokens"], **tl,
+            )
+            for metric, block in (
+                ("latency_s", "tenant_latency_seconds"),
+                ("ttft_s", "tenant_ttft_seconds"),
+                ("queue_delay_s", "tenant_queue_delay_seconds"),
+            ):
+                dig = (tb.get("acc") or {}).get(metric)
+                if dig is None or not dig.count:
+                    continue
+                for q, qs in (
+                    ("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99")
+                ):
+                    v = dig.quantile(float(qs))
+                    if v is not None:
+                        m.add(
+                            block, "gauge",
+                            "warm-request decode percentile, by tenant",
+                            v, quantile=qs, **tl,
+                        )
+
+    # -- per-tenant SLO error-budget burn (obs/slo.py; the same
+    # evaluation `obs slo` renders and --fail-slo-burn gates) ------------
+    stats = fold.serving()
+    if stats.tenants and log_dir is not None:
+        from ddl_tpu.obs.slo import evaluate_slo, load_slo
+
+        rep = evaluate_slo(fold, load_slo(log_dir, job_id))
+        for t in sorted(rep["tenants"]):
+            row = rep["tenants"][t]
+            tl = {
+                "tenant": t,
+                "priority_class": row.get("class") or "",
+                **job,
+            }
+            for key, obj in sorted(row["objectives"].items()):
+                if obj.get("burn") is not None:
+                    m.add(
+                        "tenant_slo_burn", "gauge",
+                        "error-budget burn rate, whole-job window "
+                        "(1 = spending exactly the budget)",
+                        obj["burn"], objective=key, **tl,
+                    )
+                if obj.get("fast_burn") is not None:
+                    m.add(
+                        "tenant_slo_fast_burn", "gauge",
+                        "error-budget burn rate over the newest "
+                        "incarnation (the fast alert window)",
+                        obj["fast_burn"], objective=key, **tl,
+                    )
 
 
 def _write_atomic(path: str, text: str) -> None:
@@ -437,7 +532,8 @@ def export_command(
 
     def scrape() -> str:
         return prometheus_text(
-            fold_job(log_dir, job_id, cache=cache), job_id
+            fold_job(log_dir, job_id, cache=cache), job_id,
+            log_dir=log_dir,
         )
 
     if http_port is not None:
@@ -450,7 +546,7 @@ def export_command(
             f"no events for job {job_id!r} under {log_dir} "
             f"(looked for {_job_dir(log_dir, job_id)}/events-h*.jsonl)"
         )
-    text = prometheus_text(fold, job_id)
+    text = prometheus_text(fold, job_id, log_dir=log_dir)
     if prom is None:
         print(text, end="")
         return

@@ -9,7 +9,9 @@ bytes), so the rollup is as cheap as the sum of its watches) and
 renders the fleet health table: per-job steps/s, MFU (when the family
 reports it — period events carry ``rates`` since the causal-tracing
 PR), p99 TTFT and aggregate tok/s/chip for serving jobs, restart /
-anomaly / stall counts, and staleness.  ``--json`` is the scripting
+anomaly / stall counts, and staleness; multi-tenant serving jobs get
+per-tenant sub-rows (goodput ratio, dominant badput, availability —
+the ledger's per-tenant account, obs/goodput.py).  ``--json`` is the scripting
 surface; ``--prom FILE`` writes ONE combined Prometheus scrape with
 every job's series (``export.fill_metrics`` per job into a shared
 accumulator — all series are ``job_id``-labelled, so the fleet scrape
@@ -89,6 +91,23 @@ def _job_row(fold, summary: dict) -> dict:
     tr = summary.get("trace") or {}
     gp = (summary.get("goodput") or {}).get("job") or {}
     dom = gp.get("dominant_badput")
+    # per-tenant sub-rows for serving jobs: the tenant's own goodput
+    # ratio (served / served+queued+modeled-shed chip-seconds) and its
+    # dominant badput bucket, from the ledger's job-level account
+    from ddl_tpu.obs.goodput import tenant_dominant_badput
+
+    tenants = {}
+    for t in sorted(gp.get("tenants") or {}):
+        row = gp["tenants"][t]
+        dom_t = tenant_dominant_badput(row)
+        tenants[t] = {
+            "class": row.get("class"),
+            "goodput": row.get("ratio"),
+            "badput": dom_t[0] if dom_t else None,
+            "availability": row.get("availability"),
+            "served_s": row.get("served_s"),
+            "sheds": row.get("sheds", 0),
+        }
     return {
         "hosts": len(hosts),
         "steps": summary.get("steps", 0),
@@ -107,6 +126,7 @@ def _job_row(fold, summary: dict) -> dict:
         "incidents": restarts + anomalies + stalls,
         "last_ts": last_ts,
         "slowest_request": (tr.get("slowest") or {}).get("request"),
+        "tenants": tenants,
     }
 
 
@@ -184,6 +204,21 @@ def render_fleet(
             f"{r['restarts']:>5} {r['anomalies']:>5} {r['stalls']:>5} "
             f"{_fmt(age, '.0f', 8)}"
         )
+        for t in sorted(r.get("tenants") or {}):
+            tr_ = r["tenants"][t]
+            gp_t = (
+                f"{tr_['goodput']:.1%}"
+                if tr_.get("goodput") is not None else "-"
+            )
+            avail = (
+                f"{tr_['availability']:.1%}"
+                if tr_.get("availability") is not None else "-"
+            )
+            lines.append(
+                f"  tenant {t[:14]:<14} [{(tr_.get('class') or '-')[:12]:<12}]"
+                f" goodput {gp_t:>7}  badput {(tr_.get('badput') or '-'):<7}"
+                f" avail {avail:>7}  shed {tr_.get('sheds', 0)}"
+            )
     return "\n".join(lines)
 
 
@@ -194,15 +229,17 @@ def fleet_prometheus_text(
     ``log_root`` — ``export.fill_metrics`` per job into a shared
     accumulator, one # HELP/# TYPE header per family, every sample
     ``job_id``-labelled."""
-    return _prom_from_triples(_summarized(_folds(log_root, cache=cache)))
+    return _prom_from_triples(
+        _summarized(_folds(log_root, cache=cache)), log_root=log_root
+    )
 
 
-def _prom_from_triples(triples) -> str:
+def _prom_from_triples(triples, log_root=None) -> str:
     from ddl_tpu.obs.export import _Metrics, fill_metrics
 
     m = _Metrics()
     for job, fold, s in triples:
-        fill_metrics(m, fold, job, summary=s)
+        fill_metrics(m, fold, job, summary=s, log_dir=log_root)
     return m.render()
 
 
@@ -233,7 +270,7 @@ def fleet_command(
 
         # reuse the folds AND summaries already built for the table —
         # no second read pass, no second digest merge
-        text = _prom_from_triples(triples)
+        text = _prom_from_triples(triples, log_root=log_root)
         _write_atomic(prom, text)
         # status to stderr: `obs fleet --json --prom F | jq` must keep
         # reading valid JSON on stdout

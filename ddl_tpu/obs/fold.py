@@ -47,7 +47,7 @@ import statistics
 import threading
 from pathlib import Path
 
-from ddl_tpu.obs.serving import ServingStats
+from ddl_tpu.obs.serving import ServingStats, tenant_of
 
 __all__ = [
     "JobFold",
@@ -68,9 +68,12 @@ SIDECAR_NAME = ".obs_fold.json"
 # schedule identity + modeled bubble accounting); v8 adds the goodput
 # ledger reducer (per-repoch wall-clock accounting: window bounds,
 # phase/compile/restore/stall sums, replay charging off rollback +
-# snapshot_restore cursors — obs/goodput.py renders it) — older
-# sidecars rebuild cleanly
-VERSION = 8
+# snapshot_restore cursors — obs/goodput.py renders it); v9 adds the
+# per-tenant attribution layer (ServingStats per-tenant digests, the
+# tenant_serve admit/shed/retire counters, and the per-repoch per-tenant
+# served/queued/shed chip-second split obs/slo.py evaluates budgets
+# over) — older sidecars rebuild cleanly
+VERSION = 9
 
 # the serving-cursor sidecar this module's cache superseded; removed
 # opportunistically when the fold sidecar is written so a job dir does
@@ -159,7 +162,17 @@ def _new_goodput() -> dict:
         "stall_s": 0.0, "gap_s": 0.0, "rolled_back_s": 0.0,
         "serve_t0": None, "serve_t1": None,
         "periods": {}, "await_bad": None,
+        # per-tenant chip-second split of the serving window: sums of
+        # decode durations (served) and queue delays (queued) plus the
+        # shed count, keyed by the normalized tenant tag — what the
+        # goodput ledger's per-tenant accounts and obs/slo.py's
+        # availability burn rates reduce from
+        "tenants": {},
     }
+
+
+def _new_tenant_goodput() -> dict:
+    return {"served_s": 0.0, "queued_s": 0.0, "requests": 0, "shed": 0}
 
 
 class StreamFold:
@@ -212,6 +225,10 @@ class StreamFold:
             "prefix_hits": 0, "prefix_hit_tokens": 0, "prefix_inserts": 0,
             "cow_copies": 0, "cached_tokens": 0, "prefill_tokens": 0,
         }
+        # per-tenant admit/shed/retire counters (normalized tag; kept
+        # OUT of self.serve so the flat-counter sums there stay flat) —
+        # the shed-rate / availability inputs obs/slo.py evaluates
+        self.tenant_serve: dict[str, dict] = {}
         # job-level restart accounting: every host of a pod emits its
         # own pod_restart event for the SAME pod-wide restart, so the
         # per-stream "restarts" counter (kept for the per-host export/
@@ -238,6 +255,16 @@ class StreamFold:
         self.goodput: dict[int, dict] = {}
         self.all_span: list = [None, None]  # [first_ts, last_ts], any kind
         self.serving = ServingStats(capacity)
+
+    def _tenant_counters(self, e: dict) -> dict:
+        t = tenant_of(e)
+        ts = self.tenant_serve.get(t)
+        if ts is None:
+            ts = self.tenant_serve[t] = {
+                "admit": 0, "shed": 0, "retire": 0,
+                "cached_tokens": 0, "prefill_tokens": 0,
+            }
+        return ts
 
     def _push(self, key: str, item: dict) -> None:
         lst = getattr(self, key)
@@ -375,6 +402,17 @@ class StreamFold:
                     g["serve_t0"] = t0
                 if g["serve_t1"] is None or ts > g["serve_t1"]:
                     g["serve_t1"] = ts
+            if g is not None:
+                # per-tenant chip-second split: the request's decode
+                # duration is chip time served to its tenant, its queue
+                # delay is time the tenant waited for a lane — both
+                # plain sums, so resumed slices reduce identically
+                tg = g["tenants"].setdefault(
+                    tenant_of(e), _new_tenant_goodput()
+                )
+                tg["served_s"] += float(e.get("dur", 0.0) or 0.0)
+                tg["queued_s"] += float(e.get("queue_delay", 0.0) or 0.0)
+                tg["requests"] += 1
             self.serving.observe(e)
         elif kind == "serve_admit":
             self.serve["admit"] += 1
@@ -382,10 +420,22 @@ class StreamFold:
             self.serve["prefill_tokens"] += int(
                 e.get("prefill_tokens", e.get("prompt_len", 0) or 0)
             )
+            ten = self._tenant_counters(e)
+            ten["admit"] += 1
+            ten["cached_tokens"] += int(e.get("cached_tokens", 0))
+            ten["prefill_tokens"] += int(
+                e.get("prefill_tokens", e.get("prompt_len", 0) or 0)
+            )
         elif kind == "serve_shed":
             self.serve["shed"] += 1
+            self._tenant_counters(e)["shed"] += 1
+            if g is not None:
+                g["tenants"].setdefault(
+                    tenant_of(e), _new_tenant_goodput()
+                )["shed"] += 1
         elif kind == "serve_retire":
             self.serve["retire"] += 1
+            self._tenant_counters(e)["retire"] += 1
         elif kind == "kv_pool_stats":
             self.serve["kv_last"] = dict(e)
         elif kind == "prefix_hit":
@@ -606,6 +656,9 @@ class StreamFold:
             "barrier_ts": self.barrier_ts,
             "restart_latency": self.restart_latency,
             "serve": self.serve,
+            "tenant_serve": {
+                t: self.tenant_serve[t] for t in sorted(self.tenant_serve)
+            },
             "trace": self.trace,
             "pipe_schedule": self.pipe_schedule,
             "goodput": {str(r): a for r, a in self.goodput.items()},
@@ -639,6 +692,9 @@ class StreamFold:
         sf.barrier_ts = dict(state["barrier_ts"])
         sf.restart_latency = dict(state["restart_latency"])
         sf.serve = dict(state["serve"])
+        sf.tenant_serve = {
+            t: dict(v) for t, v in state.get("tenant_serve", {}).items()
+        }
         sf.trace = dict(state["trace"])
         sf.pipe_schedule = state.get("pipe_schedule")
         sf.goodput = {

@@ -51,6 +51,13 @@ incarnation's wall clock starts at its restart DECISION when one is on
 record (``restart_latency.decision_ts``) — the relaunch gap belongs to
 the incarnation it produced — else at its first event.
 
+Since the fold's per-tenant attribution layer (sidecar v9) the job row
+also carries a ``tenants`` account: per tenant, chip-seconds split into
+served (decode durations), queued (lane waits) and modeled shed cost,
+plus admit/shed/retire counts and availability (1 - shed rate) — the
+inputs ``obs/slo.py`` evaluates error budgets over and ``obs fleet``
+renders per-tenant columns from.
+
 Pure stdlib over the fold state — no JAX, no stream re-read.
 """
 
@@ -61,6 +68,7 @@ __all__ = [
     "dominant_badput",
     "ledger_from_fold",
     "render_goodput",
+    "tenant_dominant_badput",
 ]
 
 CATEGORIES = (
@@ -125,6 +133,13 @@ def _incarnation_account(
         "start_ts": start, "end_ts": last, "wall_s": wall,
         "seconds": sec,
         "ratio": (sec["productive"] / wall) if wall > 0 else None,
+        # per-tenant chip-second split inside this incarnation's serve
+        # window (fold._new_tenant_goodput shape); sorted so the account
+        # is byte-stable across fold resumes
+        "tenants": {
+            t: dict(v)
+            for t, v in sorted((g.get("tenants") or {}).items())
+        },
     }
 
 
@@ -137,6 +152,19 @@ def dominant_badput(seconds: dict) -> tuple[str, float] | None:
         if cat == "productive":
             continue
         v = seconds.get(cat, 0.0)
+        if v > 0 and (best is None or v > best[1]):
+            best = (cat, v)
+    return best
+
+
+def tenant_dominant_badput(row: dict) -> tuple[str, float] | None:
+    """A tenant's largest lost-chip-time bucket — ``("queued", s)`` or
+    ``("shed", s)`` from its ledger row — or None when nothing was lost.
+    Ties break queued-first for determinism (mirrors
+    ``dominant_badput``'s CATEGORIES-order rule)."""
+    best = None
+    for cat in ("queued", "shed"):
+        v = float(row.get(cat + "_s", 0.0) or 0.0)
         if v > 0 and (best is None or v > best[1]):
             best = (cat, v)
     return best
@@ -164,6 +192,18 @@ def ledger_from_fold(fold) -> dict:
     incarnations = []
     job = {c: 0.0 for c in CATEGORIES}
     job_wall = 0.0
+    tenants: dict[str, dict] = {}
+
+    def _trow(t: str) -> dict:
+        row = tenants.get(t)
+        if row is None:
+            row = tenants[t] = {
+                "served_s": 0.0, "queued_s": 0.0, "shed_s": 0.0,
+                "admits": 0, "sheds": 0, "retires": 0,
+                "availability": None, "ratio": None, "class": None,
+            }
+        return row
+
     for name in sorted(fold.streams):
         sf = fold.streams[name]
         if sf.host is None:
@@ -189,6 +229,18 @@ def ledger_from_fold(fold) -> dict:
                 if c != "untracked":
                     job[c] += v
                     host_attr += v
+            for t, tg in acc["tenants"].items():
+                row = _trow(t)
+                row["served_s"] += tg.get("served_s", 0.0)
+                row["queued_s"] += tg.get("queued_s", 0.0)
+        # stream-level per-tenant request counters (fold.tenant_serve;
+        # authoritative for counts — the per-repoch split above only
+        # covers events stamped with an incarnation)
+        for t, tc in getattr(sf, "tenant_serve", {}).items():
+            row = _trow(t)
+            row["admits"] += tc.get("admit", 0)
+            row["sheds"] += tc.get("shed", 0)
+            row["retires"] += tc.get("retire", 0)
         # job-level extras this host carries: barrier waits no
         # incarnation claimed (the start barrier, join epochs without a
         # trainer window)
@@ -206,11 +258,37 @@ def ledger_from_fold(fold) -> dict:
             host_wall = max(0.0, span[1] - span[0], host_inc_walls)
             job_wall += host_wall
             job["untracked"] += host_wall - host_attr
+    # finalize the per-tenant account: availability is the admitted
+    # fraction of the tenant's offered load (1 - shed rate); shed_s is
+    # MODELED — shed requests never ran, so their cost is estimated at
+    # the tenant's own mean served duration (0 when nothing retired);
+    # ratio is the tenant's goodput analogue, served over
+    # served+queued+shed chip-seconds.  Priority class comes from the
+    # serving digests (the one place the tag is max-reduced).
+    classes: dict[str, str | None] = {}
+    serving = getattr(fold, "serving", None)
+    if callable(serving):
+        for t, tb in serving().tenants.items():
+            classes[t] = tb.get("class")
+    for t in sorted(tenants):
+        row = tenants[t]
+        offered = row["admits"] + row["sheds"]
+        if offered > 0:
+            row["availability"] = row["admits"] / offered
+        mean_served = (
+            row["served_s"] / row["retires"] if row["retires"] else 0.0
+        )
+        row["shed_s"] = row["sheds"] * mean_served
+        denom = row["served_s"] + row["queued_s"] + row["shed_s"]
+        if denom > 0:
+            row["ratio"] = row["served_s"] / denom
+        row["class"] = classes.get(t)
     job_row = {
         "wall_s": job_wall,
         "seconds": job,
         "ratio": (job["productive"] / job_wall) if job_wall > 0 else None,
         "dominant_badput": dominant_badput(job),
+        "tenants": {t: tenants[t] for t in sorted(tenants)},
     }
     incarnations.sort(key=lambda a: (a["host"], a["repoch"]))
     return {"incarnations": incarnations, "job": job_row}
@@ -269,4 +347,25 @@ def render_goodput(ledger: dict, job_id: str = "") -> str:
         row += f"{cell:>{width}}"
     row += f"{ratio:>{width}.1%}" if ratio is not None else f"{'-':>{width}}"
     lines.append(row)
+
+    tenants = job.get("tenants") or {}
+    if tenants:
+        lines.append("per-tenant chip-seconds (shed modeled at mean served):")
+        lines.append(
+            f"  {'tenant':<14}{'class':<14}{'served':>9}{'queued':>9}"
+            f"{'shed':>9}{'avail':>8}{'goodput':>9}{'reqs':>7}"
+        )
+        for t in sorted(tenants):
+            r = tenants[t]
+            avail = (
+                f"{r['availability']:.1%}"
+                if r["availability"] is not None else "-"
+            )
+            gp = f"{r['ratio']:.1%}" if r["ratio"] is not None else "-"
+            lines.append(
+                f"  {t:<14}{(r['class'] or '-'):<14}"
+                f"{_fmt_s(r['served_s']):>9}{_fmt_s(r['queued_s']):>9}"
+                f"{_fmt_s(r['shed_s']):>9}{avail:>8}{gp:>9}"
+                f"{r['admits']:>7}"
+            )
     return "\n".join(lines)

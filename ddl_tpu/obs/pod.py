@@ -309,6 +309,22 @@ def render_pod_summary(s: dict, job_id: str = "", tail: int = 40) -> str:
             f"serving: {sv['requests']} requests, {sv['tokens']} "
             f"tokens{agg}"
         )
+        tenants = sv.get("tenants") or {}
+        if tenants:
+            lines.append("-- tenants --")
+            lines.append(
+                f"{'tenant':<14}{'class':<14}{'reqs':>6}{'tokens':>8}"
+                f"{'p99 ttft':>10}{'p99 lat':>10}"
+            )
+            for t in sorted(tenants):
+                tb = tenants[t]
+                pct = tb.get("percentiles") or {}
+                lines.append(
+                    f"{t:<14}{(tb.get('class') or '-'):<14}"
+                    f"{tb['requests']:>6}{tb['tokens']:>8}"
+                    f"{_fmt((pct.get('ttft_s') or {}).get('p99'), '.4g', 10)}"
+                    f"{_fmt((pct.get('latency_s') or {}).get('p99'), '.4g', 10)}"
+                )
 
     if s["barriers"]:
         lines.append("-- barrier waits (s, summed per host) --")

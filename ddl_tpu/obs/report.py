@@ -24,7 +24,20 @@
                                 inflation or an aggregate tokens/s/chip
                                 drop past the same fraction (the CI
                                 gate); --fail-goodput-drop F additionally
-                                gates the job-level goodput ratio
+                                gates the job-level goodput ratio;
+                                --fail-slo-burn F exits nonzero when the
+                                run under test's worst per-tenant SLO
+                                error-budget burn rate (obs/slo.py)
+                                exceeds F
+    slo <job_id> [--json]       per-tenant SLO evaluation (obs/slo.py):
+                                declarative per-priority-class budgets
+                                (p99 TTFT, p99 latency, availability =
+                                1 - shed rate) from the job's slo.json
+                                (--slo FILE overrides; built-in defaults
+                                otherwise), rendered as error-budget
+                                burn rates with fast (newest
+                                incarnation) / slow (whole job) windows
+                                and page/ticket/ok alert levels
     pod <job_id>                pod-wide view over ALL hosts' streams
                                 (obs/pod.py): per-host skew/straggler
                                 table with barrier-fit clock offsets,
@@ -461,6 +474,27 @@ def render_summary(s: dict, job_id: str = "") -> str:
 
             lines.append("-- decode percentiles (warm requests) --")
             lines.extend(render_percentiles(d["percentiles"]))
+        tenants = d.get("tenants") or {}
+        if tenants:
+            lines.append("-- per-tenant (warm requests) --")
+            lines.append(
+                f"{'tenant':<14}{'class':<14}{'reqs':>6}"
+                f"{'p99 ttft':>10}{'p99 lat':>10}{'tokens':>8}"
+            )
+
+            def _tp99(pct: dict, metric: str) -> str:
+                v = (pct.get(metric) or {}).get("p99")
+                return f"{v:>10.4g}" if v is not None else f"{'n/a':>10}"
+
+            for t in sorted(tenants):
+                tb = tenants[t]
+                pct = tb.get("percentiles") or {}
+                lines.append(
+                    f"{t:<14}{(tb.get('class') or '-'):<14}"
+                    f"{tb['requests']:>6}"
+                    + _tp99(pct, "ttft_s") + _tp99(pct, "latency_s")
+                    + f"{tb['tokens']:>8}"
+                )
     sv = s.get("serve")
     if sv:
         rate = sv.get("prefix_hit_rate")
@@ -704,6 +738,34 @@ def main(argv=None) -> None:
         "— both sides must carry a goodput account (regenerate a "
         "pre-ledger baseline first)",
     )
+    p_diff.add_argument(
+        "--fail-slo-burn", type=float, default=None, metavar="BURN",
+        help="CI SLO gate: exit nonzero when the run under test's worst "
+        "per-tenant error-budget burn rate (obs/slo.py; 1.0 = spending "
+        "exactly the budget) exceeds BURN — the run must carry "
+        "per-tenant serving data (a pre-tenant stream must not pass "
+        "silently)",
+    )
+    p_diff.add_argument(
+        "--slo", metavar="FILE", default=None,
+        help="explicit SLO config for --fail-slo-burn (default: the "
+        "run-under-test job dir's slo.json, else built-in defaults)",
+    )
+    p_slo = sub.add_parser(
+        "slo", parents=[common],
+        help="per-tenant SLO evaluation: error-budget burn rates per "
+        "priority class from declarative budgets (obs/slo.py)",
+    )
+    p_slo.add_argument("job_id")
+    p_slo.add_argument(
+        "--json", action="store_true",
+        help="emit the evaluation as JSON instead of the rendered view",
+    )
+    p_slo.add_argument(
+        "--slo", metavar="FILE", default=None,
+        help="explicit SLO config JSON (default: the job dir's "
+        "slo.json, else built-in defaults)",
+    )
     p_good = sub.add_parser(
         "goodput", parents=[common],
         help="end-to-end chip-time account: productive vs badput per "
@@ -851,18 +913,24 @@ def main(argv=None) -> None:
     elif args.command == "diff":
         from ddl_tpu.obs.fold import fold_job
 
-        sb = summarize_from_fold(_fold_or_exit(args))
-        name_b = args.job_a
+        # fold_b / job_b_id track the RUN UNDER TEST (job_a against a
+        # baseline, job_b in a two-job diff) — the side the SLO burn
+        # gate evaluates, which needs the fold, not just the summary
+        fold_b = _fold_or_exit(args)
+        sb = summarize_from_fold(fold_b)
+        name_b, job_b_id = args.job_a, args.job_a
         if args.baseline:
             stored = json.loads(Path(args.baseline).read_text())
             sa = stored["summary"]
             name_a = f"baseline:{stored.get('job_id', '?')}"
         elif args.job_b:
             # two-job diff keeps its original orientation (a vs b)
-            sa, sb = sb, summarize_from_fold(fold_job(
+            fold_b = fold_job(
                 args.log_dir, args.job_b, cache=not args.no_cache,
-            ))
+            )
+            sa, sb = sb, summarize_from_fold(fold_b)
             name_a, name_b = name_b, args.job_b
+            job_b_id = args.job_b
         else:
             raise SystemExit("obs diff needs a second job id or --baseline")
         print(diff_runs(sa, sb, name_a, name_b))
@@ -982,6 +1050,52 @@ def main(argv=None) -> None:
                 f"OK: goodput within the {frac:.0%} gate "
                 f"({ga:.1%} -> {gb:.1%})"
             )
+        if args.fail_slo_burn is not None:
+            from ddl_tpu.obs.slo import evaluate_slo, load_slo
+
+            cfg = load_slo(args.log_dir, job_b_id, path=args.slo)
+            rep = evaluate_slo(fold_b, cfg)
+            worst = rep.get("worst_burn")
+            if not rep.get("tenants") or worst is None:
+                # the flag was explicit — a run without per-tenant
+                # serving data (pre-tenant stream, no serve traffic, or
+                # no evaluable budget) must not pass silently
+                raise SystemExit(
+                    f"FAIL: --fail-slo-burn needs per-tenant serving "
+                    f"data with at least one evaluable budget on "
+                    f"{name_b} — pre-tenant streams and serve-free runs "
+                    "do not carry the signal"
+                )
+            if worst > args.fail_slo_burn:
+                culprit = ""
+                for t in sorted(rep["tenants"]):
+                    for key, obj in rep["tenants"][t]["objectives"].items():
+                        if obj.get("burn") == worst:
+                            culprit = f" ({t}/{key})"
+                            break
+                    if culprit:
+                        break
+                raise SystemExit(
+                    f"FAIL: {name_b} worst SLO burn "
+                    f"{worst:.2f}x{culprit} exceeds the "
+                    f"{args.fail_slo_burn:.2f}x gate "
+                    f"[alert: {rep['alert']}]"
+                )
+            print(
+                f"OK: worst SLO burn {worst:.2f}x within the "
+                f"{args.fail_slo_burn:.2f}x gate "
+                f"({len(rep['tenants'])} tenant(s))"
+            )
+    elif args.command == "slo":
+        from ddl_tpu.obs.slo import evaluate_slo, load_slo, render_slo
+
+        fold = _fold_or_exit(args)
+        cfg = load_slo(args.log_dir, args.job_id, path=args.slo)
+        rep = evaluate_slo(fold, cfg)
+        if args.json:
+            print(json.dumps(rep))
+        else:
+            print(render_slo(rep, args.job_id))
     elif args.command == "baseline":
         fold = _fold_or_exit(args)
         payload = {

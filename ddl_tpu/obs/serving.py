@@ -38,6 +38,7 @@ __all__ = [
     "ServingStats",
     "TDigest",
     "PERCENTILES",
+    "tenant_of",
 ]
 
 PERCENTILES = (0.5, 0.95, 0.99)
@@ -50,6 +51,15 @@ METRICS = (
     ("ttft", "ttft_s"),
     ("tok_per_s", "tok_per_s"),
 )
+
+
+def tenant_of(event: dict) -> str:
+    """An event's tenant tag, normalized: absence — or any falsy tag
+    (None from a pre-tenant stream, an empty string from a sloppy
+    client) — IS the ``"default"`` tenant.  The single normalization
+    point every consumer shares, so mixed old/new streams fold into one
+    coherent per-tenant account instead of a schema split."""
+    return str(event.get("tenant") or "default")
 
 
 class QuantileAccumulator:
@@ -411,9 +421,18 @@ class ServingStats:
     a min/max, or a mergeable digest, so merged == fed-as-one-stream."""
 
     def __init__(self, capacity: int = 4096) -> None:
+        self.capacity = int(capacity)
         self.acc = {
             name: TDigest(exact_max=capacity) for _, name in METRICS
         }
+        # per-tenant accumulators, keyed by the normalized tenant tag
+        # (``tenant_of``): each tenant gets its own mergeable digest per
+        # metric plus request/cold/token counts, so obs/slo.py can
+        # evaluate p99 budgets per class without a second stream pass.
+        # ``class`` is the tenant's priority class (deterministic max —
+        # one class per tenant in practice; the max makes a conflicting
+        # mixed stream reduce identically in any merge order).
+        self.tenants: dict[str, dict] = {}
         self.requests = 0
         self.cold = 0
         self.tokens = 0
@@ -450,6 +469,18 @@ class ServingStats:
             return f"{engine}:{run}" if run else str(engine)
         return f"run:{run}" if run else "decode"
 
+    def _tenant(self, name: str) -> dict:
+        tb = self.tenants.get(name)
+        if tb is None:
+            tb = self.tenants[name] = {
+                "acc": {
+                    m: TDigest(exact_max=self.capacity)
+                    for _, m in METRICS
+                },
+                "requests": 0, "cold": 0, "tokens": 0, "class": None,
+            }
+        return tb
+
     def observe(self, event: dict) -> None:
         self.requests += 1
         self.tokens += int(
@@ -465,8 +496,17 @@ class ServingStats:
         if rate is not None:
             self.all_rate_sum += float(rate)
             self.all_rate_n += 1
+        tb = self._tenant(tenant_of(event))
+        tb["requests"] += 1
+        tb["tokens"] += int(
+            event.get("new_tokens", 0) * event.get("batch", 1)
+        )
+        pc = event.get("priority_class")
+        if pc and (tb["class"] is None or str(pc) > tb["class"]):
+            tb["class"] = str(pc)
         if not event.get("warm"):
             self.cold += 1
+            tb["cold"] += 1
             return
         for field, name in METRICS:
             v = event.get(field)
@@ -476,6 +516,7 @@ class ServingStats:
             # bug class the regression test pins (test_serve.py).
             if v is not None:
                 self.acc[name].add(v)
+                tb["acc"][name].add(v)
         tok = int(event.get("new_tokens", 0) * event.get("batch", 1))
         ts = event.get("ts")
         if ts is not None:
@@ -498,6 +539,22 @@ class ServingStats:
                 self.acc[name] = TDigest.from_state(dig.state_dict())
             else:
                 mine.merge(dig)
+        for t in sorted(other.tenants):
+            ob = other.tenants[t]
+            tb = self._tenant(t)
+            for name, dig in ob["acc"].items():
+                mine = tb["acc"].get(name)
+                if mine is None:
+                    tb["acc"][name] = TDigest.from_state(dig.state_dict())
+                else:
+                    mine.merge(dig)
+            tb["requests"] += ob["requests"]
+            tb["cold"] += ob["cold"]
+            tb["tokens"] += ob["tokens"]
+            if ob["class"] and (
+                tb["class"] is None or ob["class"] > tb["class"]
+            ):
+                tb["class"] = ob["class"]
         self.requests += other.requests
         self.cold += other.cold
         self.tokens += other.tokens
@@ -517,6 +574,19 @@ class ServingStats:
     def state_dict(self) -> dict:
         return {
             "acc": {name: a.state_dict() for name, a in self.acc.items()},
+            "tenants": {
+                t: {
+                    "acc": {
+                        name: a.state_dict()
+                        for name, a in tb["acc"].items()
+                    },
+                    "requests": tb["requests"],
+                    "cold": tb["cold"],
+                    "tokens": tb["tokens"],
+                    "class": tb["class"],
+                }
+                for t, tb in sorted(self.tenants.items())
+            },
             "requests": self.requests,
             "cold": self.cold,
             "tokens": self.tokens,
@@ -533,6 +603,22 @@ class ServingStats:
         stats.acc = {
             name: TDigest.from_state(s)
             for name, s in state["acc"].items()
+        }
+        # pre-tenant sidecars lack the tenants map (the fold's version
+        # bump rebuilds them anyway; direct state_dict round-trips in
+        # tests may not)
+        stats.tenants = {
+            t: {
+                "acc": {
+                    name: TDigest.from_state(s)
+                    for name, s in tb["acc"].items()
+                },
+                "requests": int(tb["requests"]),
+                "cold": int(tb["cold"]),
+                "tokens": int(tb["tokens"]),
+                "class": tb.get("class"),
+            }
+            for t, tb in state.get("tenants", {}).items()
         }
         stats.requests = int(state["requests"])
         stats.cold = int(state["cold"])
@@ -583,6 +669,23 @@ class ServingStats:
                 name: self.acc[name].summary()
                 for _field, name in METRICS
                 if self.acc[name].count
+            },
+            # per-tenant block, sorted so warm and cold folds render
+            # byte-identically; absent only when no request carried a
+            # tag at all AND none were observed (requests == 0 above)
+            "tenants": {
+                t: {
+                    "requests": tb["requests"],
+                    "cold": tb["cold"],
+                    "tokens": tb["tokens"],
+                    "class": tb["class"],
+                    "percentiles": {
+                        name: tb["acc"][name].summary()
+                        for _field, name in METRICS
+                        if tb["acc"][name].count
+                    },
+                }
+                for t, tb in sorted(self.tenants.items())
             },
         }
 

@@ -1,0 +1,311 @@
+"""Per-tenant SLO engine: declarative error budgets over the fold.
+
+The per-tenant attribution layer (fold sidecar v9) gives every serving
+job a per-tenant account — TDigests per latency metric, admit/shed/
+retire counters, a per-incarnation served/queued/shed chip-second
+split.  This module turns those reductions into the question an
+operator actually pages on: **is each priority class inside its error
+budget, and how fast is it burning what's left?**
+
+Budgets are declarative, per priority class, loaded from a job-level
+``slo.json`` (``<log_dir>/by_job_id/<job>/slo.json``; serve-bench's
+``--scenario multi-tenant`` writes one) with built-in defaults when the
+job carries none::
+
+    {
+      "classes": {
+        "interactive": {"p99_ttft_s": 0.5, "p99_latency_s": 2.0,
+                        "availability": 0.999},
+        "batch":       {"p99_latency_s": 30.0, "availability": 0.99},
+        "best_effort": {"availability": 0.9}
+      },
+      "default_class": "batch",
+      "alerts": {"page_fast_burn": 14.4, "ticket_slow_burn": 2.0}
+    }
+
+Objectives and their error budgets:
+
+* ``p99_ttft_s`` / ``p99_latency_s`` — a p99 target budgets 1% of
+  requests over it.  The actual over-rate comes from the tenant's
+  TDigest CDF (``rank(target)``), so it is exact in the singleton
+  regime every CI smoke lives in; burn = over_rate / 0.01.
+* ``availability`` — 1 - shed rate.  Budget = 1 - target; actual error
+  = sheds / (admits + sheds); burn = shed_rate / budget.
+
+Burn rates use the classic multi-window reading, adapted to the obs
+stack's incarnation clock instead of wall-clock windows: the **slow**
+window is the whole job (cumulative — the fold is one running
+reduction, there is no retention to re-window), the **fast** window is
+the newest incarnation's per-repoch tenant split (availability only;
+latency digests are job-cumulative by design).  ``page`` fires when the
+fast burn crosses ``page_fast_burn`` while the slow burn confirms
+(>= 1), ``ticket`` when the cumulative burn alone crosses
+``ticket_slow_burn``.
+
+Surfaces, all from this one evaluation: ``ddl_tpu obs slo <job>
+[--json]``, ``ddl_obs_tenant_slo_burn`` gauges in ``obs export``, and
+the ``obs diff --fail-slo-burn F`` CI gate.
+
+Pure stdlib over the fold state — no JAX, no stream re-read.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+__all__ = [
+    "DEFAULT_SLO",
+    "alert_level",
+    "burn_rate",
+    "evaluate_slo",
+    "load_slo",
+    "render_slo",
+]
+
+# the budget a pNN-style objective implies: targets are phrased at p99,
+# so 1% of requests may exceed them before the budget is spent
+P99_BUDGET = 0.01
+
+_ALERT_ORDER = ("ok", "ticket", "page")
+
+DEFAULT_SLO = {
+    "classes": {
+        "interactive": {
+            "p99_ttft_s": 0.5, "p99_latency_s": 2.0,
+            "availability": 0.999,
+        },
+        "batch": {"p99_latency_s": 30.0, "availability": 0.99},
+        "best_effort": {"availability": 0.9},
+    },
+    "default_class": "batch",
+    "alerts": {"page_fast_burn": 14.4, "ticket_slow_burn": 2.0},
+}
+
+
+def load_slo(
+    log_dir: str | None = None,
+    job_id: str | None = None,
+    path: str | None = None,
+) -> dict:
+    """The job's SLO config: an explicit ``path`` wins, else the job
+    dir's ``slo.json``, else ``DEFAULT_SLO``.  Missing top-level keys
+    fall back to the defaults, so a config may declare only its
+    classes."""
+    cfg = None
+    if path:
+        cfg = json.loads(Path(path).read_text())
+    elif log_dir is not None and job_id is not None:
+        f = Path(log_dir) / "by_job_id" / job_id / "slo.json"
+        if f.exists():
+            cfg = json.loads(f.read_text())
+    if cfg is None:
+        return json.loads(json.dumps(DEFAULT_SLO))
+    for key, val in DEFAULT_SLO.items():
+        cfg.setdefault(key, json.loads(json.dumps(val)))
+    return cfg
+
+
+def burn_rate(error_rate: float, budget: float) -> float:
+    """Error-budget burn rate: how many budgets the observed error rate
+    consumes per budget's worth of traffic.  1.0 = exactly on budget;
+    a zero budget burns infinitely fast the moment anything errors."""
+    error_rate = max(0.0, float(error_rate))
+    if budget <= 0:
+        return float("inf") if error_rate > 0 else 0.0
+    return error_rate / float(budget)
+
+
+def alert_level(
+    fast_burn: float | None, slow_burn: float | None, alerts: dict
+) -> str:
+    """``"page"`` / ``"ticket"`` / ``"ok"`` from the two burn windows.
+    A missing fast window (no per-incarnation data) falls back to the
+    slow burn, so single-incarnation jobs still page."""
+    slow = 0.0 if slow_burn is None else slow_burn
+    fast = slow if fast_burn is None else fast_burn
+    if fast >= alerts.get("page_fast_burn", 14.4) and slow >= 1.0:
+        return "page"
+    if slow >= alerts.get("ticket_slow_burn", 2.0):
+        return "ticket"
+    return "ok"
+
+
+def _worse(a: str, b: str) -> str:
+    return a if _ALERT_ORDER.index(a) >= _ALERT_ORDER.index(b) else b
+
+
+def _latency_objective(dig, target: float) -> dict:
+    """One pNN latency objective from a tenant's digest: observed p99,
+    the over-target rate via the digest CDF, and its burn."""
+    obj = {
+        "target": float(target), "budget": P99_BUDGET,
+        "p99": None, "over_rate": None, "burn": None,
+    }
+    if dig is None or not dig.count:
+        return obj
+    obj["p99"] = dig.quantile(0.99)
+    at_or_under = dig.rank(float(target))
+    over = max(0.0, 1.0 - (at_or_under or 0.0) / dig.count)
+    obj["over_rate"] = over
+    obj["burn"] = burn_rate(over, P99_BUDGET)
+    return obj
+
+
+# objective key -> serving metric name (obs/serving.METRICS vocabulary)
+_LATENCY_OBJECTIVES = {
+    "p99_ttft_s": "ttft_s",
+    "p99_latency_s": "latency_s",
+}
+
+
+def evaluate_slo(fold, cfg: dict) -> dict:
+    """Evaluate ``cfg`` against a ``JobFold``'s per-tenant account.
+
+    Returns ``{"tenants": {name: {class, requests, admits, sheds,
+    objectives, alert, worst_burn}}, "alert", "worst_burn"}`` — tenants
+    sorted, burns None where the job carries no signal for an
+    objective.  Tenants whose class declares no budgets still appear
+    (alert "ok") so the report shows the whole mix."""
+    alerts = cfg.get("alerts") or DEFAULT_SLO["alerts"]
+    classes = cfg.get("classes") or {}
+    default_class = cfg.get("default_class")
+
+    stats = fold.serving()
+    # job-cumulative (slow window) admit/shed per tenant
+    counts: dict[str, dict] = {}
+    # fast window: the newest incarnation's per-repoch tenant split
+    newest: dict[str, dict] = {}
+    top_repoch = None
+    for name in sorted(fold.streams):
+        sf = fold.streams[name]
+        for t, tc in getattr(sf, "tenant_serve", {}).items():
+            row = counts.setdefault(t, {"admits": 0, "sheds": 0})
+            row["admits"] += tc.get("admit", 0)
+            row["sheds"] += tc.get("shed", 0)
+        for repoch in getattr(sf, "goodput", {}):
+            if top_repoch is None or repoch > top_repoch:
+                top_repoch = repoch
+    if top_repoch is not None:
+        for name in sorted(fold.streams):
+            g = fold.streams[name].goodput.get(top_repoch)
+            for t, tg in ((g or {}).get("tenants") or {}).items():
+                row = newest.setdefault(t, {"requests": 0, "shed": 0})
+                row["requests"] += tg.get("requests", 0)
+                row["shed"] += tg.get("shed", 0)
+
+    names = sorted(set(stats.tenants) | set(counts))
+    tenants: dict[str, dict] = {}
+    job_alert, job_worst = "ok", None
+    for t in names:
+        tb = stats.tenants.get(t) or {}
+        cls = tb.get("class") or default_class
+        budgets = classes.get(cls) or {}
+        cnt = counts.get(t, {"admits": 0, "sheds": 0})
+        objectives: dict[str, dict] = {}
+        worst = None
+        fast_burn = None
+        for key, metric in _LATENCY_OBJECTIVES.items():
+            if key not in budgets:
+                continue
+            dig = (tb.get("acc") or {}).get(metric)
+            objectives[key] = _latency_objective(dig, budgets[key])
+        if "availability" in budgets:
+            target = float(budgets["availability"])
+            offered = cnt["admits"] + cnt["sheds"]
+            obj = {
+                "target": target, "budget": 1.0 - target,
+                "availability": None, "shed_rate": None,
+                "burn": None, "fast_burn": None,
+            }
+            if offered > 0:
+                shed_rate = cnt["sheds"] / offered
+                obj["shed_rate"] = shed_rate
+                obj["availability"] = 1.0 - shed_rate
+                obj["burn"] = burn_rate(shed_rate, 1.0 - target)
+            fw = newest.get(t)
+            if fw is not None and (fw["requests"] + fw["shed"]) > 0:
+                fr = fw["shed"] / (fw["requests"] + fw["shed"])
+                obj["fast_burn"] = burn_rate(fr, 1.0 - target)
+                fast_burn = obj["fast_burn"]
+            objectives["availability"] = obj
+        for obj in objectives.values():
+            b = obj.get("burn")
+            if b is not None and (worst is None or b > worst):
+                worst = b
+        level = alert_level(fast_burn, worst, alerts)
+        tenants[t] = {
+            "class": cls,
+            "requests": int(tb.get("requests", 0)),
+            "admits": cnt["admits"],
+            "sheds": cnt["sheds"],
+            "objectives": objectives,
+            "worst_burn": worst,
+            "alert": level,
+        }
+        job_alert = _worse(job_alert, level)
+        if worst is not None and (job_worst is None or worst > job_worst):
+            job_worst = worst
+    return {"tenants": tenants, "alert": job_alert, "worst_burn": job_worst}
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_burn(b: float | None) -> str:
+    if b is None:
+        return "-"
+    if b == float("inf"):
+        return "inf"
+    return f"{b:.2f}x"
+
+
+def render_slo(report: dict, job_id: str = "") -> str:
+    """The ``obs slo`` report: one block per tenant, one line per
+    objective, burn rates against budget (1.00x = spending exactly the
+    budget)."""
+    lines = [f"== slo — {job_id} ==" if job_id else "== slo =="]
+    tenants = report.get("tenants") or {}
+    if not tenants:
+        lines.append(
+            "no per-tenant serving data in this job "
+            "(pre-tenant stream, or no serve traffic)"
+        )
+        return "\n".join(lines)
+    worst = report.get("worst_burn")
+    lines.append(
+        f"alert: {report.get('alert', 'ok')} | worst burn: "
+        f"{_fmt_burn(worst)} | tenants: {len(tenants)}"
+    )
+    for t in sorted(tenants):
+        row = tenants[t]
+        lines.append(
+            f"tenant {t} [{row.get('class') or '-'}] — "
+            f"{row['requests']} served, {row['sheds']} shed, "
+            f"alert {row['alert']}"
+        )
+        for key in ("p99_ttft_s", "p99_latency_s", "availability"):
+            obj = (row.get("objectives") or {}).get(key)
+            if obj is None:
+                continue
+            if key == "availability":
+                actual = obj.get("availability")
+                cell = f"{actual:.3%}" if actual is not None else "n/a"
+                extra = ""
+                if obj.get("fast_burn") is not None:
+                    extra = f" fast {_fmt_burn(obj['fast_burn'])}"
+                lines.append(
+                    f"  {key:<14} target {obj['target']:.3%}  "
+                    f"actual {cell}  burn {_fmt_burn(obj.get('burn'))}"
+                    f"{extra}"
+                )
+            else:
+                p99 = obj.get("p99")
+                cell = f"{p99:.3f}s" if p99 is not None else "n/a"
+                lines.append(
+                    f"  {key:<14} target {obj['target']:.3f}s "
+                    f"p99 {cell}  burn {_fmt_burn(obj.get('burn'))}"
+                )
+    return "\n".join(lines)

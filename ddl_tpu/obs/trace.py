@@ -643,6 +643,11 @@ def serve_trace_http(
       that fetch).
     * ``GET /trace.json?request=ID|slowest=1|incident=N|step=N`` — the
       same JSON ``obs trace --out`` writes, built on demand.
+    * ``GET /goodput`` — the job's chip-time ledger (obs/goodput.py)
+      as HTML with one ``#h<host>-e<repoch>`` anchor per incarnation
+      account; each incident row on the index deep-links to the
+      account of the incarnation it cost, so "what did this incident
+      cost" is one click from "what happened".
 
     ``max_requests`` bounds the serve loop (tests)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -696,9 +701,17 @@ def serve_trace_http(
             ))
         for i, inc in enumerate(incidents):
             kinds = sorted({e["kind"] for _, _, e in inc["events"]})
+            # the incarnation this incident cost: its first event's
+            # (host, restart epoch) — the /goodput anchor of the
+            # account that absorbed the stall/restart/rollback seconds
+            _adj, ihost, ie = inc["events"][0]
+            repoch = int(ie.get("repoch", 0) or 0)
             rows.append(row(
                 f"incident {i}: {len(inc['events'])} event(s) "
                 f"({', '.join(kinds)})", f"incident={i}",
+            )[:-len("</li>")] + (
+                f" · <a href='/goodput#h{ihost}-e{repoch}'>chip-time "
+                f"account h{ihost}/e{repoch}</a></li>"
             ))
         body = "\n".join(rows) or "<li>(nothing traceable yet)</li>"
         return (
@@ -708,8 +721,56 @@ def serve_trace_http(
             "<p>Each link loads the clock-corrected Chrome trace JSON; "
             "the Perfetto deep link opens it in ui.perfetto.dev "
             "directly (the server sends CORS headers for that fetch). "
-            "Step traces: <code>/trace.json?step=N</code>.</p>"
+            "Step traces: <code>/trace.json?step=N</code>. "
+            "The <a href='/goodput'>goodput ledger</a> carries one "
+            "anchor per incarnation account.</p>"
             f"<ul>{body}</ul></body></html>"
+        )
+
+    def goodput_html() -> str:
+        from ddl_tpu.obs.fold import fold_job
+        from ddl_tpu.obs.goodput import CATEGORIES, ledger_from_fold
+
+        fold = fold_job(log_dir, job_id, cache=cache)
+        ledger = ledger_from_fold(fold)
+        blocks = []
+        for a in ledger["incarnations"]:
+            anchor = f"h{a['host']}-e{a['repoch']}"
+            ratio = f"{a['ratio']:.1%}" if a["ratio"] is not None else "n/a"
+            cells = "".join(
+                f"<tr><td>{c}</td><td align='right'>"
+                f"{a['seconds'][c]:.2f}s</td></tr>"
+                for c in CATEGORIES if a["seconds"].get(c, 0.0) > 0
+            )
+            blocks.append(
+                f"<h2 id='{anchor}'>h{a['host']} / epoch {a['repoch']} "
+                f"— {a['wall_s']:.1f}s wall, {ratio} productive</h2>"
+                f"<table>{cells}</table>"
+            )
+        tenants = (ledger["job"].get("tenants") or {})
+        if tenants:
+            rows = "".join(
+                f"<tr><td>{t}</td><td>{r.get('class') or '-'}</td>"
+                f"<td align='right'>{r['served_s']:.2f}s</td>"
+                f"<td align='right'>{r['queued_s']:.2f}s</td>"
+                f"<td align='right'>{r['shed_s']:.2f}s</td></tr>"
+                for t, r in sorted(tenants.items())
+            )
+            blocks.append(
+                "<h2>per-tenant chip-seconds</h2><table>"
+                "<tr><th>tenant</th><th>class</th><th>served</th>"
+                f"<th>queued</th><th>shed (modeled)</th></tr>{rows}"
+                "</table>"
+            )
+        body = "\n".join(blocks) or "<p>(no incarnation accounts)</p>"
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>goodput — {job_id}</title></head><body>"
+            f"<h1>goodput — {job_id}</h1>"
+            "<p>One account per (host, restart-epoch) incarnation — "
+            "the same ledger <code>obs goodput</code> renders; "
+            "<a href='/'>back to the trace index</a>.</p>"
+            f"{body}</body></html>"
         )
 
     class Handler(BaseHTTPRequestHandler):
@@ -732,6 +793,11 @@ def serve_trace_http(
                     )
                     self._send(
                         200, index_html(host).encode(),
+                        "text/html; charset=utf-8",
+                    )
+                elif parsed.path == "/goodput":
+                    self._send(
+                        200, goodput_html().encode(),
                         "text/html; charset=utf-8",
                     )
                 elif parsed.path == "/trace.json":

@@ -11,7 +11,7 @@ steps/s and loss per host, the run's phase breakdown, the pod
 skew/straggler table with barrier-wait attribution and barrier-fit
 clock offsets, recent incidents (anomalies / stalls / restarts /
 profile captures), restart latencies, and the serving lane/pool/
-admission counters.  Because each refresh folds only the bytes appended
+admission counters with per-tenant request/shed/percentile rows.  Because each refresh folds only the bytes appended
 since the previous one, watching a week-old job costs the same per tick
 as watching a fresh smoke — the property ``obs summarize``'s old
 full-parse read path could never give a refresh loop.
@@ -182,6 +182,27 @@ def build_frame(fold, job_id: str, now: float | None = None) -> str:
                         f", {kv['cached']} block(s) cached"
                         if kv and kv.get("cached") is not None else ""
                     )
+                )
+
+        tenants = d.get("tenants") or {}
+        if tenants:
+            tshed: dict[str, int] = {}
+            for sf in fold.streams.values():
+                for t, tc in getattr(sf, "tenant_serve", {}).items():
+                    tshed[t] = tshed.get(t, 0) + tc.get("shed", 0)
+            lines.append("-- tenants --")
+            lines.append(
+                f"{'tenant':<14}{'class':<14}{'reqs':>6}{'shed':>6}"
+                f"{'p99 ttft':>10}{'p99 lat':>10}"
+            )
+            for t in sorted(tenants):
+                tb = tenants[t]
+                pct = tb.get("percentiles") or {}
+                lines.append(
+                    f"{t:<14}{(tb.get('class') or '-'):<14}"
+                    f"{tb['requests']:>6}{tshed.get(t, 0):>6}"
+                    f"{_fmt((pct.get('ttft_s') or {}).get('p99'), '.4g', 10)}"
+                    f"{_fmt((pct.get('latency_s') or {}).get('p99'), '.4g', 10)}"
                 )
 
     # -- goodput ---------------------------------------------------------

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from collections import deque
 
-from ddl_tpu.serve.scheduler import Request
+from ddl_tpu.serve.scheduler import Request, tenant_tags
 
 __all__ = ["AdmissionController", "POLICIES"]
 
@@ -75,6 +75,7 @@ class AdmissionController:
                 reason=reason,
                 policy=self.policy,
                 queue_depth=len(self.queue),
+                **tenant_tags(req),
             )
             # terminal causal mark: a shed request's trace ends here,
             # not at a retire (obs/trace.py renders it as the trace's
@@ -90,6 +91,7 @@ class AdmissionController:
                     request_id=req.id,
                     reason=reason,
                     policy=self.policy,
+                    **tenant_tags(req),
                 )
         if self.on_shed is not None:
             self.on_shed(req, reason)

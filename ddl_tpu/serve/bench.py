@@ -23,6 +23,14 @@ shape, not a slogan):
                     spaced exponentially (``--arrival-s`` = mean gap)
     mixed           shared-prefix cohort + a long prompt + unique short
                     fillers under bursty arrivals
+    multi-tenant    a weighted tenant mix (~50% interactive / 30% batch
+                    / 20% best-effort) with per-class arrival rates and
+                    prompt shapes; every request carries its
+                    ``tenant``/``priority_class`` tags through the
+                    event stream, the report gains a per-tenant block,
+                    and (with --obs-log-dir) a declarative ``slo.json``
+                    lands in the job dir so ``obs slo <job>`` evaluates
+                    per-class error budgets over the run
 
 ``--compare-sequential`` replays the same requests one-at-a-time
 through ``infer.decode.make_lm_generator`` at equal per-request
@@ -96,7 +104,7 @@ def main(argv=None) -> None:
                     "0 = all arrive at t0, the closed-burst worst case)")
     ap.add_argument("--scenario", default="none",
                     choices=["none", "shared-prefix", "long-prompt",
-                             "bursty", "mixed"],
+                             "bursty", "mixed", "multi-tenant"],
                     help="parameterized client mix (see module docstring); "
                     "'none' keeps the plain --prompt-len/--max-new mix")
     ap.add_argument("--shared-prefix-len", type=int, default=64,
@@ -219,6 +227,8 @@ def main(argv=None) -> None:
         from ddl_tpu.obs import EventWriter
 
         obs = EventWriter(args.obs_log_dir, args.job_id)
+        if args.scenario == "multi-tenant":
+            _write_bench_slo(args.obs_log_dir, args.job_id)
 
     prefill_chunk = args.prefill_chunk
     if prefill_chunk is None and args.scenario in ("long-prompt", "mixed"):
@@ -273,6 +283,8 @@ def main(argv=None) -> None:
                 c["prompt"], c["max_new"], request_id=c["id"],
                 submitted_at=t_start + c["arrival"],
                 rng_seed=args.seed,
+                tenant=c.get("tenant"),
+                priority_class=c.get("priority_class"),
             )
         progressed = engine.step()
         if not progressed and pending:
@@ -372,6 +384,29 @@ def main(argv=None) -> None:
         print("-- percentiles (warm requests) --")
         for line in render_percentiles(summary["percentiles"]):
             print(line)
+    tenants = (summary or {}).get("tenants") or {}
+    if tenants:
+        # per-class separation is the scenario's acceptance signal:
+        # each tenant's percentiles come from its OWN digest, so a
+        # tail-heavy class can't hide inside the aggregate table above
+        print("-- per-tenant (warm requests) --")
+        print(
+            f"{'tenant':<12} {'class':<14} {'reqs':>5} "
+            f"{'p99 ttft':>9} {'p99 lat':>9} {'tokens':>8}"
+        )
+        for t in sorted(tenants):
+            tb = tenants[t]
+            pct = tb.get("percentiles") or {}
+
+            def _p99(metric, pct=pct):
+                v = (pct.get(metric) or {}).get("p99")
+                return f"{v:>9.4g}" if v is not None else f"{'-':>9}"
+
+            print(
+                f"{t[:12]:<12} {(tb.get('class') or '-')[:14]:<14} "
+                f"{tb.get('requests', 0):>5} {_p99('ttft_s')} "
+                f"{_p99('latency_s')} {tb.get('tokens', 0):>8}"
+            )
     if summary and summary.get("agg_tok_per_s") is not None:
         print(
             f"warm-span aggregate: {summary['agg_tok_per_s']:.1f} tok/s "
@@ -496,6 +531,41 @@ def _make_clients(args, cfg, p_lo, p_hi, n_lo, n_hi) -> list[dict]:
             clients.append(
                 {"id": cid, "prompt": prompt, "max_new": rint(n_lo, n_hi)}
             )
+    elif args.scenario == "multi-tenant":
+        # weighted tenant mix: interactive traffic dominates and
+        # arrives steadily, batch sends fewer/longer requests at a
+        # slower rate, best-effort dumps its whole backlog at t0 —
+        # three genuinely different distributions for the per-tenant
+        # digests and SLO budgets to separate.  Each entry:
+        # (tenant, priority class, weight, prompt range, max_new range,
+        # arrival-gap multiplier on --arrival-s; 0 = all present at t0)
+        mix = [
+            ("acme", "interactive", 5, (p_lo, p_hi),
+             (n_lo, max(n_lo, (n_lo + n_hi) // 2)), 1.0),
+            ("bulk", "batch", 3, (p_hi, 2 * p_hi), (n_hi, n_hi), 3.0),
+            ("scav", "best_effort", 2, (p_lo, p_hi), (n_lo, n_hi), 0.0),
+        ]
+        weights = np.array([m[2] for m in mix], dtype=float)
+        draws = rng.choice(len(mix), size=n, p=weights / weights.sum())
+        t_cls = [0.0] * len(mix)
+        for i in range(n):
+            k = int(draws[i])
+            tenant, cls, _w, (plo, phi), (nlo, nhi), pace = mix[k]
+            if pace and args.arrival_s:
+                t_cls[k] += rng.exponential(args.arrival_s * pace)
+            clients.append({
+                "id": f"{tenant}-{i:04d}",
+                "prompt": toks(rint(plo, phi)),
+                "max_new": rint(nlo, nhi),
+                "tenant": tenant,
+                "priority_class": cls,
+                "arrival": t_cls[k],
+            })
+        # the submit loop drains pending in list order against a
+        # nondecreasing clock — interleave the per-class arrival
+        # processes into one timeline
+        clients.sort(key=lambda c: c["arrival"])
+        return clients
     else:  # "none" and "bursty" use the plain length mix
         for i in range(n):
             clients.append({
@@ -506,6 +576,34 @@ def _make_clients(args, cfg, p_lo, p_hi, n_lo, n_hi) -> list[dict]:
     for c, t in zip(clients, arrivals(len(clients))):
         c["arrival"] = t
     return clients
+
+
+def _write_bench_slo(log_dir, job_id) -> None:
+    """Drop a declarative ``slo.json`` next to the run's event streams
+    so ``obs slo <job>`` / ``obs diff --fail-slo-burn`` evaluate the
+    bench without hand-authoring budgets.  Latency targets are generous
+    (the smoke runs on CPU where absolute times mean little), so
+    availability — 1 - shed rate — is the budget a mis-provisioned run
+    actually burns."""
+    import json
+    from pathlib import Path
+
+    job_dir = Path(log_dir) / "by_job_id" / str(job_id)
+    job_dir.mkdir(parents=True, exist_ok=True)
+    cfg = {
+        "classes": {
+            "interactive": {
+                "p99_ttft_s": 30.0,
+                "p99_latency_s": 60.0,
+                "availability": 0.999,
+            },
+            "batch": {"p99_latency_s": 120.0, "availability": 0.99},
+            "best_effort": {"availability": 0.9},
+        },
+        "default_class": "batch",
+        "alerts": {"page_fast_burn": 14.4, "ticket_slow_burn": 2.0},
+    }
+    (job_dir / "slo.json").write_text(json.dumps(cfg, indent=2) + "\n")
 
 
 def _sequential_baseline(cfg, spec, params, clients, args):

@@ -106,7 +106,11 @@ from ddl_tpu.serve.kv_pool import (
     pool_write_token,
     pool_write_prefill,
 )
-from ddl_tpu.serve.scheduler import ContinuousScheduler, Request
+from ddl_tpu.serve.scheduler import (
+    ContinuousScheduler,
+    Request,
+    tenant_tags,
+)
 
 __all__ = [
     "ServeEngine", "make_serve_step_fns", "prompt_bucket", "pow2_at_most",
@@ -730,9 +734,13 @@ class ServeEngine:
     def submit(
         self, prompt, max_new: int, request_id: str | None = None,
         submitted_at: float | None = None, rng_seed: int = 0,
+        tenant: str | None = None, priority_class: str | None = None,
     ) -> str:
         """Offer one prompt; returns its admission outcome (see
-        ``AdmissionController.offer``)."""
+        ``AdmissionController.offer``).  ``tenant``/``priority_class``
+        tag every event the request emits (admit/shed/retire/decode/
+        trace spans) for per-tenant SLO attribution; untagged requests
+        fold into the ``"default"`` tenant downstream."""
         if request_id is None:
             request_id = f"r{self._req_counter:05d}"
         seq = self._req_counter
@@ -751,6 +759,8 @@ class ServeEngine:
             # same requests and `obs trace --slowest-request` selects
             # over a stable subset
             traced=self.trace_requests and seq % self.trace_sample == 0,
+            tenant=str(tenant) if tenant else None,
+            priority_class=str(priority_class) if priority_class else None,
         )
         self.stats["submitted"] += 1
         outcome = self.admission.offer(
@@ -824,6 +834,7 @@ class ServeEngine:
                 warm=not state.cold,
                 chips=self.fns.mesh.size,
                 engine="serve",
+                **tenant_tags(req),
             )
             self.request_log.append(
                 {"kind": "decode", "ts": time.time(), **record}
@@ -843,6 +854,7 @@ class ServeEngine:
                 prompt_len=req.prompt_len, new_tokens=len(state.outputs),
                 dispatches=len(state.dispatches), outcome="ok",
                 cached_tokens=state.cached_tokens,
+                **tenant_tags(req),
             )
             if self.obs is not None:
                 self.obs.emit("decode", **record)
@@ -853,6 +865,7 @@ class ServeEngine:
                     new_tokens=len(state.outputs),
                     dur=dur,
                     freed_blocks=len(state.block_ids),
+                    **tenant_tags(req),
                 )
                 self._emit_pool_stats()
 
@@ -901,6 +914,7 @@ class ServeEngine:
                 trace=req.id, span=f"{req.id}/queue",
                 parent=f"{req.id}/req", traced=req.traced,
                 request_id=req.id,
+                **tenant_tags(req),
             )
         if self.obs is not None:
             self.obs.emit(
@@ -924,6 +938,7 @@ class ServeEngine:
                 compiled=state.cold,
                 chunked=chunked,
                 **({"scenario": self.scenario} if self.scenario else {}),
+                **tenant_tags(req),
             )
             self._emit_pool_stats()
 
@@ -966,6 +981,7 @@ class ServeEngine:
             parent=f"{req.id}/req", traced=req.traced,
             request_id=req.id, lane=state.lane,
             bucket=bucket, compiled=compiled,
+            **tenant_tags(req),
         )
         self._finish_prefill(state, tok0, rng, cold=compiled)
 
@@ -1146,6 +1162,7 @@ class ServeEngine:
             request_id=req.id, lane=state.lane,
             bucket=cb, chunk=chunk_idx, offset=off, compiled=compiled,
             mode=mode,
+            **tenant_tags(req),
         )
         if final:
             self._finish_prefill(state, tok0, rng, cold=compiled)
@@ -1223,6 +1240,7 @@ class ServeEngine:
                 parent=f"{s.request.id}/req", traced=s.request.traced,
                 request_id=s.request.id, lane=s.lane, dispatch=seq,
                 steps=k, riders=len(active),
+                **tenant_tags(s.request),
             )
             if s.done:
                 s.finished_at = now

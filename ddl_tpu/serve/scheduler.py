@@ -41,7 +41,21 @@ from typing import Any, Optional
 
 from ddl_tpu.serve.kv_pool import BlockAllocator, PrefixIndex, blocks_for
 
-__all__ = ["Request", "LaneState", "ContinuousScheduler"]
+__all__ = ["Request", "LaneState", "ContinuousScheduler", "tenant_tags"]
+
+
+def tenant_tags(req: "Request") -> dict:
+    """Event-field dict for a request's tenant tags.  Fields appear only
+    when set (the serve_admit scenario-tag pattern), so untagged runs'
+    event bytes are unchanged and pre-tenant streams keep folding; every
+    consumer normalizes absence — or a falsy tag — to the ``"default"``
+    tenant (obs/serving.py, obs/fold.py)."""
+    out = {}
+    if getattr(req, "tenant", None):
+        out["tenant"] = req.tenant
+    if getattr(req, "priority_class", None):
+        out["priority_class"] = req.priority_class
+    return out
 
 
 @dataclasses.dataclass
@@ -50,7 +64,13 @@ class Request:
     — nothing here touches devices); ``submitted_at`` is a
     ``perf_counter`` timestamp so queueing delay is measurable.
     ``traced`` marks whether this request emits causal trace spans (the
-    ``DDL_OBS_TRACE_SAMPLE`` 1-in-N sampler clears it)."""
+    ``DDL_OBS_TRACE_SAMPLE`` 1-in-N sampler clears it).  ``tenant`` /
+    ``priority_class`` are the multi-tenant attribution tags: carried
+    onto every serve_admit/serve_shed/serve_retire/decode/trace event so
+    the obs stack can split latency percentiles, shed rates, and
+    chip-seconds per tenant (obs/serving.py, obs/slo.py).  None means
+    untagged — every consumer folds that into the ``"default"`` tenant,
+    so old and new streams aggregate together."""
 
     id: str
     prompt: Any
@@ -58,6 +78,8 @@ class Request:
     submitted_at: float | None = None
     rng_seed: int = 0
     traced: bool = True
+    tenant: str | None = None
+    priority_class: str | None = None
     # memoized PrefixIndex.chain_keys over the immutable prompt: a
     # parked queue head is looked up every scheduler tick, and only the
     # index-dict walk needs to be fresh — not O(prompt) SHA-1 hashing

@@ -1,0 +1,24 @@
+"""Known-bad fixture: tenant-tagged serving kinds.  The REGISTERED
+kinds (``serve_admit``/``serve_shed``, obs/events.py) pass the
+obs-event rule with or without ``tenant``/``priority_class`` tags —
+the tags are optional FIELDS, not new kinds; an unregistered
+tenant-tagged kind must still fail.  The regression this fixture pins
+is a future multi-tenant emitter assuming the tenant tag exempts it
+from the registry, which would silently drop that tenant's events from
+every per-tenant digest, SLO budget, and goodput account.  Parsed by
+tests/test_analysis.py — never imported."""
+
+
+def emit_tenant(writer):
+    writer.emit(
+        "serve_admit", request_id="r1", queue_depth=0,
+        tenant="acme", priority_class="interactive",
+    )  # registered: the tenant tag rides an existing kind — fine
+    writer.emit(
+        "serve_shed", request_id="r2", reason="queue_full",
+        tenant="acme", priority_class="interactive",
+    )  # registered: fine
+    writer.emit(
+        "tenant_quota", tenant="acme", priority_class="interactive",
+        remaining=0,
+    )  # obs-event-unregistered

@@ -74,6 +74,19 @@ def test_trace_kind_fixture_registered_vs_not():
     assert "trace_hop" in fs[0].message
 
 
+def test_tenant_tagged_kind_still_needs_registry():
+    """A ``tenant``/``priority_class`` tag rides the registered serving
+    kinds as optional fields — it does not exempt an UNREGISTERED kind
+    from the obs-event rule (LINT_BASELINE.json stays empty, so a
+    tenant-tagged typo'd kind fails the gate on the spot instead of
+    silently vanishing from every per-tenant digest)."""
+    fs = _lint_fixture("bad_tenant_kind.py")
+    rules = _rules(fs)
+    assert rules.count("obs-event-unregistered") == 1
+    assert len(fs) == 1
+    assert "tenant_quota" in fs[0].message
+
+
 def test_bad_misc_fixture_rules():
     fs = _lint_fixture("bad_misc.py")
     rules = _rules(fs)

@@ -60,14 +60,34 @@ def _rich_events(host, *, offset=0.0, periods=4, step_s=0.10):
         batch=1, dur=0.5, queue_delay=0.0, ttft=0.1 + 0.01 * host,
         tok_per_s=32.0, warm=False, chips=2,
     ))
+    # two tenant-tagged warm decodes + one untagged (the untagged one
+    # folds into the "default" tenant): the split/truncate/recreate
+    # equivalence tests below exercise the v9 per-tenant layer through
+    # every sidecar history for free
+    tags = [
+        {"tenant": "acme", "priority_class": "interactive"},
+        {"tenant": "bulk", "priority_class": "batch"},
+        {},
+    ]
     for i in range(3):
         evs.append(_ev(
             host, "decode", 51.0 + i + offset, prompt_len=8,
             new_tokens=16, batch=1, dur=0.4 + 0.1 * i,
             queue_delay=0.01 * i, ttft=0.1, tok_per_s=30.0 + i,
-            warm=True, chips=2,
+            warm=True, chips=2, **tags[i],
         ))
-    evs.append(_ev(host, "serve_admit", 55.0 + offset, request_id=1))
+    evs.append(_ev(
+        host, "serve_admit", 55.0 + offset, request_id=1,
+        tenant="acme", priority_class="interactive",
+    ))
+    evs.append(_ev(
+        host, "serve_retire", 55.2 + offset, request_id=1,
+        tenant="acme", priority_class="interactive",
+    ))
+    evs.append(_ev(
+        host, "serve_shed", 55.5 + offset, request_id=2,
+        reason="queue_full", tenant="bulk", priority_class="batch",
+    ))
     evs.append(_ev(
         host, "kv_pool_stats", 56.0 + offset, num_blocks=64,
         block_size=8, free=60, used=4, high_water=8, fragmentation=0.0,

@@ -1,0 +1,359 @@
+"""Per-tenant SLO engine (obs/slo.py) + the tenant attribution layer
+it evaluates: burn-rate math units, the "default" tenant for untagged
+and falsy-tagged events, config loading precedence, a multi-tenant
+end-to-end fold asserting each class's percentiles land in ITS OWN
+digest, and the ``obs diff --fail-slo-burn`` CI gate's exit behavior.
+"""
+
+import json
+import math
+
+import pytest
+
+from ddl_tpu.obs.slo import (
+    DEFAULT_SLO,
+    alert_level,
+    burn_rate,
+    evaluate_slo,
+    load_slo,
+    render_slo,
+)
+
+# ---------------------------------------------------------------------------
+# synthetic streams (same layout the fold tests use)
+# ---------------------------------------------------------------------------
+
+
+def _ev(host, kind, ts, **kw):
+    e = {
+        "ts": ts, "mono": ts, "run": f"r{host}", "host": host,
+        "step": kw.pop("step", None), "kind": kind,
+    }
+    e.update(kw)
+    return e
+
+
+def _append(log_dir, job, host, events):
+    d = log_dir / "by_job_id" / job
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / f"events-h{host:03d}.jsonl", "a") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def _serve_events(host, reqs):
+    """``reqs``: (tenant_kw, latency, ttft, shed) tuples — an admit per
+    request, a tagged decode + retire for served ones, a shed for the
+    rest."""
+    evs = [_ev(host, "run_start", 1.0, family="lm")]
+    t = 10.0
+    for i, (tags, lat, ttft, shed) in enumerate(reqs):
+        if shed:
+            # the engine sheds at offer time: no admit precedes it
+            evs.append(_ev(
+                host, "serve_shed", t + 0.01, request_id=i,
+                reason="queue_full", **tags,
+            ))
+        else:
+            evs.append(_ev(host, "serve_admit", t, request_id=i, **tags))
+            evs.append(_ev(
+                host, "decode", t + lat, prompt_len=8, new_tokens=16,
+                batch=1, dur=lat, queue_delay=0.0, ttft=ttft,
+                tok_per_s=16.0 / lat, warm=True, chips=1, **tags,
+            ))
+            evs.append(_ev(
+                host, "serve_retire", t + lat + 0.01, request_id=i,
+                **tags,
+            ))
+        t += 1.0
+    evs.append(_ev(host, "run_end", t + 1.0, phases={}, anomalies=0))
+    return evs
+
+
+# ---------------------------------------------------------------------------
+# burn-rate + alert math
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rate_units():
+    # 1.0 = spending exactly the budget; linear in the error rate
+    assert burn_rate(0.01, 0.01) == 1.0
+    assert burn_rate(0.02, 0.01) == 2.0
+    assert burn_rate(0.0, 0.01) == 0.0
+    # negative error rates clamp (defensive: modeled rates)
+    assert burn_rate(-0.5, 0.01) == 0.0
+    # a zero budget burns infinitely fast the moment anything errors,
+    # but a clean run against a zero budget is NOT on fire
+    assert burn_rate(0.5, 0.0) == float("inf")
+    assert burn_rate(0.0, 0.0) == 0.0
+
+
+def test_alert_level_windows():
+    alerts = {"page_fast_burn": 14.4, "ticket_slow_burn": 2.0}
+    # page needs the fast window to spike AND the slow to confirm
+    assert alert_level(20.0, 1.5, alerts) == "page"
+    assert alert_level(20.0, 0.5, alerts) == "ok"
+    assert alert_level(1.0, 3.0, alerts) == "ticket"
+    assert alert_level(0.0, 0.0, alerts) == "ok"
+    # no fast window (single incarnation): slow stands in, so a
+    # cumulative catastrophe still pages
+    assert alert_level(None, 20.0, alerts) == "page"
+    assert alert_level(None, None, alerts) == "ok"
+    assert alert_level(float("inf"), float("inf"), alerts) == "page"
+
+
+def test_tenant_of_falsy_tags_fold_to_default():
+    """Absent, None, and empty-string tenant tags all normalize to the
+    "default" tenant — mixed pre-tenant/tenant streams fold into one
+    account instead of splitting on a falsy variant."""
+    from ddl_tpu.obs.serving import tenant_of
+
+    assert tenant_of({}) == "default"
+    assert tenant_of({"tenant": None}) == "default"
+    assert tenant_of({"tenant": ""}) == "default"
+    assert tenant_of({"tenant": 0}) == "default"
+    assert tenant_of({"tenant": "acme"}) == "acme"
+
+
+# ---------------------------------------------------------------------------
+# config loading
+# ---------------------------------------------------------------------------
+
+
+def test_load_slo_precedence_and_backfill(tmp_path):
+    # no config anywhere: a deep copy of the defaults (mutating the
+    # result must not poison later loads)
+    cfg = load_slo(tmp_path, "nope")
+    assert cfg == DEFAULT_SLO and cfg is not DEFAULT_SLO
+    cfg["classes"]["interactive"]["availability"] = 0.0
+    assert DEFAULT_SLO["classes"]["interactive"]["availability"] == 0.999
+
+    # job-dir slo.json wins over defaults; missing top-level keys
+    # backfill so a config may declare only its classes
+    job_dir = tmp_path / "by_job_id" / "j"
+    job_dir.mkdir(parents=True)
+    (job_dir / "slo.json").write_text(json.dumps(
+        {"classes": {"gold": {"availability": 0.9999}}}
+    ))
+    cfg = load_slo(tmp_path, "j")
+    assert cfg["classes"] == {"gold": {"availability": 0.9999}}
+    assert cfg["default_class"] == "batch"  # backfilled
+    assert cfg["alerts"]["page_fast_burn"] == 14.4
+
+    # an explicit --slo path wins over the job dir's file
+    other = tmp_path / "override.json"
+    other.write_text(json.dumps(
+        {"classes": {"silver": {"availability": 0.5}},
+         "default_class": "silver"}
+    ))
+    cfg = load_slo(tmp_path, "j", path=str(other))
+    assert "silver" in cfg["classes"] and "gold" not in cfg["classes"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the fold's per-tenant account -> budgets
+# ---------------------------------------------------------------------------
+
+ACME = {"tenant": "acme", "priority_class": "interactive"}
+BULK = {"tenant": "bulk", "priority_class": "batch"}
+
+
+def test_multitenant_percentiles_land_in_own_digest(tmp_path):
+    """Two tenants with deliberately separated latency distributions:
+    each class's percentiles must come from ITS OWN digest — the
+    tail-heavy batch tenant cannot leak into interactive's p99, and
+    untagged requests land in "default", not in either tenant."""
+    from ddl_tpu.obs.fold import fold_job
+
+    reqs = (
+        [(ACME, 0.010 + 0.001 * i, 0.002, False) for i in range(8)]
+        + [(BULK, 5.0 + i, 0.5, False) for i in range(4)]
+        + [({}, 0.5, 0.05, False)]  # untagged -> "default"
+    )
+    _append(tmp_path, "mt", 0, _serve_events(0, reqs))
+    fold = fold_job(tmp_path, "mt", cache=False)
+    stats = fold.serving()
+    assert sorted(stats.tenants) == ["acme", "bulk", "default"]
+    acme = stats.tenants["acme"]
+    bulk = stats.tenants["bulk"]
+    assert acme["class"] == "interactive" and bulk["class"] == "batch"
+    assert acme["requests"] == 8 and bulk["requests"] == 4
+    assert stats.tenants["default"]["requests"] == 1
+    # separation: interactive's whole distribution sits below 0.02s,
+    # batch's above 5s — cross-leaks would drag either p99 across
+    assert acme["acc"]["latency_s"].quantile(0.99) < 0.02
+    assert bulk["acc"]["latency_s"].quantile(0.99) >= 5.0
+
+    cfg = load_slo()  # defaults: interactive p99_latency_s 2.0
+    rep = evaluate_slo(fold, cfg)
+    assert sorted(rep["tenants"]) == ["acme", "bulk", "default"]
+    a_obj = rep["tenants"]["acme"]["objectives"]
+    # every interactive latency sits far under target: zero burn
+    assert a_obj["p99_latency_s"]["burn"] == 0.0
+    assert a_obj["p99_ttft_s"]["burn"] == 0.0
+    assert a_obj["availability"]["burn"] == 0.0
+    # batch p99 target is 30s and its latencies top out near 8s
+    assert rep["tenants"]["bulk"]["objectives"]["p99_latency_s"]["burn"] == 0.0
+    assert rep["alert"] == "ok" and rep["worst_burn"] == 0.0
+    # untagged requests got the default class ("batch") budgets
+    assert rep["tenants"]["default"]["class"] == "batch"
+
+    # the renderer shows every tenant block
+    text = render_slo(rep, "mt")
+    for t in ("acme", "bulk", "default"):
+        assert f"tenant {t} " in text
+
+
+def test_latency_budget_burns_when_tail_crosses_target(tmp_path):
+    """Half of interactive's requests over the 2s p99 target: the
+    over-rate (~0.5) against the 1% budget is a ~50x burn, and the
+    cumulative alert escalates to ticket."""
+    from ddl_tpu.obs.fold import fold_job
+
+    reqs = (
+        [(ACME, 0.01, 0.001, False) for _ in range(4)]
+        + [(ACME, 10.0, 3.0, False) for _ in range(4)]
+    )
+    _append(tmp_path, "burn", 0, _serve_events(0, reqs))
+    fold = fold_job(tmp_path, "burn", cache=False)
+    rep = evaluate_slo(fold, load_slo())
+    obj = rep["tenants"]["acme"]["objectives"]["p99_latency_s"]
+    assert obj["over_rate"] == pytest.approx(0.5)
+    assert obj["burn"] == pytest.approx(50.0)
+    # ttft budget (0.5s) burns too: same 50% over-rate
+    assert rep["tenants"]["acme"]["objectives"]["p99_ttft_s"][
+        "burn"
+    ] == pytest.approx(50.0)
+    assert rep["tenants"]["acme"]["alert"] == "ticket"
+    assert rep["worst_burn"] == pytest.approx(50.0)
+
+
+def test_availability_burn_and_fast_window(tmp_path):
+    """Sheds burn the availability budget: 2 sheds in 8 offered against
+    a 0.9 target is a 2.5x burn; the fast window (newest incarnation's
+    per-repoch split) sees the same rate in a single-epoch job, and a
+    zero-shed tenant burns nothing."""
+    from ddl_tpu.obs.fold import fold_job
+
+    best = {"tenant": "scav", "priority_class": "best_effort"}
+    reqs = (
+        [(best, 0.01, 0.001, False) for _ in range(6)]
+        + [(best, 0.0, 0.0, True) for _ in range(2)]
+        + [(ACME, 0.01, 0.001, False) for _ in range(4)]
+    )
+    _append(tmp_path, "avail", 0, _serve_events(0, reqs))
+    fold = fold_job(tmp_path, "avail", cache=False)
+    rep = evaluate_slo(fold, load_slo())
+    scav = rep["tenants"]["scav"]
+    assert scav["admits"] == 6 and scav["sheds"] == 2
+    obj = scav["objectives"]["availability"]
+    assert obj["shed_rate"] == pytest.approx(0.25)
+    assert obj["availability"] == pytest.approx(0.75)
+    assert obj["burn"] == pytest.approx(0.25 / 0.1)
+    assert obj["fast_burn"] is not None and math.isfinite(obj["fast_burn"])
+    assert rep["tenants"]["acme"]["objectives"]["availability"]["burn"] == 0.0
+
+
+def test_fail_slo_burn_gate_exit_codes(tmp_path, capsys):
+    """The CI gate end to end through the CLI: a shed-heavy run trips
+    ``--fail-slo-burn``, a clean run passes, and a run with no
+    per-tenant signal refuses loudly instead of passing silently."""
+    from ddl_tpu import cli
+
+    clean = [(ACME, 0.01, 0.001, False) for _ in range(6)]
+    shed_heavy = (
+        [(ACME, 0.01, 0.001, False)]
+        + [(ACME, 0.0, 0.0, True) for _ in range(5)]
+    )
+    _append(tmp_path, "clean", 0, _serve_events(0, clean))
+    _append(tmp_path, "shedy", 0, _serve_events(0, shed_heavy))
+    base = tmp_path / "base.json"
+    cli.main([
+        "obs", "baseline", "clean", "--log-dir", str(tmp_path),
+        "--out", str(base),
+    ])
+    capsys.readouterr()
+
+    # clean run within a generous gate: exit 0, OK line
+    cli.main([
+        "obs", "diff", "clean", "--log-dir", str(tmp_path),
+        "--baseline", str(base), "--fail-slo-burn", "2.0",
+    ])
+    assert "OK: worst SLO burn" in capsys.readouterr().out
+
+    # shed-heavy run: 5/6 shed against interactive's 0.1% budget
+    with pytest.raises(SystemExit, match="worst SLO burn"):
+        cli.main([
+            "obs", "diff", "shedy", "--log-dir", str(tmp_path),
+            "--baseline", str(base), "--fail-slo-burn", "2.0",
+        ])
+    capsys.readouterr()
+
+    # no serving data at all: the gate must refuse, not silently pass
+    _append(tmp_path, "noserve", 0, [
+        _ev(0, "run_start", 1.0, family="lm"),
+        _ev(0, "run_end", 2.0, phases={}, anomalies=0),
+    ])
+    with pytest.raises(SystemExit, match="per-tenant serving data"):
+        cli.main([
+            "obs", "diff", "noserve", "--log-dir", str(tmp_path),
+            "--baseline", str(base), "--fail-slo-burn", "2.0",
+        ])
+
+
+def test_slo_cli_renders_and_json(tmp_path, capsys):
+    """``obs slo`` end to end: table and ``--json`` agree on the same
+    evaluation, and a custom --slo file changes the verdict."""
+    from ddl_tpu import cli
+
+    reqs = [(ACME, 0.01, 0.001, False) for _ in range(5)]
+    _append(tmp_path, "cli", 0, _serve_events(0, reqs))
+    cli.main(["obs", "slo", "cli", "--log-dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "== slo — cli ==" in out and "tenant acme [interactive]" in out
+
+    cli.main(["obs", "slo", "cli", "--log-dir", str(tmp_path), "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["alert"] == "ok"
+    assert rep["tenants"]["acme"]["worst_burn"] == 0.0
+
+    # a hostile budget via --slo: every request now violates ttft
+    tight = tmp_path / "tight.json"
+    tight.write_text(json.dumps({
+        "classes": {"interactive": {"p99_ttft_s": 0.0001}},
+    }))
+    cli.main([
+        "obs", "slo", "cli", "--log-dir", str(tmp_path),
+        "--slo", str(tight), "--json",
+    ])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["tenants"]["acme"]["worst_burn"] == pytest.approx(100.0)
+
+
+def test_tenant_goodput_split_in_ledger(tmp_path):
+    """The goodput ledger's per-tenant account: chip-seconds split by
+    tenant, availability from the serve counters, and the dominant
+    badput picker."""
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.goodput import ledger_from_fold, tenant_dominant_badput
+
+    reqs = (
+        [(ACME, 0.4, 0.01, False) for _ in range(4)]
+        + [(BULK, 2.0, 0.1, False) for _ in range(2)]
+        + [(BULK, 0.0, 0.0, True)]
+    )
+    _append(tmp_path, "led", 0, _serve_events(0, reqs))
+    ledger = ledger_from_fold(fold_job(tmp_path, "led", cache=False))
+    tens = ledger["job"]["tenants"]
+    assert sorted(tens) == ["acme", "bulk"]
+    assert tens["acme"]["served_s"] == pytest.approx(1.6)
+    assert tens["bulk"]["served_s"] == pytest.approx(4.0)
+    assert tens["acme"]["availability"] == 1.0
+    assert tens["bulk"]["availability"] == pytest.approx(2 / 3)
+    # bulk's shed is modeled at its own mean served duration (2.0s)
+    assert tens["bulk"]["shed_s"] == pytest.approx(2.0)
+    dom = tenant_dominant_badput(tens["bulk"])
+    assert dom == ("shed", pytest.approx(2.0))
+    assert tenant_dominant_badput(
+        {"queued_s": 0.0, "shed_s": 0.0}
+    ) is None

@@ -205,7 +205,11 @@ def read_cursor(
     snapshots, manifest-less legacy ones, unreadable manifests).  Read
     from the manifest, not the Orbax tree: the cursor describes the
     HOST-side data stream and must be readable without touching array
-    bytes."""
+    bytes.  Besides ``period``/``offset``/``step``, the LM cursor may
+    carry ``shuffle_epoch``/``epoch_pos`` — the corpus reshuffle state
+    that ``TokenBatches.anchor_resume`` pins so an elastic N-1 relaunch
+    (whose shard layout changed the per-epoch length) continues the
+    same shuffle trajectory instead of rewinding its epoch clock."""
     manifest = snapshot_path(checkpoint_dir, job_id, epoch) / MANIFEST_NAME
     try:
         cursor = json.loads(manifest.read_text()).get("cursor")

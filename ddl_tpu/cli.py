@@ -30,6 +30,21 @@ the same restart epoch, restoring the rank-0-agreed snapshot
     python -m ddl_tpu.cli train --supervise --pod /nas/job1/coord \
         --hosts 4 --host-id $DDL_PROCESS_ID --preset dp ...
 
+``--elastic`` upgrades pod mode from all-or-nothing to
+continue-on-N−1: a host whose supervisor dies outright (heartbeat
+silent past the eviction grace, or absent from a restart epoch's join
+barrier) is evicted instead of aborting the pod — the survivors agree
+a shrunken membership through the restart-epoch ledger and relaunch on
+a respecced data axis (``DDL_NUM_PROCESSES``/``DDL_PROCESS_ID``
+renumber survivors; the resumed cursor re-splits so no batch is lost
+or replayed).  Set ``DDL_COMPILE_CACHE`` (or rely on the pod-agreed
+default under the coord dir) to make every relaunch warm: a
+persistent, topology-keyed XLA compile cache that the ``restart_latency``
+and ``recompile`` goodput buckets gate via ``obs diff``:
+
+    python -m ddl_tpu.cli train --supervise --pod /nas/job1/coord \
+        --hosts 4 --host-id $DDL_PROCESS_ID --elastic --preset dp ...
+
 (the leading ``train`` subcommand is optional and accepted for symmetry
 with ``obs``).  Run inspection over the structured event streams every
 trainer writes (``ddl_tpu/obs/``) lives under the ``obs`` subcommand:
@@ -188,6 +203,9 @@ def main(argv=None) -> None:
     sup.add_argument("--pod", metavar="DIR", default=None)
     sup.add_argument("--hosts", type=int, default=None)
     sup.add_argument("--host-id", type=int, default=None)
+    # elastic pod mode: continue on N-1 survivors when a host is lost
+    # permanently, instead of aborting the whole pod
+    sup.add_argument("--elastic", action="store_true")
     sup_args, rest = sup.parse_known_args(argv)
     if sup_args.max_restarts is not None and not sup_args.supervise:
         # loud, not silently dropped: the user believes crash-relaunch
@@ -202,6 +220,10 @@ def main(argv=None) -> None:
         # each restart alone and hang at the first collective — the
         # exact failure pod mode exists to prevent
         raise SystemExit("--hosts/--host-id require --pod")
+    if sup_args.elastic and sup_args.pod is None:
+        # loud, not silently dropped: single-host supervision has no
+        # membership to shrink
+        raise SystemExit("--elastic requires --pod")
     if sup_args.supervise:
         max_restarts = (
             5 if sup_args.max_restarts is None else sup_args.max_restarts
@@ -227,6 +249,7 @@ def main(argv=None) -> None:
                 supervise_pod_command(
                     child_argv, sup_args.pod, host, n_hosts,
                     max_restarts=max_restarts,
+                    elastic=sup_args.elastic,
                 )
             )
         from ddl_tpu.supervisor import supervise_command

@@ -70,6 +70,8 @@ __all__ = [
     "Rendezvous",
     "acquire_launch",
     "active_launch_root",
+    "agreed_resume_epoch",
+    "agreed_rollback_epoch",
     "from_env",
     "publish_exit_intent_from_env",
 ]
@@ -81,8 +83,18 @@ ENV_HOSTS = "DDL_COORD_HOSTS"
 ENV_HOST = "DDL_COORD_HOST"
 ENV_EPOCH = "DDL_RESTART_EPOCH"
 ENV_TIMEOUT = "DDL_COORD_TIMEOUT_S"
+# Comma-separated live host ids after an elastic scale-down (e.g.
+# "0,2").  ENV_HOSTS stays the ORIGINAL pod size and ENV_HOST the
+# original host id — membership shrinks, identities do not renumber —
+# so host ids in barriers/heartbeats/intents stay stable across
+# evictions.
+ENV_MEMBERS = "DDL_COORD_MEMBERS"
+# How stale an OPEN launch's markers may be before acquire_launch
+# refuses to join it (seconds; see _launch_stale).
+ENV_LAUNCH_STALE = "DDL_LAUNCH_STALE_S"
 
 DEFAULT_TIMEOUT_S = 300.0
+DEFAULT_LAUNCH_STALE_S = 600.0
 
 
 class BarrierTimeout(RuntimeError):
@@ -136,12 +148,28 @@ class Rendezvous:
         poll_s: float = 0.05,
         sleep=time.sleep,
         clock=time.time,
+        members=None,
     ) -> None:
         if not 0 <= host < n_hosts:
             raise ValueError(f"host {host} out of range for {n_hosts}")
         self.root = Path(root)
         self.host = int(host)
         self.n_hosts = int(n_hosts)
+        # live membership (elastic scale-down): host ids still in the
+        # pod.  ``n_hosts`` stays the ORIGINAL pod size — ids never
+        # renumber — while barriers/peers/agreement run over members
+        # only.  Default: everyone.
+        if members is None:
+            members = range(n_hosts)
+        self.members = tuple(sorted({int(m) for m in members}))
+        if self.host not in self.members:
+            raise ValueError(
+                f"host {host} not in membership {self.members}"
+            )
+        if any(not 0 <= m < n_hosts for m in self.members):
+            raise ValueError(
+                f"membership {self.members} out of range for {n_hosts}"
+            )
         self.timeout_s = float(timeout_s)
         self.poll_s = float(poll_s)
         # wall clock, not monotonic: heartbeat ages are compared across
@@ -155,6 +183,35 @@ class Rendezvous:
         # monotonic wait duration into wall time
         self.last_arrive_ts: float | None = None
         self.root.mkdir(parents=True, exist_ok=True)
+
+    # --------------------------------------------------------- membership
+
+    @property
+    def world(self) -> int:
+        """Live pod size — the data-axis world after any scale-down."""
+        return len(self.members)
+
+    @property
+    def leader(self) -> int:
+        """The agreement publisher: the lowest LIVE host id, so rank-0
+        duties survive rank 0's own eviction."""
+        return self.members[0]
+
+    def adopt_membership(self, hosts) -> None:
+        """Shrink (or restate) the live membership — called after an
+        epoch record carrying an agreed ``hosts`` set wins the ledger
+        race.  Raises if this host is not among the survivors (its
+        supervisor must exit, not relaunch)."""
+        members = tuple(sorted({int(h) for h in hosts}))
+        if self.host not in members:
+            raise ValueError(
+                f"host {self.host} evicted by membership {members}"
+            )
+        if any(not 0 <= m < self.n_hosts for m in members):
+            raise ValueError(
+                f"membership {members} out of range for {self.n_hosts}"
+            )
+        self.members = members
 
     # ------------------------------------------------------------ liveness
 
@@ -172,8 +229,10 @@ class Rendezvous:
         )
 
     def peers(self) -> dict[int, dict]:
-        """Other hosts' latest heartbeats, keyed by host id, each with an
-        ``age`` (seconds since the writer stamped it)."""
+        """Other LIVE hosts' latest heartbeats, keyed by host id, each
+        with an ``age`` (seconds since the writer stamped it).  Evicted
+        hosts' leftover heartbeat files are invisible — a scaled-down
+        pod must not keep re-judging its casualty."""
         out: dict[int, dict] = {}
         hosts_dir = self.root / "hosts"
         if not hosts_dir.is_dir():
@@ -182,6 +241,8 @@ class Rendezvous:
         for p in hosts_dir.iterdir():
             rec = _read_json(p)
             if rec is None or rec.get("host") == self.host:
+                continue
+            if int(rec.get("host", -1)) not in self.members:
                 continue
             rec["age"] = now - float(rec.get("ts", 0.0))
             out[int(rec["host"])] = rec
@@ -259,6 +320,7 @@ class Rendezvous:
         preempt: bool,
         rc: int = 1,
         delay_fn=None,
+        hosts=None,
     ) -> dict:
         """First-writer-wins proposal of restart epoch ``cur_epoch + 1``.
 
@@ -269,7 +331,15 @@ class Rendezvous:
         computed once by the proposer — N hosts must not each draw their
         own jitter).  Losers adopt the winner's record unchanged, even if
         they raced with a different reason: one restart event, one
-        classification."""
+        classification.
+
+        ``hosts`` (elastic scale-down) proposes a SHRUNKEN membership:
+        the record carries the agreed live host set and world size, and
+        because the record is atomically created, the membership
+        agreement rides the same first-writer-wins ledger — no second
+        agreement round, no split-brain window between "which epoch" and
+        "who is still in it".  Omitted, the proposer's current
+        membership is recorded (a plain same-world restart)."""
         nxt = int(cur_epoch) + 1
         prev = self.epoch_record(cur_epoch) if cur_epoch else None
         crashes = (prev or {}).get("crashes", 0) + (1 if crash else 0)
@@ -277,6 +347,10 @@ class Rendezvous:
             1 if preempt else 0
         )
         delay = float(delay_fn(crashes) if (crash and delay_fn) else 0.0)
+        members = (
+            sorted({int(h) for h in hosts}) if hosts is not None
+            else list(self.members)
+        )
         record = {
             "ts": self.clock(),
             "epoch": nxt,
@@ -290,6 +364,8 @@ class Rendezvous:
             "crashes": int(crashes),
             "preemptions": int(preemptions),
             "delay": delay,
+            "hosts": members,
+            "world": len(members),
         }
         path = self._epoch_path(nxt)
         path.parent.mkdir(parents=True, exist_ok=True)
@@ -338,20 +414,44 @@ class Rendezvous:
             self.timeout_s if timeout_s is None else timeout_s
         )
         while True:
-            present = len(list(d.glob("h*")))
-            if present >= self.n_hosts:
+            missing = self._missing_members(d)
+            if not missing:
                 return self.clock()
             ab = self.aborted()
             if ab is not None:
                 raise PodAborted(ab)
             if self.clock() > deadline:
                 raise BarrierTimeout(
-                    f"barrier {name!r}: {present}/{self.n_hosts} hosts "
-                    f"after {self.timeout_s if timeout_s is None else timeout_s:.0f}s"
+                    f"barrier {name!r}: "
+                    f"{len(self.members) - len(missing)}/{len(self.members)}"
+                    " hosts after "
+                    f"{self.timeout_s if timeout_s is None else timeout_s:.0f}s"
+                    f" (missing {missing})"
                 )
             if on_wait is not None:
                 on_wait()
             self.sleep(self.poll_s)
+
+    def _missing_members(self, barrier_dir: Path) -> list[int]:
+        """Live members with no arrival marker yet.  Presence is judged
+        per member id (not a count): an evicted host's stale marker in a
+        reused barrier name must neither complete a barrier early nor
+        block one."""
+        return [
+            m for m in self.members
+            if not (barrier_dir / f"h{m:03d}").exists()
+        ]
+
+    def barrier_arrivals(self, name: str) -> list[int]:
+        """Host ids with an arrival marker at ``name`` (members only) —
+        what an elastic supervisor scales down to when the join barrier
+        times out on a host whose supervisor died outright."""
+        d = self.root / "barriers" / name
+        if not d.is_dir():
+            return []
+        return [
+            m for m in self.members if (d / f"h{m:03d}").exists()
+        ]
 
     def arrive(self, name: str) -> None:
         """Mark arrival at a barrier WITHOUT waiting (callers that must
@@ -364,17 +464,18 @@ class Rendezvous:
 
     def barrier_complete(self, name: str) -> bool:
         d = self.root / "barriers" / name
-        return d.is_dir() and len(list(d.glob("h*"))) >= self.n_hosts
+        return d.is_dir() and not self._missing_members(d)
 
     # ----------------------------------------------- rank-0 value agreement
 
     def agree(self, key: str, compute_fn, timeout_s: float | None = None):
-        """Rank-0 computes and publishes a value; every other host waits
+        """The LEADER (lowest live host id — rank 0 until rank 0 is
+        evicted) computes and publishes a value; every other host waits
         for it and returns the same value.  The single-decider shape that
         keeps a torn NAS view (hosts disagreeing on ``latest_valid_epoch``)
         from restoring different snapshots on different hosts."""
         path = self.root / "agree" / f"{key}.json"
-        if self.host == 0:
+        if self.host == self.leader:
             value = compute_fn()
             _write_json(path, {"ts": self.clock(), "value": value})
             return value
@@ -445,8 +546,34 @@ def _launch_closed(root: Path) -> bool:
     return (root / "finished.json").is_file() or (root / "abort.json").is_file()
 
 
+def _launch_stale(root: Path, stale_after_s: float) -> bool:
+    """An OPEN launch whose markers have all gone silent: every
+    heartbeat's writer-stamped ts (and the creation stamp) is older than
+    ``stale_after_s``.  Such a launch is a dead pod's leftover — its
+    supervisors crashed without closing it — and joining it would trust
+    fully-arrived barriers no live peer will ever re-cross (the same
+    hang ``acquire_launch`` scoping defused for CLOSED launches).  A
+    launch with no markers at all is a peer mid-creation, not stale."""
+    newest = None
+    for p in (root / "hosts").glob("h*.json") if (
+        root / "hosts"
+    ).is_dir() else ():
+        rec = _read_json(p)
+        if rec is not None:
+            ts = float(rec.get("ts", 0.0))
+            newest = ts if newest is None else max(newest, ts)
+    if newest is None:
+        rec = _read_json(root / "launch.json")
+        if rec is None:
+            return False  # nothing written yet: a fresh launch, joinable
+        newest = float(rec.get("ts", 0.0))
+    return (time.time() - newest) > stale_after_s
+
+
 def acquire_launch(
-    pod_dir: str | os.PathLike, token: str | None = None
+    pod_dir: str | os.PathLike,
+    token: str | None = None,
+    stale_after_s: float | None = None,
 ) -> Path:
     """The rendezvous root for THIS launch: a token subdir under
     ``<pod_dir>/launches/``, so one ``--pod`` directory can serve
@@ -465,9 +592,24 @@ def acquire_launch(
     hosts and fresh per launch) the subdir is exactly that token.
     Otherwise hosts agree leaderlessly: join the highest-numbered launch
     that is not yet closed, else atomically ``mkdir`` the next number —
-    losers of the create race re-read and join the winner's."""
+    losers of the create race re-read and join the winner's.
+
+    An UNFINISHED launch is only joinable while its markers are alive:
+    heartbeat ages are re-validated first (``stale_after_s``, default
+    ``DDL_LAUNCH_STALE_S`` env or 10 minutes), so a host restarted after
+    every supervisor of a crashed pod is long gone opens a fresh launch
+    (numbered path) or errors loudly (explicit token) instead of sailing
+    into the dead pod's rendezvous state."""
     launches = Path(pod_dir) / "launches"
     launches.mkdir(parents=True, exist_ok=True)
+    if stale_after_s is None:
+        try:
+            stale_after_s = float(
+                os.environ.get(ENV_LAUNCH_STALE)
+                or DEFAULT_LAUNCH_STALE_S
+            )
+        except ValueError:
+            stale_after_s = DEFAULT_LAUNCH_STALE_S
     if token:
         d = launches / f"t-{token}"
         if _launch_closed(d):
@@ -477,6 +619,14 @@ def acquire_launch(
                 f"launch token {token!r} names a finished/aborted launch "
                 f"({d}) — DDL_LAUNCH_TOKEN must be fresh per launch; "
                 "refusing to rejoin a closed run's rendezvous state"
+            )
+        if d.is_dir() and _launch_stale(d, stale_after_s):
+            raise RuntimeError(
+                f"launch token {token!r} names an open launch ({d}) whose "
+                f"markers have been silent > {stale_after_s:.0f}s — the "
+                "pod that owned it is gone.  Use a fresh DDL_LAUNCH_TOKEN "
+                "(or raise DDL_LAUNCH_STALE_S if the pod is merely slow); "
+                "refusing to trust a dead launch's barriers"
             )
         d.mkdir(exist_ok=True)
         return d
@@ -488,7 +638,9 @@ def acquire_launch(
         cur = nums[-1] if nums else 0
         if cur:
             d = launches / f"L{cur:04d}"
-            if not _launch_closed(d):
+            if not _launch_closed(d) and not _launch_stale(
+                d, stale_after_s
+            ):
                 return d
         nxt = launches / f"L{cur + 1:04d}"
         try:
@@ -522,14 +674,25 @@ def active_launch_root(pod_dir: str | os.PathLike) -> Path | None:
 def from_env(env=os.environ) -> Rendezvous | None:
     """The rendezvous this process belongs to, or None outside pod mode.
     ``supervise_pod_command`` sets the env for both the supervisor's own
-    helpers and the trainer child it spawns."""
+    helpers and the trainer child it spawns.  ``DDL_COORD_MEMBERS``
+    (set after an elastic scale-down) restricts barriers/agreement to
+    the surviving hosts while ids keep their original numbering."""
     root = env.get(ENV_DIR)
     if not root:
         return None
     n_hosts = int(env.get(ENV_HOSTS) or 1)
     host = int(env.get(ENV_HOST) or env.get("DDL_HOST_ID") or 0)
     timeout = float(env.get(ENV_TIMEOUT) or DEFAULT_TIMEOUT_S)
-    return Rendezvous(root, host, n_hosts, timeout_s=timeout)
+    members = None
+    raw = env.get(ENV_MEMBERS)
+    if raw:
+        try:
+            members = [int(x) for x in raw.split(",") if x.strip() != ""]
+        except ValueError:
+            members = None  # malformed: fall back to full membership
+    return Rendezvous(
+        root, host, n_hosts, timeout_s=timeout, members=members
+    )
 
 
 def restart_epoch(env=os.environ) -> int:
@@ -569,7 +732,24 @@ def agreed_resume_epoch(job_id: str, compute_fn):
     snapshot store.  Falls back to the local computation outside pod mode
     or on a single-host pod."""
     rv = from_env()
-    if rv is None or rv.n_hosts < 2:
+    if rv is None or rv.world < 2:
         return compute_fn()
     key = f"resume-{job_id}-e{restart_epoch()}"
+    return rv.agree(key, compute_fn)
+
+
+def agreed_rollback_epoch(job_id: str, compute_fn, seq: int):
+    """Pod-consistent IN-LOOP rollback target (the NaN-recovery path):
+    the leader computes which snapshot to roll back to and publishes it;
+    every host restores the same one.  The same single-decider shape as
+    ``agreed_resume_epoch``, but rollback can fire repeatedly within one
+    incarnation and ``agree`` keys are write-once — so the key carries a
+    per-process rollback sequence number.  ``seq`` is identical across
+    hosts because the rollback decision is SPMD: every host sees the
+    same non-finite loss at the same step, so their counters advance in
+    lock-step.  Falls back to the local computation outside pod mode."""
+    rv = from_env()
+    if rv is None or rv.world < 2:
+        return compute_fn()
+    key = f"rollback-{job_id}-e{restart_epoch()}-{int(seq)}"
     return rv.agree(key, compute_fn)

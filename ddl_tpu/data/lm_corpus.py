@@ -123,6 +123,12 @@ class TokenBatches:
     ) -> None:
         self.corpus = corpus
         self.batch = batch
+        # (step, shuffle_epoch, epoch_pos) resume anchor, or None.  Set
+        # by anchor_resume() when a snapshot cursor carries shuffle
+        # state; realigns the step -> (epoch, pos) mapping so a resumed
+        # run continues the SAME shuffle trajectory even when the shard
+        # layout (and hence len(self)) changed across the restart.
+        self._anchor: tuple[int, int, int] | None = None
         self.sampler = ShardedEpochSampler(
             len(corpus), num_shards, shard_rank, shuffle=shuffle,
             drop_last=True, seed=seed,
@@ -155,12 +161,43 @@ class TokenBatches:
             self._idxs = self.sampler.indices()
         return self._idxs
 
+    def locate(self, step: int) -> tuple[int, int]:
+        """The (shuffle_epoch, epoch_pos) global *training step* ``step``
+        maps to: a pure ``divmod(step, len(self))``, unless a resume
+        anchor is set — then the offset from the anchor step, so the
+        shuffle-epoch trajectory survives restarts whose shard layout
+        changed ``len(self)`` (e.g. an elastic N-1 respec: the epoch
+        permutation reseeds from the PERSISTED epoch, not from a divmod
+        against the new epoch length)."""
+        if self._anchor is not None:
+            a_step, a_epoch, a_pos = self._anchor
+            off = a_pos + (step - a_step)
+            return a_epoch + off // len(self), off % len(self)
+        return divmod(step, len(self))
+
+    def cursor_state(self, step: int) -> dict:
+        """Shuffle state to persist in the snapshot data cursor at
+        ``step`` — what anchor_resume() needs to continue the epoch
+        reshuffle sequence exactly, beyond one corpus pass."""
+        epoch, pos = self.locate(step)
+        return {"shuffle_epoch": epoch, "epoch_pos": pos}
+
+    def anchor_resume(
+        self, step: int, shuffle_epoch: int, epoch_pos: int
+    ) -> None:
+        """Pin the mapping so ``step`` lands on the persisted
+        (shuffle_epoch, epoch_pos) and later steps advance from there.
+        Called on snapshot resume/rollback with the restored cursor's
+        shuffle state."""
+        self._anchor = (int(step), int(shuffle_epoch), int(epoch_pos))
+        self.set_epoch(int(shuffle_epoch))
+
     def batch_at(self, step: int):
-        """Deterministic batch for global *training step* ``step``: epoch
-        ``step // len(self)``, position ``step % len(self)``.  Because the
-        mapping is pure in ``step``, a resumed run continues the token
+        """Deterministic batch for global *training step* ``step`` (see
+        ``locate``).  Because the mapping is pure in ``step`` (relative
+        to the resume anchor, if any), a resumed run continues the token
         stream exactly where the interrupted run left it."""
-        epoch, pos = divmod(step, len(self))
+        epoch, pos = self.locate(step)
         self.set_epoch(epoch)
         idxs = self._indices()
         return self._materialize(idxs[pos * self.batch : (pos + 1) * self.batch])

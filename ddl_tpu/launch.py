@@ -129,6 +129,7 @@ def bootstrap(
     elif os.environ.get("DDL_MULTIHOST") == "1":
         initialize = lambda: jax.distributed.initialize()  # noqa: E731
     else:
+        _arm_compile_cache()
         return
 
     from ddl_tpu.utils.backoff import Backoff, retry_with_backoff
@@ -149,6 +150,30 @@ def bootstrap(
         backoff=Backoff(base=2.0, factor=2.0, max_delay=60.0, jitter=0.5),
         on_retry=note,
     )
+    _arm_compile_cache()
+
+
+def _arm_compile_cache() -> None:
+    """Warm restarts: arm the persistent, topology-keyed XLA compile
+    cache (``utils/compile_cache``) on the launch path — opt-in via
+    ``DDL_COMPILE_CACHE`` or pod mode (the rendezvous leader publishes
+    one shared NAS cache root for every host).  Runs AFTER distributed
+    init so the topology key sees the full world; failures degrade to a
+    cold compile, never a failed launch."""
+    from ddl_tpu import coord
+    from ddl_tpu.utils.compile_cache import activate_compile_cache
+
+    try:
+        stats = activate_compile_cache(rv=coord.from_env())
+    except Exception as e:  # ddl-lint: disable=broad-except
+        print(f"[ddl_tpu] compile cache unavailable ({e})")
+        return
+    if stats is not None:
+        state = "warm" if stats["warm"] else "cold"
+        print(
+            f"[ddl_tpu] compile cache {state}: {stats['dir']} "
+            f"({stats['entries_before']} entries)"
+        )
 
 
 def world_info() -> dict:

@@ -94,8 +94,21 @@ EVENT_KINDS = (
     "snapshot_restore",
     # supervisor.py restart lifecycle
     "supervisor_start", "supervisor_relaunch", "supervisor_done",
-    # pod-level coordinated recovery (coord.py + PodSupervisor)
-    "coord_barrier", "peer_stale", "pod_restart",
+    # pod-level coordinated recovery (coord.py + PodSupervisor);
+    # peer_lost is the elastic eviction decision — a peer silent past
+    # the eviction grace (or absent from a join barrier), answered by a
+    # shrunken-membership restart epoch instead of a pod abort
+    "coord_barrier", "peer_stale", "peer_lost", "pod_restart",
+    # warm restarts (utils/compile_cache.py): one event per incarnation
+    # recording where the persistent topology-keyed XLA cache points and
+    # whether it started warm (entries_before > 0) plus hit/miss
+    # counters — read next to restart_latency and the recompile goodput
+    # bucket by the warm-relaunch drill
+    "compile_cache",
+    # serve/engine.py preempt-drain: admission closed, queued requests
+    # shed tenant-tagged, in-flight lanes finishing — the multi-tenant
+    # SLO gates see a drain, not a cliff
+    "serve_drain",
     # relaunch-decision -> child-first-step wall time, emitted by
     # StepTrace on a relaunched child's first completed step (the
     # supervisor stamps DDL_RELAUNCH_TS); gateable via `obs diff

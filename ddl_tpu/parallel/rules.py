@@ -35,6 +35,13 @@ dimension divisible by the ``data``-axis size and shard the *optimizer
 state and weight update* over it (the cross-replica weight-update
 sharding of PAPERS.md's "Automatic Cross-Replica Sharding" paper —
 ``train/fused_optim.py`` consumes it).
+
+Because placement is a pure function of the parameter path — never of
+the mesh extent — the tables are what make elastic pod scale-down a
+*derivable* respec: a relaunch on N-1 hosts re-enters the same table
+with a smaller ``data`` axis (``DDL_NUM_PROCESSES`` from the agreed
+membership, see ``supervisor.py``) and every parameter lands in the
+same logical position; only the data-parallel extent shrinks.
 """
 
 from __future__ import annotations

@@ -637,6 +637,7 @@ class ServeEngine:
         trace_sample: int | None = None,
         obs=None,
         trace_requests: bool = True,
+        guard=None,
         devices=None,
         mesh=None,
     ) -> None:
@@ -729,6 +730,15 @@ class ServeEngine:
             "prefill_tokens": 0, "prefill_chunks": 0, "cow_copies": 0,
         }
         self._compiled_buckets: set[int] = set()
+        # preempt-drain: ``guard`` is a utils/preemption.PreemptionGuard
+        # (or anything with ``.requested``) polled at every step() — the
+        # supervisor's SIGTERM flips it, and the engine answers by
+        # draining (admission closed, queued requests shed tenant-
+        # tagged, in-flight lanes finishing) instead of dying
+        # mid-dispatch.  None = drain only on an explicit drain() call.
+        self.guard = guard
+        self.draining = False
+        self.drain_reason: str | None = None
 
     # -- submission -------------------------------------------------------
     def submit(
@@ -763,9 +773,15 @@ class ServeEngine:
             priority_class=str(priority_class) if priority_class else None,
         )
         self.stats["submitted"] += 1
-        outcome = self.admission.offer(
-            req, fits_ever=self.scheduler.fits_ever(req)
-        )
+        if self.draining:
+            # admission is closed: shed at the door (tenant-tagged, so
+            # the per-class SLO accounting sees WHO the drain cost)
+            self.admission.shed_request(req, "draining")
+            outcome = "rejected"
+        else:
+            outcome = self.admission.offer(
+                req, fits_ever=self.scheduler.fits_ever(req)
+            )
         if outcome == "rejected":
             self.stats["shed"] += 1
         return outcome
@@ -1245,14 +1261,67 @@ class ServeEngine:
             if s.done:
                 s.finished_at = now
 
+    def drain(self, reason: str = "preempt", park: bool = False) -> dict:
+        """Close admission and shed everything queued (tenant-tagged
+        ``serve_shed`` events, reason ``"drained"``); in-flight lanes
+        keep decoding to completion through subsequent ``step()`` calls
+        — the drain is a taper, not a cliff.  ``park=True`` is the hard
+        stop for a deadline the taper cannot meet: every unfinished
+        lane is retired NOW (blocks recycled, no torn refcounts), its
+        partial outputs recorded under outcome ``parked:<reason>`` so a
+        resubmission can skip what was already generated.  Idempotent;
+        emits one ``serve_drain`` event with the shed/parked counts."""
+        if self.draining and not park:
+            return {"shed": 0, "parked": 0}
+        first = not self.draining
+        self.draining = True
+        self.drain_reason = self.drain_reason or reason
+        shed = 0
+        while self.admission.queue:
+            self.admission.shed_request(self.admission.pop(), "drained")
+            self.stats["shed"] += 1
+            shed += 1
+        parked = 0
+        if park:
+            # finished lanes retire through the normal path first (full
+            # decode record + completed count); only genuinely
+            # unfinished lanes park
+            self._retire_finished()
+            for state in self.scheduler.park_all():
+                if state.request.id in self.results:
+                    continue  # finished lane: retired with its result
+                self.results[state.request.id] = np.asarray(
+                    state.outputs, np.int32
+                )
+                self.outcomes[state.request.id] = f"parked:{reason}"
+                parked += 1
+        if self.obs is not None and (first or parked):
+            self.obs.emit(
+                "serve_drain",
+                reason=reason,
+                shed=shed,
+                parked=parked,
+                active_lanes=len(self.scheduler.active()),
+            )
+        return {"shed": shed, "parked": parked}
+
     def step(self) -> bool:
         """One scheduler iteration; False when fully drained.  Order:
         retire -> admit -> ONE prefill chunk -> one batched decode
         dispatch — chunked prefills and decode interleave, so a long
         prompt stalls the decode batch for at most one bounded chunk
-        per iteration instead of its whole prefill."""
+        per iteration instead of its whole prefill.  When the
+        preemption guard trips (or ``drain()`` was called), admission
+        stops and the in-flight lanes finish instead of the engine
+        dying mid-dispatch."""
+        if (
+            not self.draining
+            and self.guard is not None
+            and getattr(self.guard, "requested", False)
+        ):
+            self.drain("preempt")
         self._retire_finished()
-        while self.admission.queue:
+        while not self.draining and self.admission.queue:
             head = self.admission.peek()
             # ONE chain-hash lookup per head per iteration, threaded
             # through fits/can_admit/admit (hashing a parked 32k prompt

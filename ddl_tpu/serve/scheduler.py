@@ -316,6 +316,14 @@ class ContinuousScheduler:
         self.lanes[lane] = None
         return state
 
+    def park_all(self) -> list[LaneState]:
+        """Retire every active lane NOW — the drain's hard stop.  Blocks
+        are recycled through the normal ``retire`` path (refcounts and
+        the prefix index stay coherent), and the states come back with
+        whatever outputs they produced so the engine can record them as
+        parked rather than silently dropped."""
+        return [self.retire(s.lane) for s in self.active()]
+
     def active(self) -> list[LaneState]:
         return [l for l in self.lanes if l is not None]
 

@@ -62,6 +62,20 @@ a join barrier so every host kills and relaunches together, stale-peer
 detection (a host whose heartbeat ages out while "running" triggers a
 pod restart instead of an eternal collective hang), and a pod-wide
 abort marker so giving up is also a coordinated event.
+
+**Elastic mode** (``elastic=True``, CLI ``--elastic``): permanent host
+loss no longer kills the pod.  A peer whose heartbeat ages past
+``stale_after_s + elastic_grace_s`` — or that never reaches a restart
+epoch's join barrier — is *evicted*: the survivors propose a shrunken
+membership through the same first-writer-wins epoch ledger (the record
+carries ``hosts``/``world``), adopt it, and relaunch N−1 children with
+``DDL_COORD_MEMBERS`` plus a respecced SPMD bootstrap
+(``DDL_NUM_PROCESSES``/``DDL_PROCESS_ID`` renumber the survivors
+contiguously).  The relaunched trainers re-derive the data axis from
+the smaller world (``parallel/rules.py``), resume the rank-0-agreed
+snapshot, and re-split the exact-resume cursor across survivors — no
+batch lost or replayed.  A host that finds itself evicted by an
+adopted record exits cleanly instead of aborting the pod.
 """
 
 from __future__ import annotations
@@ -422,6 +436,8 @@ class PodSupervisor:
         signal_poll_s: float | None = None,
         heartbeat_s: float = 1.0,
         stale_after_s: float = 30.0,
+        elastic: bool = False,
+        elastic_grace_s: float | None = None,
         sleep: Callable[[float], None] = time.sleep,
         clock: Callable[[], float] = time.monotonic,
         log: Callable[[str], None] = print,
@@ -431,6 +447,19 @@ class PodSupervisor:
         self.rv = rv
         self.max_restarts = max_restarts
         self.max_preemptions = max_preemptions
+        # elastic scale-down: a peer silent past stale_after_s gets an
+        # extra grace window to come back before the pod agrees it is
+        # PERMANENTLY gone and continues on the survivors; non-elastic
+        # pods keep the all-or-nothing protocol (stale peer -> pod
+        # restart, absent peer at the join barrier -> abort)
+        self.elastic = elastic
+        self.elastic_grace_s = (
+            2.0 * stale_after_s if elastic_grace_s is None
+            else float(elastic_grace_s)
+        )
+        # (epoch, host) pairs already logged as stale-within-grace, so
+        # the hold-the-grace decision is announced once, not per poll
+        self._grace_noted: set = set()
         self.backoff = backoff if backoff is not None else Backoff(
             base=1.0, factor=2.0, max_delay=120.0, jitter=0.5
         )
@@ -481,6 +510,36 @@ class PodSupervisor:
             return ("peer_intent", intents[0])
         if self.stale_after_s:
             stale = rv.stale_peers(self.stale_after_s)
+            if stale and self.elastic:
+                # elastic: a stale peer gets elastic_grace_s to come
+                # back before eviction.  Restarting the pod meanwhile
+                # would not help — staleness means the peer's SUPERVISOR
+                # is silent, so it could not rejoin a restart anyway.
+                lost = rv.stale_peers(
+                    self.stale_after_s + self.elastic_grace_s
+                )
+                if lost:
+                    self._emit("peer_lost", lost_hosts=lost, epoch=epoch)
+                    self._log(
+                        f"peer(s) {lost} silent past the eviction grace "
+                        f"(> {self.stale_after_s + self.elastic_grace_s:.0f}s"
+                        "); continuing on the survivors"
+                    )
+                    return ("peer_lost", lost)
+                for h in stale:
+                    if (epoch, h) not in self._grace_noted:
+                        self._grace_noted.add((epoch, h))
+                        self._emit(
+                            "peer_stale", stale_host=h, epoch=epoch,
+                            in_grace=True,
+                        )
+                        self._log(
+                            f"peer h{h} heartbeat aged out "
+                            f"(> {self.stale_after_s:.0f}s); holding "
+                            f"{self.elastic_grace_s:.0f}s eviction grace "
+                            "before scaling down"
+                        )
+                return None
             if stale:
                 self._emit("peer_stale", stale_host=stale[0], epoch=epoch)
                 self._log(
@@ -620,6 +679,7 @@ class PodSupervisor:
                 return self._finish_abort(detail)
 
             # ---- coordinate a pod-wide restart -------------------------
+            survivors = None  # elastic: a shrunken membership to propose
             if kind == "exit":
                 rc = int(detail)
                 crash = rc not in (0, EXIT_PREEMPTED)
@@ -639,6 +699,17 @@ class PodSupervisor:
                 preempt = rc == EXIT_PREEMPTED
                 reason = f"peer_{detail.get('reason', 'exit')}"
                 self._reap(child)
+            elif kind == "peer_lost":
+                # elastic eviction: propose the next epoch WITHOUT the
+                # lost hosts — the atomically-created record IS the
+                # membership agreement (coord.propose_restart)
+                rc = EXIT_PREEMPTED
+                crash = False
+                preempt = True
+                reason = "peer_lost"
+                gone = set(detail)
+                survivors = [m for m in rv.members if m not in gone]
+                self._reap(child)
             else:
                 rc = EXIT_PREEMPTED
                 crash = False
@@ -655,77 +726,136 @@ class PodSupervisor:
                     rec = rv.propose_restart(
                         epoch, reason, crash, preempt, rc=rc,
                         delay_fn=lambda c: self.backoff.delay(c - 1),
+                        hosts=survivors,
                     )
                 except BarrierTimeout as e:
                     ab = rv.abort(f"h{rv.host}: {e}", 1)
                     return self._finish_abort(ab)
-            if rec["crashes"] > self.max_restarts:
-                # the abort rc comes from the RECORD, not this host's
-                # local view: a bystander that adopted a peer's proposal
-                # must still surface the crashing child's exit code
-                ab = rv.abort(
-                    f"crash budget exhausted "
-                    f"({rec['crashes']} > {self.max_restarts})",
-                    int(rec.get("rc", rc)) if rec.get("crash") else 1,
-                )
-                return self._finish_abort(ab)
-            if rec["preemptions"] > self.max_preemptions:
-                ab = rv.abort(
-                    f"resumable-exit budget exhausted "
-                    f"({rec['preemptions']} > {self.max_preemptions})",
-                    EXIT_PREEMPTED,
-                )
-                return self._finish_abort(ab)
-            self._emit(
-                "pod_restart",
-                epoch=rec["epoch"],
-                reason=rec["reason"],
-                proposer=rec["proposer"],
-                crashes=rec["crashes"],
-                preemptions=rec["preemptions"],
-                delay=rec["delay"],
-                # the pod-wide decision instant (epoch-record proposal
-                # stamp) — the flow-arrow origin the incident trace
-                # draws to every host's join-barrier span
-                decision_ts=rec.get("ts"),
-            )
-            self._log(
-                f"joining restart epoch {rec['epoch']} "
-                f"(reason={rec['reason']} by h{rec['proposer']}, "
-                f"crashes {rec['crashes']}/{self.max_restarts}, "
-                f"delay {rec['delay']:.1f}s)"
-            )
-            # heartbeat while waiting at the join barrier — throttled to
-            # heartbeat_s (on_wait fires every poll iteration, and an
-            # unthrottled atomic write per poll would load the NAS the
-            # signal_poll_s split exists to protect)
-            last_hb = [-float("inf")]
-
-            def _hb_while_waiting(epoch=epoch):
-                now = self.clock()
-                if now - last_hb[0] >= self.heartbeat_s:
-                    rv.publish_heartbeat("restarting", epoch)
-                    last_hb[0] = now
-
-            try:
-                t0 = self.clock()
-                done_ts = rv.barrier(
-                    f"e{rec['epoch']}-join", on_wait=_hb_while_waiting,
-                )
+            # Join the agreed epoch.  This is a loop only in elastic
+            # mode: a join barrier that times out on a host whose
+            # supervisor died outright is answered by proposing the NEXT
+            # epoch over the hosts that DID arrive, then joining that.
+            while True:
+                try:
+                    # the record's membership is the pod's truth: adopt
+                    # it BEFORE judging the join barrier, so a shrunken
+                    # epoch only waits on its survivors
+                    rv.adopt_membership(rec.get("hosts") or rv.members)
+                except ValueError:
+                    self._log(
+                        f"evicted by restart epoch {rec['epoch']} "
+                        f"(membership {rec.get('hosts')}); exiting — the "
+                        "pod continues without this host"
+                    )
+                    self._emit(
+                        "supervisor_done", rc=0, gave_up=False,
+                        evicted=True, epoch=rec["epoch"],
+                    )
+                    return 0
+                if rec["crashes"] > self.max_restarts:
+                    # the abort rc comes from the RECORD, not this
+                    # host's local view: a bystander that adopted a
+                    # peer's proposal must still surface the crashing
+                    # child's exit code
+                    ab = rv.abort(
+                        f"crash budget exhausted "
+                        f"({rec['crashes']} > {self.max_restarts})",
+                        int(rec.get("rc", rc)) if rec.get("crash") else 1,
+                    )
+                    return self._finish_abort(ab)
+                if rec["preemptions"] > self.max_preemptions:
+                    ab = rv.abort(
+                        f"resumable-exit budget exhausted "
+                        f"({rec['preemptions']} > {self.max_preemptions})",
+                        EXIT_PREEMPTED,
+                    )
+                    return self._finish_abort(ab)
                 self._emit(
-                    "coord_barrier",
-                    name=f"e{rec['epoch']}-join",
-                    wait=self.clock() - t0,
-                    completed_ts=done_ts,
-                    arrive_ts=rv.last_arrive_ts,
+                    "pod_restart",
+                    epoch=rec["epoch"],
+                    reason=rec["reason"],
+                    proposer=rec["proposer"],
+                    crashes=rec["crashes"],
+                    preemptions=rec["preemptions"],
+                    delay=rec["delay"],
+                    hosts=rec.get("hosts"),
+                    world=rec.get("world"),
+                    # the pod-wide decision instant (epoch-record
+                    # proposal stamp) — the flow-arrow origin the
+                    # incident trace draws to every host's join-barrier
+                    # span
+                    decision_ts=rec.get("ts"),
                 )
-            except BarrierTimeout as e:
-                # a peer never joined: its supervisor is gone, and a
-                # partial relaunch would just hang — give the pod up
-                ab = rv.abort(f"h{rv.host}: {e}", 1)
-                return self._finish_abort(ab)
-            except PodAborted as e:
-                return self._finish_abort(e.record)
+                self._log(
+                    f"joining restart epoch {rec['epoch']} "
+                    f"(reason={rec['reason']} by h{rec['proposer']}, "
+                    f"world {rec.get('world', rv.world)}, "
+                    f"crashes {rec['crashes']}/{self.max_restarts}, "
+                    f"delay {rec['delay']:.1f}s)"
+                )
+                # heartbeat while waiting at the join barrier —
+                # throttled to heartbeat_s (on_wait fires every poll
+                # iteration, and an unthrottled atomic write per poll
+                # would load the NAS the signal_poll_s split exists to
+                # protect)
+                last_hb = [-float("inf")]
+
+                def _hb_while_waiting(epoch=epoch):
+                    now = self.clock()
+                    if now - last_hb[0] >= self.heartbeat_s:
+                        rv.publish_heartbeat("restarting", epoch)
+                        last_hb[0] = now
+
+                join = f"e{rec['epoch']}-join"
+                try:
+                    t0 = self.clock()
+                    done_ts = rv.barrier(join, on_wait=_hb_while_waiting)
+                    self._emit(
+                        "coord_barrier",
+                        name=join,
+                        wait=self.clock() - t0,
+                        completed_ts=done_ts,
+                        arrive_ts=rv.last_arrive_ts,
+                    )
+                    break
+                except BarrierTimeout as e:
+                    arrivals = rv.barrier_arrivals(join)
+                    if not self.elastic or not arrivals or (
+                        len(arrivals) >= len(rv.members)
+                    ):
+                        # a peer never joined: its supervisor is gone,
+                        # and a partial relaunch would just hang — give
+                        # the pod up
+                        ab = rv.abort(f"h{rv.host}: {e}", 1)
+                        return self._finish_abort(ab)
+                    # elastic: the arrived hosts ARE the pod now.  All
+                    # of them hit this timeout within a poll interval of
+                    # each other and race the same next-epoch proposal;
+                    # first writer wins, the rest adopt.
+                    self._log(
+                        f"join barrier {join} timed out with arrivals "
+                        f"{arrivals}; proposing continue-on-survivors"
+                    )
+                    self._emit(
+                        "peer_lost", epoch=rec["epoch"],
+                        lost_hosts=[
+                            m for m in rv.members if m not in arrivals
+                        ],
+                        at_barrier=join,
+                    )
+                    try:
+                        rec = rv.propose_restart(
+                            int(rec["epoch"]), "peer_lost",
+                            crash=False, preempt=True, rc=EXIT_PREEMPTED,
+                            delay_fn=lambda c: self.backoff.delay(c - 1),
+                            hosts=arrivals,
+                        )
+                    except BarrierTimeout as e2:
+                        ab = rv.abort(f"h{rv.host}: {e2}", 1)
+                        return self._finish_abort(ab)
+                    continue
+                except PodAborted as e:
+                    return self._finish_abort(e.record)
             if rec["delay"] > 0:
                 self.sleep(rec["delay"])
             # the restart decision instant: the epoch record's proposal
@@ -801,6 +931,22 @@ def supervise_pod_command(
         child_env[coord.ENV_DIR] = str(launch_root)
         child_env[coord.ENV_HOSTS] = str(n_hosts)
         child_env[coord.ENV_HOST] = str(host)
+        # live membership (elastic scale-down may have shrunk it): the
+        # child's own Rendezvous (watchdog intent, resume agreement)
+        # must judge barriers over the SAME member set the supervisors
+        # agreed, or it would wait on evicted hosts forever
+        child_env[coord.ENV_MEMBERS] = ",".join(
+            str(m) for m in rv.members
+        )
+        if rv.world < n_hosts:
+            # data-axis respec: survivors renumber contiguously for the
+            # SPMD bootstrap (launch.init_distributed reads these) while
+            # keeping their original pod host ids for coordination —
+            # jax.process_count() shrinks to the agreed world, so
+            # parallel/rules.py derives a smaller `data` axis and the
+            # data loader re-splits the resumed cursor over survivors
+            child_env["DDL_NUM_PROCESSES"] = str(rv.world)
+            child_env["DDL_PROCESS_ID"] = str(rv.members.index(host))
         child_env.setdefault("DDL_HOST_ID", str(host))
         child_env.setdefault("DDL_WATCHDOG_ACTION", "exit")
         _prepare_fault_env(child_env, restart_index, fault_state)

@@ -227,6 +227,7 @@ class LMTrainer(BaseTrainer):
             run.checkpoint_dir, run.job_id, step, self.state, verify=False
         )
         self._start_step = int(self.state.step)
+        self._anchor_shuffle(step)
         self.periods_run = bisect.bisect_right(
             self._boundaries, self._start_step
         )
@@ -279,6 +280,8 @@ class LMTrainer(BaseTrainer):
     def _build_data(self) -> None:
         run = self.run
         self._eval_batches = None
+        self._batches = None  # TokenBatches on the corpus path, for
+        # shuffle-cursor persistence (save_snapshot/_anchor_shuffle)
         n_proc, proc = jax.process_count(), jax.process_index()
         self._n_proc = n_proc
         if run.corpus:
@@ -334,6 +337,7 @@ class LMTrainer(BaseTrainer):
             batches = TokenBatches(
                 train_view, run.batch // n_proc, n_proc, proc, seed=0
             )
+            self._batches = batches
             self._eval_batches = (
                 TokenBatches(eval_view, run.batch // n_proc, n_proc, proc,
                              shuffle=False, seed=0)
@@ -450,7 +454,24 @@ class LMTrainer(BaseTrainer):
                 f"virtual={run.virtual_stages})"
             )
         self._start_step = int(self.state.step)
+        self._anchor_shuffle(resume_step)
         print(f"continuing from step {self._start_step}")
+
+    def _anchor_shuffle(self, snap_step: int) -> None:
+        """Re-anchor the corpus shuffle from the restored snapshot's
+        cursor: the persisted (shuffle_epoch, epoch_pos) pins the epoch
+        reshuffle trajectory across restarts — including elastic ones
+        where the shard layout changed batches/epoch.  Pre-shuffle-cursor
+        snapshots anchor nothing (divmod fallback, the old behaviour)."""
+        if self._batches is None:
+            return
+        cur = ckpt.read_cursor(
+            self.run.checkpoint_dir, self.run.job_id, snap_step
+        )
+        if cur and "shuffle_epoch" in cur:
+            self._batches.anchor_resume(
+                snap_step, cur["shuffle_epoch"], cur.get("epoch_pos", 0)
+            )
 
     # ------------------------------------------------------- loop hooks
 
@@ -564,6 +585,14 @@ class LMTrainer(BaseTrainer):
         # pure in step), so step IS the exact-resume cursor; period/
         # offset ride along for the pod sim's no-dup/no-skip audit
         cursor = dict(self.data_cursor or {}, step=step)
+        if self._batches is not None:
+            # persist the shuffle trajectory too (epoch of the global
+            # reshuffle + position within it), so a resume beyond one
+            # corpus pass — or under a respec'd data axis, where
+            # batches/epoch changed — reseeds the SAME permutation
+            # sequence instead of re-deriving it from a divmod against
+            # the new epoch length
+            cursor.update(self._batches.cursor_state(step))
         path = ckpt.save_snapshot(
             self.run.checkpoint_dir, self.job_id, step, self.state,
             cursor=cursor,

@@ -201,16 +201,33 @@ class BaseTrainer:
         ``epoch`` and rewind the family's resume cursor."""
         raise NotImplementedError
 
+    # one agreement key per in-loop rollback this process performs: the
+    # NaN-recovery path is SPMD-identical across hosts (every host sees
+    # the same non-finite loss at the same period), so the counter
+    # advances in lockstep and scopes each rollback's rank-0 agreement
+    _rollback_seq = 0
+
     def rollback_to_snapshot(self) -> bool:
         """Restore the latest *valid* snapshot and rewind the resume
-        cursor; return False when there is nothing to roll back to."""
+        cursor; return False when there is nothing to roll back to.
+
+        On a pod, WHICH snapshot is the rollback target is a rank-0
+        agreement (``coord.agreed_rollback_epoch``), not a per-host
+        ``latest_valid_epoch`` walk: under a torn NAS view (host A sees
+        snapshot 12 committed, host B still sees 11) per-host choices
+        diverge and the restored worlds silently fork."""
         store = self._snapshot_store()
         if store is None:
             return False
         self.wait_for_saves()  # commit any in-flight async snapshot first
         from ddl_tpu import checkpoint as ckpt
+        from ddl_tpu import coord
 
-        epoch = ckpt.latest_valid_epoch(*store)
+        seq = self._rollback_seq
+        self._rollback_seq = seq + 1
+        epoch = coord.agreed_rollback_epoch(
+            store[1], lambda: ckpt.latest_valid_epoch(*store), seq
+        )
         if epoch is None:
             return False
         self._rollback_restore(epoch)
@@ -273,6 +290,13 @@ class BaseTrainer:
             from ddl_tpu.obs import StepTrace
 
             self.obs = StepTrace.create(log_dir, job_id, family, host=host_id())
+            # warm-restart observability: one compile_cache event per
+            # incarnation (no-op when the persistent cache is off) — the
+            # warm-relaunch drill reads warm/entries_before next to
+            # restart_latency and the recompile goodput bucket
+            from ddl_tpu.utils.compile_cache import emit_cache_event
+
+            emit_cache_event(self.obs.writer)
 
     def _emit_snapshot_restore(
         self, dur: float, epoch, period: int, offset: int = 0

@@ -1,26 +1,220 @@
-"""Persistent XLA compile cache setup, shared by the bench entry points.
+"""Persistent XLA compile cache: warm restarts for supervised pods.
 
-Repeated bench runs — and the cost-analysis AOT compile in
-``bench.mfu.compiled_step_flops``, which bypasses jit's in-memory
-executable cache — skip the multi-ten-second XLA compile when the
-persistent cache is on.
+Grown from the bench-only stub into a launch-path subsystem (ROADMAP
+"elastic pod scale-down, warm restarts, and a persistent compile
+cache").  A relaunched incarnation pays the full XLA compile again —
+the goodput ledger prices it as the ``recompile`` bucket and the
+``restart_latency`` obs event times it — unless the persistent cache
+survives the process.  Three pieces make that safe and observable:
+
+* **Topology keying** (:func:`topology_key`): executables are only
+  reusable on the mesh they were built for, so the cache root is
+  subdivided per ``<platform>-d<devices>-p<processes>`` — an elastic
+  scale-down (8 hosts → 7) compiles into its own keyed subdir instead
+  of colliding with the full pod's entries, and scaling BACK up finds
+  the original entries untouched.
+* **Pod-agreed root** (:func:`activate_compile_cache` with a
+  rendezvous): the leader publishes the cache root through
+  ``coord.Rendezvous.agree`` so every host of a pod compiles into ONE
+  NAS directory — host 3's incarnation 2 reuses what host 0 compiled
+  in incarnation 1.  The agreed default lives under the ``--pod``
+  directory (``<pod>/compile_cache``), which outlives launches by
+  construction.
+* **Hit/miss counters** (:func:`cache_stats`): entry counts before the
+  run plus ``jax.monitoring`` cache-hit/miss listeners, emitted as the
+  ``compile_cache`` obs event so `obs summarize`/`obs diff` can gate
+  "the second incarnation must be warm" (``restart_latency`` and the
+  ``recompile`` goodput bucket strictly lower).
+
+Activation is opt-in: ``DDL_COMPILE_CACHE=<dir>`` (any run) or pod mode
+(where the rendezvous supplies the agreed default).  ``DDL_COMPILE_CACHE=off``
+disables even in pod mode.  Bench entry points keep their historical
+:func:`enable_compile_cache` always-on behavior.
 """
 
 from __future__ import annotations
 
 import os
+from pathlib import Path
 
-__all__ = ["enable_compile_cache"]
+__all__ = [
+    "ENV_CACHE",
+    "ENV_CACHE_MIN_S",
+    "activate_compile_cache",
+    "cache_entries",
+    "cache_stats",
+    "emit_cache_event",
+    "enable_compile_cache",
+    "topology_key",
+]
+
+ENV_CACHE = "DDL_COMPILE_CACHE"
+# Minimum compile seconds before XLA persists an executable (JAX's
+# jax_persistent_cache_min_compile_time_secs).  1s skips trivial CPU
+# kernels in production; tests/sims set 0 so every compile is cached.
+ENV_CACHE_MIN_S = "DDL_COMPILE_CACHE_MIN_S"
+DEFAULT_MIN_COMPILE_S = 1.0
+
+# The last activation's stats (one activation per process — jax.config
+# is global), read back by cache_stats()/emit_cache_event().
+_active: dict | None = None
+_counters = {"hits": 0, "misses": 0}
+_listener_installed = False
+
+
+def topology_key() -> str:
+    """The cache subdir key for the current mesh: platform, device
+    count, process count.  Executables are sharding-specialized, so two
+    topologies must never share entries — and after an elastic
+    scale-down the shrunken world's key differs from the full pod's, so
+    a later scale-back-up still finds its original warm entries."""
+    import jax
+
+    return (
+        f"{jax.default_backend()}"
+        f"-d{jax.device_count()}-p{jax.process_count()}"
+    )
+
+
+def cache_entries(cache_dir: str | os.PathLike) -> int:
+    """Persisted executables under one keyed cache dir (files only —
+    XLA writes flat content-addressed entries)."""
+    try:
+        return sum(1 for p in Path(cache_dir).iterdir() if p.is_file())
+    except OSError:
+        return 0
+
+
+def _install_counters() -> None:
+    """Count persistent-cache hits/misses via ``jax.monitoring`` —
+    the same listener surface steptrace's compile timer uses.  Best
+    effort: older JAX exposes different event names; the entry counts
+    in the activation stats are the load-bearing warm/cold signal."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    _listener_installed = True
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            if "compilation_cache" in event:
+                if "hit" in event:
+                    _counters["hits"] += 1
+                elif "miss" in event:
+                    _counters["misses"] += 1
+
+        monitoring.register_event_listener(_on_event)
+    except Exception:  # ddl-lint: disable=broad-except — telemetry only
+        pass
+
+
+def _point_jax_at(cache_dir: Path, min_compile_s: float) -> bool:
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_s),
+        )
+        return True
+    except Exception:  # ddl-lint: disable=broad-except
+        # a backend/jax version without persistent-cache support: warm
+        # restarts degrade to cold ones, never to a failed launch
+        return False
+
+
+def activate_compile_cache(
+    rv=None,
+    cache_root: str | os.PathLike | None = None,
+    events=None,
+) -> dict | None:
+    """Arm the persistent compile cache for this process's launch path.
+
+    Root precedence: explicit ``cache_root`` arg > ``DDL_COMPILE_CACHE``
+    env > the pod-agreed default (``<pod>/compile_cache``, published by
+    the rendezvous leader so every host uses the same NAS directory).
+    Without any of those (bare local run) the cache stays off —
+    activation is opt-in.  ``DDL_COMPILE_CACHE=off|0`` force-disables.
+
+    Returns the activation stats (also kept for :func:`cache_stats`):
+    ``{"dir", "key", "entries_before", "warm", "agreed"}`` — ``warm``
+    is True when the keyed subdir already holds entries, i.e. this
+    incarnation's compiles should be hits.  Emits one ``compile_cache``
+    event when ``events`` is given.
+    """
+    global _active
+    env_root = os.environ.get(ENV_CACHE)
+    if env_root is not None and env_root.strip().lower() in ("", "0", "off"):
+        return None
+    root = cache_root or env_root
+    agreed = False
+    if rv is not None:
+        # one pod, one cache dir: the leader publishes (its env wins so
+        # an operator override propagates), everyone else adopts.  The
+        # default sits beside the launches/ subdirs, so it survives
+        # relaunches AND later launches of the same pod directory.
+        default = str(Path(rv.root).parent.parent / "compile_cache")
+        local = str(root) if root else default
+        try:
+            root = rv.agree("compile-cache", lambda: local)
+            agreed = True
+        except Exception:  # ddl-lint: disable=broad-except
+            # agreement is an optimization (identical envs agree
+            # trivially); a coord hiccup must not fail the launch
+            root = local
+    if not root:
+        return None
+    try:
+        min_s = float(
+            os.environ.get(ENV_CACHE_MIN_S) or DEFAULT_MIN_COMPILE_S
+        )
+    except ValueError:
+        min_s = DEFAULT_MIN_COMPILE_S
+    key = topology_key()
+    cache_dir = Path(root) / key
+    try:
+        cache_dir.mkdir(parents=True, exist_ok=True)
+    except OSError:
+        return None
+    entries = cache_entries(cache_dir)
+    if not _point_jax_at(cache_dir, min_s):
+        return None
+    _install_counters()
+    _active = {
+        "dir": str(cache_dir),
+        "key": key,
+        "entries_before": entries,
+        "warm": entries > 0,
+        "agreed": agreed,
+    }
+    if events is not None:
+        emit_cache_event(events)
+    return _active
+
+
+def cache_stats() -> dict | None:
+    """The current activation's stats plus live hit/miss counters, or
+    None when the cache is off."""
+    if _active is None:
+        return None
+    return {**_active, **_counters}
+
+
+def emit_cache_event(events) -> None:
+    """One ``compile_cache`` obs event for this incarnation: where the
+    cache points, whether it started warm, and the counters so far.
+    The warm-relaunch drill reads ``warm``/``entries_before`` alongside
+    ``restart_latency`` and the ``recompile`` goodput bucket."""
+    stats = cache_stats()
+    if stats is None or events is None:
+        return
+    events.emit("compile_cache", **stats)
 
 
 def enable_compile_cache(default_dir: str = "/tmp/ddl_tpu_xla_cache") -> None:
-    """Point JAX's persistent compilation cache at ``$DDL_COMPILE_CACHE``
-    (or ``default_dir``); a no-op on backends without cache support."""
-    import jax
-
-    cache_dir = os.environ.get("DDL_COMPILE_CACHE", default_dir)
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    """Bench entry points' historical always-on activation: point the
+    cache at ``$DDL_COMPILE_CACHE`` (or ``default_dir``), topology-keyed
+    like the launch path; a no-op on backends without cache support."""
+    activate_compile_cache(cache_root=os.environ.get(ENV_CACHE, default_dir))

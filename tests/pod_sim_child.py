@@ -50,6 +50,17 @@ def main() -> None:
     steps = int(os.environ.get("DDL_SIM_STEPS", "10"))
     epoch = os.environ.get("DDL_RESTART_EPOCH", "0")
 
+    # membership/respec audit: record what the supervisor's spawn env
+    # said about this incarnation's world (the elastic e2e asserts the
+    # epoch-1 relaunch carried the shrunken membership and the
+    # renumbered SPMD bootstrap vars)
+    with open(os.path.join(sim, f"env_h{host}.log"), "a") as fh:
+        fh.write(
+            f"{epoch} members={os.environ.get('DDL_COORD_MEMBERS', '-')} "
+            f"nproc={os.environ.get('DDL_NUM_PROCESSES', '-')} "
+            f"pid={os.environ.get('DDL_PROCESS_ID', '-')}\n"
+        )
+
     cfg = LMConfig(
         vocab_size=256, d_model=16, n_layers=1, n_heads=2, head_dim=8,
         d_ff=32, compute_dtype="float32", remat=False,

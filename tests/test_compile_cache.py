@@ -1,0 +1,132 @@
+"""Persistent compile cache (utils/compile_cache.py): warm restarts.
+
+Host-tier tests of the activation logic — root precedence (arg > env >
+pod-agreed default), the off switch, topology keying, warm/cold entry
+counting, hit/miss counters via jax.monitoring, and the compile_cache
+obs event.  XLA's own persistence is not under test here (the pod-sim
+e2e exercises it via the suite cache); what is under test is that the
+launch path points JAX at one agreed, keyed directory and reports the
+truth about it.
+"""
+
+import jax
+import pytest
+
+from ddl_tpu.utils import compile_cache as cc
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch):
+    """Each test starts deactivated with zeroed counters, and the global
+    jax config the module mutates is restored afterwards."""
+    monkeypatch.setattr(cc, "_active", None)
+    monkeypatch.setattr(cc, "_counters", {"hits": 0, "misses": 0})
+    monkeypatch.delenv(cc.ENV_CACHE, raising=False)
+    monkeypatch.delenv(cc.ENV_CACHE_MIN_S, raising=False)
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
+def test_activation_is_opt_in_and_off_wins(tmp_path, monkeypatch):
+    # bare local run: no env, no rendezvous -> stays off
+    assert cc.activate_compile_cache() is None
+    assert cc.cache_stats() is None
+    # the force-disable beats even an explicit root
+    for off in ("off", "0", ""):
+        monkeypatch.setenv(cc.ENV_CACHE, off)
+        assert cc.activate_compile_cache(cache_root=tmp_path) is None
+
+
+def test_env_activation_keys_by_topology_and_counts_entries(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv(cc.ENV_CACHE, str(tmp_path))
+    monkeypatch.setenv(cc.ENV_CACHE_MIN_S, "0")
+    stats = cc.activate_compile_cache()
+    assert stats is not None
+    key = cc.topology_key()
+    assert key.startswith("cpu-d") and key.endswith(
+        f"-p{jax.process_count()}"
+    )
+    assert stats["key"] == key
+    assert stats["dir"] == str(tmp_path / key)
+    assert stats["entries_before"] == 0 and stats["warm"] is False
+    assert stats["agreed"] is False
+    # jax was actually pointed at the keyed dir with the min-compile
+    # override
+    assert jax.config.jax_compilation_cache_dir == str(tmp_path / key)
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+    # a second incarnation finding entries reports warm
+    (tmp_path / key / "xla_exec_a").write_bytes(b"x")
+    (tmp_path / key / "xla_exec_b").write_bytes(b"x")
+    stats2 = cc.activate_compile_cache()
+    assert stats2["entries_before"] == 2 and stats2["warm"] is True
+
+
+def test_pod_agreed_default_sits_beside_launches(tmp_path):
+    from ddl_tpu.coord import Rendezvous
+
+    # the rendezvous root is coord_dir/launches/<token>; the agreed
+    # default must OUTLIVE launches: <coord_dir>/compile_cache
+    launch = tmp_path / "pod" / "launches" / "l0"
+    rv = Rendezvous(launch, 0, 1)
+    stats = cc.activate_compile_cache(rv=rv)
+    assert stats is not None and stats["agreed"] is True
+    assert stats["dir"] == str(
+        tmp_path / "pod" / "compile_cache" / stats["key"]
+    )
+
+
+def test_explicit_root_beats_pod_default(tmp_path):
+    from ddl_tpu.coord import Rendezvous
+
+    launch = tmp_path / "pod" / "launches" / "l0"
+    rv = Rendezvous(launch, 0, 1)
+    stats = cc.activate_compile_cache(rv=rv, cache_root=tmp_path / "mine")
+    assert stats["dir"].startswith(str(tmp_path / "mine"))
+
+
+def test_hit_miss_counters_and_event_emission(tmp_path, monkeypatch):
+    monkeypatch.setenv(cc.ENV_CACHE, str(tmp_path))
+
+    class Events:
+        def __init__(self):
+            self.emitted = []
+
+        def emit(self, kind, **fields):
+            self.emitted.append((kind, fields))
+
+    ev = Events()
+    stats = cc.activate_compile_cache(events=ev)
+    assert stats is not None
+    # activation emitted one compile_cache event carrying the stats
+    assert ev.emitted and ev.emitted[0][0] == "compile_cache"
+    assert ev.emitted[0][1]["warm"] is False
+    # the monitoring listener counts persistent-cache hit/miss events
+    before = dict(cc._counters)
+    try:
+        from jax import monitoring
+
+        monitoring.record_event("/jax/compilation_cache/cache_hits")
+        monitoring.record_event("/jax/compilation_cache/cache_misses")
+    except Exception:
+        pytest.skip("jax.monitoring.record_event unavailable")
+    live = cc.cache_stats()
+    assert live["hits"] == before["hits"] + 1
+    assert live["misses"] == before["misses"] + 1
+    # re-emission reports the live counters
+    cc.emit_cache_event(ev)
+    assert ev.emitted[-1][1]["hits"] == live["hits"]
+
+
+def test_bench_enable_stays_always_on(tmp_path, monkeypatch):
+    # the historical bench entry point: no env -> default dir, still
+    # topology-keyed
+    monkeypatch.delenv(cc.ENV_CACHE, raising=False)
+    cc.enable_compile_cache(default_dir=str(tmp_path / "bench"))
+    stats = cc.cache_stats()
+    assert stats is not None
+    assert stats["dir"].startswith(str(tmp_path / "bench"))

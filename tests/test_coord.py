@@ -80,6 +80,37 @@ def test_stale_peer_ageout_only_for_running_hosts(tmp_path):
     assert a.stale_peers(0.1) == []
 
 
+def test_membership_scopes_barriers_peers_and_agreement(tmp_path):
+    """Elastic membership: barriers complete over the LIVE member set,
+    evicted hosts' heartbeats go invisible, and the agreement leader is
+    the lowest surviving id."""
+    a = _rv(tmp_path, 0, 3)
+    c = _rv(tmp_path, 2, 3)
+    # host 1 beat once, then was evicted
+    _rv(tmp_path, 1, 3).publish_heartbeat("running", 0)
+    a.adopt_membership([0, 2])
+    c.adopt_membership([0, 2])
+    assert a.world == 2 and a.leader == 0 and a.members == (0, 2)
+    time.sleep(0.15)
+    assert a.stale_peers(0.1) == []  # the casualty is not re-judged
+    # a 2-member barrier completes without host 1
+    done = []
+
+    def arrive(rv):
+        rv.barrier("shrunk")
+        done.append(rv.host)
+
+    t = threading.Thread(target=arrive, args=(c,))
+    t.start()
+    arrive(a)
+    t.join(timeout=10)
+    assert sorted(done) == [0, 2]
+    assert sorted(a.barrier_arrivals("shrunk")) == [0, 2]
+    # eviction is loud: an excluded host cannot adopt the membership
+    with pytest.raises(ValueError, match="evicted"):
+        _rv(tmp_path, 1, 3).adopt_membership([0, 2])
+
+
 def test_restart_epoch_proposal_is_split_brain_free(tmp_path):
     """N hosts racing to propose the same restart epoch converge on ONE
     record: one proposer, one cumulative crash count, one agreed
@@ -310,8 +341,154 @@ def test_pod_supervisor_emits_coordination_events(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# elastic mode: continue on N-1 (scripted fake children)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_stale_peer_evicted_after_grace_and_pod_continues(tmp_path):
+    """Host 1's supervisor dies permanently right after the start
+    barrier.  The elastic survivors hold the eviction grace, then agree
+    restart epoch 1 with membership [0, 2] / world 2 — and finish as a
+    2-host pod instead of aborting."""
+    rv1 = _rv(tmp_path, 1, 3)
+    rv1.arrive("start")
+    rv1.publish_heartbeat("running", 0)  # beat once, then silence
+
+    scripts = {0: [FakeChild(rc=None), FakeChild(rc=0)],
+               2: [FakeChild(rc=None), FakeChild(rc=0)]}
+    results = {}
+
+    def host(i):
+        rv = _rv(tmp_path, i, 3)
+        it = iter(scripts[i])
+        sup = PodSupervisor(
+            lambda epoch, idx: next(it), rv,
+            poll_s=0.005, heartbeat_s=0.02,
+            stale_after_s=0.15, elastic=True, elastic_grace_s=0.2,
+            backoff=Backoff(base=0.01, jitter=0.0), log=lambda m: None,
+        )
+        results[i] = sup.run()
+
+    threads = [threading.Thread(target=host, args=(i,)) for i in (0, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "elastic pod deadlocked"
+    assert results == {0: 0, 2: 0}
+    # the epoch-0 children (hung in the dead host's collective) were
+    # killed, not abandoned
+    assert scripts[0][0].killed and scripts[2][0].killed
+    rv = _rv(tmp_path, 0, 3)
+    assert rv.aborted() is None
+    assert rv.current_epoch() == 1
+    rec = rv.epoch_record(1)
+    assert rec["reason"] == "peer_lost"
+    assert rec["hosts"] == [0, 2] and rec["world"] == 2
+    # an eviction is a preemption-class event, never a crash
+    assert rec["crashes"] == 0 and rec["preemptions"] == 1
+
+
+def test_elastic_join_barrier_timeout_scales_down_to_arrivals(tmp_path):
+    """The second eviction route: a peer whose child crashed and whose
+    supervisor then died never reaches the join barrier.  The arrived
+    host proposes the NEXT epoch over the arrivals and continues
+    alone."""
+    rv1 = _rv(tmp_path, 1, 2)
+    rv1.arrive("start")
+    rv1.publish_heartbeat("running", 0)
+    rv1.publish_intent("crash", 1, 0)  # child died; supervisor died too
+
+    child0, child1 = FakeChild(rc=None), FakeChild(rc=0)
+    it = iter([child0, child1])
+    rv0 = _rv(tmp_path, 0, 2, timeout_s=0.4)
+    sup = PodSupervisor(
+        lambda epoch, idx: next(it), rv0,
+        poll_s=0.005, heartbeat_s=0.02, stale_after_s=30.0,
+        elastic=True,
+        backoff=Backoff(base=0.01, jitter=0.0), log=lambda m: None,
+    )
+    assert sup.run() == 0
+    assert child0.killed
+    assert rv0.aborted() is None
+    # epoch 1 = the crash restart (full membership, budget consumed);
+    # epoch 2 = the join-timeout eviction (membership [0])
+    assert rv0.current_epoch() == 2
+    rec1, rec2 = rv0.epoch_record(1), rv0.epoch_record(2)
+    assert rec1["crashes"] == 1
+    assert rec2["reason"] == "peer_lost"
+    assert rec2["hosts"] == [0] and rec2["world"] == 1
+    assert rec2["crashes"] == 1  # rolled forward, not re-counted
+
+
+def test_evicted_host_exits_cleanly_instead_of_aborting(tmp_path):
+    """A live-but-slow host that catches up after the survivors already
+    scaled down must exit 0 (evicted), never abort the pod out from
+    under them."""
+    from ddl_tpu.obs import EventWriter, read_events
+
+    w1 = EventWriter(tmp_path / "logs", "evictjob", host=1)
+    scripts = {
+        0: [FakeChild(rc=1, delay=0.05), FakeChild(rc=0)],
+        1: [FakeChild(rc=None), FakeChild(rc=None)],
+    }
+    results = {}
+
+    def host(i):
+        rv = _rv(
+            tmp_path / "nas", i, 2,
+            timeout_s=(0.4 if i == 0 else 10.0),
+        )
+        it = iter(scripts[i])
+        sup = PodSupervisor(
+            lambda epoch, idx: next(it), rv,
+            poll_s=0.005, heartbeat_s=0.02, stale_after_s=30.0,
+            # host 1 keeps heartbeating but is slow to see signals, so
+            # it misses host 0's join barrier (the barrier route, not
+            # the staleness route)
+            signal_poll_s=(0.05 if i == 0 else 1.5),
+            elastic=True,
+            backoff=Backoff(base=0.01, jitter=0.0), log=lambda m: None,
+            events=(w1 if i == 1 else None),
+        )
+        results[i] = sup.run()
+
+    threads = [threading.Thread(target=host, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "evict sim deadlocked"
+    # BOTH exit 0: host 0 finished the run alone, host 1 was evicted
+    assert results == {0: 0, 1: 0}
+    w1.close()
+    rv = _rv(tmp_path / "nas", 0, 2)
+    assert rv.aborted() is None
+    final = rv.epoch_record(rv.current_epoch())
+    assert final["hosts"] == [0]
+    done = [e for e in read_events(w1.path)
+            if e["kind"] == "supervisor_done"]
+    assert done and done[-1]["rc"] == 0 and done[-1].get("evicted") is True
+
+
+# ---------------------------------------------------------------------------
 # end-to-end: the 3-host pod sim (real trainers, real supervisors)
 # ---------------------------------------------------------------------------
+
+
+def _clean_env() -> dict:
+    """The suite's environment minus everything that would leak pod/
+    fault/coordination state into a sim's children."""
+    return {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DDL_FAULT",
+                     "DDL_FAULT_STATE", "DDL_WATCHDOG_S", "DDL_COORD_DIR",
+                     "DDL_COORD_HOSTS", "DDL_COORD_HOST", "DDL_HOST_ID",
+                     "DDL_RESTART_EPOCH", "DDL_SUPERVISED",
+                     "DDL_OBS_STEP_SPANS", "DDL_COORD_MEMBERS",
+                     "DDL_NUM_PROCESSES", "DDL_PROCESS_ID",
+                     "DDL_LAUNCH_TOKEN", "DDL_COMPILE_CACHE")
+    }
 
 
 def _read_consumed(sim: Path, host: int) -> list[tuple[int, int]]:
@@ -351,14 +528,7 @@ def test_three_host_pod_sim_stall_escalation_and_exact_resume(tmp_path):
     sim.mkdir()
     nas.mkdir()
     steps = 10
-    base_env = {
-        k: v for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "DDL_FAULT",
-                     "DDL_FAULT_STATE", "DDL_WATCHDOG_S", "DDL_COORD_DIR",
-                     "DDL_COORD_HOSTS", "DDL_COORD_HOST", "DDL_HOST_ID",
-                     "DDL_RESTART_EPOCH", "DDL_SUPERVISED",
-                     "DDL_OBS_STEP_SPANS")
-    }
+    base_env = _clean_env()
     base_env.update(
         DDL_SIM_DIR=str(sim),
         DDL_SIM_STEPS=str(steps),
@@ -527,3 +697,119 @@ def test_three_host_pod_sim_stall_escalation_and_exact_resume(tmp_path):
             "podsim",
         )
         assert warm == render_goodput(ledger, "podsim")
+
+
+def test_three_host_pod_sim_permanent_host_loss_elastic_continue(tmp_path):
+    """The elastic acceptance e2e: host 1's supervisor makes the start
+    barrier, heartbeats once, and dies PERMANENTLY before launching its
+    trainer.  The two elastic survivors hold the eviction grace, agree
+    restart epoch 1 with membership [0, 2] / world 2 through the epoch
+    ledger, relaunch with the respecced bootstrap env
+    (``DDL_COORD_MEMBERS=0,2``, survivors renumbered contiguously),
+    resume the rank-0-agreed snapshot, and finish with identical final
+    weights — the epoch-1 tail consuming exactly [resume, steps) on
+    both survivors (no batch lost to the eviction, none replayed)."""
+    import json
+
+    from ddl_tpu import checkpoint as ckpt
+    from ddl_tpu import coord
+    from ddl_tpu.supervisor import supervise_pod_command
+
+    sim = tmp_path / "sim"
+    nas = tmp_path / "nas"
+    sim.mkdir()
+    nas.mkdir()
+    steps = 8
+    base_env = _clean_env()
+    base_env.update(
+        DDL_SIM_DIR=str(sim),
+        DDL_SIM_STEPS=str(steps),
+        DDL_SIM_PACE="0.5",
+        DDL_JOB_ID="podelastic",
+        DDL_LOG_DIR=str(sim / "suplogs"),
+        DDL_WATCHDOG_S="30",
+        DDL_TEST_COMPILE_CACHE=os.environ.get(
+            "DDL_TEST_COMPILE_CACHE", "/tmp/ddl_tpu_test_xla_cache"
+        ),
+    )
+    _warm_compile_cache(base_env, tmp_path)
+
+    # host 1: the supervisor joins the pod's launch, arrives at the
+    # start barrier, beats once as "running" — then dies outright (it
+    # never spawns a child and never beats again)
+    launch1 = coord.acquire_launch(nas)
+    rv1 = Rendezvous(launch1, 1, 3)
+    rv1.arrive("start")
+    rv1.publish_heartbeat("running", 0)
+
+    results = {}
+
+    def host(i):
+        results[i] = supervise_pod_command(
+            [sys.executable, str(CHILD)], nas, i, 3,
+            env=dict(base_env), max_restarts=3,
+            backoff=Backoff(base=0.01, jitter=0.0),
+            poll_s=0.05, heartbeat_s=0.2, stale_after_s=1.5,
+            elastic=True, elastic_grace_s=1.5,
+            log=lambda m: None,
+        )
+
+    threads = [threading.Thread(target=host, args=(i,)) for i in (0, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "elastic sim deadlocked"
+    assert results == {0: 0, 2: 0}, results
+
+    # all three joined ONE launch; the survivors closed it
+    launch = coord.active_launch_root(nas)
+    assert launch == launch1
+    assert (launch / "finished.json").is_file()
+    rv = _rv(launch, 0, 3)
+    assert rv.aborted() is None
+    assert rv.current_epoch() == 1, rv.current_epoch()
+    rec = rv.epoch_record(1)
+    assert rec["reason"] == "peer_lost"
+    assert rec["hosts"] == [0, 2] and rec["world"] == 2
+    assert rec["crashes"] == 0  # losing a host is not a crash
+
+    # both survivors finished IN EPOCH 1, same step, identical weights;
+    # the dead host never trained at all
+    finals = {}
+    for i in (0, 2):
+        last = (sim / f"final_h{i}.log").read_text().splitlines()[-1]
+        e, step, digest = last.split()
+        finals[i] = (int(e), int(step), digest)
+    assert all(f == (1, steps, finals[0][2]) for f in finals.values()), finals
+    assert not (sim / "final_h1.log").exists()
+
+    # the relaunch env carried the agreed membership and the
+    # contiguously-renumbered SPMD bootstrap (the data-axis respec the
+    # children's `parallel/rules` world derivation reads)
+    for i in (0, 2):
+        lines = (sim / f"env_h{i}.log").read_text().splitlines()
+        e1 = [ln for ln in lines if ln.startswith("1 ")][-1]
+        assert "members=0,2" in e1, e1
+        assert "nproc=2" in e1, e1
+        assert f"pid={0 if i == 0 else 1}" in e1, e1
+        e0 = [ln for ln in lines if ln.startswith("0 ")][0]
+        assert "members=0,1,2" in e0 and "nproc=-" in e0, e0
+
+    # exact resume over the agreed snapshot: the epoch-1 incarnations
+    # consumed exactly [resume, steps) — agreed None is the legal
+    # killed-before-first-commit race (retrain from scratch, still
+    # batch-exact)
+    agreed = json.loads(
+        (launch / "agree" / "resume-podelastic-e1.json").read_text()
+    )["value"]
+    if agreed is not None:
+        cursor = ckpt.read_cursor(sim / "ckpt", "podelastic", agreed)
+        assert cursor is not None and cursor["step"] == agreed
+    resume_from = 0 if agreed is None else agreed
+    for i in (0, 2):
+        tail = [s for e, s in _read_consumed(sim, i) if e == 1]
+        assert tail == list(range(resume_from, steps)), (
+            f"h{i} replayed or skipped batches: {tail} "
+            f"(agreed resume {agreed})"
+        )

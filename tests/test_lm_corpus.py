@@ -101,3 +101,52 @@ def test_batch_at_is_step_pure_and_epochs_reshuffle(corpus_path):
     a = b.batch_at(per_epoch + 2)[0]
     _ = b.batch_at(3)
     np.testing.assert_array_equal(a, b.batch_at(per_epoch + 2)[0])
+
+
+def test_cursor_roundtrip_anchors_same_layout(corpus_path):
+    """cursor_state -> anchor_resume on an identical layout is a no-op
+    for the trajectory: the anchored instance serves the same batches
+    as the original, including across the next epoch boundary."""
+    c = TokenCorpus(corpus_path, seq_len=16)
+    b = TokenBatches(c, batch=4)
+    step = len(b) + 3  # 3 batches into shuffle epoch 1
+    cur = b.cursor_state(step)
+    assert cur == {"shuffle_epoch": 1, "epoch_pos": 3}
+
+    b2 = TokenBatches(TokenCorpus(corpus_path, seq_len=16), batch=4)
+    b2.anchor_resume(step, **cur)
+    assert b2.locate(step) == (1, 3)
+    for s in (step, step + 1, 2 * len(b) + 1):  # incl. epoch 1 -> 2 cross
+        np.testing.assert_array_equal(b.batch_at(s)[0], b2.batch_at(s)[0])
+
+
+def test_anchor_preserves_shuffle_trajectory_when_layout_changes(
+    corpus_path, tmp_path
+):
+    """The elastic case: a restart whose shard layout changed len(b).
+    Plain divmod would restart the shuffle-epoch clock from the new
+    length; the persisted anchor keeps the epoch sequence going."""
+    c_old = TokenCorpus(corpus_path, seq_len=16)   # 62 windows
+    b_old = TokenBatches(c_old, batch=4)           # 15 batches/epoch
+    step = 17                                      # epoch 1, pos 2
+    cur = b_old.cursor_state(step)
+    assert cur == {"shuffle_epoch": 1, "epoch_pos": 2}
+
+    # restart sees a grown corpus: 80 windows -> 20 batches/epoch
+    np.save(tmp_path / "grown.npy",
+            np.arange(1300, dtype=np.uint16) % 251)
+    b_new = TokenBatches(TokenCorpus(tmp_path / "grown.npy", 16), batch=4)
+    assert len(b_new) == 20
+    b_new.anchor_resume(step, **cur)
+    # un-anchored divmod would say (0, 17) — a rewind into epoch 0
+    assert divmod(step, len(b_new)) == (0, 17)
+    assert b_new.locate(step) == (1, 2)
+    # the permutation was reseeded from the PERSISTED epoch
+    assert b_new.sampler.epoch == 1
+    # epochs advance from the anchor: 18 more batches exhausts epoch 1
+    assert b_new.locate(step + 18) == (2, 0)
+    # and batch_at at the anchor step is epoch-1's pos-2 batch exactly
+    b_ref = TokenBatches(TokenCorpus(tmp_path / "grown.npy", 16), batch=4)
+    b_ref.set_epoch(1)
+    want = b_ref._materialize(b_ref._indices()[2 * 4 : 3 * 4])
+    np.testing.assert_array_equal(b_new.batch_at(step)[0], want[0])

@@ -930,3 +930,113 @@ def test_request_log_feeds_serving_stats(lm):
     pct = s["percentiles"]
     assert pct["ttft_s"]["count"] == 3
     assert pct["queue_delay_s"]["count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# device tier: drain-and-reshard (elastic serving)
+# ---------------------------------------------------------------------------
+
+
+def test_drain_tapers_active_and_sheds_queued(lm, tmp_path):
+    """drain(): admission closes, queued requests shed tenant-tagged,
+    the in-flight lane finishes bit-exact through the normal loop, and
+    late submits are rejected at the door."""
+    from ddl_tpu.obs import EventWriter
+    from ddl_tpu.obs.report import load_run
+    from ddl_tpu.serve.engine import ServeEngine
+
+    cfg, params, spec = lm
+    clients = _clients(3, np.random.default_rng(9), new_lo=3, new_hi=6)
+    obs = EventWriter(tmp_path, "drain-test")
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=16,
+                      max_batch=1, max_queue=4, obs=obs)
+    for i, (cid, prompt, mn) in enumerate(clients):
+        assert eng.submit(prompt, mn, request_id=cid,
+                          tenant=f"t{i}") == "queued"
+    eng.step()  # admits c0 into the single lane; c1/c2 stay queued
+    assert len(eng.scheduler.active()) == 1
+
+    counts = eng.drain("preempt")
+    assert counts == {"shed": 2, "parked": 0}
+    assert eng.draining and eng.drain_reason == "preempt"
+    assert eng.outcomes["c1"] == "shed:drained"
+    assert eng.outcomes["c2"] == "shed:drained"
+    # a second call is a no-op (no double-shed, no duplicate event)
+    assert eng.drain("preempt") == {"shed": 0, "parked": 0}
+    # admission is closed: the late arrival sheds at the door
+    assert eng.submit(clients[0][1], 3, request_id="late",
+                      tenant="t9") == "rejected"
+    assert eng.outcomes["late"] == "shed:draining"
+
+    got = eng.run()  # taper: the in-flight lane finishes normally
+    obs.close()
+    assert sorted(got) == ["c0"]
+    assert eng.outcomes["c0"] == "ok"
+    want = _sequential_tokens(cfg, spec, params, clients[:1], seed=0)
+    np.testing.assert_array_equal(got["c0"], want["c0"])
+    assert eng.allocator.used_blocks == 0 and not eng.busy
+    assert eng.stats["shed"] == 3
+
+    events = load_run(tmp_path, "drain-test")
+    drains = [e for e in events if e["kind"] == "serve_drain"]
+    assert len(drains) == 1
+    assert drains[0]["reason"] == "preempt"
+    assert drains[0]["shed"] == 2 and drains[0]["active_lanes"] == 1
+    sheds = {e["request_id"]: e for e in events
+             if e["kind"] == "serve_shed" and e["reason"] == "drained"}
+    assert sorted(sheds) == ["c1", "c2"]
+    assert sheds["c1"]["tenant"] == "t1"  # shed stays SLO-attributable
+
+
+def test_drain_park_hard_stops_lanes_with_partial_outputs(lm):
+    """drain(park=True): the deadline the taper cannot meet — unfinished
+    lanes park NOW with partial outputs recorded, blocks recycle, and
+    the engine reports not-busy."""
+    from ddl_tpu.serve.engine import ServeEngine
+
+    cfg, params, spec = lm
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=32,
+                      max_batch=2, max_steps_per_dispatch=1)
+    eng.submit(np.arange(1, 9, dtype=np.int32), 12, request_id="a")
+    eng.submit(np.arange(1, 6, dtype=np.int32), 12, request_id="b")
+    # run both lanes into mid-decode (well short of 12 new tokens)
+    for _ in range(3):
+        eng.step()
+    active = eng.scheduler.active()
+    assert len(active) == 2
+    assert all(0 < len(s.outputs) < 12 for s in active)
+
+    counts = eng.drain("deadline", park=True)
+    assert counts["parked"] == 2
+    assert eng.outcomes["a"] == "parked:deadline"
+    assert eng.outcomes["b"] == "parked:deadline"
+    # partial outputs preserved so a resubmission can skip them
+    assert 0 < len(eng.results["a"]) < 12
+    # every block recycled, nothing left to do
+    assert eng.allocator.used_blocks == 0
+    assert not eng.busy and not eng.step()
+
+
+def test_preempt_guard_trips_drain_in_step(lm):
+    """The supervisor-style preemption guard: step() polls it and flips
+    the engine into drain without a direct drain() call."""
+    from ddl_tpu.serve.engine import ServeEngine
+
+    class Guard:
+        requested = False
+
+    cfg, params, spec = lm
+    guard = Guard()
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=16,
+                      max_batch=1, max_queue=4, guard=guard)
+    c = _clients(2, np.random.default_rng(3), new_lo=3, new_hi=5)
+    for cid, prompt, mn in c:
+        eng.submit(prompt, mn, request_id=cid)
+    eng.step()  # c0 admitted, guard quiet, c1 still queued
+    assert not eng.draining
+    guard.requested = True
+    eng.step()
+    assert eng.draining and eng.drain_reason == "preempt"
+    assert eng.outcomes["c1"] == "shed:drained"
+    got = eng.run()
+    assert sorted(got) == ["c0"] and eng.outcomes["c0"] == "ok"

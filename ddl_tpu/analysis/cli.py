@@ -11,6 +11,13 @@
                                                      #  findings, then re-lint
     python -m ddl_tpu.cli lint --fix --check         # CI gate: diff + exit 1
                                                      #  if fixes are pending
+    python -m ddl_tpu.cli lint --hlo                 # compiled-IR pass:
+                                                     #  lower+compile the probe
+                                                     #  programs, rule-check
+                                                     #  the collective/memory
+                                                     #  inventory
+    python -m ddl_tpu.cli lint --hlo --hlo-baseline HLO_BASELINE.json
+    python -m ddl_tpu.cli lint --hlo --update-baseline
 
 Exit codes: 0 = clean (every finding baselined or suppressed), 1 = new
 findings.  With ``--baseline`` the committed ``LINT_BASELINE.json``
@@ -37,6 +44,21 @@ findings inside the scope are exact, not approximated.
 ``--package-root DIR`` lints an alternate package tree (fixture
 packages in tests); the baseline default and the fixers' registry/rule
 -table lookups follow it.
+
+``--hlo`` runs the *compiled-IR* pass (``analysis/hlolint.py``) instead
+of the AST/contract pass: every contract probe program is lowered and
+compiled on its simulated mesh, the StableHLO/optimized-HLO text is
+parsed into a per-program collective + memory-traffic inventory, and
+the IR rule family (oversized-all-gather, zero-missing-reduce-scatter,
+pipeline-collective-symmetry, steady-state-copy-hotspot,
+shape-specialized-constant) runs over it.  ``--hlo-baseline
+HLO_BASELINE.json`` drift-gates the inventory against the committed
+snapshot — a new collective kind/axis, a count increase, >10% payload
+growth, a lost donation alias, or copy-traffic growth fails the run,
+while shrinks and fingerprint-only changes are reported as stale
+entries.  ``--hlo --update-baseline`` rewrites the snapshot after
+intentional changes; ``--hlo --changed`` probes only the programs whose
+factory module is in the changed set's reverse-dependency closure.
 """
 
 from __future__ import annotations
@@ -93,6 +115,17 @@ def main(argv=None) -> int:
         help="lint this package directory instead of the installed "
         "ddl_tpu (fixture packages in tests)",
     )
+    ap.add_argument(
+        "--hlo", action="store_true",
+        help="run the compiled-IR pass (lower + compile the probe "
+        "programs, inventory collectives/memory traffic, apply the IR "
+        "rule family) instead of the AST/contract pass",
+    )
+    ap.add_argument(
+        "--hlo-baseline", default=None, metavar="FILE",
+        help="with --hlo: drift-gate the inventory against this "
+        "committed HLO_BASELINE.json snapshot",
+    )
     args = ap.parse_args(argv)
     if args.check and not args.fix:
         ap.error("--check requires --fix")
@@ -104,6 +137,22 @@ def main(argv=None) -> int:
         # a scoped run sees only the closure's findings — rewriting the
         # baseline from it would silently delete every out-of-scope entry
         ap.error("--update-baseline needs a full run, not --changed")
+    if args.hlo_baseline and not args.hlo:
+        ap.error("--hlo-baseline requires --hlo")
+    if args.hlo:
+        for flag, name in (
+            (args.fix, "--fix"), (args.check, "--check"),
+            (args.no_contracts, "--no-contracts"),
+            (bool(args.paths), "explicit paths"),
+            (bool(args.baseline), "--baseline"),
+            (bool(args.package_root), "--package-root"),
+        ):
+            if flag:
+                ap.error(
+                    f"--hlo and {name} are mutually exclusive (the IR "
+                    "pass probes whole programs, has its own baseline, "
+                    "and has no autofixes)"
+                )
 
     from ddl_tpu.analysis.findings import save_baseline
     from ddl_tpu.analysis.runner import package_root, run_lint
@@ -113,6 +162,8 @@ def main(argv=None) -> int:
         if args.package_root else package_root()
     )
     repo_root = pkg.parent
+    if args.hlo:
+        return _hlo_main(args, repo_root, pkg)
     files = [Path(p) for p in args.paths] or None
     notes: list[str] = []
     graph = None  # prebuilt by --changed; reused by the first lint pass
@@ -250,6 +301,119 @@ def main(argv=None) -> int:
         print("lint: clean")
         return 0
     print(f"lint: {len(result.new)} new finding(s)")
+    return 1
+
+
+def _hlo_main(args, repo_root: Path, pkg: Path) -> int:
+    """The ``lint --hlo`` flow: probe selection (--changed), the IR
+    pass, baseline update/drift, reporting."""
+    from ddl_tpu.analysis.hlolint import (
+        affected_probes,
+        run_hlo_lint,
+        save_hlo_baseline,
+    )
+
+    probes = None  # None = every registered probe
+    notes: list[str] = []
+    if args.changed:
+        from ddl_tpu.analysis.callgraph import (
+            CallGraph,
+            changed_package_files,
+        )
+
+        changed = changed_package_files(repo_root)
+        if changed is None:
+            print("lint --changed: git unavailable; run a full lint")
+            return 2
+        graph = CallGraph(pkg)
+        changed_mods = {
+            graph.by_rel[rel].name
+            for rel in changed if rel in graph.by_rel
+        }
+        if not changed_mods:
+            print("lint --hlo --changed: no changed package modules")
+            return 0
+        closure = graph.reverse_closure(changed_mods)
+        if closure & {
+            "ddl_tpu.analysis.hlolint", "ddl_tpu.analysis.contracts"
+        }:
+            # the engine itself moved: every inventory may change
+            notes.append(
+                "--changed scope reaches the IR lint engine; probing "
+                "every program"
+            )
+        else:
+            probes = affected_probes(closure)
+            if not probes:
+                print(
+                    "lint --hlo --changed: no probe program is affected "
+                    f"by the {len(changed_mods)} changed module(s)"
+                )
+                return 0
+            notes.append(
+                f"--changed scope: probing {', '.join(probes)}"
+            )
+
+    baseline_path = args.hlo_baseline
+    if baseline_path is None and args.update_baseline:
+        baseline_path = repo_root / "HLO_BASELINE.json"
+
+    result = run_hlo_lint(
+        probes=probes,
+        baseline_path=None if args.update_baseline else baseline_path,
+    )
+
+    if args.update_baseline:
+        broken = [
+            f for f in result.findings if f.rule == "hlo-probe-build"
+        ]
+        if broken:
+            for f in broken:
+                print(f.format())
+            print(
+                "lint --hlo --update-baseline: refusing to write an "
+                "incomplete baseline while probes fail to build"
+            )
+            return 1
+        save_hlo_baseline(baseline_path, result.baseline_programs())
+        print(
+            f"wrote {len(result.inventories)} program inventories to "
+            f"{baseline_path}"
+        )
+        return 0
+
+    notes = notes + result.notes
+    if args.as_json:
+        print(json.dumps(
+            {
+                "findings": [f.to_dict() for f in result.findings],
+                "notes": notes,
+                "stale_baseline": result.stale,
+                "programs": result.baseline_programs(),
+                "ok": result.ok,
+            },
+            indent=1,
+        ))
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.format())
+    for note in notes:
+        print(f"note: {note}")
+    if result.stale:
+        print(
+            f"{len(result.stale)} stale HLO baseline entr(ies) — run "
+            "--hlo --update-baseline to refresh:"
+        )
+        for s in result.stale:
+            print(f"  stale: {s}")
+    if result.ok:
+        print(
+            f"lint --hlo: clean "
+            f"({len(result.inventories)} programs inventoried)"
+        )
+        return 0
+    print(f"lint --hlo: {len(result.findings)} finding(s)")
     return 1
 
 

@@ -518,12 +518,15 @@ def _probe_lm_zero() -> _Probe:
 
 
 def _probe_zero_donation() -> _Probe:
-    """Donation effectiveness in the lowered ZeRO step (PR-3 carry-over):
-    on runtimes where compat.py strips jit donation, report the waiver;
-    once compat retires, compile the ZeRO CNN step and assert the
-    donated state buffers actually alias outputs (input_output_alias in
-    the compiled module) — donation that silently stopped aliasing would
-    double state HBM right where ZeRO is trying to save it."""
+    """Donation effectiveness across the train-step families (PR-3
+    carry-over, generalized): on runtimes where compat.py strips jit
+    donation, report the waiver; once compat retires, compile one step
+    per family (CNN-ZeRO, LM, ViT) and measure how much of the donated
+    train state actually aliases outputs — aliased-bytes over
+    donatable-bytes from the compiled module's ``input_output_alias``
+    header, parsed by the compiled-IR lint (analysis/hlolint.py).
+    Donation that silently stopped aliasing would double state HBM
+    right where ZeRO/donation is trying to save it."""
     import jax
 
     from ddl_tpu.train.steps import make_dp_step_fns
@@ -534,34 +537,90 @@ def _probe_zero_donation() -> _Probe:
             "donation-effectiveness waived: compat.py strips jit donation "
             "on this runtime (old jaxlib mis-aliases donated buffers "
             "under shard_map); when compat retires, this probe compiles "
-            "the ZeRO step and asserts input_output_alias"
+            "one step per family (CNN-ZeRO, LM, ViT) and asserts "
+            "input_output_alias coverage of the donated state"
         )
         return probe
-    import jax.numpy as jnp
 
-    # the same ZeRO composition cnn_dp_zero validates — one builder,
-    # no drift between the two probes
-    fns, state, _mesh = _cnn_build(zero=True, data=4)
-    img = jax.ShapeDtypeStruct((8, 16, 16, 3), jnp.uint8)
-    lbl = jax.ShapeDtypeStruct((8,), jnp.int32)
-    try:
-        compiled = fns.train.lower(state, img, lbl).compile()
-        text = compiled.as_text()
-    except Exception as e:
-        msg = str(e).splitlines()[0][:200] if str(e) else ""
-        probe.add(
-            "contract-trace",
-            f"ZeRO donation probe failed to compile: {type(e).__name__}: "
-            f"{msg}",
+    from ddl_tpu.analysis.hlolint import (
+        _state_bytes,
+        parse_aliases,
+        parse_param_bytes,
+    )
+
+    def check(name: str, build) -> None:
+        try:
+            train, state = build()
+            text = train.lower(state, *train.probe_inputs()).compile(
+            ).as_text()
+        except Exception as e:
+            msg = str(e).splitlines()[0][:200] if str(e) else ""
+            probe.add(
+                "contract-trace",
+                f"{name} donation probe failed to compile: "
+                f"{type(e).__name__}: {msg}",
+            )
+            return
+        aliases = parse_aliases(text)
+        if not aliases:
+            probe.add(
+                "contract-donation",
+                f"the compiled {name} train step shows no "
+                "input_output_alias: the donated state is being copied, "
+                "doubling state HBM across the update",
+            )
+            return
+        param_bytes = parse_param_bytes(text)
+        aliased = sum(
+            param_bytes.get(p, 0)
+            for _out, p, pidx in aliases if pidx == ""
         )
-        return probe
-    if not _donation_alias_present(text):
-        probe.add(
-            "contract-donation",
-            "the compiled ZeRO train step shows no input_output_alias: "
-            "the donated state is being copied, doubling state HBM "
-            "across the update",
+        donatable = _state_bytes(state)
+        probe.note(
+            f"{name} donation effectiveness: {aliased}/{donatable} "
+            f"bytes aliased ({aliased / max(donatable, 1):.0%})"
         )
+
+    def build_cnn():
+        # the same ZeRO composition cnn_dp_zero validates — one
+        # builder, no drift between the two probes
+        fns, state, _mesh = _cnn_build(zero=True, data=4)
+        return fns.train, state
+
+    def build_lm():
+        import optax
+
+        from ddl_tpu.parallel.sharding import LMMeshSpec
+        from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+        fns = make_lm_step_fns(
+            _tiny_lm_cfg(), LMMeshSpec(data=2, model=2),
+            optax.adam(1e-3), jax.random.key(0), batch=8, seq_len=32,
+        )
+        return fns.train, fns.init_state()
+
+    def build_vit():
+        import optax
+
+        from ddl_tpu.models.vit import ViTConfig
+        from ddl_tpu.parallel.sharding import LMMeshSpec
+        from ddl_tpu.train.vit_steps import make_vit_step_fns
+
+        cfg = ViTConfig(
+            image_size=16, patch_size=8, d_model=64, n_layers=2,
+            n_heads=4, head_dim=16, d_ff=256, compute_dtype="float32",
+            remat=False,
+        )
+        fns = make_vit_step_fns(
+            cfg, LMMeshSpec(data=2, model=2), optax.adam(1e-3),
+            jax.random.key(0), batch=8,
+        )
+        return fns.train, fns.init_state()
+
+    for name, build in (
+        ("CNN-ZeRO", build_cnn), ("LM", build_lm), ("ViT", build_vit),
+    ):
+        check(name, build)
     return probe
 
 
@@ -658,21 +717,17 @@ def _probe_serve_decode() -> _Probe:
         )
     )
     pools = jax.eval_shape(fns.init_pools)
-    tables = jax.ShapeDtypeStruct((4, fns.max_blocks_per_seq), jnp.int32)
-    lengths = jax.ShapeDtypeStruct((4,), jnp.int32)
-    pending = jax.ShapeDtypeStruct((4,), jnp.int32)
-    rngs = jax.ShapeDtypeStruct((4, 2), jnp.uint32)
+    # arg structs come from the engine's own probe_inputs so the probe
+    # can never drift from the real call sites (shared with the
+    # compiled-IR probes in analysis/hlolint.py)
     decode, _ = fns.decode_for(4, fns.max_blocks_per_seq)
     _lower(
-        probe, decode, params, pools, tables, lengths, pending, rngs,
+        probe, decode, params, pools, *fns.probe_inputs("decode", 4),
         what="serve continuous-batch decode chunk",
     )
     _lower(
         probe, fns.prefill_for(8), params, pools,
-        jax.ShapeDtypeStruct((1, 8), jnp.int32),
-        jax.ShapeDtypeStruct((1,), jnp.int32),
-        jax.ShapeDtypeStruct((), jnp.int32),
-        jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+        *fns.probe_inputs("prefill", 8),
         what="serve bucketed prefill",
     )
     # the round-17 chunk prefill (prefix-cache tails / long-prompt
@@ -680,12 +735,7 @@ def _probe_serve_decode() -> _Probe:
     # gathered pool view must lower under the same sharded mesh
     chunk, _ = fns.chunk_for(8, fns.max_blocks_per_seq, "final")
     _lower(
-        probe, chunk, params, pools,
-        jax.ShapeDtypeStruct((1, 8), jnp.int32),
-        jax.ShapeDtypeStruct((fns.max_blocks_per_seq,), jnp.int32),
-        jax.ShapeDtypeStruct((), jnp.int32),
-        jax.ShapeDtypeStruct((), jnp.int32),
-        jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+        probe, chunk, params, pools, *fns.probe_inputs("chunk", 8),
         what="serve chunk prefill",
     )
     return probe

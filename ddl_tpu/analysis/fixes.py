@@ -16,7 +16,12 @@ edit is implied by the finding itself:
   spec constants is replaced by that constant's name, and the import is
   added/extended;
 * ``obs-event-unregistered`` — the emitted-but-unregistered kind is
-  appended to ``EVENT_KINDS`` in ``<package>/obs/events.py``.
+  appended to ``EVENT_KINDS`` in ``<package>/obs/events.py``;
+* ``donation-missing`` — ``donate_argnums=(0,)`` is inserted into the
+  flagged ``jax.jit(train_step, ...)`` call (behavior-safe: compat.py
+  strips donation on runtimes that can't honor it, and on runtimes that
+  can, donating the consumed train state is exactly what the finding
+  demands).
 
 The contract the tests pin: fixes are **deterministic** (same findings →
 same bytes) and **idempotent** (fix → clean lint for these classes → a
@@ -46,6 +51,7 @@ FIXABLE_RULES = frozenset({
     "compat-bypass",
     "pspec-hand-rolled",
     "obs-event-unregistered",
+    "donation-missing",
 })
 
 
@@ -233,6 +239,53 @@ def _fix_compat(ed: _FileEditor, tree, finding: Finding) -> bool:
                 ed.replace(start, end, f"from jax import shard_map{as_clause}")
                 return True
     return False  # pjit variants and compound imports stay manual
+
+
+def _fix_donation(ed: _FileEditor, tree, finding: Finding) -> bool:
+    """Insert ``donate_argnums=(0,)`` into the flagged ``jax.jit(...)``
+    step-factory call (the train state is argument 0 by the step-fns
+    convention the astlint rule checks)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or node.lineno != finding.line:
+            continue
+        func = node.func
+        fname = (
+            func.id if isinstance(func, ast.Name)
+            else getattr(func, "attr", "")
+        )
+        if fname != "jit" or not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Name) and "train" in first.id):
+            continue
+        if any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in node.keywords
+        ):
+            continue
+        # anchor on the last argument's end, same discipline as
+        # _register_event_kinds (never scan backwards over comments)
+        last = max(
+            list(node.args) + list(node.keywords),
+            key=lambda n: (n.end_lineno, n.end_col_offset),
+        )
+        last_end = ed.offset(last.end_lineno, last.end_col_offset)
+        close = ed.offset(node.end_lineno, node.end_col_offset) - 1
+        tail = ed.src[last_end:close]
+        if tail.lstrip().startswith(","):
+            ins = last_end + tail.index(",") + 1
+            prefix = ""
+        else:
+            ins = last_end
+            prefix = ","
+        if node.lineno != node.end_lineno:
+            indent = re.match(r"\s*", ed.line_text(last.lineno)).group(0)
+            text = prefix + f"\n{indent}donate_argnums=(0,),"
+        else:
+            text = prefix + " donate_argnums=(0,)"
+        ed.replace(ins, ins, text)
+        return True
+    return False
 
 
 _KIND_RE = re.compile(r"obs event kind '([^']+)'")
@@ -439,6 +492,8 @@ def plan_fixes(
                 ok = _fix_bare_except(ed, tree, f)
             elif f.rule == "compat-bypass":
                 ok = _fix_compat(ed, tree, f)
+            elif f.rule == "donation-missing":
+                ok = _fix_donation(ed, tree, f)
             else:  # pspec-hand-rolled
                 ok = _fix_pspec(
                     ed, tree, f, constants, needed_imports,

@@ -1,0 +1,1167 @@
+"""Compiled-IR lint: collective/memory inventory over lowered programs.
+
+The AST rules see source and the contract probes see *traces* — neither
+sees what GSPMD actually emitted.  The bugs that cost chips live one
+layer lower: an accidental all-gather of a ZeRO-sharded moment, a lost
+donation alias, a ppermute regression in the zb schedule, a decode step
+that quietly copies the whole KV pool.  This module closes that gap
+with zero chips: every contract probe program is lowered (and, where
+the CPU backend can, compiled) on its simulated mesh, the
+StableHLO/optimized-HLO **text** is parsed into a structured
+inventory, a small rule family runs over it, and the inventory is
+drift-gated against a committed ``HLO_BASELINE.json``.
+
+Inventory per program (JSON-stable, the baseline unit):
+
+* ``collectives`` — per-kind counts and payload bytes (all-reduce /
+  all-gather / reduce-scatter / collective-permute / all-to-all),
+  keyed by the **mesh axes the replica groups span** (``all-gather@data``)
+  — replica groups are decoded from both the explicit ``{{0,2},{1,3}}``
+  and the iota ``[4,2]<=[8]`` / ``T(perm)`` forms and mapped back to
+  mesh coordinates;
+* ``permutes`` — collective-permute source→target pair sets (the
+  pipeline boundary rings), kept exactly for the symmetry rule;
+* ``mem`` — transpose/copy/convert counts, total and max payload bytes;
+* ``aliases`` — donation aliasing pairs (``input_output_alias``), plus
+  ``donation`` effectiveness (aliased bytes / donatable bytes);
+* ``fingerprint`` — a shape-normalized structural hash of the lowered
+  StableHLO (the dialect-op token stream), equal across batch sizes for
+  a shape-generic program — the two-shape lowering diff that catches
+  recompile hazards the AST rules can't see.
+
+Rule family (absolute — no baseline needed):
+
+* ``oversized-all-gather`` — a ≥threshold-element data-axis all-gather
+  in a ZeRO program whose output shape is not one of the gather shapes
+  the rule table derives for eligible leaves
+  (``parallel/rules.zero_gather_plan``);
+* ``zero-missing-reduce-scatter`` — a ZeRO-eligible leaf with no
+  evidence of the scatter→update→gather cycle: neither a literal
+  reduce-scatter nor a data-axis all-gather producing the leaf's
+  gather shape (XLA:CPU lowers reduce-scatter to
+  all-reduce+dynamic-slice, so the gather side is the portable
+  evidence);
+* ``pipeline-collective-symmetry`` — the collective-permute pair sets
+  of a pipeline program must be closed under inversion (every forward
+  boundary ring has the matching reverse ring) and each must be a
+  bijection over the stage boundary;
+* ``steady-state-copy-hotspot`` — a single copy in a decode/serve
+  program at least as large as the whole KV pool (the paged pool
+  degenerating to copy-per-step);
+* ``shape-specialized-constant`` — the two-shape structural
+  fingerprints differ: some op count or structure depends on the batch
+  size, so every new batch shape is a recompile.
+
+Drift gates (vs ``HLO_BASELINE.json``, ``LINT_BASELINE.json``
+semantics: shrink-only, stale entries reported, ``--update-baseline``
+rewrites): a **new** collective key, a collective **count** increase, a
+>10% payload-**bytes** increase, a **lost** donation alias, and >10%
+copy-bytes growth in a steady-state program each fail ``lint --hlo``
+with a ``file:probe:op`` finding; shrinks are reported stale so the
+baseline only ever shrinks through an intentional rewrite.
+
+The text parsers are pure (no JAX import) so the fixture tests under
+``tests/lint_fixtures/hlo/`` run in milliseconds; the probe registry
+imports JAX lazily and reuses the contract probes' builders — one tiny
+model zoo, no drift between the trace-level and IR-level gates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import inspect
+import json
+import math
+import re
+from pathlib import Path
+
+from ddl_tpu.analysis.findings import Finding
+
+__all__ = [
+    "HLO_RULES",
+    "HloLintResult",
+    "ProgramInventory",
+    "affected_probes",
+    "build_inventories",
+    "diff_baseline",
+    "load_hlo_baseline",
+    "parse_hlo_ops",
+    "parse_replica_groups",
+    "parse_stablehlo_ops",
+    "parse_aliases",
+    "probe_names",
+    "run_hlo_lint",
+    "save_hlo_baseline",
+    "structural_fingerprint",
+]
+
+HLO_RULES = (
+    "oversized-all-gather",
+    "zero-missing-reduce-scatter",
+    "pipeline-collective-symmetry",
+    "steady-state-copy-hotspot",
+    "shape-specialized-constant",
+)
+
+# payload growth tolerated before drift fails (the ISSUE's 10%)
+DRIFT_BYTES_RATIO = 1.10
+
+# smallest data-axis all-gather the oversized rule flags: leaves under
+# this never rate ZeRO sharding, and sub-floor gathers in a compiled
+# step are activation resharding rather than re-materialised state
+OVERSIZED_GATHER_ELEMS = 8192
+
+_COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+_MEM_KINDS = ("copy", "transpose", "convert")
+
+_ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "token": 0, "opaque": 0,
+}
+
+# ---------------------------------------------------------------------------
+# pure text parsing — optimized HLO
+# ---------------------------------------------------------------------------
+
+_SHAPE_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
+# one HLO instruction head: `%name = <shape-or-tuple> opcode(` — the
+# shape may be a tuple `(f32[..]{..}, u32[..]{..})`; capture lazily up
+# to the opcode token
+_HLO_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^=]*?\)|\S+)\s+([\w\-]+)\(",
+    re.M,
+)
+_OP_NAME_RE = re.compile(r'metadata=\{[^}]*?op_name="([^"]+)"')
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[\d,{} ]*\}\}|\[[\d,]+\]<=\[[\d,]+\](?:T\([\d,]+\))?)"
+)
+# collective-permute carries pairs, not groups — same {{s,t},...} shape
+_SOURCE_TARGET_RE = re.compile(r"source_target_pairs=(\{\{[\d,{} ]*\}\})")
+_PARAM_RE = re.compile(
+    r"^\s*%?[\w.\-]+\s*=\s*(\S+)\s+parameter\((\d+)\)", re.M
+)
+# entries end with `)`, so the block closes at the last `)}` — a plain
+# lazy-to-`}` match would stop inside the first entry's empty `{}` index
+_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?\))\s*\}")
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([\d, ]*)\}:\s*\((\d+),\s*\{([\d, ]*)\}(?:,\s*([\w\-]+))?\)"
+)
+
+
+def _shape_dims(shape_text: str) -> tuple[str, tuple[int, ...]] | None:
+    m = _SHAPE_RE.match(shape_text)
+    if m is None:
+        return None
+    dtype, dims = m.groups()
+    return dtype, tuple(int(d) for d in dims.split(",") if d)
+
+
+def shape_bytes(shape_text: str) -> int:
+    """Total bytes of one HLO shape string — a plain ``f32[8,64]{1,0}``
+    or a tuple ``(f32[8]{0}, u32[2]{0})`` (summed)."""
+    total = 0
+    for m in _SHAPE_RE.finditer(shape_text):
+        dtype, dims = m.groups()
+        n = math.prod(int(d) for d in dims.split(",") if d) if dims else 1
+        total += n * _ITEMSIZE.get(dtype, 4)
+    return total
+
+
+def shape_elems(shape_text: str) -> int:
+    parsed = _shape_dims(shape_text)
+    if parsed is None:
+        return 0
+    return math.prod(parsed[1]) if parsed[1] else 1
+
+
+def _iota_replica_groups(
+    dims: list[int], reshape: list[int], perm: list[int] | None
+) -> list[list[int]]:
+    """Decode the iota replica-group form ``[d0,d1]<=[r0,...](T(p...))?``:
+    arange(prod(r)).reshape(r).transpose(p).reshape(d) → rows."""
+    n = math.prod(reshape)
+    if perm is None:
+        flat = list(range(n))
+    else:
+        shape_t = [reshape[p] for p in perm]
+        # strides of the ORIGINAL (row-major) layout, permuted
+        strides = [1] * len(reshape)
+        for i in range(len(reshape) - 2, -1, -1):
+            strides[i] = strides[i + 1] * reshape[i + 1]
+        strides_t = [strides[p] for p in perm]
+        flat = []
+        idx = [0] * len(shape_t)
+        for _ in range(n):
+            flat.append(sum(i * s for i, s in zip(idx, strides_t)))
+            for d in range(len(shape_t) - 1, -1, -1):
+                idx[d] += 1
+                if idx[d] < shape_t[d]:
+                    break
+                idx[d] = 0
+    group_size = dims[-1] if dims else n
+    return [
+        flat[i:i + group_size] for i in range(0, len(flat), group_size)
+    ]
+
+
+def parse_replica_groups(text: str) -> list[list[int]]:
+    """Decode one ``replica_groups=`` value — explicit ``{{0,2},{1,3}}``
+    or iota ``[4,2]<=[8]`` / ``[2,4]<=[2,2,2]T(1,0,2)``."""
+    text = text.strip()
+    if text.startswith("{"):
+        return [
+            [int(x) for x in grp.split(",") if x.strip()]
+            for grp in re.findall(r"\{([\d, ]+)\}", text)
+        ]
+    m = re.match(r"\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?", text)
+    if m is None:
+        return []
+    dims = [int(d) for d in m.group(1).split(",")]
+    reshape = [int(d) for d in m.group(2).split(",")]
+    perm = (
+        [int(d) for d in m.group(3).split(",")] if m.group(3) else None
+    )
+    return _iota_replica_groups(dims, reshape, perm)
+
+
+def group_axes(
+    groups: list[list[int]], mesh_axes: list[tuple[str, int]]
+) -> str:
+    """Which mesh axes the replica groups span, as a stable label
+    (``"data"``, ``"data+model"``; ``"none"`` for singleton groups,
+    ``"devices"`` when no mesh is known).  Device id → coordinates is
+    row-major over the probe mesh axis order, which is how
+    ``build_mesh`` lays simulated devices out."""
+    if not mesh_axes:
+        return "devices"
+    sizes = [s for _, s in mesh_axes]
+    varying: set[int] = set()
+    for grp in groups:
+        coords = []
+        for dev in grp:
+            c = []
+            rem = dev
+            for s in reversed(sizes):
+                c.append(rem % s)
+                rem //= s
+            coords.append(tuple(reversed(c)))
+        for i in range(len(sizes)):
+            if len({c[i] for c in coords}) > 1:
+                varying.add(i)
+    if not varying:
+        return "none"
+    return "+".join(mesh_axes[i][0] for i in sorted(varying))
+
+
+@dataclasses.dataclass
+class HloOp:
+    """One parsed collective/memory instruction."""
+
+    kind: str
+    shape: str  # output shape text
+    bytes: int
+    dims: tuple[int, ...] | None
+    groups: list[list[int]]
+    op_name: str  # JAX provenance from metadata
+    line: str  # raw instruction text (for findings)
+
+
+def parse_hlo_ops(text: str) -> list[HloOp]:
+    """Every collective and memory-traffic instruction of an optimized
+    HLO module text.  Async pairs are normalised: ``-start`` variants
+    count as the op, ``-done`` halves are skipped."""
+    ops: list[HloOp] = []
+    for m in _HLO_OP_RE.finditer(text):
+        shape, opcode = m.groups()
+        kind = opcode[:-6] if opcode.endswith("-start") else opcode
+        if kind not in _COLLECTIVE_KINDS and kind not in _MEM_KINDS:
+            continue
+        if opcode.endswith("-done"):
+            continue
+        line_end = text.find("\n", m.start())
+        line = text[m.start():line_end if line_end != -1 else len(text)]
+        rg = _REPLICA_GROUPS_RE.search(line) or _SOURCE_TARGET_RE.search(line)
+        groups = parse_replica_groups(rg.group(1)) if rg else []
+        name = _OP_NAME_RE.search(line)
+        parsed = _shape_dims(shape.lstrip("("))
+        ops.append(HloOp(
+            kind=kind,
+            shape=shape,
+            bytes=shape_bytes(shape),
+            dims=parsed[1] if parsed else None,
+            groups=groups,
+            op_name=name.group(1) if name else "",
+            line=line.strip(),
+        ))
+    return ops
+
+
+def parse_aliases(text: str) -> list[tuple[str, int, str]]:
+    """Donation aliasing pairs from a compiled module's
+    ``input_output_alias={ {0}: (0, {}, may-alias), ... }`` header:
+    ``(output_index, param_number, param_index)``."""
+    block = _ALIAS_BLOCK_RE.search(text)
+    if block is None:
+        return []
+    return sorted(
+        (out.replace(" ", ""), int(param), pidx.replace(" ", ""))
+        for out, param, pidx, _kind in _ALIAS_ENTRY_RE.findall(block.group(1))
+    )
+
+
+def parse_param_bytes(text: str) -> dict[int, int]:
+    """``parameter(N)`` instruction shapes → bytes, for alias-payload
+    accounting (jit flattens pytrees, so leaves are numbered params)."""
+    out: dict[int, int] = {}
+    for m in _PARAM_RE.finditer(text):
+        shape, num = m.groups()
+        out.setdefault(int(num), shape_bytes(shape))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pure text parsing — lowered StableHLO
+# ---------------------------------------------------------------------------
+
+_FP_TOKEN_RE = re.compile(r"(?:stablehlo|func|sdy|mhlo|chlo)\.[\w.]+")
+_SHLO_PERMUTE_RE = re.compile(
+    r"stablehlo\.collective_permute\"?[^\n]*?source_target_pairs\s*=\s*"
+    r"dense<\[?\[([\d\], \[]+)\]?\]>[^\n]*?->\s*tensor<([\dx]+x)?(\w+)>"
+)
+_SHLO_COLLECTIVE_RE = re.compile(
+    r"stablehlo\.(all_reduce|all_gather|reduce_scatter|all_to_all|"
+    r"collective_permute)\b"
+)
+
+
+def structural_fingerprint(stablehlo_text: str) -> str:
+    """Shape-normalized structural hash of a lowered module: the ordered
+    dialect-op token stream, minus ``stablehlo.constant`` (constant
+    hoisting order tracks batch-derived *values*, not structure).  Equal
+    across batch sizes for a shape-generic program; any structural
+    specialization (an op count that tracks the batch dim) changes
+    it."""
+    tokens = " ".join(
+        t for t in _FP_TOKEN_RE.findall(stablehlo_text)
+        if t != "stablehlo.constant"
+    )
+    return hashlib.sha256(tokens.encode()).hexdigest()
+
+
+def parse_stablehlo_ops(text: str) -> tuple[dict[str, int], list[dict]]:
+    """Collective census of a lowered StableHLO module: per-op counts
+    (names normalised to the HLO spellings) and the collective_permute
+    pair sets with payload bytes — the level the pipeline programs are
+    inventoried at when the CPU backend cannot compile them
+    (PartitionId is unimplemented for SPMD on XLA:CPU)."""
+    counts: dict[str, int] = {}
+    for m in _SHLO_COLLECTIVE_RE.finditer(text):
+        kind = m.group(1).replace("_", "-")
+        kind = {"all-to-all": "all-to-all"}.get(kind, kind)
+        counts[kind] = counts.get(kind, 0) + 1
+    permutes: list[dict] = []
+    for m in _SHLO_PERMUTE_RE.finditer(text):
+        nums = [int(x) for x in re.findall(r"\d+", m.group(1))]
+        pairs = [
+            [nums[i], nums[i + 1]] for i in range(0, len(nums) - 1, 2)
+        ]
+        dims_txt, dtype = m.group(2) or "", m.group(3)
+        n = math.prod(
+            int(d) for d in dims_txt.rstrip("x").split("x") if d
+        ) if dims_txt else 1
+        permutes.append({
+            "pairs": pairs,
+            "bytes": n * _ITEMSIZE.get(dtype, 4),
+        })
+    return counts, permutes
+
+
+# ---------------------------------------------------------------------------
+# inventory construction
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One lowered probe program plus the facts the rules need."""
+
+    name: str
+    lowered: object  # jax .lower() result: .compile(), .as_text()
+    path: str  # factory source, repo-relative (finding attribution)
+    line: int
+    mesh_axes: list[tuple[str, int]]
+    alt_lowered: object | None = None  # second-shape lowering
+    zero_plan: dict | None = None  # rules.zero_gather_plan output
+    pool_bytes: int | None = None  # steady-state KV pool/state bytes
+    pipeline: bool = False
+    donatable_bytes: int | None = None
+
+
+@dataclasses.dataclass
+class ProgramInventory:
+    spec: ProgramSpec
+    data: dict  # the JSON-stable baseline entry
+    ops: list[HloOp]  # per-op detail (rules only; not baselined)
+    permutes: list[dict]
+    notes: list[str]
+
+
+def _aggregate(ops: list[HloOp], mesh_axes) -> tuple[dict, dict]:
+    collectives: dict[str, dict] = {}
+    mem: dict[str, dict] = {}
+    for op in ops:
+        if op.kind in _COLLECTIVE_KINDS:
+            key = f"{op.kind}@{group_axes(op.groups, mesh_axes)}"
+            ent = collectives.setdefault(key, {"count": 0, "bytes": 0})
+        else:
+            ent = mem.setdefault(
+                op.kind, {"count": 0, "bytes": 0, "max_bytes": 0}
+            )
+            ent["max_bytes"] = max(ent["max_bytes"], op.bytes)
+        ent["count"] += 1
+        ent["bytes"] += op.bytes
+    return collectives, mem
+
+
+def build_inventory(spec: ProgramSpec) -> ProgramInventory:
+    """Lower→compile→parse one program; falls back to the StableHLO
+    census when the simulated backend cannot compile it."""
+    notes: list[str] = []
+    shlo = spec.lowered.as_text()
+    fingerprint = structural_fingerprint(shlo)
+    two_shape = None
+    if spec.alt_lowered is not None:
+        alt_fp = structural_fingerprint(spec.alt_lowered.as_text())
+        two_shape = "equal" if alt_fp == fingerprint else "differs"
+    try:
+        compiled_text = spec.lowered.compile().as_text()
+        level = "hlo"
+    except Exception as e:
+        compiled_text = None
+        level = "stablehlo"
+        notes.append(
+            f"{spec.name}: compiled-HLO inventory unavailable on this "
+            f"backend ({type(e).__name__}: {str(e).splitlines()[0][:120]}); "
+            "inventoried at the StableHLO level"
+        )
+    if compiled_text is not None:
+        ops = parse_hlo_ops(compiled_text)
+        collectives, mem = _aggregate(ops, spec.mesh_axes)
+        aliases = parse_aliases(compiled_text)
+        param_bytes = parse_param_bytes(compiled_text)
+        aliased = sum(
+            param_bytes.get(p, 0) for _out, p, pidx in aliases if pidx == ""
+        )
+        permutes = [
+            {"pairs": [list(g[:2]) for g in op.groups], "bytes": op.bytes}
+            for op in ops if op.kind == "collective-permute"
+        ]
+    else:
+        ops = []
+        counts, permutes = parse_stablehlo_ops(shlo)
+        collectives = {
+            f"{kind}@manual": {"count": n, "bytes": 0}
+            for kind, n in sorted(counts.items())
+            if kind != "collective-permute"
+        }
+        for p in permutes:
+            key = "collective-permute@manual"
+            ent = collectives.setdefault(key, {"count": 0, "bytes": 0})
+            ent["count"] += 1
+            ent["bytes"] += p["bytes"]
+        mem = {}
+        aliases = []
+        aliased = 0
+    donation = None
+    if spec.donatable_bytes:
+        donation = {
+            "aliased_bytes": aliased,
+            "donatable_bytes": spec.donatable_bytes,
+        }
+    data = {
+        "level": level,
+        "mesh": [[name, size] for name, size in spec.mesh_axes],
+        "collectives": collectives,
+        "mem": mem,
+        "aliases": [list(a) for a in aliases],
+        "donation": donation,
+        "fingerprint": fingerprint,
+        "two_shape": two_shape,
+    }
+    # permute pair-set summary is baselined too (symmetry regressions
+    # that keep counts/bytes equal still show here)
+    data["permutes"] = sorted(
+        {json.dumps(sorted(map(tuple, p["pairs"]))) for p in permutes}
+    )
+    return ProgramInventory(
+        spec=spec, data=data, ops=ops, permutes=permutes, notes=notes
+    )
+
+
+# ---------------------------------------------------------------------------
+# the rule family
+# ---------------------------------------------------------------------------
+
+
+def _finding(spec: ProgramSpec, rule: str, msg: str) -> Finding:
+    return Finding(spec.path, spec.line, rule, f"{spec.name}: {msg}")
+
+
+def _rule_zero(inv: ProgramInventory) -> list[Finding]:
+    """oversized-all-gather + zero-missing-reduce-scatter over a ZeRO
+    program's data-axis gathers, against the gather geometry the rule
+    table derives (``zero_gather_plan``)."""
+    spec = inv.spec
+    plan = spec.zero_plan
+    findings: list[Finding] = []
+    if plan is None or inv.data["level"] != "hlo":
+        return findings
+    # the oversized flag keeps the ISSUE floor even when the probe's
+    # resolved ZeRO threshold is tiny: sub-floor data-axis gathers are
+    # activation resharding (jvp/transpose provenance), not state
+    floor = max(plan["threshold"] or 0, OVERSIZED_GATHER_ELEMS)
+    allowed = {tuple(s) for s in plan["gather_shapes"]}
+    allowed |= {tuple(s) for s in plan["leaf_shard_shapes"]}
+    seen_gather_shapes: set[tuple[int, ...]] = set()
+    has_reduce_scatter = False
+    for op in inv.ops:
+        axes = group_axes(op.groups, spec.mesh_axes)
+        if "data" not in axes.split("+"):
+            continue
+        if op.kind == "reduce-scatter":
+            has_reduce_scatter = True
+        if op.kind != "all-gather" or op.dims is None:
+            continue
+        seen_gather_shapes.add(op.dims)
+        if math.prod(op.dims) < floor:
+            continue
+        if op.dims not in allowed:
+            findings.append(_finding(
+                spec, "oversized-all-gather",
+                f"data-axis all-gather produces {op.shape} "
+                f"({math.prod(op.dims)} elements) but no ZeRO-eligible "
+                f"leaf gathers at that shape (op_name "
+                f"{op.op_name!r}); an un-constrained gather re-"
+                "materialises state the update should touch shard-wise",
+            ))
+    for leaf in plan["eligible"]:
+        gshape = tuple(leaf["gather_shape"])
+        if gshape in seen_gather_shapes or has_reduce_scatter:
+            continue
+        findings.append(_finding(
+            spec, "zero-missing-reduce-scatter",
+            f"eligible leaf {leaf['name']} ({leaf['size']} elements) "
+            f"shows no scatter→update→gather cycle: no reduce-scatter "
+            f"and no data-axis all-gather producing its gather shape "
+            f"{gshape} — the update is running replicated",
+        ))
+    return findings
+
+
+def _rule_pipeline_symmetry(inv: ProgramInventory) -> list[Finding]:
+    spec = inv.spec
+    if not spec.pipeline:
+        return []
+    findings: list[Finding] = []
+    pair_sets = []
+    for p in inv.permutes:
+        pairs = sorted(tuple(pr[:2]) for pr in p["pairs"])
+        pair_sets.append(pairs)
+        sources = [s for s, _t in pairs]
+        targets = [t for _s, t in pairs]
+        if len(set(sources)) != len(sources) or len(set(targets)) != len(
+            targets
+        ):
+            findings.append(_finding(
+                spec, "pipeline-collective-symmetry",
+                f"collective-permute pair set {pairs} is not a bijection "
+                "over the stage boundary (duplicated source or target)",
+            ))
+    if not pair_sets:
+        findings.append(_finding(
+            spec, "pipeline-collective-symmetry",
+            "pipeline program contains no collective-permute: the stage "
+            "boundary ring is gone (stages are exchanging activations "
+            "through replicated memory, or the schedule collapsed)",
+        ))
+        return findings
+    multiset = {}
+    for pairs in pair_sets:
+        multiset[json.dumps(pairs)] = multiset.get(json.dumps(pairs), 0) + 1
+    for pairs in pair_sets:
+        inverse = json.dumps(sorted((t, s) for s, t in pairs))
+        if multiset.get(inverse, 0) == 0:
+            findings.append(_finding(
+                spec, "pipeline-collective-symmetry",
+                f"collective-permute pair set {pairs} has no inverse "
+                "partner: the forward/backward boundary rings are "
+                "asymmetric across stages",
+            ))
+    return findings
+
+
+def _rule_copy_hotspot(inv: ProgramInventory) -> list[Finding]:
+    spec = inv.spec
+    if spec.pool_bytes is None or inv.data["level"] != "hlo":
+        return []
+    copy = inv.data["mem"].get("copy")
+    if not copy or copy["max_bytes"] < spec.pool_bytes:
+        return []
+    return [_finding(
+        spec, "steady-state-copy-hotspot",
+        f"a single copy moves {copy['max_bytes']} bytes — at least the "
+        f"whole KV pool ({spec.pool_bytes} bytes) — every step; the "
+        "paged pool update has degenerated to a full-pool copy",
+    )]
+
+
+def _rule_two_shape(inv: ProgramInventory) -> list[Finding]:
+    if inv.data.get("two_shape") != "differs":
+        return []
+    return [_finding(
+        inv.spec, "shape-specialized-constant",
+        "lowering at a second batch shape changes the structural "
+        "fingerprint: some op structure is specialized on the batch "
+        "dimension, so every new shape is a full recompile (a hazard "
+        "the AST recompile rules cannot see)",
+    )]
+
+
+def apply_rules(inv: ProgramInventory) -> list[Finding]:
+    findings = []
+    findings += _rule_zero(inv)
+    findings += _rule_pipeline_symmetry(inv)
+    findings += _rule_copy_hotspot(inv)
+    findings += _rule_two_shape(inv)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline: shrink-only / stale-entry semantics, HLO_BASELINE.json
+# ---------------------------------------------------------------------------
+
+
+def load_hlo_baseline(path: str | Path) -> dict:
+    data = json.loads(Path(path).read_text())
+    return data.get("programs", {})
+
+
+def save_hlo_baseline(path: str | Path, programs: dict) -> None:
+    payload = {"version": 1, "programs": programs}
+    Path(path).write_text(
+        json.dumps(payload, indent=1, sort_keys=True) + "\n"
+    )
+
+
+def diff_baseline(
+    inventories: dict[str, ProgramInventory],
+    baseline: dict,
+    scope: set[str] | None = None,
+) -> tuple[list[Finding], list[str]]:
+    """Drift findings + stale notes.  ``scope`` (``lint --changed``)
+    restricts the comparison to those program names: out-of-scope
+    baseline entries are neither matched nor reported stale."""
+    findings: list[Finding] = []
+    stale: list[str] = []
+    for name, inv in sorted(inventories.items()):
+        spec = inv.spec
+        base = baseline.get(name)
+        if base is None:
+            findings.append(_finding(
+                spec, "hlo-unbaselined-program",
+                "program has no HLO_BASELINE.json entry; run "
+                "`ddl_tpu lint --hlo --update-baseline` to commit its "
+                "inventory",
+            ))
+            continue
+        cur_c = inv.data["collectives"]
+        base_c = base.get("collectives", {})
+        for key, ent in sorted(cur_c.items()):
+            bent = base_c.get(key)
+            if bent is None:
+                findings.append(_finding(
+                    spec, "hlo-drift-new-collective",
+                    f"new collective {key} (count {ent['count']}, "
+                    f"{ent['bytes']} bytes) not in the committed "
+                    "baseline",
+                ))
+            elif ent["count"] > bent["count"]:
+                findings.append(_finding(
+                    spec, "hlo-drift-collective-count",
+                    f"{key} count grew {bent['count']} -> {ent['count']}",
+                ))
+            elif ent["bytes"] > bent["bytes"] * DRIFT_BYTES_RATIO:
+                findings.append(_finding(
+                    spec, "hlo-drift-collective-bytes",
+                    f"{key} payload grew {bent['bytes']} -> "
+                    f"{ent['bytes']} bytes (>10%)",
+                ))
+            elif ent["count"] < bent["count"] or ent["bytes"] < bent["bytes"]:
+                stale.append(
+                    f"{name}: {key} shrank "
+                    f"(count {bent['count']}->{ent['count']}, bytes "
+                    f"{bent['bytes']}->{ent['bytes']}) — run "
+                    "--update-baseline to bank the improvement"
+                )
+        for key in sorted(set(base_c) - set(cur_c)):
+            stale.append(
+                f"{name}: baseline collective {key} no longer emitted — "
+                "run --update-baseline"
+            )
+        cur_aliases = {tuple(a) for a in inv.data["aliases"]}
+        for a in base.get("aliases", []):
+            if tuple(a) not in cur_aliases:
+                findings.append(_finding(
+                    spec, "hlo-drift-lost-alias",
+                    f"donation alias {tuple(a)} present in the baseline "
+                    "is gone from the compiled program: a donated buffer "
+                    "stopped aliasing its output (state HBM doubles "
+                    "across the update)",
+                ))
+        for a in sorted(cur_aliases - {tuple(a) for a in base.get("aliases", [])}):
+            stale.append(
+                f"{name}: new donation alias {a} not in the baseline — "
+                "run --update-baseline to bank it"
+            )
+        if spec.pool_bytes is not None:
+            cur_copy = inv.data["mem"].get("copy", {})
+            base_copy = base.get("mem", {}).get("copy", {})
+            if base_copy and cur_copy.get("bytes", 0) > base_copy.get(
+                "bytes", 0
+            ) * DRIFT_BYTES_RATIO:
+                findings.append(_finding(
+                    spec, "hlo-drift-copy-bytes",
+                    f"steady-state copy traffic grew "
+                    f"{base_copy['bytes']} -> {cur_copy['bytes']} bytes "
+                    "(>10%)",
+                ))
+        if not findings_for(findings, name) and base.get(
+            "fingerprint"
+        ) not in (None, inv.data["fingerprint"]):
+            stale.append(
+                f"{name}: program fingerprint changed with no inventory "
+                "drift (a structural edit with identical communication) "
+                "— run --update-baseline to refresh it"
+            )
+    for name in sorted(set(baseline) - set(inventories)):
+        if scope is not None and name not in scope:
+            continue
+        stale.append(
+            f"baseline program {name!r} is no longer probed — run "
+            "--update-baseline to drop it"
+        )
+    return findings, stale
+
+
+def findings_for(findings: list[Finding], program: str) -> list[Finding]:
+    return [f for f in findings if f.message.startswith(f"{program}: ")]
+
+
+# ---------------------------------------------------------------------------
+# probe registry — reuses the contract probes' builders (lazy JAX)
+# ---------------------------------------------------------------------------
+
+
+def _src_loc(factory) -> tuple[str, int]:
+    src = inspect.getsourcefile(factory)
+    root = Path(__file__).resolve().parents[2]
+    path = Path(src).resolve()
+    try:
+        rel = path.relative_to(root).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    return rel, inspect.getsourcelines(factory)[1]
+
+
+def _mesh_axes(mesh) -> list[tuple[str, int]]:
+    return [(name, int(size)) for name, size in mesh.shape.items()]
+
+
+def _state_bytes(state) -> int:
+    import jax
+
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(state)
+        if hasattr(leaf, "size")
+    )
+
+
+def _zero_plan(contract, params, mesh) -> dict | None:
+    from ddl_tpu.parallel.rules import zero_gather_plan
+
+    table = contract.get("rule_table")
+    if table is None or not contract.get("zero_sharding"):
+        return None
+    threshold = contract.get("zero_threshold")
+    return zero_gather_plan(table, params, mesh, threshold=threshold)
+
+
+def _hlo_cnn(zero: bool = False, fused: bool = False) -> list[ProgramSpec]:
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.analysis.contracts import _cnn_build
+    from ddl_tpu.train.steps import make_dp_step_fns
+
+    path, line = _src_loc(make_dp_step_fns)
+    kwargs = (
+        dict(dense_block_impl="fused", dense_block_fused_blocks=(0, 1))
+        if fused else {}
+    )
+    fns, state, mesh = _cnn_build(zero=zero, data=4 if zero else 2, **kwargs)
+    img, lbl = fns.train.probe_inputs(8)
+    img2, lbl2 = fns.train.probe_inputs(16)
+    name = "cnn_dp_zero" if zero else ("cnn_dp_fused" if fused else "cnn_dp")
+    return [ProgramSpec(
+        name=name,
+        lowered=fns.train.lower(state, img, lbl),
+        alt_lowered=fns.train.lower(state, img2, lbl2),
+        path=path, line=line,
+        mesh_axes=_mesh_axes(mesh),
+        zero_plan=(
+            _zero_plan(fns.train.contract, state.params, mesh)
+            if zero else None
+        ),
+        donatable_bytes=_state_bytes(state),
+    )]
+
+
+def _hlo_lm(zero: bool = False) -> list[ProgramSpec]:
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.analysis.contracts import _tiny_lm_cfg
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    path, line = _src_loc(make_lm_step_fns)
+    if zero:
+        from ddl_tpu.train.fused_optim import fused_adam
+
+        fns = make_lm_step_fns(
+            _tiny_lm_cfg(), LMMeshSpec(data=4, model=2), fused_adam(1e-3),
+            jax.random.key(0), batch=8, seq_len=32, zero_sharding=True,
+        )
+    else:
+        import optax
+
+        fns = make_lm_step_fns(
+            _tiny_lm_cfg(), LMMeshSpec(data=2, model=2), optax.adam(1e-3),
+            jax.random.key(0), batch=8, seq_len=32,
+        )
+    state = fns.init_state()
+    return [ProgramSpec(
+        name="lm_zero" if zero else "lm_flat",
+        lowered=fns.train.lower(state, *fns.train.probe_inputs(8)),
+        alt_lowered=fns.train.lower(state, *fns.train.probe_inputs(16)),
+        path=path, line=line,
+        mesh_axes=_mesh_axes(fns.mesh),
+        zero_plan=(
+            _zero_plan(fns.train.contract, state.params, fns.mesh)
+            if zero else None
+        ),
+        donatable_bytes=_state_bytes(state),
+    )]
+
+
+def _hlo_lm_pipeline(schedule: str) -> list[ProgramSpec]:
+    import jax
+    import optax
+
+    from ddl_tpu.analysis.contracts import _tiny_lm_cfg
+    from ddl_tpu.parallel.lm_pipeline import make_lm_pipeline_step_fns
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.lm_steps import make_lm_step_fns
+
+    path, line = _src_loc(make_lm_pipeline_step_fns)
+    fns = make_lm_step_fns(
+        _tiny_lm_cfg(), LMMeshSpec(data=2, pipe=2, model=2),
+        optax.adam(1e-3), jax.random.key(0), batch=8, seq_len=32,
+        num_microbatches=4 if schedule == "zb" else 2,
+        pipeline_schedule=schedule,
+    )
+    state = fns.init_state()
+    name = "lm_pipeline_zb" if schedule == "zb" else "lm_pipeline"
+    # no alt_lowered: the microbatch split bakes the committed batch
+    # into the schedule's reshape, so a second batch shape does not
+    # trace — shape specialization is *contractual* for pipelines
+    return [ProgramSpec(
+        name=name,
+        lowered=fns.train.lower(state, *fns.train.probe_inputs(8)),
+        path=path, line=line,
+        mesh_axes=_mesh_axes(fns.mesh),
+        pipeline=True,
+        donatable_bytes=_state_bytes(state),
+    )]
+
+
+def _hlo_vit(pipeline: bool = False) -> list[ProgramSpec]:
+    import jax
+    import optax
+
+    from ddl_tpu.models.vit import ViTConfig
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.train.vit_steps import make_vit_step_fns
+
+    path, line = _src_loc(make_vit_step_fns)
+    cfg = ViTConfig(
+        image_size=16, patch_size=8, d_model=64, n_layers=2, n_heads=4,
+        head_dim=16, d_ff=256, compute_dtype="float32", remat=False,
+    )
+    spec = (
+        LMMeshSpec(data=2, pipe=2, model=2) if pipeline
+        else LMMeshSpec(data=2, model=2)
+    )
+    fns = make_vit_step_fns(
+        cfg, spec, optax.adam(1e-3), jax.random.key(0), batch=8,
+        **(dict(num_microbatches=2) if pipeline else {}),
+    )
+    state = fns.init_state()
+    # pipeline path: batch is baked into the microbatch reshape, so
+    # only the committed shape traces (see _hlo_lm_pipeline)
+    return [ProgramSpec(
+        name="vit_pipeline" if pipeline else "vit_flat",
+        lowered=fns.train.lower(state, *fns.train.probe_inputs(8)),
+        alt_lowered=(
+            None if pipeline
+            else fns.train.lower(state, *fns.train.probe_inputs(16))
+        ),
+        path=path, line=line,
+        mesh_axes=_mesh_axes(fns.mesh),
+        pipeline=pipeline,
+        donatable_bytes=_state_bytes(state),
+    )]
+
+
+def _hlo_decode() -> list[ProgramSpec]:
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.analysis.contracts import _tiny_lm_cfg
+    from ddl_tpu.infer.decode import make_lm_generator
+    from ddl_tpu.models.transformer import TransformerLM
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+
+    path, line = _src_loc(make_lm_generator)
+    cfg = _tiny_lm_cfg()
+    gen = make_lm_generator(
+        cfg, LMMeshSpec(data=2, model=2), prompt_len=8, max_new=4, batch=2,
+    )
+    params = nn.meta.unbox(jax.eval_shape(
+        lambda r: TransformerLM(cfg, None).init(
+            r, jnp.zeros((2, 8), jnp.int32)
+        )["params"],
+        jax.random.key(0),
+    ))
+    return [ProgramSpec(
+        name="lm_decode",
+        lowered=gen.jitted.lower(params, *gen.probe_inputs()),
+        path=path, line=line,
+        mesh_axes=_mesh_axes(gen.mesh),
+    )]
+
+
+def _hlo_serve() -> list[ProgramSpec]:
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.analysis.contracts import _tiny_lm_cfg
+    from ddl_tpu.models.transformer import TransformerLM
+    from ddl_tpu.parallel.sharding import LMMeshSpec
+    from ddl_tpu.serve.engine import make_serve_step_fns
+
+    path, line = _src_loc(make_serve_step_fns)
+    cfg = _tiny_lm_cfg()
+    fns = make_serve_step_fns(
+        cfg, LMMeshSpec(data=2, model=2),
+        block_size=8, num_blocks=16, max_batch=4,
+    )
+    params = nn.meta.unbox(jax.eval_shape(
+        lambda r: TransformerLM(cfg, None).init(
+            r, jnp.zeros((2, 8), jnp.int32)
+        )["params"],
+        jax.random.key(0),
+    ))
+    pools = jax.eval_shape(fns.init_pools)
+    pool_bytes = sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(pools)
+    )
+    mesh_axes = _mesh_axes(fns.mesh)
+    decode, _ = fns.decode_for(4, fns.max_blocks_per_seq)
+    decode2, _ = fns.decode_for(2, fns.max_blocks_per_seq)
+    prefill = fns.prefill_for(8)
+    chunk, _ = fns.chunk_for(8, fns.max_blocks_per_seq, "final")
+    out = [
+        ProgramSpec(
+            name="serve_decode",
+            lowered=decode.lower(
+                params, pools, *fns.probe_inputs("decode", 4)
+            ),
+            alt_lowered=decode2.lower(
+                params, pools, *fns.probe_inputs("decode", 2)
+            ),
+            path=path, line=line, mesh_axes=mesh_axes,
+            pool_bytes=pool_bytes,
+        ),
+        # prefill/chunk run once per admitted request, not every decode
+        # tick, and legitimately rewrite pool-sized slabs when writing
+        # a prompt's KV — the steady-state hotspot rule only guards the
+        # per-token decode program, so no pool_bytes here
+        ProgramSpec(
+            name="serve_prefill",
+            lowered=prefill.lower(
+                params, pools, *fns.probe_inputs("prefill", 8)
+            ),
+            path=path, line=line, mesh_axes=mesh_axes,
+        ),
+        ProgramSpec(
+            name="serve_chunk",
+            lowered=chunk.lower(
+                params, pools, *fns.probe_inputs("chunk", 8)
+            ),
+            path=path, line=line, mesh_axes=mesh_axes,
+        ),
+    ]
+    return out
+
+
+# (probe name, factory module, builder) — the factory module drives the
+# ``lint --changed --hlo`` mapping through the import/call graph
+HLO_PROBES = (
+    ("cnn_dp", "ddl_tpu.train.steps", lambda: _hlo_cnn()),
+    ("cnn_dp_fused", "ddl_tpu.train.steps", lambda: _hlo_cnn(fused=True)),
+    ("cnn_dp_zero", "ddl_tpu.train.steps", lambda: _hlo_cnn(zero=True)),
+    ("lm_flat", "ddl_tpu.train.lm_steps", lambda: _hlo_lm()),
+    ("lm_zero", "ddl_tpu.train.lm_steps", lambda: _hlo_lm(zero=True)),
+    ("vit_flat", "ddl_tpu.train.vit_steps", lambda: _hlo_vit()),
+    ("lm_decode", "ddl_tpu.infer.decode", _hlo_decode),
+    ("serve", "ddl_tpu.serve.engine", _hlo_serve),
+    (
+        "lm_pipeline", "ddl_tpu.parallel.lm_pipeline",
+        lambda: _hlo_lm_pipeline("gpipe"),
+    ),
+    (
+        "lm_pipeline_zb", "ddl_tpu.parallel.lm_pipeline",
+        lambda: _hlo_lm_pipeline("zb"),
+    ),
+    (
+        "vit_pipeline", "ddl_tpu.train.vit_steps",
+        lambda: _hlo_vit(pipeline=True),
+    ),
+)
+
+
+def probe_names() -> list[str]:
+    return [name for name, _mod, _build in HLO_PROBES]
+
+
+def affected_probes(closure_modules: set[str]) -> list[str]:
+    """Probe names whose factory module is in the reverse-dependency
+    closure of the changed modules (``lint --changed --hlo``)."""
+    return [
+        name for name, mod, _build in HLO_PROBES if mod in closure_modules
+    ]
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HloLintResult:
+    findings: list[Finding]  # absolute-rule + drift findings
+    notes: list[str]
+    stale: list[str]
+    inventories: dict[str, ProgramInventory]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def baseline_programs(self) -> dict:
+        return {
+            name: inv.data for name, inv in sorted(self.inventories.items())
+        }
+
+
+def build_inventories(
+    probes: list[str] | None = None,
+) -> tuple[dict[str, ProgramInventory], list[Finding], list[str]]:
+    """Build, lower, and inventory every (selected) probe program on the
+    simulated mesh.  A probe that cannot even build is a finding, like
+    the contract probes treat it."""
+    from ddl_tpu.analysis.contracts import ensure_simulated_mesh
+
+    notes: list[str] = []
+    findings: list[Finding] = []
+    n = ensure_simulated_mesh()
+    if n < 4:
+        notes.append(
+            f"hlo lint SKIPPED: only {n} simulated device(s); the probe "
+            "meshes need 4+"
+        )
+        return {}, findings, notes
+    inventories: dict[str, ProgramInventory] = {}
+    for name, _mod, build in HLO_PROBES:
+        if probes is not None and name not in probes:
+            continue
+        try:
+            specs = build()
+        except Exception as e:
+            msg = str(e).splitlines()[0][:200] if str(e) else ""
+            findings.append(Finding(
+                "ddl_tpu/analysis/hlolint.py", 1, "hlo-probe-build",
+                f"probe {name!r} failed to build its programs: "
+                f"{type(e).__name__}: {msg}",
+            ))
+            continue
+        for spec in specs:
+            inv = build_inventory(spec)
+            inventories[spec.name] = inv
+            notes.extend(inv.notes)
+    return inventories, findings, notes
+
+
+def run_hlo_lint(
+    probes: list[str] | None = None,
+    baseline_path: str | Path | None = None,
+    scope: set[str] | None = None,
+) -> HloLintResult:
+    """The full IR pass: build inventories, run the rule family, and
+    drift-gate against the committed baseline (when given)."""
+    inventories, findings, notes = build_inventories(probes)
+    for inv in inventories.values():
+        findings.extend(apply_rules(inv))
+    stale: list[str] = []
+    if baseline_path is not None and Path(baseline_path).exists():
+        baseline = load_hlo_baseline(baseline_path)
+        if scope is None and probes is not None:
+            scope = set(inventories)
+        if scope is not None:
+            baseline = {
+                k: v for k, v in baseline.items()
+                if k in scope or k in inventories
+            }
+        drift, stale = diff_baseline(inventories, baseline, scope=scope)
+        findings.extend(drift)
+    elif baseline_path is not None:
+        notes.append(
+            f"hlo baseline {baseline_path} does not exist; run "
+            "`ddl_tpu lint --hlo --update-baseline` to create it"
+        )
+    return HloLintResult(
+        findings=sorted(findings), notes=notes, stale=stale,
+        inventories=inventories,
+    )

@@ -26,7 +26,10 @@ regress again" rule:
   **pipeline-schedule bubble** table (gpipe / 1f1b / interleaved / zb
   idle units at ``--sched-pipe``/``--sched-microbatches``,
   ``obs/schedule_model.py``) — the schedule axis the per-op digest
-  cannot attribute.
+  cannot attribute — and the per-program **compiled-collective** table
+  from the committed ``HLO_BASELINE.json`` (``lint --hlo``): the
+  collective counts and payload bytes GSPMD actually scheduled for
+  every probe program, the communication axis neither estimate covers.
 """
 
 from __future__ import annotations
@@ -37,6 +40,7 @@ import json
 import os
 import sys
 import tempfile
+from pathlib import Path
 
 __all__ = ["main"]
 
@@ -185,6 +189,56 @@ def _print_schedule_table(rows: list[dict]) -> None:
               f"{r['bubble_fraction']:>7.1%}  {idles}")
 
 
+def _hlo_collective_rows() -> list[dict]:
+    """Per-program collective summary from the committed compiled-IR
+    baseline (HLO_BASELINE.json, `lint --hlo`) — the communication the
+    compiler actually scheduled, not an estimate."""
+    path = Path(__file__).resolve().parents[2] / "HLO_BASELINE.json"
+    if not path.exists():
+        return []
+    try:
+        programs = json.loads(path.read_text()).get("programs", {})
+    except (OSError, ValueError):
+        return []
+    rows = []
+    for name, data in sorted(programs.items()):
+        coll = data.get("collectives", {})
+        rows.append({
+            "program": name,
+            "level": data.get("level", "?"),
+            "count": sum(e["count"] for e in coll.values()),
+            "bytes": sum(e["bytes"] for e in coll.values()),
+            "collectives": {
+                k: [v["count"], v["bytes"]] for k, v in sorted(coll.items())
+            },
+        })
+    return rows
+
+
+def _print_hlo_collectives(rows: list[dict]) -> None:
+    if not rows:
+        return
+    print("# compiled-program collectives (HLO_BASELINE.json, "
+          "`lint --hlo`; stablehlo-level rows carry counts only)")
+    print(f"  {'program':16s} {'level':>9s} {'colls':>6s} {'bytes':>10s}  "
+          "breakdown (kind@axes count/bytes)")
+    for r in rows:
+        parts = " ".join(
+            f"{k} {c}/{_fmt_bytes(b)}"
+            for k, (c, b) in r["collectives"].items()
+        )
+        print(f"  {r['program']:16s} {r['level']:>9s} {r['count']:>6d} "
+              f"{_fmt_bytes(r['bytes']):>10s}  {parts}")
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 2**20:
+        return f"{n / 2**20:.1f}MB"
+    if n >= 2**10:
+        return f"{n / 2**10:.1f}KB"
+    return str(n)
+
+
 def _digest(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="ddl_tpu bench digest",
@@ -241,10 +295,11 @@ def _digest(argv: list[str]) -> int:
         sched_rows = schedule_table(
             args.sched_pipe, args.sched_microbatches, args.sched_virtual
         )
+    hlo_rows = _hlo_collective_rows()
     if args.as_json:
         print(json.dumps(
             {"trace_dir": trace_dir, **dig, "opt_hbm": hbm_rows,
-             "schedules": sched_rows}
+             "schedules": sched_rows, "hlo_collectives": hlo_rows}
         ))
         return 0
     print(f"# digest: {trace_dir}")
@@ -257,6 +312,7 @@ def _digest(argv: list[str]) -> int:
         print(f"# top op: {dig['top_op']}")
     _print_opt_hbm(hbm_rows)
     _print_schedule_table(sched_rows)
+    _print_hlo_collectives(hlo_rows)
     return 0
 
 

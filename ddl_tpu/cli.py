@@ -105,6 +105,20 @@ reverse-dependency closure:
         [--update-baseline] [--no-contracts] [--changed]
         [--fix [--check]] [paths...]
 
+``lint --hlo`` is the compiled-IR pass: it lowers AND compiles every
+probe program family (CNN/LM/ViT flat/ZeRO/pipeline, decode, serving
+prefill/decode/chunk) on its simulated mesh, inventories the optimized
+HLO (collective counts and payload bytes per mesh axis, copy/transpose
+traffic, donation aliases, structural fingerprint), applies the IR
+rules (oversized all-gathers, missing ZeRO reduce-scatter cycles,
+asymmetric pipeline rings, full-pool decode copies, batch-specialized
+structure), and drift-gates against the committed
+``HLO_BASELINE.json`` — growth fails, shrinks are stale notes until
+banked with ``--update-baseline``:
+
+    python -m ddl_tpu.cli lint --hlo [--hlo-baseline HLO_BASELINE.json]
+        [--update-baseline] [--changed] [--json]
+
 Headline perf gate (``ddl_tpu/bench/gate.py``): the MFU / steps-per-sec
 regression gate against ``BASELINE.json``'s stored headline (the bench
 sibling of ``obs diff --fail-slowdown``), and the per-op device-time

@@ -453,4 +453,11 @@ def make_lm_generator(
     run.contract = decode_rules().contract()
     run.jitted = jitted
     run.mesh = mesh
+    # abstract generate() args for the compiled-IR probes
+    # (analysis/hlolint.py): the generator bakes batch/prompt_len in, so
+    # the probe asks the factory for the committed shapes
+    run.probe_inputs = lambda: (
+        jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32),
+        jax.random.key(0),
+    )
     return run

@@ -1577,4 +1577,8 @@ def make_lm_pipeline_step_fns(
     return finalize_step_fns(
         mesh, tx, loss_fn, create_state, rng, manual_grad_fn=manual_grad_fn,
         contract=contract,
+        probe_inputs=lambda n=batch: (
+            jax.ShapeDtypeStruct((n, seq_len), jnp.int32),
+            jax.ShapeDtypeStruct((n, seq_len), jnp.int32),
+        ),
     )

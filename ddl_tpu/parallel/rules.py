@@ -66,6 +66,7 @@ __all__ = [
     "vit_rules",
     "decode_rules",
     "zero_shard_spec",
+    "zero_gather_plan",
     "spec_axes",
     "spec_num_shards",
     "optimizer_hbm_bytes",
@@ -424,6 +425,67 @@ def zero_shard_spec(
         if e is None and dim % dp == 0:
             return P(*entries[:i], axis, *entries[i + 1:])
     return None
+
+
+def zero_gather_plan(
+    table: RuleTable,
+    abstract_params,
+    mesh: Mesh,
+    axis: str = "data",
+    threshold: int | None = None,
+) -> dict:
+    """The expected all-gather geometry of a ZeRO-1 program, derived
+    from the rule table — the leaf-size/spec provenance the compiled-IR
+    lint (``analysis/hlolint.py``) checks GSPMD's emitted gathers
+    against.
+
+    Per eligible leaf (``zero_shard_spec`` accepts it): its *gather
+    shape* — the full shape divided by the leaf's non-``axis`` shard
+    counts — which is what the weight-update all-gather must produce
+    (shard-sized operand in, non-data-shard out).  ``leaf_shard_shapes``
+    additionally lists every ≥threshold leaf's shard shape, eligible or
+    not: backward-pass gathers (embedding scatter-add) legitimately
+    produce param-shaped outputs, so they are allowed, while a gather
+    producing any *other* large shape has no business in the step."""
+    if threshold is None:
+        threshold = ZERO_THRESHOLD
+    eligible: list[dict] = []
+    leaf_shard_shapes: set[tuple[int, ...]] = set()
+    for name, leaf, spec, _pat in table.provenance(
+        abstract_params, strict=False
+    ):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        size = math.prod(shape) if shape else 1
+        if size < threshold:
+            continue
+        entries = _norm_entries(spec, len(shape))
+        shard = tuple(
+            dim // math.prod(
+                mesh.shape.get(a, 1)
+                for a in ((e,) if not isinstance(e, tuple) else e)
+                if a != axis
+            ) if e is not None else dim
+            for e, dim in zip(entries, shape)
+        )
+        leaf_shard_shapes.add(shard)
+        zspec = zero_shard_spec(spec, shape, mesh, axis, threshold)
+        if zspec is None:
+            continue
+        eligible.append({
+            "name": name,
+            "size": size,
+            "shape": list(shape),
+            "gather_shape": list(shard),
+        })
+    return {
+        "axis": axis,
+        "threshold": threshold,
+        "eligible": eligible,
+        "gather_shapes": sorted(
+            {tuple(leaf["gather_shape"]) for leaf in eligible}
+        ),
+        "leaf_shard_shapes": sorted(leaf_shard_shapes),
+    }
 
 
 def optimizer_hbm_bytes(

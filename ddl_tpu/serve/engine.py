@@ -314,7 +314,7 @@ ServeStepFns = namedtuple(
     "ServeStepFns",
     ["prefill_for", "chunk_for", "decode_for", "mesh", "contract", "cfg",
      "block_size", "num_blocks", "max_batch", "max_blocks_per_seq",
-     "kv_quant", "init_pools"],
+     "kv_quant", "init_pools", "probe_inputs"],
 )
 
 # Minimum gathered-view rows for the CHUNK prefill programs (Tq > 1
@@ -576,6 +576,41 @@ def make_serve_step_fns(
         # no model axis — same waiver as the one-shot decode generator
         "replicated_params_ok": True,
     }
+
+    def probe_inputs(kind, n):
+        """Abstract per-program args (after params/pools) for the
+        lowering probes (analysis/contracts.py, analysis/hlolint.py):
+        ``("decode", k)`` matches ``decode_for(k, nmax)``, ``("prefill",
+        bucket)`` matches ``prefill_for(bucket)``, ``("chunk", cb)``
+        matches ``chunk_for(cb, nmax, mode)`` — the engine owns these
+        shapes, so the probes can't drift from the real call sites."""
+        i32 = jnp.int32
+        nmax = max_blocks_per_seq
+        key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        if kind == "decode":
+            return (
+                jax.ShapeDtypeStruct((n, nmax), i32),
+                jax.ShapeDtypeStruct((n,), i32),
+                jax.ShapeDtypeStruct((n,), i32),
+                jax.ShapeDtypeStruct((n, 2), jnp.uint32),
+            )
+        if kind == "prefill":
+            return (
+                jax.ShapeDtypeStruct((1, n), i32),
+                jax.ShapeDtypeStruct((1,), i32),
+                jax.ShapeDtypeStruct((), i32),
+                key,
+            )
+        if kind == "chunk":
+            return (
+                jax.ShapeDtypeStruct((1, n), i32),
+                jax.ShapeDtypeStruct((nmax,), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32),
+                key,
+            )
+        raise ValueError(f"unknown probe kind {kind!r}")
+
     return ServeStepFns(
         prefill_for=prefill_for, chunk_for=chunk_for,
         decode_for=decode_for, mesh=mesh,
@@ -585,6 +620,7 @@ def make_serve_step_fns(
         init_pools=lambda: init_kv_pool(
             cfg, num_blocks, block_size, quant=kv_quant
         ),
+        probe_inputs=probe_inputs,
     )
 
 

@@ -247,6 +247,7 @@ def finalize_step_fns(
     accum_steps: int = 1,
     manual_grad_fn=None,
     contract: dict | None = None,
+    probe_inputs=None,
 ) -> LMStepFns:
     """Shared tail for the non-pipelined and pipelined LM paths: wrap a
     ``loss_fn(params, inputs, targets, step=None) -> (loss, (logits,
@@ -374,6 +375,11 @@ def finalize_step_fns(
         zero_sharding=_zero is not None,
         zero_threshold=_zero.resolved_threshold() if _zero is not None else None,
     )
+    # abstract batch structs at an arbitrary batch size, for the
+    # compiled-IR probes (analysis/hlolint.py): lowering the same
+    # program at two batch shapes and diffing structural fingerprints
+    # is how shape-specialized constants are caught
+    train.probe_inputs = probe_inputs
     return LMStepFns(
         train=train,
         evaluate=evaluate,
@@ -646,4 +652,8 @@ def make_lm_step_fns(
     return finalize_step_fns(
         mesh, tx, loss_fn, create_state, rng, accum_steps=accum_steps,
         contract=table.contract(),
+        probe_inputs=lambda n=batch: (
+            jax.ShapeDtypeStruct((n, seq_len), jnp.int32),
+            jax.ShapeDtypeStruct((n, seq_len), jnp.int32),
+        ),
     )

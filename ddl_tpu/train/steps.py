@@ -130,6 +130,14 @@ def make_dp_step_fns(
         zero_sharding=zero is not None,
         zero_threshold=zero.resolved_threshold() if zero is not None else None,
     )
+    # abstract batch structs for the compiled-IR probes
+    # (analysis/hlolint.py): the factory doesn't know the image extent,
+    # so the probe supplies it; two-shape lowering diffs the structural
+    # fingerprints to catch batch-specialized constants
+    train.probe_inputs = lambda n=8, hw=(16, 16): (
+        jax.ShapeDtypeStruct((n, *hw, 3), jnp.uint8),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+    )
     return StepFns(train=train, evaluate=evaluate)
 
 

@@ -160,12 +160,26 @@ def make_vit_step_fns(
             )
 
     return _finalize_vit(mesh, tx, forward, create_state, rng,
-                         accum_steps=accum_steps, contract=table.contract())
+                         accum_steps=accum_steps, contract=table.contract(),
+                         probe_inputs=_vit_probe_inputs(cfg))
+
+
+def _vit_probe_inputs(cfg: ViTConfig):
+    """Abstract batch structs for the compiled-IR probes
+    (analysis/hlolint.py) — the family knows its image extent from the
+    config, so two-shape lowering needs only a batch size."""
+    return lambda n=8: (
+        jax.ShapeDtypeStruct(
+            (n, cfg.image_size, cfg.image_size, 3), jnp.uint8
+        ),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+    )
 
 
 def _finalize_vit(mesh, tx, forward, create_state, rng,
                   accum_steps: int = 1, manual_grad_fn=None,
-                  contract: dict | None = None) -> ViTStepFns:
+                  contract: dict | None = None,
+                  probe_inputs=None) -> ViTStepFns:
     """Shared jit tail for the plain and pipelined ViT paths: wraps a
     ``forward(params, images, step=None) -> logits`` (``step`` drives the
     train-mode dropout rng; eval passes nothing) and a
@@ -264,6 +278,7 @@ def _finalize_vit(mesh, tx, forward, create_state, rng,
         zero_sharding=_zero is not None,
         zero_threshold=_zero.resolved_threshold() if _zero is not None else None,
     )
+    train.probe_inputs = probe_inputs
     return ViTStepFns(
         train=train,
         evaluate=_with_mesh(jax.jit(
@@ -498,4 +513,5 @@ def _make_vit_pipeline_step_fns(
                              pipeline_schedule=schedule,
                              pipeline_stages=n_stages,
                              virtual_stages=V,
-                         ))
+                         ),
+                         probe_inputs=_vit_probe_inputs(cfg))

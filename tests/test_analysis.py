@@ -321,6 +321,55 @@ def test_shipped_package_matches_committed_baseline():
     )
 
 
+def test_hlo_baseline_file_matches_probe_registry():
+    """Fast half of the compiled-IR gate: HLO_BASELINE.json exists,
+    loads, and its program set is exactly what the probe registry
+    builds — a renamed or dropped probe fails here in milliseconds,
+    before anyone pays for a compile."""
+    from ddl_tpu.analysis.hlolint import (
+        HLO_PROBES, load_hlo_baseline, probe_names,
+    )
+
+    path = REPO / "HLO_BASELINE.json"
+    assert path.exists(), (
+        "HLO_BASELINE.json missing — run "
+        "`ddl_tpu lint --hlo --update-baseline`"
+    )
+    programs = load_hlo_baseline(path)
+    assert programs, "HLO_BASELINE.json has no program inventories"
+    for name, data in programs.items():
+        assert data["level"] in ("hlo", "stablehlo"), name
+        assert "collectives" in data and "fingerprint" in data, name
+    # every baselined program belongs to a registered probe family
+    # (serve fans out to serve_prefill/serve_decode/serve_chunk)
+    families = set(probe_names())
+    for name in programs:
+        assert name in families or name.rsplit("_", 1)[0] in families, (
+            f"baseline program {name!r} matches no registered probe"
+        )
+    # every registered probe module really exists in the package
+    for _name, mod, _build in HLO_PROBES:
+        rel = Path(*mod.split(".")).with_suffix(".py")
+        assert (REPO / rel).exists(), mod
+
+
+@pytest.mark.slow
+def test_shipped_package_matches_committed_hlo_baseline():
+    """The live compiled-IR gate: lower + compile every probe program
+    on its simulated mesh and diff the inventories against the
+    committed HLO_BASELINE.json — the test-suite twin of the CI step
+    `ddl_tpu lint --hlo --hlo-baseline HLO_BASELINE.json`."""
+    from ddl_tpu.analysis.contracts import ensure_simulated_mesh
+    from ddl_tpu.analysis.hlolint import run_hlo_lint
+
+    ensure_simulated_mesh(8)
+    result = run_hlo_lint(baseline_path=REPO / "HLO_BASELINE.json")
+    assert result.ok, (
+        "compiled-IR drift against HLO_BASELINE.json:\n"
+        + "\n".join(f.format() for f in result.findings)
+    )
+
+
 def test_event_registry_covers_package_emits():
     """Every emit(<literal>) in the package names a registered kind —
     the registry rule over the real tree, independent of the baseline."""

@@ -120,6 +120,8 @@ def test_digest_renders_cpu_trace(tmp_path, capsys):
 
     out_hbm = out  # text mode printed the optimizer-HBM section too
     assert "optimizer-state HBM per device" in out_hbm
+    # ...and the compiled-collective table from HLO_BASELINE.json
+    assert "compiled-program collectives" in out
 
     rc = bench_main(["digest", str(trace), "--json", "--opt-hbm-dp", "4"])
     row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
@@ -129,6 +131,11 @@ def test_digest_renders_cpu_trace(tmp_path, capsys):
     for r in row["opt_hbm"]:
         assert r["dp"] == 4
         assert 0 < r["zero_bytes"] < r["replicated_bytes"]
+    hlo = {r["program"]: r for r in row["hlo_collectives"]}
+    assert "cnn_dp_zero" in hlo and "serve_decode" in hlo
+    assert hlo["cnn_dp_zero"]["count"] > 0
+    assert any(k.startswith("all-gather@") and "data" in k
+               for k in hlo["cnn_dp_zero"]["collectives"])
 
     # 0 disables the section (fast path for trace-only digests)
     rc = bench_main(["digest", str(trace), "--opt-hbm-dp", "0"])

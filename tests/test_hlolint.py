@@ -1,0 +1,405 @@
+"""Compiled-IR lint (`ddl_tpu lint --hlo`, ddl_tpu/analysis/hlolint.py):
+text parsers over synthetic HLO/StableHLO fixtures
+(tests/lint_fixtures/hlo/), the IR rule family against known-good /
+known-bad programs, two-shape fingerprint diffing, and the
+HLO_BASELINE.json drift-gate semantics (fail on growth, stale on
+shrink) — all without compiling a single program, so the whole module
+runs in milliseconds.  The live end-to-end gate (lower + compile every
+probe and diff against the committed baseline) is the slow-marked test
+in test_analysis.py.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from ddl_tpu.analysis.findings import Finding
+from ddl_tpu.analysis.hlolint import (
+    HLO_PROBES,
+    ProgramSpec,
+    affected_probes,
+    apply_rules,
+    build_inventory,
+    diff_baseline,
+    findings_for,
+    group_axes,
+    load_hlo_baseline,
+    parse_aliases,
+    parse_hlo_ops,
+    parse_param_bytes,
+    parse_replica_groups,
+    parse_stablehlo_ops,
+    probe_names,
+    save_hlo_baseline,
+    shape_bytes,
+    structural_fingerprint,
+)
+
+FIXTURES = Path(__file__).parent / "lint_fixtures" / "hlo"
+
+# fixture programs are written against this probe mesh: device id =
+# data * 2 + model (row-major), 8 devices
+MESH = [("data", 4), ("model", 2)]
+
+ZERO_PLAN = {
+    "axis": "data",
+    "threshold": 8192,
+    "eligible": [
+        {
+            "name": "mlp/wi/kernel", "size": 16384,
+            "shape": [64, 256], "gather_shape": [64, 128],
+        },
+    ],
+    "gather_shapes": [[64, 128]],
+    "leaf_shard_shapes": [[64, 128]],
+}
+
+
+class _FakeCompiled:
+    def __init__(self, text):
+        self._text = text
+
+    def as_text(self):
+        return self._text
+
+
+class _FakeLowered:
+    """Duck-types a jax .lower() result for build_inventory: StableHLO
+    text via as_text(), compiled HLO via compile().as_text() — or a
+    compile() that raises, like the pipeline programs on XLA:CPU."""
+
+    def __init__(self, shlo, hlo=None):
+        self._shlo = shlo
+        self._hlo = hlo
+
+    def as_text(self):
+        return self._shlo
+
+    def compile(self):
+        if self._hlo is None:
+            raise RuntimeError("UNIMPLEMENTED: PartitionId (fixture)")
+        return _FakeCompiled(self._hlo)
+
+
+def _fixture(name):
+    return (FIXTURES / name).read_text()
+
+
+def _spec(name, hlo=None, shlo="", **kw):
+    kw.setdefault("mesh_axes", MESH)
+    return ProgramSpec(
+        name=name, lowered=_FakeLowered(shlo, hlo),
+        path="ddl_tpu/train/steps.py", line=48, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# text parsers
+# ---------------------------------------------------------------------------
+
+
+def test_parse_replica_groups_explicit():
+    assert parse_replica_groups("{{0,2,4,6},{1,3,5,7}}") == [
+        [0, 2, 4, 6], [1, 3, 5, 7],
+    ]
+    assert parse_replica_groups("{{0}, {1}}") == [[0], [1]]
+
+
+def test_parse_replica_groups_iota():
+    assert parse_replica_groups("[2,4]<=[8]") == [
+        [0, 1, 2, 3], [4, 5, 6, 7],
+    ]
+    # transposed iota: arange(8).reshape(4,2).T.reshape(2,4)
+    assert parse_replica_groups("[2,4]<=[4,2]T(1,0)") == [
+        [0, 2, 4, 6], [1, 3, 5, 7],
+    ]
+
+
+def test_group_axes_labels():
+    assert group_axes([[0, 2, 4, 6], [1, 3, 5, 7]], MESH) == "data"
+    assert group_axes([[0, 1], [2, 3]], MESH) == "model"
+    assert group_axes([[0, 1, 2, 3], [4, 5, 6, 7]], MESH) == "data+model"
+    assert group_axes([[0], [1]], MESH) == "none"
+    assert group_axes([[0, 1]], []) == "devices"
+
+
+def test_shape_bytes_scalar_and_tuple():
+    assert shape_bytes("f32[64,128]{1,0}") == 64 * 128 * 4
+    assert shape_bytes("bf16[8]{0}") == 16
+    assert shape_bytes("(f32[8]{0}, u32[2]{0})") == 32 + 8
+    assert shape_bytes("pred[]") == 1
+
+
+def test_parse_hlo_ops_census():
+    ops = parse_hlo_ops(_fixture("zero_good.hlo.txt"))
+    kinds = sorted(o.kind for o in ops)
+    assert kinds == ["all-gather", "all-gather", "all-reduce", "copy"]
+    big = next(o for o in ops if o.dims == (64, 128) and
+               o.kind == "all-gather")
+    assert group_axes(big.groups, MESH) == "data"
+    assert big.op_name == "jit(train_step)/jit(main)/add"
+    assert big.bytes == 64 * 128 * 4
+
+
+def test_parse_aliases_and_param_bytes():
+    text = _fixture("aliased.hlo.txt")
+    aliases = parse_aliases(text)
+    assert ("0", 0, "") in aliases
+    assert ("1", 1, "") in aliases
+    # nested tuple index entries carry their param index path
+    assert any(pidx != "" for _o, _p, pidx in aliases)
+    pb = parse_param_bytes(text)
+    assert pb[0] == 64 * 128 * 4
+    assert pb[1] == 256 * 4
+
+
+def test_parse_stablehlo_ops_permutes():
+    counts, permutes = parse_stablehlo_ops(_fixture("pipeline_good.shlo.txt"))
+    assert counts["collective-permute"] == 2
+    assert counts["all-reduce"] == 1
+    assert [p["pairs"] for p in permutes] == [
+        [[0, 2], [1, 3], [4, 6], [5, 7]],
+        [[2, 0], [3, 1], [6, 4], [7, 5]],
+    ]
+    assert permutes[0]["bytes"] == 4 * 32 * 64 * 4
+
+
+def test_structural_fingerprint_ignores_constant_motion():
+    a = 'x = "stablehlo.constant" y = "stablehlo.add" z = "stablehlo.dot"'
+    b = 'x = "stablehlo.add" y = "stablehlo.constant" z = "stablehlo.dot"'
+    c = 'x = "stablehlo.add" y = "stablehlo.dot" z = "stablehlo.dot"'
+    assert structural_fingerprint(a) == structural_fingerprint(b)
+    assert structural_fingerprint(a) != structural_fingerprint(c)
+
+
+# ---------------------------------------------------------------------------
+# rule family over fixture programs
+# ---------------------------------------------------------------------------
+
+
+def test_zero_rules_clean_on_good_fixture():
+    inv = build_inventory(_spec(
+        "cnn_dp_zero", hlo=_fixture("zero_good.hlo.txt"),
+        zero_plan=ZERO_PLAN,
+    ))
+    assert apply_rules(inv) == []
+
+
+def test_oversized_all_gather_flagged():
+    inv = build_inventory(_spec(
+        "cnn_dp_zero", hlo=_fixture("zero_bad_gather.hlo.txt"),
+        zero_plan=ZERO_PLAN,
+    ))
+    fs = apply_rules(inv)
+    assert [f.rule for f in fs] == ["oversized-all-gather"]
+    assert "f32[512,64]" in fs[0].message
+    # probe-attributed: file:line of the step factory, program-prefixed
+    assert fs[0].path == "ddl_tpu/train/steps.py"
+    assert fs[0].message.startswith("cnn_dp_zero: ")
+
+
+def test_zero_missing_reduce_scatter_flagged():
+    inv = build_inventory(_spec(
+        "cnn_dp_zero", hlo=_fixture("zero_bad_missing.hlo.txt"),
+        zero_plan=ZERO_PLAN,
+    ))
+    fs = apply_rules(inv)
+    assert [f.rule for f in fs] == ["zero-missing-reduce-scatter"]
+    assert "mlp/wi/kernel" in fs[0].message
+
+
+def test_reduce_scatter_satisfies_the_cycle():
+    text = _fixture("zero_bad_missing.hlo.txt").replace(
+        "all-reduce.1 = f32[64,128]{1,0} all-reduce(",
+        "reduce-scatter.1 = f32[64,128]{1,0} reduce-scatter(",
+    )
+    inv = build_inventory(_spec("cnn_dp_zero", hlo=text,
+                                zero_plan=ZERO_PLAN))
+    assert apply_rules(inv) == []
+
+
+def test_pipeline_symmetry_clean_on_good_fixture():
+    inv = build_inventory(_spec(
+        "lm_pipeline", shlo=_fixture("pipeline_good.shlo.txt"),
+        pipeline=True,
+    ))
+    assert inv.data["level"] == "stablehlo"  # compile() raised
+    assert inv.notes  # the fallback is explained, not silent
+    assert apply_rules(inv) == []
+
+
+def test_pipeline_symmetry_flags_asymmetric_rings():
+    inv = build_inventory(_spec(
+        "lm_pipeline", shlo=_fixture("pipeline_bad_asym.shlo.txt"),
+        pipeline=True,
+    ))
+    rules = [f.rule for f in apply_rules(inv)]
+    assert rules and set(rules) == {"pipeline-collective-symmetry"}
+    # both failure modes: duplicated target (non-bijection) AND a
+    # forward ring with no inverse partner
+    assert len(rules) >= 2
+
+
+def test_pipeline_symmetry_flags_missing_permutes():
+    inv = build_inventory(_spec(
+        "lm_pipeline", shlo="module @jit_train_step {}", pipeline=True,
+    ))
+    fs = apply_rules(inv)
+    assert [f.rule for f in fs] == ["pipeline-collective-symmetry"]
+    assert "no collective-permute" in fs[0].message
+
+
+def test_copy_hotspot_on_decode_pool():
+    pool = 16 * 8 * 64 * 4
+    good = build_inventory(_spec(
+        "serve_decode", hlo=_fixture("decode_good.hlo.txt"),
+        pool_bytes=pool,
+    ))
+    assert apply_rules(good) == []
+    bad = build_inventory(_spec(
+        "serve_decode", hlo=_fixture("decode_bad_copy.hlo.txt"),
+        pool_bytes=pool,
+    ))
+    fs = apply_rules(bad)
+    assert [f.rule for f in fs] == ["steady-state-copy-hotspot"]
+
+
+def test_two_shape_fingerprint_diff():
+    shlo = _fixture("pipeline_good.shlo.txt")
+    same = _spec("lm_flat", hlo=_fixture("decode_good.hlo.txt"), shlo=shlo)
+    same.alt_lowered = _FakeLowered(shlo)
+    assert build_inventory(same).data["two_shape"] == "equal"
+
+    specialized = _spec(
+        "lm_flat", hlo=_fixture("decode_good.hlo.txt"), shlo=shlo,
+    )
+    specialized.alt_lowered = _FakeLowered(
+        shlo + '\n%x = "stablehlo.reshape"()'
+    )
+    inv = build_inventory(specialized)
+    assert inv.data["two_shape"] == "differs"
+    assert [f.rule for f in apply_rules(inv)] == [
+        "shape-specialized-constant",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# baseline: drift fails, shrink goes stale, round-trip is byte-stable
+# ---------------------------------------------------------------------------
+
+
+def _inv(name="cnn_dp", hlo=None, **kw):
+    return build_inventory(_spec(
+        name, hlo=hlo or _fixture("zero_good.hlo.txt"), **kw,
+    ))
+
+
+def test_baseline_roundtrip_byte_identical(tmp_path):
+    path = tmp_path / "HLO_BASELINE.json"
+    programs = {"cnn_dp": _inv().data}
+    save_hlo_baseline(path, programs)
+    first = path.read_bytes()
+    assert load_hlo_baseline(path) == programs
+    save_hlo_baseline(path, load_hlo_baseline(path))
+    assert path.read_bytes() == first
+
+
+def test_drift_new_collective_and_count_growth_fail():
+    inv = _inv()
+    base = {"cnn_dp": json.loads(json.dumps(inv.data))}
+    # identical → no findings, no stale
+    fs, stale = diff_baseline({"cnn_dp": inv}, base, scope=None)
+    assert (fs, stale) == ([], [])
+    # a collective kind the baseline never saw
+    grown = json.loads(json.dumps(base))
+    del grown["cnn_dp"]["collectives"]["all-reduce@data+model"]
+    fs, _ = diff_baseline({"cnn_dp": inv}, grown, scope=None)
+    assert [f.rule for f in fs] == ["hlo-drift-new-collective"]
+    # count growth on a known key
+    grown = json.loads(json.dumps(base))
+    grown["cnn_dp"]["collectives"]["all-gather@data"]["count"] -= 1
+    fs, _ = diff_baseline({"cnn_dp": inv}, grown, scope=None)
+    assert [f.rule for f in fs] == ["hlo-drift-collective-count"]
+
+
+def test_drift_bytes_tolerance_is_ten_percent():
+    inv = _inv()
+    base = json.loads(json.dumps({"cnn_dp": inv.data}))
+    key = "all-gather@data"
+    ent = base["cnn_dp"]["collectives"][key]
+    # within 10%: fine (count must match, so only shrink bytes)
+    ent["bytes"] = int(inv.data["collectives"][key]["bytes"] / 1.05)
+    fs, _ = diff_baseline({"cnn_dp": inv}, base, scope=None)
+    assert fs == []
+    ent["bytes"] = int(inv.data["collectives"][key]["bytes"] / 1.5)
+    fs, _ = diff_baseline({"cnn_dp": inv}, base, scope=None)
+    assert [f.rule for f in fs] == ["hlo-drift-collective-bytes"]
+
+
+def test_drift_lost_alias_fails():
+    inv = _inv()
+    base = json.loads(json.dumps({"cnn_dp": inv.data}))
+    base["cnn_dp"]["aliases"] = [["0", 0, ""]]
+    fs, _ = diff_baseline({"cnn_dp": inv}, base, scope=None)
+    assert [f.rule for f in fs] == ["hlo-drift-lost-alias"]
+
+
+def test_shrink_and_fingerprint_changes_go_stale_not_fail():
+    inv = _inv()
+    base = json.loads(json.dumps({"cnn_dp": inv.data}))
+    # baseline remembers MORE traffic than the program now has → stale
+    base["cnn_dp"]["collectives"]["all-gather@data"]["count"] += 3
+    base["cnn_dp"]["fingerprint"] = "f" * 64
+    fs, stale = diff_baseline({"cnn_dp": inv}, base, scope=None)
+    assert fs == []
+    assert len(stale) == 2
+
+
+def test_unbaselined_and_unprobed_programs():
+    inv = _inv()
+    fs, stale = diff_baseline({"cnn_dp": inv}, {}, scope=None)
+    assert [f.rule for f in fs] == ["hlo-unbaselined-program"]
+    fs, stale = diff_baseline(
+        {}, {"ghost": {"collectives": {}}}, scope=None,
+    )
+    assert fs == []
+    assert any("ghost" in s for s in stale)
+    # scoped run: out-of-scope baseline programs are not reported
+    fs, stale = diff_baseline(
+        {}, {"ghost": {"collectives": {}}}, scope={"cnn_dp"},
+    )
+    assert (fs, stale) == ([], [])
+
+
+def test_findings_for_attributes_by_program():
+    f1 = Finding("a.py", 1, "r", "cnn_dp: x")
+    f2 = Finding("a.py", 1, "r", "lm_flat: y")
+    assert findings_for([f1, f2], "cnn_dp") == [f1]
+
+
+# ---------------------------------------------------------------------------
+# probe registry / --changed mapping
+# ---------------------------------------------------------------------------
+
+
+def test_probe_registry_covers_every_family():
+    names = probe_names()
+    for expected in (
+        "cnn_dp", "cnn_dp_zero", "cnn_dp_fused", "lm_flat", "lm_zero",
+        "vit_flat", "lm_decode", "serve", "lm_pipeline",
+        "lm_pipeline_zb", "vit_pipeline",
+    ):
+        assert expected in names
+
+
+def test_affected_probes_maps_modules():
+    assert affected_probes({"ddl_tpu.train.lm_steps"}) == [
+        "lm_flat", "lm_zero",
+    ]
+    assert affected_probes({"ddl_tpu.serve.engine"}) == ["serve"]
+    assert affected_probes({"ddl_tpu.obs.events"}) == []
+    # every registered factory module is a real package module
+    pkg = Path(__file__).resolve().parents[1]
+    for _name, mod, _build in HLO_PROBES:
+        assert (pkg / Path(*mod.split("."))).with_suffix(".py").exists(), mod

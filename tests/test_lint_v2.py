@@ -454,6 +454,44 @@ def test_fix_round_trip_clean_then_byte_noop(tmp_path, capsys):
     assert rc == 0 and "nothing to fix" in out
 
 
+_DONATION_SRC = '''\
+import jax
+
+train_step = jax.jit(
+    _train_step,
+    static_argnames=("cfg",),
+)
+eval_step = jax.jit(_eval_step)
+other_train = jax.jit(_other_train_step, static_argnames=("cfg",),)
+'''
+
+
+def test_fix_donation_missing_inserts_donate_argnums(tmp_path):
+    """`lint --fix` on donation-missing: donate_argnums=(0,) lands in
+    the jit(train...) calls — multi-line and trailing-comma shapes —
+    eval steps are untouched, and a second fix is a byte no-op."""
+    p = tmp_path / "train" / "steps.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(_DONATION_SRC)
+    findings = lint_file(p, tmp_path, REGISTRY)
+    assert _rules(findings).count("donation-missing") == 2
+
+    plan = plan_fixes(findings, tmp_path, tmp_path)
+    assert [f.rule for f in plan.fixed].count("donation-missing") == 2
+    plan.apply()
+    fixed = p.read_text()
+    compile(fixed, str(p), "exec")  # still valid python
+    assert fixed.count("donate_argnums=(0,)") == 2
+    assert "jax.jit(_eval_step)" in fixed  # eval step untouched
+
+    # fixed file lints clean and a second pass changes nothing
+    findings2 = lint_file(p, tmp_path, REGISTRY)
+    assert "donation-missing" not in _rules(findings2)
+    plan2 = plan_fixes(findings2, tmp_path, tmp_path)
+    plan2.apply()
+    assert p.read_text() == fixed
+
+
 def test_fix_is_deterministic(tmp_path, capsys):
     from ddl_tpu.analysis.cli import main
 

@@ -16,7 +16,12 @@ CNN ``TrainState`` and the transformer family's ``LMTrainState``
 (``train/lm_steps.py``), and because Orbax writes *global* arrays, a
 snapshot saved on one mesh restores onto a different mesh/sharding
 (elastic resharding — restore's ``abstract_state`` carries the target
-shardings).  The reference's DCP resume is fixed-topology.
+shardings).  That contract is direction-free: a ZeRO snapshot sharded
+over a SMALLER data axis restores bit-identically into a larger
+world's layout (the elastic scale-UP grow epoch, round 24) just as a
+full pod's snapshot restores onto survivors — the grow path is one
+rank-0-agreed restore with the new world's shardings, nothing more.
+The reference's DCP resume is fixed-topology.
 """
 
 from __future__ import annotations

@@ -43,8 +43,12 @@ election, no extra service — with four primitives:
 
 plus ``agree/<key>.json`` (rank-0 publishes a value, peers wait — how
 the resume snapshot epoch is agreed even when a torn NAS write leaves
-hosts seeing different ``latest_valid_epoch``) and ``abort.json`` (a
-give-up is pod-wide, never one host quietly exiting).
+hosts seeing different ``latest_valid_epoch``), ``abort.json`` (a
+give-up is pod-wide, never one host quietly exiting), and
+``joins/h<i>.json`` (elastic scale-UP: a returning/replacement host —
+outside the live membership, so invisible to every member-scoped
+primitive — asks to be admitted; the leader answers with a restart
+epoch whose ledger record carries the GROWN ``hosts`` set).
 
 Atomicity: every marker is written tmp-file + ``os.replace`` (the same
 pattern as ``checkpoint.write_manifest``), so readers never observe a
@@ -198,10 +202,13 @@ class Rendezvous:
         return self.members[0]
 
     def adopt_membership(self, hosts) -> None:
-        """Shrink (or restate) the live membership — called after an
-        epoch record carrying an agreed ``hosts`` set wins the ledger
-        race.  Raises if this host is not among the survivors (its
-        supervisor must exit, not relaunch)."""
+        """Shrink, GROW, or restate the live membership — called after
+        an epoch record carrying an agreed ``hosts`` set wins the
+        ledger race (a grow epoch's set is larger than the current
+        one; nothing here is direction-sensitive).  Raises if this host
+        is not among the members (its supervisor must exit — or, under
+        ``--elastic``, publish a join_request and wait to be grown back
+        in instead of relaunching)."""
         members = tuple(sorted({int(h) for h in hosts}))
         if self.host not in members:
             raise ValueError(
@@ -212,6 +219,67 @@ class Rendezvous:
                 f"membership {members} out of range for {self.n_hosts}"
             )
         self.members = members
+
+    # --------------------------------------------------------- join intake
+    #
+    # The grow half of elasticity: a returning (or replacement) host is
+    # OUTSIDE the live membership, so none of the member-scoped
+    # primitives can carry its voice — its heartbeats are invisible and
+    # it may not arrive at barriers.  It announces itself through a
+    # dedicated ``joins/h<i>.json`` marker instead; the leader folds
+    # pending requests into the next restart epoch's ``hosts`` set (the
+    # same atomically-created ledger record that agrees shrink
+    # memberships agrees grown ones), and the joiner watches the ledger
+    # for an epoch that admits it.
+
+    def publish_join_request(self, epoch: int, **fields) -> None:
+        """Ask to be (re-)admitted to the pod.  ``epoch`` is the newest
+        restart epoch the joiner has observed.  Refreshed periodically
+        while waiting — the leader ignores requests whose writer went
+        silent (``fresh_s`` below), so a joiner that died after asking
+        cannot drag the pod through a grow epoch it will never join."""
+        _write_json(
+            self.root / "joins" / f"h{self.host:03d}.json",
+            {
+                "ts": self.clock(),
+                "host": self.host,
+                "epoch": int(epoch),
+                **fields,
+            },
+        )
+
+    def join_requests(self, fresh_s: float | None = None) -> list[dict]:
+        """Pending join requests from live NON-members (a member's
+        leftover marker is void by definition), each with an ``age``;
+        requests staler than ``fresh_s`` are dropped."""
+        joins_dir = self.root / "joins"
+        if not joins_dir.is_dir():
+            return []
+        now = self.clock()
+        out = []
+        for p in sorted(joins_dir.glob("h*.json")):
+            rec = _read_json(p)
+            if rec is None:
+                continue
+            h = int(rec.get("host", -1))
+            if h in self.members or not 0 <= h < self.n_hosts:
+                continue
+            rec["age"] = now - float(rec.get("ts", 0.0))
+            if fresh_s is not None and rec["age"] > fresh_s:
+                continue
+            out.append(rec)
+        return out
+
+    def clear_join_request(self, host: int | None = None) -> None:
+        """Withdraw a join request (the joiner's own, by default) —
+        called once an epoch record admits the host, or when it gives
+        up.  Best-effort: a leftover marker from an admitted host is
+        filtered by ``join_requests`` anyway."""
+        h = self.host if host is None else int(host)
+        try:
+            (self.root / "joins" / f"h{h:03d}.json").unlink()
+        except OSError:
+            pass
 
     # ------------------------------------------------------------ liveness
 
@@ -333,13 +401,16 @@ class Rendezvous:
         they raced with a different reason: one restart event, one
         classification.
 
-        ``hosts`` (elastic scale-down) proposes a SHRUNKEN membership:
+        ``hosts`` (elastic) proposes a CHANGED membership — shrunken
+        (scale-down: survivors of an eviction) or GROWN (scale-up: the
+        current members plus admitted joiners, see ``join_requests``):
         the record carries the agreed live host set and world size, and
         because the record is atomically created, the membership
         agreement rides the same first-writer-wins ledger — no second
         agreement round, no split-brain window between "which epoch" and
-        "who is still in it".  Omitted, the proposer's current
-        membership is recorded (a plain same-world restart)."""
+        "who is still in it", in either direction.  Omitted, the
+        proposer's current membership is recorded (a plain same-world
+        restart)."""
         nxt = int(cur_epoch) + 1
         prev = self.epoch_record(cur_epoch) if cur_epoch else None
         crashes = (prev or {}).get("crashes", 0) + (1 if crash else 0)
@@ -514,6 +585,9 @@ class Rendezvous:
         }
         _write_json(path, record)
         return record
+
+    def finished(self) -> dict | None:
+        return _read_json(self.root / "finished.json")
 
     # --------------------------------------------------------------- abort
 

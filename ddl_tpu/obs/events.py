@@ -128,6 +128,13 @@ EVENT_KINDS = (
     # dispatch -> retire/shed).  Training step and incident traces are
     # DERIVED from the existing kinds by the trace builder instead.
     "trace_span", "trace_mark",
+    # elastic scale-UP (round 24): join_request is the joiner side (an
+    # evicted/replacement host publishing its marker and waiting),
+    # peer_join is the leader observing fresh join markers and growing
+    # the membership at the next restart boundary; serve_resume is a
+    # parked serving request re-admitted after the grow epoch with its
+    # partial output re-prefilled (serve/engine.resume_parked)
+    "join_request", "peer_join", "serve_resume",
 )
 
 # ``type`` values carried by "anomaly" events (AnomalyMonitor.record and

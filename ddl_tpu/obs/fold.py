@@ -87,6 +87,10 @@ TIMELINE_KINDS = (
     "supervisor_done", "pod_restart", "peer_stale", "coord_barrier",
     "anomaly", "stall", "watchdog_exit", "rollback", "profile_capture",
     "restart_latency", "snapshot_restore",
+    # elastic membership churn: eviction (peer_lost), the joiner's ask
+    # (join_request) and the leader's grow decision (peer_join) — the
+    # scale-down/scale-up narrative the incident timeline exists to tell
+    "peer_lost", "join_request", "peer_join",
 )
 
 # kinds emitted by a SUPERVISOR process into the same stream as its
@@ -98,6 +102,7 @@ TIMELINE_KINDS = (
 SUPERVISOR_KINDS = frozenset((
     "supervisor_start", "supervisor_relaunch", "supervisor_done",
     "pod_restart", "peer_stale", "coord_barrier",
+    "peer_lost", "join_request", "peer_join",
 ))
 
 # goodput per-repoch replay bookkeeping: retain the last N periods'

@@ -228,10 +228,22 @@ def _timeline_label(e: dict) -> str:
     if kind == "supervisor_relaunch":
         return f"relaunch:{e.get('reason')}"
     if kind == "pod_restart":
+        hosts = e.get("hosts")
         return (
             f"pod_restart:{e.get('reason')} -> epoch {e.get('epoch')} "
             f"(proposer h{e.get('proposer')})"
+            # membership per repoch: elastic shrink/grow epochs carry
+            # the agreed host set — the one line that shows the pod's
+            # world changing size
+            + (f" hosts={hosts}" if hosts else "")
         )
+    if kind == "join_request":
+        return (
+            f"join_request (evicted at epoch {e.get('epoch')}, "
+            f"members {e.get('members')})"
+        )
+    if kind == "peer_join":
+        return f"peer_join hosts={e.get('join_hosts')}"
     if kind == "stall":
         return f"stall age={e.get('age', 0):.1f}s"
     if kind == "restart_latency":

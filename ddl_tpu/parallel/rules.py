@@ -41,7 +41,15 @@ the mesh extent — the tables are what make elastic pod scale-down a
 *derivable* respec: a relaunch on N-1 hosts re-enters the same table
 with a smaller ``data`` axis (``DDL_NUM_PROCESSES`` from the agreed
 membership, see ``supervisor.py``) and every parameter lands in the
-same logical position; only the data-parallel extent shrinks.
+same logical position; only the data-parallel extent shrinks.  The
+same property carries the GROW direction (elastic scale-up, round 24):
+a relaunch into a larger world re-enters the table with the bigger
+``data`` axis, ``zero_shard_spec`` re-picks the same dimension (the
+divisibility test only loosens as the axis grows back toward the size
+the model was originally validated for), and the restore re-shards the
+moments into the new layout with no extra mechanism
+(``checkpoint.state_rule_shardings`` + the global-array restore —
+tests/test_zero_sharding.py pins dp=2 -> dp=4 -> dp=2 bit-identity).
 """
 
 from __future__ import annotations

@@ -775,6 +775,11 @@ class ServeEngine:
         self.guard = guard
         self.draining = False
         self.drain_reason: str | None = None
+        # parked-request resume state (round 24): drain(park=True)
+        # records, per unfinished lane, everything resume_parked()
+        # needs to complete the stream exactly — the original request,
+        # its partial outputs, and the lane's rng carry at park time
+        self.parked: dict[str, dict] = {}
 
     # -- submission -------------------------------------------------------
     def submit(
@@ -865,7 +870,15 @@ class ServeEngine:
         for state in self.scheduler.finished():
             self.scheduler.retire(state.lane)
             req = state.request
-            self.results[req.id] = np.asarray(state.outputs, np.int32)
+            toks = state.outputs
+            if req.resume_prefix is not None:
+                # resumed request: the client stream is the tokens
+                # generated BEFORE the park plus this incarnation's —
+                # token-identical to an uninterrupted decode (the
+                # prefix was re-prefilled as prompt, the rng carry
+                # restored, so the continuation is the same draw)
+                toks = list(req.resume_prefix) + list(state.outputs)
+            self.results[req.id] = np.asarray(toks, np.int32)
             self.outcomes[req.id] = "ok"
             self.stats["completed"] += 1
             end = state.finished_at or perf_counter()
@@ -994,6 +1007,18 @@ class ServeEngine:
             )
             self._emit_pool_stats()
 
+    def _prefill_rng(self, req: Request):
+        """The rng a prefill program seeds its lane with.  An ordinary
+        request derives it from ``rng_seed``; a resumed one restores the
+        parked lane's CARRY — prefill's split discipline matches the
+        decode scan body's (carry in, ``(carry', sub)`` out), so
+        re-prefilling prompt+partial-outputs with the recorded carry
+        produces exactly the token the interrupted decode would have
+        sampled next, and every token after it."""
+        if req.resume_rng is not None:
+            return jnp.asarray(req.resume_rng, jnp.uint32)
+        return jax.random.PRNGKey(req.rng_seed)
+
     def _full_prefill(self, state, t0: float) -> None:
         """The original whole-prompt bucketed prefill, run inline at
         admission (short prompts with no cached prefix)."""
@@ -1007,7 +1032,7 @@ class ServeEngine:
         ids = np.full((bucket // fns.block_size,), fns.num_blocks, np.int32)
         n = min(len(ids), len(state.block_ids))
         ids[:n] = state.block_ids[:n]
-        rng = jax.random.PRNGKey(req.rng_seed)
+        rng = self._prefill_rng(req)
         before = _jit_compiles(prog)
         with jax.set_mesh(fns.mesh):
             tok0, rng, self.pools = prog(
@@ -1180,7 +1205,7 @@ class ServeEngine:
         t0 = perf_counter()
         prog, built = fns.chunk_for(cb, nmax, mode)
         before = _jit_compiles(prog)
-        rng = jax.random.PRNGKey(req.rng_seed)
+        rng = self._prefill_rng(req)
         with jax.set_mesh(fns.mesh):
             out = prog(
                 self.params, self.pools, jnp.asarray(tokens),
@@ -1304,9 +1329,11 @@ class ServeEngine:
         — the drain is a taper, not a cliff.  ``park=True`` is the hard
         stop for a deadline the taper cannot meet: every unfinished
         lane is retired NOW (blocks recycled, no torn refcounts), its
-        partial outputs recorded under outcome ``parked:<reason>`` so a
-        resubmission can skip what was already generated.  Idempotent;
-        emits one ``serve_drain`` event with the shed/parked counts."""
+        partial outputs recorded under outcome ``parked:<reason>`` AND
+        its full resume state kept in ``self.parked`` — after the
+        restart boundary, :meth:`resume_parked` re-admits each one and
+        completes its stream token-identically.  Idempotent; emits one
+        ``serve_drain`` event with the shed/parked counts."""
         if self.draining and not park:
             return {"shed": 0, "parked": 0}
         first = not self.draining
@@ -1330,6 +1357,24 @@ class ServeEngine:
                     state.outputs, np.int32
                 )
                 self.outcomes[state.request.id] = f"parked:{reason}"
+                # resume cursor: the partial outputs plus the lane's rng
+                # CARRY (the state after the last sampled token) — what
+                # resume_parked() re-prefills and re-seeds from so the
+                # completed stream is token-identical to an
+                # uninterrupted decode.  A lane parked mid-chunked-
+                # prefill has produced nothing — it resumes as a plain
+                # resubmit (rng None -> seed from rng_seed as usual).
+                self.parked[state.request.id] = {
+                    "request": state.request,
+                    "outputs": list(state.outputs),
+                    "rng": (
+                        np.asarray(
+                            jax.device_get(self._rngs[state.lane]),
+                            np.uint32,
+                        )
+                        if state.prefill_done and state.outputs else None
+                    ),
+                }
                 parked += 1
         if self.obs is not None and (first or parked):
             self.obs.emit(
@@ -1340,6 +1385,73 @@ class ServeEngine:
                 active_lanes=len(self.scheduler.active()),
             )
         return {"shed": shed, "parked": parked}
+
+    def resume_parked(self) -> dict:
+        """Re-open admission and resubmit every request parked by
+        ``drain(park=True)`` — the serving half of an elastic grow
+        epoch.  Each parked request re-enters through NORMAL admission
+        (same id, same tenant tags) with its prompt extended by the
+        tokens it already generated: prefill recomputes their KV rows
+        (the park recycled its blocks), the recorded rng carry seeds the
+        continuation, and ``_retire_finished`` prepends the prefix back
+        — so the completed stream is token-identical to a decode that
+        was never interrupted (greedy trivially; sampled because the
+        carry replays the exact split sequence).  The pool footprint is
+        unchanged: (p + j) + (m - j) - 1 = p + m - 1 cache rows.
+        Returns ``{"resumed", "rejected"}``; a request the (possibly
+        smaller) new world cannot ever fit is shed through the normal
+        admission path, never silently dropped."""
+        self.draining = False
+        self.drain_reason = None
+        parked, self.parked = self.parked, {}
+        resumed = rejected = 0
+        for rid, rec in parked.items():
+            req = rec["request"]
+            outputs = rec["outputs"]
+            if len(outputs) >= req.max_new:
+                # defensive: a record that is actually complete
+                self.results[rid] = np.asarray(outputs, np.int32)
+                self.outcomes[rid] = "ok"
+                continue
+            prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+            if outputs:
+                prompt = np.concatenate(
+                    [prompt, np.asarray(outputs, np.int32)]
+                )
+            new = Request(
+                id=rid,
+                prompt=prompt,
+                max_new=req.max_new - len(outputs),
+                submitted_at=req.submitted_at,
+                rng_seed=req.rng_seed,
+                traced=req.traced,
+                tenant=req.tenant,
+                priority_class=req.priority_class,
+                resume_prefix=list(outputs),
+                resume_rng=rec["rng"],
+            )
+            # the parked partials were surfaced under parked:<reason>;
+            # the resumed completion replaces them
+            self.results.pop(rid, None)
+            self.outcomes.pop(rid, None)
+            outcome = self.admission.offer(
+                new, fits_ever=self.scheduler.fits_ever(new)
+            )
+            if outcome == "rejected":
+                self.stats["shed"] += 1
+                rejected += 1
+            else:
+                resumed += 1
+            if self.obs is not None:
+                self.obs.emit(
+                    "serve_resume",
+                    request_id=rid,
+                    resumed_tokens=len(outputs),
+                    remaining=new.max_new,
+                    outcome=outcome,
+                    **tenant_tags(new),
+                )
+        return {"resumed": resumed, "rejected": rejected}
 
     def step(self) -> bool:
         """One scheduler iteration; False when fully drained.  Order:

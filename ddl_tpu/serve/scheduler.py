@@ -86,6 +86,20 @@ class Request:
     chain_keys: Any = dataclasses.field(
         default=None, repr=False, compare=False
     )
+    # elastic resume (round 24): a request re-admitted after
+    # ``drain(park=True)`` carries the tokens it already generated
+    # (``resume_prefix`` — folded into ``prompt`` so prefill recomputes
+    # their KV rows, prepended back at retire so the client stream is
+    # complete) and the parked lane's rng carry (``resume_rng``,
+    # uint32[2]) — prefill seeds from it instead of ``rng_seed`` so a
+    # sampled resume draws the exact split sequence an uninterrupted
+    # decode would have.  Both None for ordinary requests.
+    resume_prefix: Any = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    resume_rng: Any = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def __post_init__(self):
         if self.max_new < 1:

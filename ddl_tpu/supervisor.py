@@ -75,7 +75,30 @@ contiguously).  The relaunched trainers re-derive the data axis from
 the smaller world (``parallel/rules.py``), resume the rank-0-agreed
 snapshot, and re-split the exact-resume cursor across survivors — no
 batch lost or replayed.  A host that finds itself evicted by an
-adopted record exits cleanly instead of aborting the pod.
+adopted record exits cleanly instead of aborting the pod — unless it
+can rejoin (below).
+
+**Elastic scale-UP** (the grow half): an evicted host — or a fresh
+replacement supervisor started into the same launch — does not exit
+under ``elastic``.  It publishes a ``joins/h<i>.json`` marker
+(``coord.Rendezvous.publish_join_request``, refreshed like a
+heartbeat) and waits.  The LEADER observes fresh join requests during
+its signal polls and answers with a ``peer_join`` restart epoch whose
+ledger record carries the GROWN membership — the same first-writer-
+wins atomic-create protocol that agrees shrink memberships, so there
+is no split-brain window between "which epoch" and "who is in it".
+Every member (survivors and joiner) adopts the record, meets at the
+``e<E>-join`` barrier, and relaunches into the larger world: the
+spawn env renumbers ``DDL_NUM_PROCESSES``/``DDL_PROCESS_ID``/
+``DDL_COORD_MEMBERS`` from the adopted membership, the relaunched
+trainers re-derive the bigger data axis, restore the rank-0-agreed
+snapshot (``checkpoint.state_rule_shardings`` reshards ZeRO optimizer
+moments into the new layout), and re-split the data cursor.  The
+restart boundary IS the safe boundary: the grow epoch resumes from
+the last committed snapshot, so membership only ever changes at a
+snapshot commit.  ``EXIT_REJOIN`` (76) is the drill hook: an elastic
+child exiting with it asks its own host to step OUT of the pod and
+return through the join path (``DDL_FAULT=rejoin@epoch:K``).
 """
 
 from __future__ import annotations
@@ -92,6 +115,7 @@ from ddl_tpu.utils.backoff import Backoff
 
 __all__ = [
     "EXIT_PREEMPTED",
+    "EXIT_REJOIN",
     "PodSupervisor",
     "Supervisor",
     "supervise_command",
@@ -102,6 +126,13 @@ __all__ = [
 # a preemption's semantics, and distinguishable from crash exit codes
 # (1, 2, 134, 139, ...) without inventing a private protocol.
 EXIT_PREEMPTED = 75
+# Voluntary leave-and-return (elastic pods only): the child asks its
+# host to step out of the membership and come back through the
+# join_request path — the scripted shape of "this host is being
+# recycled; the pod should shrink now and grow when it returns".
+# Driven by the rejoin fault (DDL_FAULT=rejoin@epoch:K) in the pod-sim
+# drill; outside elastic mode the code classifies as a plain crash.
+EXIT_REJOIN = 76
 
 
 class Supervisor:
@@ -438,6 +469,7 @@ class PodSupervisor:
         stale_after_s: float = 30.0,
         elastic: bool = False,
         elastic_grace_s: float | None = None,
+        rejoin_timeout_s: float | None = None,
         sleep: Callable[[float], None] = time.sleep,
         clock: Callable[[], float] = time.monotonic,
         log: Callable[[str], None] = print,
@@ -457,6 +489,11 @@ class PodSupervisor:
             2.0 * stale_after_s if elastic_grace_s is None
             else float(elastic_grace_s)
         )
+        # elastic scale-up: how long an evicted/returning host keeps its
+        # join_request alive waiting for a grow epoch before giving up
+        # and exiting the way a plain eviction would (default: the
+        # rendezvous timeout — the same patience as a barrier)
+        self.rejoin_timeout_s = rejoin_timeout_s
         # (epoch, host) pairs already logged as stale-within-grace, so
         # the hold-the-grace decision is announced once, not per poll
         self._grace_noted: set = set()
@@ -508,6 +545,22 @@ class PodSupervisor:
         intents = rv.intents(epoch)
         if intents:
             return ("peer_intent", intents[0])
+        if self.elastic and rv.host == rv.leader:
+            # scale-up: a non-member published a fresh join_request.
+            # Only the leader answers (one proposer, not a racing herd),
+            # and the restart boundary it proposes resumes from the last
+            # committed snapshot — the "next safe boundary" by
+            # construction.  Staler-than-stale_after_s requests are a
+            # joiner that died mid-wait; ignored.
+            joins = rv.join_requests(fresh_s=self.stale_after_s or None)
+            if joins:
+                hosts = sorted({int(r["host"]) for r in joins})
+                self._emit("peer_join", join_hosts=hosts, epoch=epoch)
+                self._log(
+                    f"join request(s) from host(s) {hosts}; growing the "
+                    "pod at the next restart boundary"
+                )
+                return ("peer_join", hosts)
         if self.stale_after_s:
             stale = rv.stale_peers(self.stale_after_s)
             if stale and self.elastic:
@@ -553,7 +606,13 @@ class PodSupervisor:
     def _watch(self, child, epoch: int):
         """Run until the local child exits or a pod signal arrives."""
         last_hb = -float("inf")
-        last_sig = -float("inf")
+        # the first signal poll waits a full signal_poll_s: a freshly
+        # relaunched incarnation must get past child startup before a
+        # pending join_request (or any other non-fatal signal) can pull
+        # the pod through ANOTHER restart — otherwise a joiner that
+        # asked during the previous boundary preempts the epoch it was
+        # excluded from before that epoch runs a single step
+        last_sig = self.clock()
         while True:
             rc = child.poll()
             if rc is not None:
@@ -584,6 +643,65 @@ class PodSupervisor:
             sig = self._signals(epoch)
             if sig is not None:
                 return sig
+            self.sleep(self.signal_poll_s)
+
+    def _await_rejoin(self, rec: dict) -> dict | None:
+        """Elastic scale-up, joiner side: this host is outside ``rec``'s
+        membership (evicted earlier, or a replacement supervisor started
+        into a shrunken launch).  Publish a join_request — refreshed
+        like a heartbeat, so the leader can tell a live joiner from a
+        dead one's leftover marker — and watch the epoch ledger for a
+        record whose ``hosts`` re-admits this host.  Returns that record
+        (the caller joins it like any other restart epoch), or None when
+        the pod aborted/finished or the rejoin timeout lapsed."""
+        rv = self.rv
+        evict_epoch = int(rec["epoch"])
+        self._log(
+            f"evicted by restart epoch {evict_epoch} (membership "
+            f"{rec.get('hosts')}); publishing join_request and waiting "
+            "to be re-admitted"
+        )
+        self._emit(
+            "join_request", epoch=evict_epoch, members=rec.get("hosts"),
+        )
+        timeout = (
+            rv.timeout_s if self.rejoin_timeout_s is None
+            else self.rejoin_timeout_s
+        )
+        deadline = self.clock() + timeout
+        last_pub = -float("inf")
+        seen = evict_epoch  # newest ledger epoch this joiner has read
+        while True:
+            now = self.clock()
+            if now - last_pub >= self.heartbeat_s:
+                rv.publish_join_request(seen)
+                rv.publish_heartbeat("joining", seen)
+                last_pub = now
+            if rv.aborted() is not None or rv.finished() is not None:
+                self._log("pod ended while waiting to rejoin; giving up")
+                rv.clear_join_request()
+                return None
+            # scan forward: the pod may restart several times (even
+            # shrink further) before an epoch admits us
+            while True:
+                nxt = rv.epoch_record(seen + 1)
+                if nxt is None:
+                    break
+                seen += 1
+                if rv.host in (nxt.get("hosts") or []):
+                    self._log(
+                        f"re-admitted by restart epoch {seen} "
+                        f"(membership {nxt.get('hosts')})"
+                    )
+                    rv.clear_join_request()
+                    return nxt
+            if now > deadline:
+                self._log(
+                    f"no grow epoch admitted this host within "
+                    f"{timeout:.0f}s; giving up the rejoin"
+                )
+                rv.clear_join_request()
+                return None
             self.sleep(self.signal_poll_s)
 
     def _reap(self, child) -> None:
@@ -652,6 +770,45 @@ class PodSupervisor:
         except PodAborted as e:
             return self._finish_abort(e.record)
         restart_index = 0
+        if epoch > 0:
+            # starting into a launch that already restarted: adopt the
+            # current membership.  A host OUTSIDE it (a replacement, or
+            # this same host's supervisor restarted after eviction) is
+            # the scale-up entry point — under --elastic it publishes a
+            # join_request and waits to be grown back in instead of
+            # exiting.
+            rec0 = rv.epoch_record(epoch)
+            if rec0 is not None:
+                try:
+                    rv.adopt_membership(rec0.get("hosts") or rv.members)
+                except ValueError:
+                    if self.elastic:
+                        rec0 = self._await_rejoin(rec0)
+                    else:
+                        self._log(
+                            f"evicted by restart epoch {rec0['epoch']} "
+                            f"(membership {rec0.get('hosts')}); exiting "
+                            "cleanly — the pod continues without us"
+                        )
+                        rec0 = None
+                    if rec0 is None:
+                        self._emit(
+                            "supervisor_done", rc=0, gave_up=False,
+                            evicted=True, epoch=epoch,
+                        )
+                        return 0
+                    status, res = self._join_restart(rec0, epoch)
+                    if status == "exit":
+                        return res
+                    rec0 = res
+                    if rec0["delay"] > 0:
+                        self.sleep(rec0["delay"])
+                    self.last_relaunch_ts = float(
+                        rec0.get("ts") or time.time()
+                    )
+                    epoch = int(rec0["epoch"])
+                    restart_index = 1
+                    self.restarts = restart_index
         while True:
             ab = rv.aborted()
             if ab is not None:
@@ -682,22 +839,60 @@ class PodSupervisor:
             survivors = None  # elastic: a shrunken membership to propose
             if kind == "exit":
                 rc = int(detail)
-                crash = rc not in (0, EXIT_PREEMPTED)
-                preempt = rc == EXIT_PREEMPTED
-                reason = "crash" if crash else (
-                    "preempt" if preempt else "complete"
-                )
+                if (
+                    self.elastic and rc == EXIT_REJOIN
+                    and len(rv.members) > 1
+                ):
+                    # voluntary leave-and-return (the rejoin drill): the
+                    # child asked to leave the pod, so propose our OWN
+                    # eviction — the pod continues at N-1 — and then
+                    # take the joiner path to be re-admitted.  Burns no
+                    # budget: leaving on purpose is neither a crash nor
+                    # a preemption.
+                    crash = False
+                    preempt = False
+                    reason = "rejoin"
+                    survivors = [m for m in rv.members if m != rv.host]
+                else:
+                    crash = rc not in (0, EXIT_PREEMPTED)
+                    preempt = rc == EXIT_PREEMPTED
+                    reason = "crash" if crash else (
+                        "preempt" if preempt else "complete"
+                    )
                 # tell peers promptly — they kill their children off this
                 # marker instead of waiting for our heartbeat to age out
                 rv.publish_intent(reason, rc, epoch)
             elif kind == "peer_intent":
-                # classify from the INTENT (the peer that actually died),
-                # so the crash budget is consumed even when a bystander
-                # host wins the proposal race
                 rc = int(detail.get("rc", 1))
-                crash = rc not in (0, EXIT_PREEMPTED)
-                preempt = rc == EXIT_PREEMPTED
-                reason = f"peer_{detail.get('reason', 'exit')}"
+                if self.elastic and detail.get("reason") == "rejoin":
+                    # the peer is leaving on purpose to rejoin later:
+                    # continue without it, no budget consumed — mirrors
+                    # the leaver's own classification so the agreed
+                    # record is identical whoever wins the proposal race
+                    crash = False
+                    preempt = False
+                    reason = "peer_rejoin"
+                    gone = int(detail.get("host", -1))
+                    survivors = [m for m in rv.members if m != gone]
+                else:
+                    # classify from the INTENT (the peer that actually
+                    # died), so the crash budget is consumed even when a
+                    # bystander host wins the proposal race
+                    crash = rc not in (0, EXIT_PREEMPTED)
+                    preempt = rc == EXIT_PREEMPTED
+                    reason = f"peer_{detail.get('reason', 'exit')}"
+                self._reap(child)
+            elif kind == "peer_join":
+                # elastic scale-UP: the leader observed fresh
+                # join_request markers.  Propose the next epoch WITH the
+                # joiners — the atomically-created record IS the
+                # membership agreement (coord.propose_restart), exactly
+                # the shrink protocol run in reverse.
+                rc = EXIT_PREEMPTED
+                crash = False
+                preempt = False
+                reason = "peer_join"
+                survivors = sorted(set(rv.members) | set(detail))
                 self._reap(child)
             elif kind == "peer_lost":
                 # elastic eviction: propose the next epoch WITHOUT the
@@ -731,131 +926,10 @@ class PodSupervisor:
                 except BarrierTimeout as e:
                     ab = rv.abort(f"h{rv.host}: {e}", 1)
                     return self._finish_abort(ab)
-            # Join the agreed epoch.  This is a loop only in elastic
-            # mode: a join barrier that times out on a host whose
-            # supervisor died outright is answered by proposing the NEXT
-            # epoch over the hosts that DID arrive, then joining that.
-            while True:
-                try:
-                    # the record's membership is the pod's truth: adopt
-                    # it BEFORE judging the join barrier, so a shrunken
-                    # epoch only waits on its survivors
-                    rv.adopt_membership(rec.get("hosts") or rv.members)
-                except ValueError:
-                    self._log(
-                        f"evicted by restart epoch {rec['epoch']} "
-                        f"(membership {rec.get('hosts')}); exiting — the "
-                        "pod continues without this host"
-                    )
-                    self._emit(
-                        "supervisor_done", rc=0, gave_up=False,
-                        evicted=True, epoch=rec["epoch"],
-                    )
-                    return 0
-                if rec["crashes"] > self.max_restarts:
-                    # the abort rc comes from the RECORD, not this
-                    # host's local view: a bystander that adopted a
-                    # peer's proposal must still surface the crashing
-                    # child's exit code
-                    ab = rv.abort(
-                        f"crash budget exhausted "
-                        f"({rec['crashes']} > {self.max_restarts})",
-                        int(rec.get("rc", rc)) if rec.get("crash") else 1,
-                    )
-                    return self._finish_abort(ab)
-                if rec["preemptions"] > self.max_preemptions:
-                    ab = rv.abort(
-                        f"resumable-exit budget exhausted "
-                        f"({rec['preemptions']} > {self.max_preemptions})",
-                        EXIT_PREEMPTED,
-                    )
-                    return self._finish_abort(ab)
-                self._emit(
-                    "pod_restart",
-                    epoch=rec["epoch"],
-                    reason=rec["reason"],
-                    proposer=rec["proposer"],
-                    crashes=rec["crashes"],
-                    preemptions=rec["preemptions"],
-                    delay=rec["delay"],
-                    hosts=rec.get("hosts"),
-                    world=rec.get("world"),
-                    # the pod-wide decision instant (epoch-record
-                    # proposal stamp) — the flow-arrow origin the
-                    # incident trace draws to every host's join-barrier
-                    # span
-                    decision_ts=rec.get("ts"),
-                )
-                self._log(
-                    f"joining restart epoch {rec['epoch']} "
-                    f"(reason={rec['reason']} by h{rec['proposer']}, "
-                    f"world {rec.get('world', rv.world)}, "
-                    f"crashes {rec['crashes']}/{self.max_restarts}, "
-                    f"delay {rec['delay']:.1f}s)"
-                )
-                # heartbeat while waiting at the join barrier —
-                # throttled to heartbeat_s (on_wait fires every poll
-                # iteration, and an unthrottled atomic write per poll
-                # would load the NAS the signal_poll_s split exists to
-                # protect)
-                last_hb = [-float("inf")]
-
-                def _hb_while_waiting(epoch=epoch):
-                    now = self.clock()
-                    if now - last_hb[0] >= self.heartbeat_s:
-                        rv.publish_heartbeat("restarting", epoch)
-                        last_hb[0] = now
-
-                join = f"e{rec['epoch']}-join"
-                try:
-                    t0 = self.clock()
-                    done_ts = rv.barrier(join, on_wait=_hb_while_waiting)
-                    self._emit(
-                        "coord_barrier",
-                        name=join,
-                        wait=self.clock() - t0,
-                        completed_ts=done_ts,
-                        arrive_ts=rv.last_arrive_ts,
-                    )
-                    break
-                except BarrierTimeout as e:
-                    arrivals = rv.barrier_arrivals(join)
-                    if not self.elastic or not arrivals or (
-                        len(arrivals) >= len(rv.members)
-                    ):
-                        # a peer never joined: its supervisor is gone,
-                        # and a partial relaunch would just hang — give
-                        # the pod up
-                        ab = rv.abort(f"h{rv.host}: {e}", 1)
-                        return self._finish_abort(ab)
-                    # elastic: the arrived hosts ARE the pod now.  All
-                    # of them hit this timeout within a poll interval of
-                    # each other and race the same next-epoch proposal;
-                    # first writer wins, the rest adopt.
-                    self._log(
-                        f"join barrier {join} timed out with arrivals "
-                        f"{arrivals}; proposing continue-on-survivors"
-                    )
-                    self._emit(
-                        "peer_lost", epoch=rec["epoch"],
-                        lost_hosts=[
-                            m for m in rv.members if m not in arrivals
-                        ],
-                        at_barrier=join,
-                    )
-                    try:
-                        rec = rv.propose_restart(
-                            int(rec["epoch"]), "peer_lost",
-                            crash=False, preempt=True, rc=EXIT_PREEMPTED,
-                            delay_fn=lambda c: self.backoff.delay(c - 1),
-                            hosts=arrivals,
-                        )
-                    except BarrierTimeout as e2:
-                        ab = rv.abort(f"h{rv.host}: {e2}", 1)
-                        return self._finish_abort(ab)
-                    continue
-                except PodAborted as e:
-                    return self._finish_abort(e.record)
+            status, res = self._join_restart(rec, epoch)
+            if status == "exit":
+                return res
+            rec = res
             if rec["delay"] > 0:
                 self.sleep(rec["delay"])
             # the restart decision instant: the epoch record's proposal
@@ -864,6 +938,150 @@ class PodSupervisor:
             epoch = int(rec["epoch"])
             restart_index += 1
             self.restarts = restart_index
+
+    def _join_restart(self, rec: dict, epoch: int):
+        """Join the agreed restart epoch ``rec``: adopt its membership,
+        enforce the budgets its record carries, and meet the pod at its
+        join barrier.  Returns ``("ok", rec)`` with the (possibly
+        re-proposed) record to relaunch under, or ``("exit", rc)``.
+
+        This is a loop only in elastic mode, in two directions: a join
+        barrier that times out on a host whose supervisor died outright
+        is answered by proposing the NEXT epoch over the hosts that DID
+        arrive; and a host EVICTED by the adopted record — instead of
+        exiting — publishes a join_request and, when a later epoch
+        re-admits it, loops back to join that grow epoch."""
+        from ddl_tpu.coord import BarrierTimeout, PodAborted
+
+        rv = self.rv
+        while True:
+            try:
+                # the record's membership is the pod's truth: adopt
+                # it BEFORE judging the join barrier, so a shrunken
+                # epoch only waits on its survivors
+                rv.adopt_membership(rec.get("hosts") or rv.members)
+            except ValueError:
+                if self.elastic:
+                    # scale-up, joiner side: stay around, ask back in
+                    newrec = self._await_rejoin(rec)
+                    if newrec is not None:
+                        rec = newrec
+                        continue
+                else:
+                    self._log(
+                        f"evicted by restart epoch {rec['epoch']} "
+                        f"(membership {rec.get('hosts')}); exiting — the "
+                        "pod continues without this host"
+                    )
+                self._emit(
+                    "supervisor_done", rc=0, gave_up=False,
+                    evicted=True, epoch=rec["epoch"],
+                )
+                return ("exit", 0)
+            if rec["crashes"] > self.max_restarts:
+                # the abort rc comes from the RECORD, not this
+                # host's local view: a bystander that adopted a
+                # peer's proposal must still surface the crashing
+                # child's exit code
+                ab = rv.abort(
+                    f"crash budget exhausted "
+                    f"({rec['crashes']} > {self.max_restarts})",
+                    int(rec.get("rc", 1)) if rec.get("crash") else 1,
+                )
+                return ("exit", self._finish_abort(ab))
+            if rec["preemptions"] > self.max_preemptions:
+                ab = rv.abort(
+                    f"resumable-exit budget exhausted "
+                    f"({rec['preemptions']} > {self.max_preemptions})",
+                    EXIT_PREEMPTED,
+                )
+                return ("exit", self._finish_abort(ab))
+            self._emit(
+                "pod_restart",
+                epoch=rec["epoch"],
+                reason=rec["reason"],
+                proposer=rec["proposer"],
+                crashes=rec["crashes"],
+                preemptions=rec["preemptions"],
+                delay=rec["delay"],
+                hosts=rec.get("hosts"),
+                world=rec.get("world"),
+                # the pod-wide decision instant (epoch-record
+                # proposal stamp) — the flow-arrow origin the
+                # incident trace draws to every host's join-barrier
+                # span
+                decision_ts=rec.get("ts"),
+            )
+            self._log(
+                f"joining restart epoch {rec['epoch']} "
+                f"(reason={rec['reason']} by h{rec['proposer']}, "
+                f"world {rec.get('world', rv.world)}, "
+                f"crashes {rec['crashes']}/{self.max_restarts}, "
+                f"delay {rec['delay']:.1f}s)"
+            )
+            # heartbeat while waiting at the join barrier —
+            # throttled to heartbeat_s (on_wait fires every poll
+            # iteration, and an unthrottled atomic write per poll
+            # would load the NAS the signal_poll_s split exists to
+            # protect)
+            last_hb = [-float("inf")]
+
+            def _hb_while_waiting(epoch=epoch):
+                now = self.clock()
+                if now - last_hb[0] >= self.heartbeat_s:
+                    rv.publish_heartbeat("restarting", epoch)
+                    last_hb[0] = now
+
+            join = f"e{rec['epoch']}-join"
+            try:
+                t0 = self.clock()
+                done_ts = rv.barrier(join, on_wait=_hb_while_waiting)
+                self._emit(
+                    "coord_barrier",
+                    name=join,
+                    wait=self.clock() - t0,
+                    completed_ts=done_ts,
+                    arrive_ts=rv.last_arrive_ts,
+                )
+                return ("ok", rec)
+            except BarrierTimeout as e:
+                arrivals = rv.barrier_arrivals(join)
+                if not self.elastic or not arrivals or (
+                    len(arrivals) >= len(rv.members)
+                ):
+                    # a peer never joined: its supervisor is gone,
+                    # and a partial relaunch would just hang — give
+                    # the pod up
+                    ab = rv.abort(f"h{rv.host}: {e}", 1)
+                    return ("exit", self._finish_abort(ab))
+                # elastic: the arrived hosts ARE the pod now.  All
+                # of them hit this timeout within a poll interval of
+                # each other and race the same next-epoch proposal;
+                # first writer wins, the rest adopt.
+                self._log(
+                    f"join barrier {join} timed out with arrivals "
+                    f"{arrivals}; proposing continue-on-survivors"
+                )
+                self._emit(
+                    "peer_lost", epoch=rec["epoch"],
+                    lost_hosts=[
+                        m for m in rv.members if m not in arrivals
+                    ],
+                    at_barrier=join,
+                )
+                try:
+                    rec = rv.propose_restart(
+                        int(rec["epoch"]), "peer_lost",
+                        crash=False, preempt=True, rc=EXIT_PREEMPTED,
+                        delay_fn=lambda c: self.backoff.delay(c - 1),
+                        hosts=arrivals,
+                    )
+                except BarrierTimeout as e2:
+                    ab = rv.abort(f"h{rv.host}: {e2}", 1)
+                    return ("exit", self._finish_abort(ab))
+                continue
+            except PodAborted as e:
+                return ("exit", self._finish_abort(e.record))
 
 
 def supervise_pod_command(

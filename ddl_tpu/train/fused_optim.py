@@ -31,7 +31,12 @@ no manual collectives.  The math is element-identical to the replicated
 path (same expression, same reduction operands — asserted to 1e-6 over
 multi-step trajectories by ``tests/test_zero_sharding.py``); only
 placement changes, so snapshots interoperate both ways (Orbax restores
-global arrays into whatever sharding the live state carries).
+global arrays into whatever sharding the live state carries).  Because
+placement is derived per-world from the rule table, that interop also
+covers elastic membership churn in BOTH directions: moments sharded
+over a dp=2 data axis restore bit-identically into a dp=4 layout (the
+scale-up grow epoch, round 24) and back — pinned by
+``test_zero_snapshot_reshards_across_data_axis_grow``.
 
 Drop-in constraints, both load-bearing:
 

@@ -26,6 +26,14 @@ survives the process.  Three pieces make that safe and observable:
   "the second incarnation must be warm" (``restart_latency`` and the
   ``recompile`` goodput bucket strictly lower).
 
+* **Byte bound** (:func:`evict_to_byte_bound`): the shared NAS root
+  otherwise grows without bound — every elastic shrink/grow leaves
+  another topology key's executables behind forever.
+  ``DDL_COMPILE_CACHE_MAX_BYTES`` caps the whole root with
+  LRU-by-mtime eviction across keys; the active key's fresh entries
+  are never evicted, so the bound cannot cost this incarnation its
+  warm restart.  Eviction counts ride the same ``compile_cache`` event.
+
 Activation is opt-in: ``DDL_COMPILE_CACHE=<dir>`` (any run) or pod mode
 (where the rendezvous supplies the agreed default).  ``DDL_COMPILE_CACHE=off``
 disables even in pod mode.  Bench entry points keep their historical
@@ -39,12 +47,14 @@ from pathlib import Path
 
 __all__ = [
     "ENV_CACHE",
+    "ENV_CACHE_MAX_BYTES",
     "ENV_CACHE_MIN_S",
     "activate_compile_cache",
     "cache_entries",
     "cache_stats",
     "emit_cache_event",
     "enable_compile_cache",
+    "evict_to_byte_bound",
     "topology_key",
 ]
 
@@ -54,11 +64,20 @@ ENV_CACHE = "DDL_COMPILE_CACHE"
 # kernels in production; tests/sims set 0 so every compile is cached.
 ENV_CACHE_MIN_S = "DDL_COMPILE_CACHE_MIN_S"
 DEFAULT_MIN_COMPILE_S = 1.0
+# Byte bound for the WHOLE shared cache root (all topology keys).  The
+# pod-agreed root lives on the NAS and outlives launches by design;
+# without a bound every elastic shrink/grow leaves another keyed
+# subdir's worth of executables behind forever.  Eviction is
+# LRU-by-mtime across keys, with the ACTIVE key's fresh entries held
+# back (see evict_to_byte_bound) so bounding the dir cannot turn this
+# incarnation's warm restart cold.  Unset/empty/0 = unbounded
+# (historical behavior).
+ENV_CACHE_MAX_BYTES = "DDL_COMPILE_CACHE_MAX_BYTES"
 
 # The last activation's stats (one activation per process — jax.config
 # is global), read back by cache_stats()/emit_cache_event().
 _active: dict | None = None
-_counters = {"hits": 0, "misses": 0}
+_counters = {"hits": 0, "misses": 0, "evicted": 0, "evicted_bytes": 0}
 _listener_installed = False
 
 
@@ -125,6 +144,88 @@ def _point_jax_at(cache_dir: Path, min_compile_s: float) -> bool:
         return False
 
 
+def _cache_max_bytes() -> int:
+    try:
+        return int(float(os.environ.get(ENV_CACHE_MAX_BYTES) or 0))
+    except ValueError:
+        return 0
+
+
+def evict_to_byte_bound(
+    root: str | os.PathLike,
+    active_key: str | None = None,
+    max_bytes: int | None = None,
+    fresh_s: float = 600.0,
+) -> dict | None:
+    """Bound the WHOLE shared cache root to ``max_bytes`` (default: the
+    ``DDL_COMPILE_CACHE_MAX_BYTES`` env; unset/0 = unbounded, return
+    None).  Eviction is LRU-by-mtime across every topology key's subdir
+    — XLA touches entries on hit, so mtime order IS recency order — with
+    one carve-out: entries under ``active_key`` younger than ``fresh_s``
+    are never evicted.  Those are the executables this incarnation just
+    compiled (or is mid-warm-restart on); evicting them to satisfy the
+    bound would silently turn the warm restart the cache exists for back
+    into a cold one.  Stale entries of the active key ARE fair game — a
+    key that outgrew the bound on its own still converges.
+
+    Returns ``{"evicted", "evicted_bytes", "total_bytes", "max_bytes"}``
+    and accumulates the eviction counters into :func:`cache_stats` (and
+    therefore the ``compile_cache`` obs event).  Best-effort throughout:
+    a racing peer evicting the same NAS dir, or a file vanishing
+    mid-walk, must never fail an activation."""
+    if max_bytes is None:
+        max_bytes = _cache_max_bytes()
+    if not max_bytes or max_bytes <= 0:
+        return None
+    import time
+
+    now = time.time()
+    protected = Path(root) / active_key if active_key else None
+    files: list[tuple[float, int, Path]] = []
+    total = 0
+    try:
+        walk = list(Path(root).rglob("*"))
+    except OSError:
+        return None
+    for p in walk:
+        try:
+            if not p.is_file():
+                continue
+            st = p.stat()
+        except OSError:
+            continue
+        total += st.st_size
+        files.append((st.st_mtime, st.st_size, p))
+    evicted = 0
+    evicted_bytes = 0
+    if total > max_bytes:
+        files.sort(key=lambda t: t[0])  # oldest first
+        for mtime, size, p in files:
+            if total <= max_bytes:
+                break
+            if (
+                protected is not None
+                and p.is_relative_to(protected)
+                and now - mtime < fresh_s
+            ):
+                continue
+            try:
+                p.unlink()
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+            evicted_bytes += size
+    _counters["evicted"] += evicted
+    _counters["evicted_bytes"] += evicted_bytes
+    return {
+        "evicted": evicted,
+        "evicted_bytes": evicted_bytes,
+        "total_bytes": total,
+        "max_bytes": int(max_bytes),
+    }
+
+
 def activate_compile_cache(
     rv=None,
     cache_root: str | os.PathLike | None = None,
@@ -178,6 +279,9 @@ def activate_compile_cache(
         cache_dir.mkdir(parents=True, exist_ok=True)
     except OSError:
         return None
+    # bound the shared root BEFORE counting entries, so `warm` and
+    # `entries_before` describe what actually survived the byte bound
+    evict_to_byte_bound(root, active_key=key)
     entries = cache_entries(cache_dir)
     if not _point_jax_at(cache_dir, min_s):
         return None

@@ -23,6 +23,12 @@ point, with no hardware involved:
     DDL_FAULT="corrupt_ckpt@save:2"    corrupt the 2nd snapshot after commit
     DDL_FAULT="io@save:1:2"            OSError on save attempts 1 and 2
     DDL_FAULT="io@batch:5"             OSError on the 5th loader sample read
+    DDL_FAULT="rejoin@epoch:2"         the pod-sim child exits with
+                                       EXIT_REJOIN once it relaunches
+                                       into restart epoch >= 2 — the
+                                       elastic scale-UP drill (leave on
+                                       purpose, publish join_request,
+                                       get grown back in)
 
 Grammar: comma-separated ``kind@site:at[:arg]`` specs.  ``site`` is an
 instrumentation point (``step`` in the training loops, ``grad`` inside
@@ -65,6 +71,7 @@ __all__ = [
     "InjectedCrash",
     "activate",
     "active",
+    "check_epoch",
     "check_step",
     "corrupt_check",
     "deactivate",
@@ -73,7 +80,10 @@ __all__ = [
     "traced_nan_step",
 ]
 
-KINDS = ("preempt", "crash", "nan", "spike", "stall", "corrupt_ckpt", "io")
+KINDS = (
+    "preempt", "crash", "nan", "spike", "stall", "corrupt_ckpt", "io",
+    "rejoin",
+)
 
 
 class InjectedCrash(RuntimeError):
@@ -251,6 +261,20 @@ def check_step(step: int, guard=None) -> None:
             inj.nan_pending = True
         elif f.kind == "spike":
             inj.spike_scale = f.arg if f.arg else 1e3
+
+
+def check_epoch(epoch: int) -> bool:
+    """Startup hook for supervised children (``tests/pod_sim_child.py``):
+    True when a ``rejoin@epoch:K`` spec is due at this restart epoch.
+    The child then exits with ``supervisor.EXIT_REJOIN`` so its
+    supervisor leaves the pod voluntarily and rejoins through the
+    elastic scale-up path.  Consume-on-fire applies: the spec's key is
+    recorded before the caller exits, so the post-grow relaunch rebuilds
+    ``DDL_FAULT`` without it and trains normally."""
+    inj = active()
+    if inj is None:
+        return False
+    return bool(inj.fire("epoch", at=int(epoch), kinds=("rejoin",)))
 
 
 def poison_loss(metrics: dict) -> dict:

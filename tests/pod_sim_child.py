@@ -61,6 +61,21 @@ def main() -> None:
             f"pid={os.environ.get('DDL_PROCESS_ID', '-')}\n"
         )
 
+    # elastic scale-UP drill (DDL_FAULT="rejoin@epoch:K"): once this
+    # incarnation's restart epoch reaches K, leave the pod on purpose —
+    # the supervisor sees EXIT_REJOIN, proposes its own eviction, and
+    # rejoins through the join_request path.  Checked BEFORE training so
+    # the leave lands at a restart boundary (a committed snapshot), and
+    # consume-on-fire means the post-grow relaunch trains normally.
+    from ddl_tpu.utils import faultinject
+
+    if faultinject.check_epoch(int(epoch)):
+        from ddl_tpu.supervisor import EXIT_REJOIN
+
+        print(f"[child h{host}] injected rejoin at epoch {epoch}",
+              flush=True)
+        sys.exit(EXIT_REJOIN)
+
     cfg = LMConfig(
         vocab_size=256, d_model=16, n_layers=1, n_heads=2, head_dim=8,
         d_ff=32, compute_dtype="float32", remat=False,

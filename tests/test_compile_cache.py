@@ -20,9 +20,13 @@ def _isolate(monkeypatch):
     """Each test starts deactivated with zeroed counters, and the global
     jax config the module mutates is restored afterwards."""
     monkeypatch.setattr(cc, "_active", None)
-    monkeypatch.setattr(cc, "_counters", {"hits": 0, "misses": 0})
+    monkeypatch.setattr(
+        cc, "_counters",
+        {"hits": 0, "misses": 0, "evicted": 0, "evicted_bytes": 0},
+    )
     monkeypatch.delenv(cc.ENV_CACHE, raising=False)
     monkeypatch.delenv(cc.ENV_CACHE_MIN_S, raising=False)
+    monkeypatch.delenv(cc.ENV_CACHE_MAX_BYTES, raising=False)
     prev_dir = jax.config.jax_compilation_cache_dir
     prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
     yield
@@ -120,6 +124,96 @@ def test_hit_miss_counters_and_event_emission(tmp_path, monkeypatch):
     # re-emission reports the live counters
     cc.emit_cache_event(ev)
     assert ev.emitted[-1][1]["hits"] == live["hits"]
+
+
+def _entry(root, key, name, size=100, age_s=None):
+    """One fake cache entry of ``size`` bytes, optionally backdated."""
+    import os
+    import time
+
+    p = root / key / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_bytes(b"x" * size)
+    if age_s is not None:
+        t = time.time() - age_s
+        os.utime(p, (t, t))
+    return p
+
+
+def test_eviction_bounds_root_lru_by_mtime(tmp_path):
+    # four 100-byte entries across two topology keys, oldest first
+    old1 = _entry(tmp_path, "tpu-d8-p2", "a", age_s=4000)
+    old2 = _entry(tmp_path, "tpu-d8-p2", "b", age_s=3000)
+    new1 = _entry(tmp_path, "cpu-d8-p1", "c", age_s=2000)
+    new2 = _entry(tmp_path, "cpu-d8-p1", "d", age_s=1000)
+    # no bound configured -> unbounded, nothing touched
+    assert cc.evict_to_byte_bound(tmp_path) is None
+    assert all(p.exists() for p in (old1, old2, new1, new2))
+    # under the bound -> a report, but zero evictions
+    res = cc.evict_to_byte_bound(tmp_path, max_bytes=1000)
+    assert res == {
+        "evicted": 0, "evicted_bytes": 0,
+        "total_bytes": 400, "max_bytes": 1000,
+    }
+    # over the bound -> LRU across keys: exactly the two oldest go
+    res = cc.evict_to_byte_bound(tmp_path, max_bytes=250)
+    assert res["evicted"] == 2 and res["evicted_bytes"] == 200
+    assert res["total_bytes"] == 200
+    assert not old1.exists() and not old2.exists()
+    assert new1.exists() and new2.exists()
+    # the counters accumulate across calls (they ride cache_stats)
+    assert cc._counters["evicted"] == 2
+    assert cc._counters["evicted_bytes"] == 200
+
+
+def test_eviction_never_strands_active_keys_fresh_entries(tmp_path):
+    # the active key's FRESH entries (this incarnation's warm restart)
+    # are held back even when the bound cannot otherwise be met; its
+    # stale entries are ordinary LRU fodder
+    fresh1 = _entry(tmp_path, "cpu-d8-p1", "fresh1")
+    fresh2 = _entry(tmp_path, "cpu-d8-p1", "fresh2")
+    stale = _entry(tmp_path, "cpu-d8-p1", "stale", age_s=4000)
+    other = _entry(tmp_path, "tpu-d8-p2", "other", age_s=500)
+    res = cc.evict_to_byte_bound(
+        tmp_path, active_key="cpu-d8-p1", max_bytes=150
+    )
+    # the stale active entry and the other key's entry were evictable;
+    # the two fresh active entries survive even though 200b > 150b
+    assert not stale.exists() and not other.exists()
+    assert fresh1.exists() and fresh2.exists()
+    assert res["evicted"] == 2 and res["total_bytes"] == 200
+
+
+def test_activation_applies_byte_bound_and_reports_evictions(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv(cc.ENV_CACHE, str(tmp_path))
+    monkeypatch.setenv(cc.ENV_CACHE_MAX_BYTES, "250")
+    key = cc.topology_key()
+    kept1 = _entry(tmp_path, key, "warm_a")
+    kept2 = _entry(tmp_path, key, "warm_b")
+    for n in ("x", "y", "z"):
+        _entry(tmp_path, "tpu-d256-p32", n, age_s=4000)
+
+    class Events:
+        def __init__(self):
+            self.emitted = []
+
+        def emit(self, kind, **fields):
+            self.emitted.append((kind, fields))
+
+    ev = Events()
+    stats = cc.activate_compile_cache(events=ev)
+    # the stale key was evicted to meet the bound; the active key's
+    # fresh entries survived, so the restart is STILL warm
+    assert kept1.exists() and kept2.exists()
+    assert not (tmp_path / "tpu-d256-p32" / "x").exists()
+    assert stats["entries_before"] == 2 and stats["warm"] is True
+    live = cc.cache_stats()
+    assert live["evicted"] == 3 and live["evicted_bytes"] == 300
+    # the eviction counters ride the compile_cache obs event
+    assert ev.emitted[0][0] == "compile_cache"
+    assert ev.emitted[0][1]["evicted"] == 3
 
 
 def test_bench_enable_stays_always_on(tmp_path, monkeypatch):

@@ -14,6 +14,7 @@ consumed-batch audit proving the resumed stream replayed no batch and
 skipped none (the data cursor).
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -29,7 +30,7 @@ from ddl_tpu.coord import (
     agreed_resume_epoch,
     from_env,
 )
-from ddl_tpu.supervisor import EXIT_PREEMPTED, PodSupervisor
+from ddl_tpu.supervisor import EXIT_PREEMPTED, EXIT_REJOIN, PodSupervisor
 from ddl_tpu.utils.backoff import Backoff
 
 CHILD = Path(__file__).parent / "pod_sim_child.py"
@@ -471,6 +472,110 @@ def test_evicted_host_exits_cleanly_instead_of_aborting(tmp_path):
     assert done and done[-1]["rc"] == 0 and done[-1].get("evicted") is True
 
 
+def test_join_request_intake_filters_members_and_stale(tmp_path):
+    """The leader's view of ``joins/``: a non-member's marker surfaces
+    with an age, a member's own leftover marker is void, out-of-range
+    hosts are ignored, and stale markers (a joiner that died mid-wait)
+    are dropped under ``fresh_s``."""
+    rv0 = _rv(tmp_path, 0, 3)
+    rv0.adopt_membership([0, 2])  # host 1 was evicted earlier
+    rv1 = _rv(tmp_path, 1, 3)
+    assert rv0.join_requests() == []
+    rv1.publish_join_request(1, note="back")
+    (req,) = rv0.join_requests()
+    assert req["host"] == 1 and req["epoch"] == 1
+    assert req["age"] >= 0.0 and req["note"] == "back"
+    # a member's leftover marker is void by definition
+    rv2 = _rv(tmp_path, 2, 3)
+    rv2.adopt_membership([0, 2])
+    rv2.publish_join_request(1)
+    assert [r["host"] for r in rv0.join_requests()] == [1]
+    # a host outside this launch's [0, n_hosts) is ignored
+    (tmp_path / "joins" / "h099.json").write_text(
+        json.dumps({"ts": rv0.clock(), "host": 99, "epoch": 0})
+    )
+    assert [r["host"] for r in rv0.join_requests()] == [1]
+    # a stale marker means the joiner went silent after asking
+    (tmp_path / "joins" / "h001.json").write_text(
+        json.dumps({"ts": rv0.clock() - 60.0, "host": 1, "epoch": 1})
+    )
+    assert rv0.join_requests(fresh_s=5.0) == []
+    assert [r["host"] for r in rv0.join_requests()] == [1]  # unbounded
+    # refreshing the marker (the joiner's heartbeat analogue) revives it
+    rv1.publish_join_request(2)
+    assert [r["host"] for r in rv0.join_requests(fresh_s=5.0)] == [1]
+    rv1.clear_join_request()
+    assert rv0.join_requests() == []
+
+
+def test_grow_epoch_ledger_rides_first_writer_wins(tmp_path):
+    """A grow proposal is the same atomically-created ledger record as
+    a shrink: budgets roll forward unchanged, the record carries the
+    LARGER host set, and a racing proposer adopts the winner."""
+    rv = _rv(tmp_path, 0, 3)
+    rec1 = rv.propose_restart(
+        0, "peer_lost", crash=False, preempt=True, rc=EXIT_PREEMPTED,
+        hosts=[0, 2],
+    )
+    rv.adopt_membership(rec1["hosts"])
+    assert rv.world == 2
+    rec2 = rv.propose_restart(
+        1, "peer_join", crash=False, preempt=False, rc=EXIT_PREEMPTED,
+        hosts=[0, 1, 2],
+    )
+    assert rec2["hosts"] == [0, 1, 2] and rec2["world"] == 3
+    # a grow is neither a crash nor a preemption; budgets roll forward
+    assert rec2["crashes"] == 0 and rec2["preemptions"] == 1
+    assert rec2["delay"] == 0.0  # growth relaunches without backoff
+    # a racing proposer still on the shrunken membership loses the race
+    # and adopts the grown record unchanged (one restart event, one
+    # classification — even when the racers disagreed on the reason)
+    rv2 = _rv(tmp_path, 2, 3)
+    rv2.adopt_membership([0, 2])
+    won = rv2.propose_restart(
+        1, "peer_stale/crash", crash=True, preempt=False,
+        delay_fn=lambda n: 9.9,
+    )
+    assert won == rec2
+    rv2.adopt_membership(won["hosts"])
+    assert rv2.world == 3 and rv2.leader == 0
+
+
+def test_elastic_rejoin_child_leaves_and_is_grown_back(tmp_path):
+    """The full scripted grow cycle: host 1's child exits EXIT_REJOIN
+    (a voluntary leave, e.g. an injected ``rejoin`` fault), the pod
+    shrinks to [0], host 1's supervisor publishes a join_request from
+    ``_await_rejoin``, and the leader answers with a ``peer_join``
+    epoch whose membership is [0, 1] again.  Both hosts finish at the
+    grown world; no budget was burned at any step."""
+    scripts = {
+        # epoch-0 child killed at the rejoin intent; epoch-1 child
+        # (world [0]) killed at the peer_join; epoch-2 child completes
+        0: [FakeChild(rc=None), FakeChild(rc=None), FakeChild(rc=0)],
+        # epoch-0 child leaves voluntarily; host 1 is not a member of
+        # epoch 1, so its next child runs in epoch 2
+        1: [FakeChild(rc=EXIT_REJOIN, delay=0.05), FakeChild(rc=0)],
+    }
+    results = _run_pod(
+        tmp_path, [scripts[0], scripts[1]], elastic=True, max_restarts=0,
+    )
+    assert results == {0: 0, 1: 0}
+    assert scripts[0][0].killed and scripts[0][1].killed
+    rv = _rv(tmp_path, 0, 2)
+    assert rv.aborted() is None
+    assert rv.current_epoch() == 2
+    rec1, rec2 = rv.epoch_record(1), rv.epoch_record(2)
+    assert rec1["reason"] in ("rejoin", "peer_rejoin")
+    assert rec1["hosts"] == [0] and rec1["world"] == 1
+    assert rec1["rc"] == EXIT_REJOIN
+    assert rec1["crashes"] == 0 and rec1["preemptions"] == 0
+    assert rec2["reason"] == "peer_join"
+    assert rec2["hosts"] == [0, 1] and rec2["world"] == 2
+    assert rec2["crashes"] == 0 and rec2["preemptions"] == 0
+    # the joiner withdrew its marker once the grow epoch admitted it
+    assert rv.join_requests() == []
+
+
 # ---------------------------------------------------------------------------
 # end-to-end: the 3-host pod sim (real trainers, real supervisors)
 # ---------------------------------------------------------------------------
@@ -813,3 +918,196 @@ def test_three_host_pod_sim_permanent_host_loss_elastic_continue(tmp_path):
             f"h{i} replayed or skipped batches: {tail} "
             f"(agreed resume {agreed})"
         )
+
+
+def test_three_host_pod_sim_host_loss_then_rejoin(tmp_path):
+    """The elastic scale-UP acceptance e2e, the full churn cycle on
+    real trainers: host 1's supervisor dies permanently after the
+    start barrier, the survivors evict it and train ON at world 2
+    ([0, 2], renumbered) — then a replacement host-1 supervisor starts
+    into the shrunken launch, fails membership adoption, publishes a
+    join_request, and the leader answers with a ``peer_join`` restart
+    epoch at the FULL membership.  All three hosts finish epoch 2 with
+    identical final weights: the ZeRO-sharded state crossed dp layouts
+    twice (shrink at e1, grow at e2) through the ordinary
+    rank-0-agreed restore, and every epoch's consumed tail runs
+    exactly [agreed resume, ...) — no batch lost to the churn, none
+    replayed within a lineage."""
+    from ddl_tpu import checkpoint as ckpt
+    from ddl_tpu import coord
+    from ddl_tpu.supervisor import supervise_pod_command
+
+    sim = tmp_path / "sim"
+    nas = tmp_path / "nas"
+    sim.mkdir()
+    nas.mkdir()
+    steps = 12
+    base_env = _clean_env()
+    base_env.update(
+        DDL_SIM_DIR=str(sim),
+        DDL_SIM_STEPS=str(steps),
+        DDL_SIM_PACE="0.35",
+        DDL_JOB_ID="podrejoin",
+        DDL_LOG_DIR=str(sim / "suplogs"),
+        DDL_WATCHDOG_S="30",
+        DDL_TEST_COMPILE_CACHE=os.environ.get(
+            "DDL_TEST_COMPILE_CACHE", "/tmp/ddl_tpu_test_xla_cache"
+        ),
+    )
+    _warm_compile_cache(base_env, tmp_path)
+
+    # host 1 makes the start barrier, beats once as "running" — then
+    # its supervisor dies outright (the same loss the elastic-continue
+    # e2e pins; this test carries the story through the grow)
+    launch1 = coord.acquire_launch(nas)
+    rv1 = Rendezvous(launch1, 1, 3)
+    rv1.arrive("start")
+    rv1.publish_heartbeat("running", 0)
+
+    results = {}
+
+    def host(i):
+        results[i] = supervise_pod_command(
+            [sys.executable, str(CHILD)], nas, i, 3,
+            env=dict(base_env), max_restarts=3,
+            backoff=Backoff(base=0.01, jitter=0.0),
+            poll_s=0.05, heartbeat_s=0.2, stale_after_s=1.5,
+            elastic=True, elastic_grace_s=1.5,
+            log=lambda m: None,
+        )
+
+    threads = {i: threading.Thread(target=host, args=(i,)) for i in (0, 2)}
+    for t in threads.values():
+        t.start()
+
+    # the replacement host-1 supervisor starts only once the world-2
+    # incarnation has actually TRAINED a batch — the rejoin must
+    # interrupt a live shrunken pod mid-run, not race the eviction
+    # boundary (an immediate re-grow is legal but would leave the
+    # world-2 epoch this test audits without a single step)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            if any(e == 1 for e, _ in _read_consumed(sim, 0)):
+                break
+        except OSError:
+            pass
+        time.sleep(0.05)
+    else:
+        pytest.fail("survivors never trained at world 2")
+
+    threads[1] = threading.Thread(target=host, args=(1,))
+    threads[1].start()
+    for t in threads.values():
+        t.join(timeout=300)
+    assert not any(
+        t.is_alive() for t in threads.values()
+    ), "rejoin sim deadlocked"
+    assert results == {0: 0, 1: 0, 2: 0}, results
+
+    launch = coord.active_launch_root(nas)
+    assert launch == launch1 and (launch / "finished.json").is_file()
+    rv = _rv(launch, 0, 3)
+    assert rv.aborted() is None
+    assert rv.current_epoch() == 2, rv.current_epoch()
+    rec1, rec2 = rv.epoch_record(1), rv.epoch_record(2)
+    # epoch 1: the eviction (a preemption-class event, never a crash)
+    assert rec1["reason"] == "peer_lost", rec1
+    assert rec1["hosts"] == [0, 2] and rec1["world"] == 2
+    assert rec1["crashes"] == 0 and rec1["preemptions"] == 1
+    # epoch 2: the grow — proposed by the leader off the join_request,
+    # burning NO budget of either class
+    assert rec2["reason"] == "peer_join", rec2
+    assert rec2["hosts"] == [0, 1, 2] and rec2["world"] == 3
+    assert rec2["crashes"] == 0 and rec2["preemptions"] == 1
+    assert rv.join_requests() == []  # marker withdrawn on admission
+
+    # ALL THREE hosts finished IN EPOCH 2, same step, identical weights
+    finals = {}
+    for i in range(3):
+        last = (sim / f"final_h{i}.log").read_text().splitlines()[-1]
+        e, step, digest = last.split()
+        finals[i] = (int(e), int(step), digest)
+    assert all(
+        f == (2, steps, finals[0][2]) for f in finals.values()
+    ), finals
+
+    # env audit: epoch 1 ran the renumbered 2-host world on the
+    # survivors; epoch 2 dropped the override (back to the full world)
+    # on everyone.  Host 1's ONLY incarnation is the epoch-2 one.
+    for i in (0, 2):
+        lines = (sim / f"env_h{i}.log").read_text().splitlines()
+        e1 = [ln for ln in lines if ln.startswith("1 ")][-1]
+        assert "members=0,2" in e1 and "nproc=2" in e1, e1
+        assert f"pid={0 if i == 0 else 1}" in e1, e1
+    lines1 = (sim / "env_h1.log").read_text().splitlines()
+    assert all(ln.startswith("2 ") for ln in lines1), lines1
+    for i in range(3):
+        lines = (sim / f"env_h{i}.log").read_text().splitlines()
+        e2 = [ln for ln in lines if ln.startswith("2 ")][-1]
+        assert "members=0,1,2" in e2 and "nproc=-" in e2, e2
+
+    # batch-exactness across BOTH churn boundaries: each epoch's tail
+    # consumed exactly [agreed resume, ...) — contiguous from the
+    # restored cursor, with the final epoch reaching the end
+    for ep, hosts in ((1, (0, 2)), (2, (0, 1, 2))):
+        agreed = json.loads(
+            (launch / "agree" / f"resume-podrejoin-e{ep}.json").read_text()
+        )["value"]
+        if agreed is not None:
+            cursor = ckpt.read_cursor(sim / "ckpt", "podrejoin", agreed)
+            assert cursor is not None and cursor["step"] == agreed
+        start = 0 if agreed is None else agreed
+        for i in hosts:
+            tail = [s for e, s in _read_consumed(sim, i) if e == ep]
+            assert tail == list(range(start, start + len(tail))), (
+                f"h{i} e{ep} replayed or skipped batches: {tail} "
+                f"(agreed resume {agreed})"
+            )
+            if ep == 2:
+                assert tail and tail[-1] == steps - 1, (i, tail)
+
+    # observability: the supervisor stream timeline surfaces the whole
+    # grow cycle — the joiner's join_request, the leader's peer_join,
+    # and per-repoch memberships on the restart markers (the rendered
+    # watch frame keeps only the LAST few incidents, so assert over the
+    # full folded timeline's labels; the frame itself must carry the
+    # grow epoch's membership)
+    from ddl_tpu.obs.fold import fold_job
+    from ddl_tpu.obs.pod import _timeline_label, pod_summary_from_fold
+    from ddl_tpu.obs.watch import build_frame
+
+    fold = fold_job(sim / "suplogs", "podrejoin", cache=False)
+    labels = [
+        _timeline_label(e)
+        for e in pod_summary_from_fold(fold)["timeline"]
+    ]
+    assert any(lb.startswith("join_request") for lb in labels), labels
+    assert any(lb.startswith("peer_join hosts=[1]") for lb in labels), labels
+    assert any(
+        "peer_join -> epoch 2" in lb and "hosts=[0, 1, 2]" in lb
+        for lb in labels
+    ), labels
+    frame = build_frame(fold, "podrejoin")
+    assert "hosts=[0, 1, 2]" in frame, frame
+
+    # goodput (round 20 ledger): the joiner's grow-epoch incarnation
+    # books its relaunch into restart_gap/barrier and its re-shard
+    # restore into checkpoint — not into untracked
+    from ddl_tpu.obs.goodput import ledger_from_fold
+
+    agreed2 = json.loads(
+        (launch / "agree" / "resume-podrejoin-e2.json").read_text()
+    )["value"]
+    ledger = ledger_from_fold(fold_job(sim / "logs_h1", "podrejoin",
+                                       cache=False))
+    e2_inc = [a for a in ledger["incarnations"] if a["repoch"] == 2]
+    assert e2_inc, ledger["incarnations"]
+    acc = e2_inc[0]
+    assert sum(acc["seconds"].values()) == pytest.approx(
+        acc["wall_s"], abs=1e-9
+    )
+    assert acc["seconds"]["untracked"] >= -0.01 * max(acc["wall_s"], 1e-9)
+    assert (acc["seconds"]["restart_gap"] + acc["seconds"]["barrier"]) > 0
+    if agreed2 is not None:
+        assert acc["seconds"]["checkpoint"] > 0, acc

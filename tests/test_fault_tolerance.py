@@ -102,6 +102,29 @@ def test_crash_and_env_activation(monkeypatch):
     faultinject.deactivate()
 
 
+def test_rejoin_fault_fires_at_epoch_and_is_consumed(monkeypatch, tmp_path):
+    """``rejoin@epoch:K`` (the elastic scale-up drill): quiet below K,
+    fires once the incarnation's restart epoch reaches K, and records
+    itself consumed BEFORE the child acts on it — so the supervisor's
+    relaunch filter drops the spec and the post-grow incarnation trains
+    normally instead of leaving again."""
+    state = tmp_path / "fault_state"
+    monkeypatch.setenv("DDL_FAULT_STATE", str(state))
+    faultinject.activate("rejoin@epoch:2")
+    assert not faultinject.check_epoch(0)
+    assert not faultinject.check_epoch(1)
+    assert faultinject.check_epoch(2)
+    # consume-on-fire, recorded before the exit the fault triggers
+    assert state.read_text().splitlines() == ["rejoin@epoch:2"]
+    assert not faultinject.check_epoch(2)  # exhausted in this injector
+    # a relaunch that re-activated the spec verbatim would fire on any
+    # later epoch too (``at >=``) — dropping consumed specs from the
+    # relaunch env is what keeps the grown pod stable
+    faultinject.activate("rejoin@epoch:2")
+    assert faultinject.check_epoch(3)
+    faultinject.deactivate()
+
+
 # ---------------------------------------------------------------------------
 # backoff
 # ---------------------------------------------------------------------------

@@ -1017,6 +1017,67 @@ def test_drain_park_hard_stops_lanes_with_partial_outputs(lm):
     assert not eng.busy and not eng.step()
 
 
+@pytest.mark.parametrize(
+    "kw", [dict(), dict(temperature=0.8, top_k=17)], ids=["greedy", "sampled"]
+)
+def test_parked_requests_resume_token_identical(lm, tmp_path, kw):
+    """The serving half of an elastic grow epoch: requests parked
+    mid-decode by drain(park=True) resume through resume_parked() and
+    complete TOKEN-IDENTICAL to decodes that were never interrupted —
+    greedy trivially, sampled because the parked rng carry replays the
+    exact split sequence the uninterrupted lane would have drawn."""
+    from ddl_tpu.obs import EventWriter
+    from ddl_tpu.obs.report import load_run
+    from ddl_tpu.serve.engine import ServeEngine
+
+    cfg, params, spec = lm
+    clients = [
+        ("a", np.arange(1, 9, dtype=np.int32), 12),
+        ("b", np.arange(1, 6, dtype=np.int32), 12),
+    ]
+    obs = EventWriter(tmp_path, "resume-test")
+    eng = ServeEngine(cfg, params, spec, block_size=8, num_blocks=32,
+                      max_batch=2, max_steps_per_dispatch=1, obs=obs, **kw)
+    for cid, prompt, mn in clients:
+        eng.submit(prompt, mn, request_id=cid, rng_seed=5, tenant="t0")
+    # run both lanes into mid-decode, then hard-stop for the restart
+    for _ in range(4):
+        eng.step()
+    active = eng.scheduler.active()
+    assert len(active) == 2
+    assert all(0 < len(s.outputs) < 12 for s in active)
+    progress = {s.request.id: len(s.outputs) for s in active}
+    counts = eng.drain("scale_up", park=True)
+    assert counts["parked"] == 2
+    assert eng.allocator.used_blocks == 0
+
+    # the grown pod's engine re-admits the parked work
+    res = eng.resume_parked()
+    assert res == {"resumed": 2, "rejected": 0}
+    assert not eng.draining and eng.drain_reason is None
+    got = eng.run()
+    obs.close()
+
+    want = _sequential_tokens(cfg, spec, params, clients, seed=5, **kw)
+    assert sorted(got) == ["a", "b"]
+    for cid, _, _mn in clients:
+        np.testing.assert_array_equal(got[cid], want[cid])
+        assert eng.outcomes[cid] == "ok"
+    assert eng.allocator.used_blocks == 0 and not eng.busy
+
+    # the resume is SLO-attributable: one serve_resume per request with
+    # the park's progress and the remaining budget
+    events = load_run(tmp_path, "resume-test")
+    resumes = {e["request_id"]: e for e in events
+               if e["kind"] == "serve_resume"}
+    assert sorted(resumes) == ["a", "b"]
+    for cid, n in progress.items():
+        assert resumes[cid]["resumed_tokens"] == n
+        assert resumes[cid]["remaining"] == 12 - n
+        assert resumes[cid]["outcome"] != "rejected"
+        assert resumes[cid]["tenant"] == "t0"
+
+
 def test_preempt_guard_trips_drain_in_step(lm):
     """The supervisor-style preemption guard: step() polls it and flips
     the engine into drain without a direct drain() call."""

@@ -580,6 +580,21 @@ def _probe_zero_donation() -> _Probe:
             f"{name} donation effectiveness: {aliased}/{donatable} "
             f"bytes aliased ({aliased / max(donatable, 1):.0%})"
         )
+        # partial coverage is a real memory bill, not a style point:
+        # every non-aliased donated byte is double-buffered across the
+        # update (the HBM ledger's optimizer row shows the hit live —
+        # obs/hbm.py).  10% slack tolerates legitimately un-aliasable
+        # leaves (dtype-changing casts, scalar counters).
+        copied = donatable - aliased
+        if donatable > 0 and copied > donatable * 0.10:
+            probe.add(
+                "contract-donation",
+                f"{name} donation only partially aliases: "
+                f"{aliased}/{donatable} donated-state bytes alias "
+                f"outputs ({aliased / donatable:.0%}) — the other "
+                f"{copied} bytes are copied every step and held twice "
+                "across the update",
+            )
 
     def build_cnn():
         # the same ZeRO composition cnn_dp_zero validates — one

@@ -135,6 +135,13 @@ EVENT_KINDS = (
     # parked serving request re-admitted after the grow epoch with its
     # partial output re-prefilled (serve/engine.resume_parked)
     "join_request", "peer_join", "serve_resume",
+    # HBM ledger (obs/hbm.py): hbm_plan is a per-program static budget
+    # stamped at compile time (executable memory analysis, aval
+    # fallback); hbm_sample is the periodic live per-category breakdown
+    # against the device watermark; hbm_oom_dump is the allocation-
+    # failure forensic snapshot (resident buffers + the plans that
+    # predicted them) emitted before the process dies
+    "hbm_plan", "hbm_sample", "hbm_oom_dump",
 )
 
 # ``type`` values carried by "anomaly" events (AnomalyMonitor.record and

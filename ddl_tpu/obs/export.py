@@ -377,6 +377,57 @@ def fill_metrics(
                 "(all hosts, all incarnations, coordination included)",
                 gp["job"]["ratio"], **job,
             )
+
+    # -- HBM ledger (obs/hbm.py — the same account `obs hbm` renders) ----
+    from ddl_tpu.obs.hbm import account_from_fold
+
+    hacct = account_from_fold(fold)
+    if hacct["incarnations"]:
+        for inc in hacct["incarnations"]:
+            labels = {
+                "host": str(inc["host"]), "repoch": str(inc["repoch"]),
+                **job,
+            }
+            for cat, b in sorted(inc["bytes"].items()):
+                m.add(
+                    "hbm_bytes", "gauge",
+                    "device-memory account: bytes per category for one "
+                    "(host, restart-epoch) incarnation at its peak "
+                    "watermark (categories sum to the watermark; "
+                    "untracked is the residual, possibly negative)",
+                    b, category=cat, **labels,
+                )
+            m.add(
+                "hbm_watermark_bytes", "gauge",
+                "peak bytes-in-use sampled by one incarnation",
+                inc["watermark"], **labels,
+            )
+            if inc["headroom"] is not None:
+                m.add(
+                    "hbm_headroom_bytes", "gauge",
+                    "device limit minus the peak watermark for one "
+                    "incarnation",
+                    inc["headroom"], **labels,
+                )
+            if inc["oom_count"]:
+                m.add(
+                    "hbm_oom_dumps_total", "counter",
+                    "allocation-failure forensic dumps recorded",
+                    inc["oom_count"], **labels,
+                )
+        hjob = hacct["job"]
+        m.add(
+            "hbm_job_peak_bytes", "gauge",
+            "max peak watermark across every incarnation of the job",
+            hjob["peak_bytes"], **job,
+        )
+        if hjob["headroom"] is not None:
+            m.add(
+                "hbm_job_headroom_bytes", "gauge",
+                "worst-host headroom (min over hosts' latest "
+                "incarnations)",
+                hjob["headroom"], **job,
+            )
     d = s.get("decode")
     if d:
         m.add(

@@ -91,6 +91,9 @@ def _job_row(fold, summary: dict) -> dict:
     tr = summary.get("trace") or {}
     gp = (summary.get("goodput") or {}).get("job") or {}
     dom = gp.get("dominant_badput")
+    # worst-host HBM headroom (obs/hbm.py) — the fleet's "who is about
+    # to OOM" column; None when the job never sampled memory
+    hb = summary.get("hbm") or {}
     # per-tenant sub-rows for serving jobs: the tenant's own goodput
     # ratio (served / served+queued+modeled-shed chip-seconds) and its
     # dominant badput bucket, from the ledger's job-level account
@@ -117,6 +120,9 @@ def _job_row(fold, summary: dict) -> dict:
         "mfu": mfu,
         "goodput": gp.get("ratio"),
         "badput": dom[0] if dom else None,
+        "hbm_peak_bytes": hb.get("peak_bytes"),
+        "hbm_headroom_bytes": hb.get("headroom_bytes"),
+        "oom_dumps": hb.get("oom_count", 0),
         "ttft_p99_s": p.get("p99"),
         "agg_tok_per_s_per_chip": d.get("agg_tok_per_s_per_chip"),
         "requests": d.get("requests", 0),
@@ -181,9 +187,12 @@ def render_fleet(
         f"== fleet{f' — {log_root}' if log_root else ''} "
         f"({len(summary)} job(s)) =="
     ]
+    from ddl_tpu.obs.hbm import fmt_bytes
+
     lines.append(
         f"{'job':<20} {'hosts':>5} {'steps':>7} {'steps/s':>8} "
-        f"{'mfu':>6} {'goodput':>8} {'badput':>12} {'p99_ttft':>9} "
+        f"{'mfu':>6} {'goodput':>8} {'badput':>12} {'hbm_room':>9} "
+        f"{'p99_ttft':>9} "
         f"{'tok/s/chip':>10} {'rstrt':>5} "
         f"{'anom':>5} {'stall':>5} {'age_s':>8}"
     )
@@ -193,12 +202,19 @@ def render_fleet(
         goodput = (
             f"{r['goodput']:.1%}" if r.get("goodput") is not None else "-"
         )
+        # worst-host headroom; "-" when memory was never sampled (no
+        # room to confuse with "0 bytes left")
+        room = (
+            fmt_bytes(r["hbm_headroom_bytes"])
+            if r.get("hbm_headroom_bytes") is not None else "-"
+        )
         lines.append(
             f"{job[:20]:<20} {r['hosts']:>5} {r['steps']:>7} "
             f"{_fmt(r['steps_per_sec'], '.2f', 8)} "
             f"{_fmt(r['mfu'], '.3f', 6)} "
             f"{goodput:>8} "
             f"{(r.get('badput') or '-')[:12]:>12} "
+            f"{room:>9} "
             f"{_fmt(r['ttft_p99_s'], '.4g', 9)} "
             f"{_fmt(r['agg_tok_per_s_per_chip'], '.1f', 10)} "
             f"{r['restarts']:>5} {r['anomalies']:>5} {r['stalls']:>5} "

@@ -47,6 +47,7 @@ import statistics
 import threading
 from pathlib import Path
 
+from ddl_tpu.obs.hbm import PLAN_FIELDS, sample_categories
 from ddl_tpu.obs.serving import ServingStats, tenant_of
 
 __all__ = [
@@ -72,8 +73,11 @@ SIDECAR_NAME = ".obs_fold.json"
 # per-tenant attribution layer (ServingStats per-tenant digests, the
 # tenant_serve admit/shed/retire counters, and the per-repoch per-tenant
 # served/queued/shed chip-second split obs/slo.py evaluates budgets
-# over) — older sidecars rebuild cleanly
-VERSION = 9
+# over); v10 adds the HBM-ledger reducer (per-repoch memory cells:
+# peak-watermark category breakdown off hbm_sample, bounded last-wins
+# static plans off hbm_plan, and the hbm_oom_dump forensic cell —
+# obs/hbm.py renders the account) — older sidecars rebuild cleanly
+VERSION = 10
 
 # the serving-cursor sidecar this module's cache superseded; removed
 # opportunistically when the fold sidecar is written so a job dir does
@@ -91,6 +95,9 @@ TIMELINE_KINDS = (
     # (join_request) and the leader's grow decision (peer_join) — the
     # scale-down/scale-up narrative the incident timeline exists to tell
     "peer_lost", "join_request", "peer_join",
+    # an allocation-failure forensic dump is the last thing a dying
+    # process says — always narrative
+    "hbm_oom_dump",
 )
 
 # kinds emitted by a SUPERVISOR process into the same stream as its
@@ -180,6 +187,34 @@ def _new_tenant_goodput() -> dict:
     return {"served_s": 0.0, "queued_s": 0.0, "requests": 0, "shed": 0}
 
 
+# per-repoch cap on retained static plans (distinct compiled programs
+# are few — train/eval steps, prefill/decode buckets); drops are counted
+# so the render can say coverage was bounded, never silently truncated
+_HBM_PLAN_CAP = 64
+
+
+def _new_hbm() -> dict:
+    """One (repoch) incarnation's HBM-ledger cell (obs/hbm.py renders
+    it).  ``watermark``/``at_peak`` are a paired max cell: the largest
+    sampled live watermark plus the tracked category bytes captured at
+    that same sample (ties resolve to the later sample — deterministic
+    under any resume slicing, events arrive in stream order).  ``plans``
+    is bounded last-wins per program label; ``oom`` is last-wins."""
+    return {
+        "samples": 0,
+        "watermark": 0,      # max sampled bytes_in_use
+        "device_peak": 0,    # max backend peak_bytes_in_use
+        "limit": None,       # last-wins bytes_limit
+        "synthetic": False,  # any sample lacked backend memory stats
+        "last": {},          # last sample's tracked category bytes
+        "at_peak": {},       # tracked category bytes at the peak sample
+        "plans": {},         # label -> static budget (bounded last-wins)
+        "plans_dropped": 0,
+        "oom_count": 0,
+        "oom": None,         # last-wins slim forensic dump
+    }
+
+
 class StreamFold:
     """One event stream's running reduction.  ``consume`` is the single
     entry point; everything else is serialization.  All state is either
@@ -258,6 +293,9 @@ class StreamFold:
         # incarnation accounts plus the stream's all-event time span
         # (the job-level wall clock, supervisor coordination included)
         self.goodput: dict[int, dict] = {}
+        # HBM ledger (obs/hbm.py): per-repoch memory cells fed by the
+        # hbm_sample/hbm_plan/hbm_oom_dump kinds
+        self.hbm: dict[int, dict] = {}
         self.all_span: list = [None, None]  # [first_ts, last_ts], any kind
         self.serving = ServingStats(capacity)
 
@@ -500,6 +538,42 @@ class StreamFold:
                 for r in sorted(self.goodput):
                     if r < repoch:
                         self._charge_replay(self.goodput[r], p, off)
+        elif kind == "hbm_sample":
+            hb = self.hbm.setdefault(repoch, _new_hbm())
+            hb["samples"] += 1
+            if e.get("synthetic"):
+                hb["synthetic"] = True
+            if e.get("limit") is not None:
+                hb["limit"] = int(e["limit"])
+            cats = sample_categories(e)
+            hb["last"] = cats
+            wm = int(e.get("watermark", 0) or 0)
+            if wm >= hb["watermark"]:
+                # paired max cell: the watermark AND the category bytes
+                # observed at that same sample move together
+                hb["watermark"] = wm
+                hb["at_peak"] = cats
+            pk = int(e.get("peak", 0) or 0)
+            if pk > hb["device_peak"]:
+                hb["device_peak"] = pk
+        elif kind == "hbm_plan":
+            hb = self.hbm.setdefault(repoch, _new_hbm())
+            label = str(e.get("label", "?"))
+            if label in hb["plans"] or len(hb["plans"]) < _HBM_PLAN_CAP:
+                hb["plans"][label] = {k: e.get(k) for k in PLAN_FIELDS}
+            else:
+                hb["plans_dropped"] += 1
+        elif kind == "hbm_oom_dump":
+            hb = self.hbm.setdefault(repoch, _new_hbm())
+            hb["oom_count"] += 1
+            hb["oom"] = {
+                "ts": ts,
+                "step": step,
+                "error": e.get("error"),
+                "watermark": e.get("watermark"),
+                "limit": e.get("limit"),
+                "buffers": list(e.get("buffers") or []),
+            }
 
         if kind in ("span", "heartbeat", "stall"):
             if step is not None:
@@ -604,8 +678,10 @@ class StreamFold:
             agg["phases"][name] = agg["phases"].get(name, 0.0) + dur
         if sps:  # the cold parse filtered falsy steps_per_sec too
             agg["sps"].append(sps)
+        # `is not None`, not truthiness: a backend reporting a true 0
+        # watermark is a measurement, distinct from "no stats at all"
         hbm = e.get("hbm_peak_bytes")
-        if hbm:
+        if hbm is not None:
             agg["hbm"] = hbm if agg["hbm"] is None else max(agg["hbm"], hbm)
 
         br = self.by_repoch.setdefault(repoch, _new_repoch_agg())
@@ -667,6 +743,7 @@ class StreamFold:
             "trace": self.trace,
             "pipe_schedule": self.pipe_schedule,
             "goodput": {str(r): a for r, a in self.goodput.items()},
+            "hbm": {str(r): a for r, a in self.hbm.items()},
             "all_span": self.all_span,
             "pod_restart_epochs": sorted(self.pod_restart_epochs),
             "relaunches": self.relaunches,
@@ -705,6 +782,7 @@ class StreamFold:
         sf.goodput = {
             int(r): dict(a) for r, a in state["goodput"].items()
         }
+        sf.hbm = {int(r): dict(a) for r, a in state["hbm"].items()}
         sf.all_span = list(state["all_span"])
         sf.pod_restart_epochs = {
             int(r) for r in state["pod_restart_epochs"]

@@ -13,6 +13,15 @@
                                 restart-epoch) incarnation and whole-job
                                 — sums to the wall clock by construction,
                                 residual reported as `untracked`
+    hbm <job_id> [--json]       the device-memory ledger (obs/hbm.py):
+                                params / optimizer / KV (cached vs
+                                private vs free) / untracked bytes per
+                                (host, restart-epoch) incarnation at its
+                                peak watermark, static per-program
+                                compile-time budgets (hbm_plan) for
+                                plan-vs-live reconciliation, and any OOM
+                                forensic dumps — categories sum to the
+                                watermark by construction
     tail <job_id> [-n N]        last N events, rendered one per line
     diff <job_a> <job_b>        phase/throughput comparison of two runs
     baseline <job_id> --out F   store one run's summary as a JSON baseline
@@ -25,6 +34,9 @@
                                 drop past the same fraction (the CI
                                 gate); --fail-goodput-drop F additionally
                                 gates the job-level goodput ratio;
+                                --fail-hbm-growth F gates the job's peak
+                                HBM watermark (obs/hbm.py) against the
+                                baseline's — the leak gate;
                                 --fail-slo-burn F exits nonzero when the
                                 run under test's worst per-tenant SLO
                                 error-budget burn rate (obs/slo.py)
@@ -316,6 +328,11 @@ def summarize_from_fold(fold) -> dict:
 
     goodput = ledger_from_fold(fold)
 
+    # -- HBM ledger (obs/hbm.py — sums-to-watermark memory account) ------
+    from ddl_tpu.obs.hbm import summary_from_fold as hbm_summary_from_fold
+
+    hbm_section = hbm_summary_from_fold(fold)
+
     return {
         "runs": sorted(runs),
         "events": fold.events,
@@ -340,6 +357,7 @@ def summarize_from_fold(fold) -> dict:
         "trace": trace,
         "pipe_schedule": fold.pipe_schedule(),
         "goodput": goodput,
+        "hbm": hbm_section,
     }
 
 
@@ -392,8 +410,29 @@ def render_summary(s: dict, job_id: str = "") -> str:
             f"{trend['second_half']:.2f} steps/s "
             f"(x{trend['ratio']:.2f} second half vs first)"
         )
-    if s["peak_hbm_bytes"]:
+    # `is not None`, not truthiness: a legitimately-zero watermark (fresh
+    # simulated device) must still print — dropping it made the summary
+    # look like HBM was never measured at all
+    if s["peak_hbm_bytes"] is not None:
         lines.append(f"peak HBM: {s['peak_hbm_bytes'] / 1e9:.2f} GB")
+    hb = s.get("hbm")
+    if hb:
+        from ddl_tpu.obs.hbm import fmt_bytes
+
+        line = f"hbm: peak {fmt_bytes(hb['peak_bytes'])}"
+        if hb.get("limit_bytes"):
+            line += f" / limit {fmt_bytes(hb['limit_bytes'])}"
+        if hb.get("headroom_bytes") is not None:
+            line += f" | headroom {fmt_bytes(hb['headroom_bytes'])}"
+        top = hb.get("top") or []
+        if top:
+            line += " | top: " + ", ".join(
+                f"{c} {fmt_bytes(b)}" for c, b in top
+            )
+        if hb.get("oom_count"):
+            line += f" | OOM dumps: {hb['oom_count']}"
+        line += f" — `ddl_tpu obs hbm{f' {job_id}' if job_id else ''}`"
+        lines.append(line)
     ps = s.get("pipe_schedule")
     if ps:
         line = (
@@ -739,6 +778,14 @@ def main(argv=None) -> None:
         "pre-ledger baseline first)",
     )
     p_diff.add_argument(
+        "--fail-hbm-growth", type=float, default=None, metavar="FRAC",
+        help="CI memory gate: exit nonzero when the run under test's "
+        "peak HBM watermark (obs/hbm.py) is more than FRAC above the "
+        "comparison run's — catches leaks and silent footprint "
+        "regressions; both sides must carry an hbm account "
+        "(regenerate a pre-ledger baseline first)",
+    )
+    p_diff.add_argument(
         "--fail-slo-burn", type=float, default=None, metavar="BURN",
         help="CI SLO gate: exit nonzero when the run under test's worst "
         "per-tenant error-budget burn rate (obs/slo.py; 1.0 = spending "
@@ -776,6 +823,17 @@ def main(argv=None) -> None:
     p_good.add_argument(
         "--json", action="store_true",
         help="emit the ledger as JSON instead of the rendered tables",
+    )
+    p_hbm = sub.add_parser(
+        "hbm", parents=[common],
+        help="exhaustive device-memory account: params/optimizer/KV/"
+        "untracked per (host, restart-epoch) incarnation, static "
+        "per-program budgets, OOM forensics (obs/hbm.py)",
+    )
+    p_hbm.add_argument("job_id")
+    p_hbm.add_argument(
+        "--json", action="store_true",
+        help="emit the account as JSON instead of the rendered tables",
     )
     p_base = sub.add_parser(
         "baseline", parents=[common],
@@ -906,6 +964,14 @@ def main(argv=None) -> None:
             print(json.dumps(ledger))
         else:
             print(render_goodput(ledger, args.job_id))
+    elif args.command == "hbm":
+        from ddl_tpu.obs.hbm import account_from_fold, render_hbm
+
+        account = account_from_fold(_fold_or_exit(args))
+        if args.json:
+            print(json.dumps(account))
+        else:
+            print(render_hbm(account, args.job_id))
     elif args.command == "tail":
         events = load_run(args.log_dir, args.job_id)
         for e in events[-args.n:]:
@@ -1049,6 +1115,42 @@ def main(argv=None) -> None:
             print(
                 f"OK: goodput within the {frac:.0%} gate "
                 f"({ga:.1%} -> {gb:.1%})"
+            )
+        if args.fail_hbm_growth is not None:
+            from ddl_tpu.obs.hbm import fmt_bytes
+
+            frac = args.fail_hbm_growth
+            ha = (sa.get("hbm") or {}).get("peak_bytes")
+            hb_b = (sb.get("hbm") or {}).get("peak_bytes")
+            if ha is None or hb_b is None:
+                # the flag was explicit — a side without an hbm account
+                # must not pass silently (a pre-ledger baseline, or a
+                # run that never emitted hbm_sample)
+                raise SystemExit(
+                    f"FAIL: --fail-hbm-growth needs an hbm account on "
+                    f"both sides ({name_a}: "
+                    f"{fmt_bytes(ha) if ha is not None else 'none'}, "
+                    f"{name_b}: "
+                    f"{fmt_bytes(hb_b) if hb_b is not None else 'none'})"
+                    " — regenerate the baseline with a post-ledger "
+                    "`obs baseline`"
+                )
+            # (1+frac)*0 == 0, so any growth over an empty baseline
+            # watermark trips the gate too — no special case needed
+            if hb_b > (1.0 + frac) * ha:
+                top = (sb.get("hbm") or {}).get("top") or []
+                top_note = (
+                    f" (top consumer: {top[0][0]} {fmt_bytes(top[0][1])})"
+                    if top else ""
+                )
+                raise SystemExit(
+                    f"FAIL: {name_b} peak HBM {fmt_bytes(hb_b)} is more "
+                    f"than {frac:.0%} above {name_a} "
+                    f"({fmt_bytes(ha)}){top_note}"
+                )
+            print(
+                f"OK: peak HBM within the {frac:.0%} growth gate "
+                f"({fmt_bytes(ha)} -> {fmt_bytes(hb_b)})"
             )
         if args.fail_slo_burn is not None:
             from ddl_tpu.obs.slo import evaluate_slo, load_slo

@@ -235,6 +235,33 @@ def build_frame(fold, job_id: str, now: float | None = None) -> str:
                 )
             )
 
+    # -- HBM ledger ------------------------------------------------------
+    hb = s.get("hbm")
+    if hb:
+        from ddl_tpu.obs.hbm import fmt_bytes
+
+        lines.append("-- hbm --")
+        line = f"peak: {fmt_bytes(hb['peak_bytes'])}"
+        if hb.get("limit_bytes"):
+            line += f" / {fmt_bytes(hb['limit_bytes'])} limit"
+        if hb.get("headroom_bytes") is not None:
+            line += f" (headroom {fmt_bytes(hb['headroom_bytes'])})"
+        line += f", {hb['incarnations']} incarnation(s)"
+        if hb.get("synthetic"):
+            line += " [synthetic]"
+        lines.append(line)
+        top = hb.get("top") or []
+        if top:
+            lines.append(
+                "top consumers: " + ", ".join(
+                    f"{c} {fmt_bytes(b)}" for c, b in top
+                )
+            )
+        if hb.get("oom_count"):
+            lines.append(
+                f"OOM dumps: {hb['oom_count']} — `ddl_tpu obs hbm`"
+            )
+
     rl = s.get("restart_latency")
     if rl:
         lines.append(

@@ -730,6 +730,13 @@ class ServeEngine:
         self.scenario = scenario
         self.pools = self.fns.init_pools()
         self.allocator = BlockAllocator(num_blocks, block_size)
+        # HBM ledger (obs/hbm.py): per-shard byte sizes, computed once
+        # on first pool-stats emission.  The pool's logical footprint
+        # divides exactly into num_blocks, so the allocator's block
+        # counts (cached/used/free partition the pool) convert to bytes
+        # without rounding.
+        self._hbm_block_bytes: int | None = None
+        self._hbm_params_bytes: int | None = None
         if self.prefix is not None:
             self.allocator.on_evict = self.prefix.forget_block
         self.scheduler = ContinuousScheduler(
@@ -858,12 +865,56 @@ class ServeEngine:
 
     def _emit_pool_stats(self, **extra) -> None:
         if self.obs is not None:
+            stats = self.allocator.stats()
             self.obs.emit(
                 "kv_pool_stats",
-                **self.allocator.stats(),
+                **stats,
                 queue_depth=len(self.admission),
                 active_lanes=len(self.scheduler.active()),
                 **extra,
+            )
+            self._emit_hbm_sample(stats)
+
+    def _emit_hbm_sample(self, stats: dict) -> None:
+        """HBM ledger: one ``hbm_sample`` per pool-stats emission, with
+        the KV pool split by what the allocator knows — ``kv_private``
+        (lane-owned, refcount >= 1), ``kv_cached`` (refcount-0 prefix
+        blocks kept for reuse), ``kv_free`` (headroom).  The three
+        partition the pool, so their sum is the pool's full footprint
+        regardless of churn."""
+        from ddl_tpu.obs import hbm
+
+        if self._hbm_block_bytes is None:
+            pool_bytes = hbm.tree_shard_bytes(self.pools) or 0
+            self._hbm_block_bytes = pool_bytes // max(1, self.fns.num_blocks)
+            self._hbm_params_bytes = hbm.tree_shard_bytes(self.params)
+        bb = self._hbm_block_bytes
+        hbm.live_sample(
+            self.obs,
+            params_bytes=self._hbm_params_bytes,
+            kv_cached_bytes=stats["cached"] * bb,
+            kv_private_bytes=stats["used"] * bb,
+            kv_free_bytes=stats["free"] * bb,
+            context="serve",
+        )
+
+    def _emit_hbm_plan(self, label: str, prog, args: tuple) -> None:
+        """Stamp one ``hbm_plan`` static budget for a serving program
+        that just compiled (the caller's compile detection already
+        fired, so emission frequency == compile frequency).  Runs under
+        the serving mesh because ``lower()`` re-traces the program —
+        DDL_HBM_PLAN=off|aval dials the cost down (obs/hbm.py)."""
+        if self.obs is None:
+            return
+        mode = os.environ.get("DDL_HBM_PLAN", "").strip().lower()
+        if mode in ("0", "off", "false"):
+            return
+        from ddl_tpu.obs import hbm
+
+        with jax.set_mesh(self.fns.mesh):
+            hbm.plan_program(
+                self.obs, label, prog, args,
+                mode="aval" if mode == "aval" else "full",
             )
 
     def _retire_finished(self) -> None:
@@ -1051,6 +1102,11 @@ class ServeEngine:
         self._compiled_buckets.add(bucket)
         if compiled:
             self.stats["prefill_compiles"] += 1
+            self._emit_hbm_plan(
+                f"serve_prefill_b{bucket}", prog,
+                (self.params, self.pools, jnp.asarray(prompt),
+                 jnp.asarray(ids), jnp.int32(req.prompt_len), rng),
+            )
         self.stats["prefill_tokens"] += req.prompt_len
         self._emit_trace_span(
             "prefill", t0, perf_counter(),
@@ -1227,6 +1283,12 @@ class ServeEngine:
         if compiled:
             self.stats["prefill_compiles"] += 1
             state.cold = True
+            self._emit_hbm_plan(
+                f"serve_chunk_c{cb}_n{nmax}_{mode}", prog,
+                (self.params, self.pools, jnp.asarray(tokens),
+                 jnp.asarray(table), jnp.int32(off), jnp.int32(c - 1),
+                 rng),
+            )
         self.stats["prefill_tokens"] += c
         self.stats["prefill_chunks"] += 1
         chunk_idx = state.prefill_chunks
@@ -1298,6 +1360,11 @@ class ServeEngine:
             self.stats["decode_compiles"] += 1
             for s in active:
                 s.cold = True
+            self._emit_hbm_plan(
+                f"serve_decode_k{k}_n{nmax}", prog,
+                (self.params, self.pools, jnp.asarray(tables),
+                 jnp.asarray(lengths), jnp.asarray(pending), self._rngs),
+            )
         self.stats["decode_steps"] += k
         self.stats["decode_dispatches"] += 1
         toks = np.asarray(toks)  # (K, B): ONE fence per chunk
@@ -1591,6 +1658,10 @@ class ServeEngine:
                     jax.block_until_ready(out[0])
                     rngs, self.pools = out[1], out[2]
                 compiled["decode"] += 1
+                self._emit_hbm_plan(
+                    f"serve_decode_k{k}_n{nmax}", prog,
+                    (self.params, self.pools, t, zeros, zeros, rngs),
+                )
         for bucket in buckets:
             if bucket in self._compiled_buckets:
                 continue
@@ -1616,6 +1687,11 @@ class ServeEngine:
             self._rngs = self._rngs.at[0].set(out[1])
             self._compiled_buckets.add(bucket)
             compiled["prefill"] += 1
+            self._emit_hbm_plan(
+                f"serve_prefill_b{bucket}", prog,
+                (self.params, self.pools, jnp.zeros((1, bucket), jnp.int32),
+                 jnp.asarray(ids), jnp.int32(1), jax.random.PRNGKey(0)),
+            )
         # chunk-prefill programs: reachable when prompts can continue a
         # cached prefix (prefix cache on) or exceed the chunk bound.
         # View widths ride the same reservation-derived grid as decode,
@@ -1671,6 +1747,13 @@ class ServeEngine:
                                 jax.block_until_ready(out[0])
                                 self.pools = out[2]
                         compiled["chunk"] += 1
+                        self._emit_hbm_plan(
+                            f"serve_chunk_c{cb}_n{nmax}_{mode}", prog,
+                            (self.params, self.pools,
+                             jnp.zeros((1, cb), jnp.int32), t,
+                             jnp.int32(0), jnp.int32(0),
+                             jax.random.PRNGKey(0)),
+                        )
             if self.prefix is not None and self._cow_prog is None:
                 # the CoW copy program: src == dst is a content no-op
                 self._cow_prog = jax.jit(pool_copy_block)

@@ -495,6 +495,10 @@ class LMTrainer(BaseTrainer):
                 inp, tgt = self._sample_batch(i)
             with _phase(self.obs, "step", step=i):
                 self.state, m = self.fns.train(self.state, inp, tgt)
+            # HBM ledger: stamp the train step's static memory budget
+            # once, after its first dispatch (obs/hbm.py hbm_plan)
+            self.emit_hbm_plan("train_step", self.fns.train,
+                               self.state, inp, tgt)
             steps += 1
             faultinject.check_step(i, guard)
             if guard is not None and guard.requested:

@@ -171,6 +171,65 @@ class BaseTrainer:
         self._opt_hbm_cache = total
         return total
 
+    # Param-shard bytes, measured once like the optimizer gauge; the
+    # second tracked category of the HBM ledger (obs/hbm.py).
+    _param_hbm_cache = None
+    # program labels this trainer has already stamped an hbm_plan for
+    _hbm_planned = None
+
+    def param_hbm_bytes(self) -> int | None:
+        """Per-device bytes of this run's live parameters (actual shard
+        shapes, so ZeRO-3/TP sharding is reflected); None when no
+        parameter tree is held."""
+        if self._param_hbm_cache is not None:
+            return self._param_hbm_cache
+        from ddl_tpu.obs.hbm import tree_shard_bytes
+
+        params = getattr(getattr(self, "state", None), "params", None)
+        self._param_hbm_cache = tree_shard_bytes(params)
+        return self._param_hbm_cache
+
+    def emit_hbm_plan(self, label: str, fn, *args, **kwargs) -> None:
+        """Stamp one ``hbm_plan`` static budget for a compiled program,
+        once per label per trainer.  Families call it right AFTER the
+        program's first dispatch (the run's own compile has happened;
+        the plan's AOT lower->compile then rides the XLA compile caches
+        instead of racing the first step).  Costs one extra backend
+        compile per program when the persistent cache is cold —
+        ``DDL_HBM_PLAN=off`` disables, ``=aval`` keeps the cheap
+        shape-arithmetic budget without the executable analysis."""
+        if self.obs is None:
+            return
+        if self._hbm_planned is None:
+            self._hbm_planned = set()
+        if label in self._hbm_planned:
+            return
+        self._hbm_planned.add(label)
+        mode = os.environ.get("DDL_HBM_PLAN", "").lower()
+        if mode in ("0", "off", "false"):
+            return
+        from ddl_tpu.obs import hbm
+
+        hbm.plan_program(
+            self.obs.writer, label, fn, args, kwargs,
+            mode="aval" if mode == "aval" else "full",
+        )
+
+    def _emit_hbm_sample(self, step=None, context=None) -> None:
+        """One ``hbm_sample`` live breakdown: tracked params/optimizer
+        bytes against the device watermark (obs/hbm.live_sample)."""
+        if self.obs is None:
+            return
+        from ddl_tpu.obs import hbm
+
+        hbm.live_sample(
+            self.obs.writer,
+            params_bytes=self.param_hbm_bytes(),
+            opt_bytes=self.opt_state_hbm_bytes(),
+            step=step,
+            context=context,
+        )
+
     def snapshot_due(self, period: int) -> bool:
         """Fixed-cadence snapshots, independent of the best-metric gate."""
         return False
@@ -319,6 +378,9 @@ class BaseTrainer:
             period=int(period),
             offset=int(offset),
         )
+        # the restored state is the startup-resident memory: account it
+        # before the first period's sample (the ledger's restore column)
+        self._emit_hbm_sample(context="restore")
 
     def _emit_pipe_schedule(
         self, schedule: str, pipe: int, microbatches: int, virtual: int = 1
@@ -434,6 +496,21 @@ class BaseTrainer:
                 obs.watchdog = watchdog
         try:
             self._run_periods(max_periods, guard, obs)
+        except Exception as exc:
+            # allocation failure: dump the forensic memory snapshot
+            # (resident buffers + the plans that predicted them) into
+            # the event stream before the process dies — the memory
+            # analogue of the watchdog's stack dump
+            if obs is not None:
+                from ddl_tpu.obs import hbm
+
+                if hbm.is_oom_error(exc):
+                    hbm.dump_oom(
+                        obs.writer, exc,
+                        params_bytes=self.param_hbm_bytes(),
+                        opt_bytes=self.opt_state_hbm_bytes(),
+                    )
+            raise
         finally:
             if watchdog is not None:
                 watchdog.stop()
@@ -580,6 +657,9 @@ class BaseTrainer:
                     period, idx, elapsed, steps, train_metrics,
                     rates=rates, offset=offset_base,
                 )
+                # HBM ledger: one live per-category breakdown per period
+                # beside the period event's bare watermark (obs/hbm.py)
+                self._emit_hbm_sample(step=idx)
             self.periods_run = period + 1
             if preempted:
                 self.preempted = True

@@ -364,6 +364,10 @@ class Trainer(BaseTrainer):
                 self.logger.log_gradient_stats(stats, step=steps)
             with _phase(self.obs, "step", step=step_base + steps):
                 self.state, loss, pred = self.step_fns.train(self.state, gi, gl)
+            # HBM ledger: stamp the train step's static memory budget
+            # once, after its first dispatch (obs/hbm.py hbm_plan)
+            self.emit_hbm_plan("train_step", self.step_fns.train,
+                               self.state, gi, gl)
             losses.append(loss)
             preds.append(pred)
             targets.append(gl)

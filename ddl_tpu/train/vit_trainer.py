@@ -229,6 +229,10 @@ class ViTTrainer(BaseTrainer):
                 gi, gl = shard_batch(self.fns.mesh, images, labels)
             with _phase(self.obs, "step", step=step_base + steps):
                 self.state, m = self.fns.train(self.state, gi, gl)
+            # HBM ledger: stamp the train step's static memory budget
+            # once, after its first dispatch (obs/hbm.py hbm_plan)
+            self.emit_hbm_plan("train_step", self.fns.train,
+                               self.state, gi, gl)
             # keep the per-step loss ON DEVICE: float()-ing it here would
             # block every step on the compiled program (the host-sync
             # anti-pattern `ddl_tpu lint` flags) — fetch once per epoch,

@@ -23,6 +23,14 @@ point, with no hardware involved:
     DDL_FAULT="corrupt_ckpt@save:2"    corrupt the 2nd snapshot after commit
     DDL_FAULT="io@save:1:2"            OSError on save attempts 1 and 2
     DDL_FAULT="io@batch:5"             OSError on the 5th loader sample read
+    DDL_FAULT="leak@step:5:64"         allocate and HOLD arg MB of device
+                                       memory at step 5 (default 64MB),
+                                       never freed — the HBM-ledger
+                                       drill: the live watermark grows
+                                       with nothing tracked to explain
+                                       it, so the leak lands in the
+                                       ledger's `untracked` residual and
+                                       trips `obs diff --fail-hbm-growth`
     DDL_FAULT="rejoin@epoch:2"         the pod-sim child exits with
                                        EXIT_REJOIN once it relaunches
                                        into restart epoch >= 2 — the
@@ -76,13 +84,14 @@ __all__ = [
     "corrupt_check",
     "deactivate",
     "io_check",
+    "leaked_bytes",
     "poison_loss",
     "traced_nan_step",
 ]
 
 KINDS = (
     "preempt", "crash", "nan", "spike", "stall", "corrupt_ckpt", "io",
-    "rejoin",
+    "rejoin", "leak",
 )
 
 
@@ -222,6 +231,35 @@ def deactivate() -> None:
     _injector = None
     # re-arm the env check so a fresh DDL_FAULT is picked up next time
     _env_checked = False
+    # release injected leaks: a test that drove the leak drill must not
+    # poison subsequent tests' watermarks (a REAL leak has no deactivate)
+    _leaks.clear()
+
+
+# injected-leak registry: (buffer, nbytes) pairs held for the life of
+# the process.  The HBM ledger's live sampler (obs/hbm.live_sample)
+# adds leaked_bytes() to its synthetic watermark on backends without
+# memory stats; on a real device the held buffer grows bytes_in_use by
+# itself and this counter is just the test-visible ground truth.
+_leaks: list[tuple] = []
+
+
+def _inject_leak(mb: float | None) -> None:
+    nbytes = int((mb if mb else 64.0) * (1 << 20))
+    try:
+        import jax.numpy as jnp
+
+        buf = jnp.zeros(max(1, nbytes // 4), jnp.float32)
+    except Exception:  # ddl-lint: disable=broad-except
+        # no JAX / no device: a host bytearray stands in — the ledger
+        # books nbytes either way, which is all the drill needs
+        buf = bytearray(nbytes)
+    _leaks.append((buf, nbytes))
+
+
+def leaked_bytes() -> int:
+    """Total bytes held by fired ``leak`` specs this process."""
+    return sum(n for _, n in _leaks)
 
 
 def active() -> FaultInjector | None:
@@ -248,7 +286,8 @@ def check_step(step: int, guard=None) -> None:
     if inj is None:
         return
     for f in inj.fire(
-        "step", at=step, kinds=("preempt", "crash", "stall", "nan", "spike")
+        "step", at=step,
+        kinds=("preempt", "crash", "stall", "nan", "spike", "leak"),
     ):
         if f.kind == "preempt":
             if guard is not None:
@@ -261,6 +300,8 @@ def check_step(step: int, guard=None) -> None:
             inj.nan_pending = True
         elif f.kind == "spike":
             inj.spike_scale = f.arg if f.arg else 1e3
+        elif f.kind == "leak":
+            _inject_leak(f.arg)
 
 
 def check_epoch(epoch: int) -> bool:

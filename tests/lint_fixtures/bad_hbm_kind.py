@@ -1,0 +1,24 @@
+"""Known-bad fixture: the HBM-ledger event kinds.  The REGISTERED
+kinds (``hbm_plan``/``hbm_sample``/``hbm_oom_dump``, obs/events.py)
+must pass the obs-event rule; an unregistered memory-ish kind must
+still fail — the regression this fixture pins is a future memory
+emitter inventing a kind without registering it, which would silently
+drop that category from every ``obs hbm`` account (an exhaustive
+ledger with an invisible consumer is not exhaustive).  Parsed by
+tests/test_analysis.py — never imported."""
+
+
+def emit_memory(writer):
+    writer.emit(
+        "hbm_plan", label="train_step", analysis="compiled",
+        argument_bytes=1000, output_bytes=1000, temp_bytes=200,
+    )  # registered: fine
+    writer.emit(
+        "hbm_sample", watermark=2000, params_bytes=600, opt_bytes=1200,
+    )  # registered: fine
+    writer.emit(
+        "hbm_oom_dump", error="oom", watermark=4000, buffers=[],
+    )  # registered: fine
+    writer.emit(
+        "hbm_leak_report", leaked=4096,
+    )  # obs-event-unregistered

@@ -74,6 +74,19 @@ def test_trace_kind_fixture_registered_vs_not():
     assert "trace_hop" in fs[0].message
 
 
+def test_hbm_kind_fixture_registered_vs_not():
+    """The HBM-ledger kinds are registered; an unregistered memory-ish
+    kind still fails the obs-event rule (LINT_BASELINE.json stays
+    empty, so a new memory emitter that skips the registry fails the
+    gate on the spot instead of silently vanishing from the ``obs
+    hbm`` account)."""
+    fs = _lint_fixture("bad_hbm_kind.py")
+    rules = _rules(fs)
+    assert rules.count("obs-event-unregistered") == 1
+    assert len(fs) == 1
+    assert "hbm_leak_report" in fs[0].message
+
+
 def test_tenant_tagged_kind_still_needs_registry():
     """A ``tenant``/``priority_class`` tag rides the registered serving
     kinds as optional fields — it does not exempt an UNREGISTERED kind

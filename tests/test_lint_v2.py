@@ -492,6 +492,30 @@ def test_fix_donation_missing_inserts_donate_argnums(tmp_path):
     assert p.read_text() == fixed
 
 
+def test_fix_donation_missing_respects_existing_donation(tmp_path):
+    """A jit(train...) already carrying donate_argnums (positional
+    tuple or keyword) is not a finding and survives --fix untouched —
+    the autofix must never double-insert or rewrite a working
+    donation."""
+    p = tmp_path / "train" / "steps.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(
+        "import jax\n\n"
+        "train_step = jax.jit(_train_step, donate_argnums=(0,))\n"
+        "other_train = jax.jit(\n"
+        "    _other_train_step,\n"
+        "    static_argnames=('cfg',),\n"
+        "    donate_argnums=(0, 1),\n"
+        ")\n"
+    )
+    before = p.read_text()
+    findings = lint_file(p, tmp_path, REGISTRY)
+    assert "donation-missing" not in _rules(findings)
+    plan = plan_fixes(findings, tmp_path, tmp_path)
+    plan.apply()
+    assert p.read_text() == before
+
+
 def test_fix_is_deterministic(tmp_path, capsys):
     from ddl_tpu.analysis.cli import main
 

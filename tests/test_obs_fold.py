@@ -93,6 +93,34 @@ def _rich_events(host, *, offset=0.0, periods=4, step_s=0.10):
         block_size=8, free=60, used=4, high_water=8, fragmentation=0.0,
         queue_depth=0, active_lanes=1,
     ))
+    # HBM ledger kinds (v10): a static plan, two samples (the second is
+    # the peak — the paired max cell must carry ITS categories), and on
+    # host 1 an OOM forensic dump; the sidecar-history equivalence
+    # tests below exercise the hbm reducer through every fold path
+    evs.append(_ev(
+        host, "hbm_plan", 56.2 + offset, label="train_step",
+        analysis="compiled", argument_bytes=1000, output_bytes=1000,
+        temp_bytes=200, alias_bytes=900, code_bytes=50,
+    ))
+    evs.append(_ev(
+        host, "hbm_sample", 56.4 + offset, params_bytes=600,
+        opt_bytes=1200, watermark=2000, peak=2000, limit=4096,
+        synthetic=True,
+    ))
+    evs.append(_ev(
+        host, "hbm_sample", 56.6 + offset, params_bytes=600,
+        opt_bytes=1200, kv_cached_bytes=64, kv_private_bytes=32,
+        kv_free_bytes=128, watermark=2200 + host, peak=2300 + host,
+        limit=4096, synthetic=True,
+    ))
+    if host == 1:
+        evs.append(_ev(
+            host, "hbm_oom_dump", 56.8 + offset, step=9,
+            error="RESOURCE_EXHAUSTED: out of memory", watermark=4000,
+            limit=4096,
+            buffers=[{"shape": [64, 64], "dtype": "float32",
+                      "count": 2, "bytes": 32768}],
+        ))
     if host == 0:
         evs.append(_ev(
             host, "anomaly", 60.0 + offset, step=2, type="loss_spike",

@@ -1,0 +1,374 @@
+"""HBM ledger (obs/hbm.py): the exhaustive per-device memory account.
+
+The load-bearing properties pinned here:
+
+- **sums-to-total**: per-(host, repoch) category bytes sum EXACTLY to
+  the sampled watermark — ``untracked`` is the reported residual,
+  never dropped (negative when tracking over-counts: still honest).
+- **paired max cell**: the account's categories are the ones observed
+  AT the peak-watermark sample, not independent per-category maxima.
+- **plan vs live**: ``plan_program`` stamps a static budget (aval
+  arithmetic always; the compiled executable's own memory analysis in
+  full mode) that the reducer retains per label.
+- **OOM forensics**: ``dump_oom`` writes a final snapshot the account
+  renders after the process dies.
+- **the leak gate**: an injected leak (``DDL_FAULT=leak@step``) grows
+  the synthetic watermark on CPU, and ``obs diff --fail-hbm-growth``
+  exits nonzero against a clean baseline — the CI wiring for "this PR
+  leaks device memory".
+"""
+
+import json
+
+import pytest
+
+
+def _ev(host, kind, ts, **kw):
+    e = {
+        "ts": ts, "mono": ts, "run": f"r{host}", "host": host,
+        "step": kw.pop("step", None), "kind": kind,
+    }
+    e.update(kw)
+    return e
+
+
+def _append(log_dir, job, host, lines):
+    d = log_dir / "by_job_id" / job
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / f"events-h{host:03d}.jsonl", "a") as f:
+        for ln in lines:
+            f.write(ln + "\n")
+
+
+def _fold(log_dir, job):
+    from ddl_tpu.obs.fold import fold_job
+
+    return fold_job(log_dir, job, cache=False)
+
+
+# ---------------------------------------------------------------------------
+# the account
+# ---------------------------------------------------------------------------
+
+
+def test_account_sums_to_watermark_bucket_exact(tmp_path):
+    """Synthetic two-sample stream: the account carries the PEAK
+    sample's categories, and every category (untracked included) sums
+    byte-exactly to that sample's watermark."""
+    from ddl_tpu.obs.hbm import CATEGORIES, account_from_fold
+
+    evs = [
+        _ev(0, "run_start", 1.0),
+        # early sample: higher opt bytes, lower watermark — must NOT
+        # leak into the peak cell (paired max, not per-category max)
+        _ev(0, "hbm_sample", 2.0, params_bytes=500, opt_bytes=9999,
+            watermark=11000, peak=11000, limit=50000, synthetic=True),
+        _ev(0, "hbm_sample", 3.0, params_bytes=600, opt_bytes=1200,
+            kv_cached_bytes=64, kv_private_bytes=32, kv_free_bytes=128,
+            watermark=12345, peak=12400, limit=50000, synthetic=True),
+        _ev(0, "run_end", 4.0),
+    ]
+    _append(tmp_path, "acct", 0, [json.dumps(e) for e in evs])
+    account = account_from_fold(_fold(tmp_path, "acct"))
+    assert len(account["incarnations"]) == 1
+    inc = account["incarnations"][0]
+    assert inc["watermark"] == 12345
+    # paired max cell: the peak sample's categories, not the maxima
+    assert inc["bytes"]["optimizer"] == 1200
+    assert inc["bytes"]["params"] == 600
+    assert inc["bytes"]["kv_cached"] == 64
+    # exhaustive: every category sums exactly to the watermark
+    assert set(inc["bytes"]) == set(CATEGORIES)
+    assert sum(inc["bytes"].values()) == inc["watermark"]
+    assert inc["bytes"]["untracked"] == 12345 - (600 + 1200 + 64 + 32 + 128)
+    assert inc["headroom"] == 50000 - 12345
+    # job row over one host == that host's latest incarnation
+    assert account["job"]["watermark"] == 12345
+    assert account["job"]["peak_bytes"] == 12345
+    assert sum(account["job"]["bytes"].values()) == 12345
+
+
+def test_account_untracked_negative_is_reported(tmp_path):
+    """Tracked bytes exceeding the watermark (double-booked category or
+    allocator slack) must surface as a NEGATIVE untracked residual, not
+    be clamped away — the reconciliation is only trustworthy if it is
+    allowed to say 'the books don't balance'."""
+    from ddl_tpu.obs.hbm import account_from_fold
+
+    evs = [
+        _ev(0, "hbm_sample", 2.0, params_bytes=900, opt_bytes=300,
+            watermark=1000, peak=1000, synthetic=True),
+    ]
+    _append(tmp_path, "neg", 0, [json.dumps(e) for e in evs])
+    inc = account_from_fold(_fold(tmp_path, "neg"))["incarnations"][0]
+    assert inc["bytes"]["untracked"] == -200
+    assert sum(inc["bytes"].values()) == 1000
+
+
+def test_account_job_row_sums_latest_repoch_per_host(tmp_path):
+    """A restarted host's repoch-1 memory REPLACES its repoch-0 memory
+    on the same device — the job row sums each host's latest repoch
+    (summing both would double-book the device), while the headline
+    peak is the max watermark ever sampled anywhere."""
+    from ddl_tpu.obs.hbm import account_from_fold
+
+    evs = [
+        _ev(0, "hbm_sample", 2.0, params_bytes=700, opt_bytes=0,
+            watermark=900, peak=900, synthetic=True),
+        _ev(0, "hbm_sample", 5.0, params_bytes=500, opt_bytes=0,
+            watermark=600, peak=600, synthetic=True, repoch=1),
+    ]
+    _append(tmp_path, "repo", 0, [json.dumps(e) for e in evs])
+    account = account_from_fold(_fold(tmp_path, "repo"))
+    assert len(account["incarnations"]) == 2
+    assert account["job"]["watermark"] == 600  # latest repoch only
+    assert account["job"]["peak_bytes"] == 900  # headline: ever-max
+
+
+def test_render_hbm_shows_plans_and_oom(tmp_path):
+    """The rendered account: category table, plan table, OOM line."""
+    from ddl_tpu.obs.hbm import account_from_fold, render_hbm
+
+    evs = [
+        _ev(0, "hbm_plan", 1.5, label="train_step", analysis="compiled",
+            argument_bytes=4096, output_bytes=4096, temp_bytes=512,
+            alias_bytes=4000, code_bytes=64),
+        _ev(0, "hbm_sample", 2.0, params_bytes=600, opt_bytes=1200,
+            watermark=2000, peak=2000, limit=4096, synthetic=True),
+        _ev(0, "hbm_oom_dump", 3.0, step=7,
+            error="RESOURCE_EXHAUSTED: out of memory", watermark=4000,
+            limit=4096,
+            buffers=[{"shape": [64, 64], "dtype": "float32",
+                      "count": 2, "bytes": 32768}]),
+    ]
+    _append(tmp_path, "rend", 0, [json.dumps(e) for e in evs])
+    out = render_hbm(account_from_fold(_fold(tmp_path, "rend")), "rend")
+    assert "optimizer" in out and "untracked" in out
+    assert "train_step" in out and "static plans" in out
+    assert "OOM forensics: 1 dump(s)" in out
+    assert "float32[64x64] x2" in out
+    assert "synthetic watermark" in out  # CPU watermarks must say so
+
+
+# ---------------------------------------------------------------------------
+# emission: live_sample / plan_program / dump_oom through a real writer
+# ---------------------------------------------------------------------------
+
+
+def test_live_sample_plan_and_oom_roundtrip(tmp_path):
+    """Emit through a real EventWriter and fold the stream back: the
+    synthetic watermark equals the tracked sum (no leak active), the
+    full-mode plan carries the compiled executable's temp bytes, and
+    the OOM dump books live buffers."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.obs import hbm
+    from ddl_tpu.obs.events import EventWriter
+    from ddl_tpu.obs.hbm import account_from_fold
+
+    w = EventWriter(tmp_path, "rt", host=0)
+    e = hbm.live_sample(
+        w, params_bytes=1000, opt_bytes=2000, kv_free_bytes=500,
+    )
+    assert e["synthetic"] is True
+    assert e["watermark"] == 3500
+
+    fn = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.zeros((128, 128), jnp.float32)
+    fn(x)  # dispatch once, like the trainers (plan after first step)
+    plan = hbm.plan_program(w, "double", fn, (x,))
+    assert plan is not None
+    assert plan["analysis"] == "memory_analysis"
+    assert plan["argument_bytes"] == x.nbytes
+    assert plan["output_bytes"] == x.nbytes
+    assert plan["temp_bytes"] is not None
+
+    err = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating")
+    assert hbm.is_oom_error(err)
+    assert not hbm.is_oom_error(ValueError("shape mismatch"))
+    dump = hbm.dump_oom(w, err, step=3, params_bytes=1000, opt_bytes=2000)
+    assert dump is not None and dump["buffers"]
+    w.close()
+
+    account = account_from_fold(_fold(tmp_path, "rt"))
+    inc = account["incarnations"][0]
+    assert inc["watermark"] == 3500
+    assert sum(inc["bytes"].values()) == 3500
+    assert inc["plans"]["double"]["analysis"] == "memory_analysis"
+    assert inc["oom_count"] == 1
+    assert inc["oom"]["error"].startswith("RESOURCE_EXHAUSTED")
+
+
+def test_plan_program_aval_mode_never_compiles(tmp_path):
+    """DDL_HBM_PLAN=aval's budget: shape arithmetic only — argument and
+    output bytes filled, temp/code honestly absent."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddl_tpu.obs import hbm
+    from ddl_tpu.obs.events import EventWriter
+
+    w = EventWriter(tmp_path, "aval", host=0)
+    fn = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((64,), jnp.float32)
+    plan = hbm.plan_program(w, "inc", fn, (x,), mode="aval")
+    w.close()
+    assert plan["analysis"] == "aval"
+    assert plan["argument_bytes"] == x.nbytes
+    assert plan["output_bytes"] == x.nbytes
+    assert plan["temp_bytes"] is None
+
+
+def test_tree_shard_bytes_counts_per_shard(tmp_path):
+    """Unsharded arrays: per-shard bytes == nbytes; empty trees are
+    None (a serving engine with no params must not book a zero row)."""
+    import jax.numpy as jnp
+
+    from ddl_tpu.obs.hbm import tree_shard_bytes
+
+    tree = {"a": jnp.zeros((8, 8), jnp.float32), "b": jnp.zeros((4,))}
+    assert tree_shard_bytes(tree) == 8 * 8 * 4 + 4 * 4
+    assert tree_shard_bytes(None) is None
+    assert tree_shard_bytes({}) is None
+
+
+def test_tree_shard_bytes_reflects_sharding():
+    """The ZeRO measurement contract: a leaf sharded 8-way books 1/8 of
+    its global bytes per device — the optimizer row of a --zero run
+    must show the saving, not the global size."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from ddl_tpu.obs.hbm import tree_shard_bytes
+
+    mesh = Mesh(jax.devices()[:8], ("data",))
+    x = jax.device_put(
+        jnp.zeros((64, 16), jnp.float32),
+        NamedSharding(mesh, PartitionSpec("data", None)),
+    )
+    assert tree_shard_bytes({"m": x}) == x.nbytes // 8
+    # replicated leaf: full bytes per device
+    r = jax.device_put(
+        jnp.zeros((8, 8), jnp.float32),
+        NamedSharding(mesh, PartitionSpec(None, None)),
+    )
+    assert tree_shard_bytes({"m": x, "r": r}) == x.nbytes // 8 + r.nbytes
+
+
+# ---------------------------------------------------------------------------
+# the injected leak and the CI gate
+# ---------------------------------------------------------------------------
+
+
+def test_faultinject_leak_books_into_synthetic_watermark(tmp_path):
+    """DDL_FAULT=leak@step: the held buffer shows up in leaked_bytes()
+    and therefore in the synthetic watermark, and deactivate() releases
+    it (the test API must not leak across tests)."""
+    from ddl_tpu.obs import hbm
+    from ddl_tpu.obs.events import EventWriter
+    from ddl_tpu.utils import faultinject
+
+    faultinject.activate("leak@step:2:1")  # 1 MB at step 2
+    try:
+        assert faultinject.leaked_bytes() == 0
+        faultinject.check_step(1)
+        assert faultinject.leaked_bytes() == 0
+        faultinject.check_step(2)
+        leaked = faultinject.leaked_bytes()
+        assert leaked >= 1 << 20
+
+        w = EventWriter(tmp_path, "leak", host=0)
+        e = hbm.live_sample(w, params_bytes=100, opt_bytes=200)
+        w.close()
+        assert e["synthetic"] is True
+        assert e["watermark"] == 300 + leaked
+    finally:
+        faultinject.deactivate()
+    assert faultinject.leaked_bytes() == 0
+
+
+def test_diff_fail_hbm_growth_gate(tmp_path, capsys):
+    """The CI leak gate end-to-end: a leak-grown run against a clean
+    baseline exits nonzero under --fail-hbm-growth; a matching clean
+    run passes; a pre-ledger baseline is rejected loudly."""
+    from ddl_tpu import cli
+
+    def mk(job, extra_watermark):
+        evs = [
+            _ev(0, "run_start", 1.0),
+            _ev(0, "hbm_sample", 2.0, params_bytes=600, opt_bytes=1200,
+                watermark=1800, peak=1800, synthetic=True),
+            _ev(0, "hbm_sample", 3.0, params_bytes=600, opt_bytes=1200,
+                watermark=1800 + extra_watermark,
+                peak=1800 + extra_watermark, synthetic=True),
+            _ev(0, "run_end", 4.0),
+        ]
+        _append(tmp_path, job, 0, [json.dumps(e) for e in evs])
+
+    mk("clean", 0)
+    mk("clean2", 0)
+    mk("leaky", 4000)  # > 2x growth: an injected leak's signature
+
+    base = tmp_path / "base.json"
+    cli.main(["obs", "baseline", "clean", "--log-dir", str(tmp_path),
+              "--out", str(base)])
+    capsys.readouterr()
+
+    cli.main(["obs", "diff", "clean2", "--log-dir", str(tmp_path),
+              "--baseline", str(base), "--fail-hbm-growth", "0.5"])
+    out = capsys.readouterr().out
+    assert "OK: peak HBM within the 50% growth gate" in out
+
+    with pytest.raises(SystemExit, match="peak HBM.*above"):
+        cli.main(["obs", "diff", "leaky", "--log-dir", str(tmp_path),
+                  "--baseline", str(base), "--fail-hbm-growth", "0.5"])
+    capsys.readouterr()
+
+    # a baseline without an hbm account (pre-ledger) fails loudly
+    stored = json.loads(base.read_text())
+    del stored["summary"]["hbm"]
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(stored))
+    with pytest.raises(SystemExit, match="regenerate the baseline"):
+        cli.main(["obs", "diff", "clean2", "--log-dir", str(tmp_path),
+                  "--baseline", str(old), "--fail-hbm-growth", "0.5"])
+
+
+def test_leak_injected_training_run_trips_gate(tmp_path, capsys):
+    """The whole loop: a real (tiny) training run with DDL_FAULT=leak
+    emits hbm_samples whose synthetic watermark grows mid-run, and the
+    gate catches it against the same trainer run without the fault."""
+    from ddl_tpu import cli
+    from ddl_tpu.obs import hbm
+    from ddl_tpu.obs.events import EventWriter
+    from ddl_tpu.utils import faultinject
+
+    def run(job, fault):
+        if fault:
+            faultinject.activate(fault)
+        try:
+            w = EventWriter(tmp_path, job, host=0)
+            for step in range(4):
+                try:
+                    faultinject.check_step(step)
+                except Exception:
+                    pass
+                hbm.live_sample(
+                    w, params_bytes=1000, opt_bytes=2000, step=step,
+                )
+            w.close()
+        finally:
+            faultinject.deactivate()
+
+    run("noleak", None)
+    run("leaks", "leak@step:2:2")  # 2 MB held from step 2 on
+
+    base = tmp_path / "b.json"
+    cli.main(["obs", "baseline", "noleak", "--log-dir", str(tmp_path),
+              "--out", str(base)])
+    capsys.readouterr()
+    with pytest.raises(SystemExit, match="peak HBM"):
+        cli.main(["obs", "diff", "leaks", "--log-dir", str(tmp_path),
+                  "--baseline", str(base), "--fail-hbm-growth", "0.5"])
